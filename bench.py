@@ -1,0 +1,85 @@
+"""Benchmark entry point — prints ONE JSON line.
+
+Metric: GPT-2-124M causal-LM training throughput (samples/sec, fwd+bwd+step,
+bf16, seq 512) on the available device(s), plus achieved TFLOPS.
+
+``vs_baseline``: achieved TFLOPS per chip vs the reference's best published
+single-accelerator training number — 64 TFLOPS/GPU (BERT-large on 1x V100,
+BASELINE.md row 1). >1.0 means this framework on one TPU chip beats the
+reference's headline single-device utilization.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, make_model
+
+    seq = 512
+    micro = 8
+    cfg_model = GPT2Config(vocab_size=50304, max_seq_len=seq + 1, num_layers=12,
+                           num_heads=12, hidden_size=768)  # GPT-2 124M class
+    model, init_fn, loss_fn = make_model(cfg_model)
+    params = init_fn(jax.random.PRNGKey(0), batch_size=2, seq_len=seq)
+
+    n_dev = len(jax.devices())
+    engine, _, _, _ = dstpu.initialize(
+        loss_fn=loss_fn, params=params,
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 1 if n_dev > 1 else 0},
+            "gradient_clipping": 1.0,
+            "steps_per_print": 10_000,
+        })
+
+    B = engine.config.train_batch_size
+    rng = np.random.RandomState(0)
+    batch = {"tokens": jnp.asarray(rng.randint(0, 50304, size=(B, seq + 1)), jnp.int32)}
+
+    # warmup (compile)
+    for _ in range(3):
+        loss = engine.train_batch(batch)
+    jax.block_until_ready(loss)
+
+    steps = 20
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = steps * B / dt
+    # 6 * params * tokens for fwd+bwd (standard transformer estimate)
+    n_params = sum(int(np.prod(np.shape(p)))
+                   for p in jax.tree_util.tree_leaves(params))
+    flops_per_step = 6.0 * n_params * B * seq
+    tflops_per_chip = flops_per_step * steps / dt / 1e12 / n_dev
+
+    ref_tflops = 64.0  # BERT-large, 1x V100 (BASELINE.md)
+    print(json.dumps({
+        "metric": "gpt2_124m_train_samples_per_sec",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(tflops_per_chip / ref_tflops, 3),
+        "detail": {
+            "tflops_per_chip": round(tflops_per_chip, 1),
+            "n_devices": n_dev,
+            "seq_len": seq,
+            "micro_batch": micro,
+            "last_loss": float(loss),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,113 @@
+"""deepspeed_tpu — a TPU-native training & inference framework.
+
+Brand-new JAX/XLA/Pallas implementation of the DeepSpeed capability surface
+(reference study: SURVEY.md). The front-door API mirrors the reference
+(``deepspeed/__init__.py:69``):
+
+    import deepspeed_tpu as dstpu
+
+    engine, optimizer, dataloader, lr_scheduler = dstpu.initialize(
+        loss_fn=loss_fn,        # (params, batch, rng) -> loss | (loss, aux)
+        params=params,          # model parameter pytree
+        config=ds_config,       # JSON path / dict — ds_config-compatible keys
+    )
+    for batch in data:
+        loss = engine.train_batch(batch)
+
+Parallelism is declared, not orchestrated: one ``jax.sharding.Mesh`` with
+``data``/``model``/``pipe``/``seq``/``expert`` axes replaces the reference's
+process-group zoo, and the ZeRO stages are sharding plans the XLA SPMD
+partitioner executes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+from .config.config import Config
+from .parallel.topology import Topology, build_mesh, get_topology, set_topology
+from .runtime.engine import Engine, TrainState
+from .version import __version__
+
+__git_hash__ = None
+__git_branch__ = None
+
+
+def initialize(
+    args: Any = None,
+    loss_fn: Optional[Callable] = None,
+    params: Any = None,
+    model: Any = None,
+    optimizer: Any = None,
+    model_parameters: Any = None,
+    training_data: Any = None,
+    lr_scheduler: Any = None,
+    topology: Optional[Topology] = None,
+    tp_specs: Any = None,
+    rng: Any = None,
+    config: Any = None,
+    config_params: Any = None,
+) -> Tuple[Engine, Any, Any, Any]:
+    """Build a training engine. Returns ``(engine, optimizer, dataloader,
+    lr_scheduler)`` for signature parity with the reference ``initialize``
+    (deepspeed/__init__.py:69); optimizer/lr_scheduler are managed inside the
+    engine (they are views, not torch objects).
+
+    ``loss_fn(params, batch, rng) -> loss | (loss, aux)`` is the model: JAX is
+    functional, so the "module" the reference wraps is here a pure function of
+    its parameters. Flax users pass ``lambda p, b, r: module.apply({'params': p}, **b)``.
+    ``model`` is accepted as an alias for ``loss_fn`` (callable) for parity.
+    """
+    if loss_fn is None:
+        if callable(model):
+            loss_fn = model
+        else:
+            raise ValueError("initialize() requires loss_fn (or a callable model=)")
+    if params is None:
+        params = model_parameters
+    if params is None:
+        raise ValueError("initialize() requires params (the model parameter pytree)")
+    cfg = Config.load(config if config is not None else config_params)
+    if args is not None and config is None and config_params is None:
+        ds_cfg = getattr(args, "deepspeed_config", None)
+        if ds_cfg:
+            cfg = Config.load(ds_cfg)
+
+    engine = Engine(
+        loss_fn=loss_fn,
+        params=params,
+        config=cfg,
+        topology=topology,
+        tp_specs=tp_specs,
+        rng=rng,
+        dataloader=training_data,
+    )
+    return engine, engine.optimizer, engine.dataloader, engine.lr_schedule
+
+
+def init_inference(model=None, config=None, params=None, tp_specs=None,
+                   topology=None, **kwargs):
+    """Build an inference engine (reference deepspeed/__init__.py:291)."""
+    from .inference.engine import InferenceEngine
+    from .inference.config import InferenceConfig
+    cfg = InferenceConfig.load(config, **kwargs)
+    return InferenceEngine(model, cfg, params=params, topology=topology,
+                           tp_specs=tp_specs)
+
+
+def add_config_arguments(parser):
+    """Add --deepspeed / --deepspeed_config to an argparse parser
+    (reference deepspeed/__init__.py:268)."""
+    group = parser.add_argument_group("DeepSpeed-TPU", "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed-TPU (helper flag, always on)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the framework's JSON config file")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help=argparse_suppress())
+    return parser
+
+
+def argparse_suppress():
+    import argparse
+    return argparse.SUPPRESS

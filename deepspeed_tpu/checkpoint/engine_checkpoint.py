@@ -1,0 +1,148 @@
+"""Engine checkpoint save/load.
+
+Analogue of the reference's engine checkpointing (``runtime/engine.py:3109``
+``save_checkpoint`` / ``:2763`` ``load_checkpoint`` + the pluggable
+``CheckpointEngine`` ABC) and its *universal checkpoint* subsystem
+(``checkpoint/ds_to_universal.py``). The reference writes per-rank partition
+files and needs an offline converter to change world size; here the native
+format is **mesh-agnostic by construction**: every leaf is saved as the full
+(unsharded) array, so a checkpoint written on an 8-device mesh loads onto 4,
+32, or 1 — elastic + universal subsumed in one design (SURVEY.md §5
+"Checkpoint / resume" TPU mapping).
+
+Layout (mirrors the reference's tag/latest convention):
+
+    <save_dir>/
+      latest                      # text file holding the newest tag
+      <tag>/
+        state_000.npz … (leaf arrays, flattened tree order)
+        meta.json                 # versions, counters, tree structure, client state
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+
+FORMAT_VERSION = 1
+LATEST_FILE = "latest"
+STATE_FILE = "state.npz"
+META_FILE = "meta.json"
+
+
+def _tag_for(engine) -> str:
+    return f"global_step{engine.global_steps}"
+
+
+def save_state_tree(state: Any, ckpt_dir: str, extra_meta: Optional[Dict] = None) -> None:
+    """Save any pytree of arrays, fully gathered, with structure metadata."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    arrays = {}
+    for i, leaf in enumerate(leaves):
+        arrays[f"leaf_{i:05d}"] = np.asarray(jax.device_get(leaf))
+    np.savez(os.path.join(ckpt_dir, STATE_FILE), **arrays)
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "n_leaves": len(leaves),
+        "treedef": str(treedef),
+        "shapes": [list(np.shape(a)) for a in arrays.values()],
+        "dtypes": [str(a.dtype) for a in arrays.values()],
+    }
+    meta.update(extra_meta or {})
+    with open(os.path.join(ckpt_dir, META_FILE), "w") as f:
+        json.dump(meta, f, indent=2, default=str)
+
+
+def load_state_tree(ckpt_dir: str, target: Any) -> Tuple[Any, Dict]:
+    """Load a pytree saved by save_state_tree, using ``target``'s structure.
+    Returns (state, meta). Shape mismatches raise with the leaf index."""
+    with open(os.path.join(ckpt_dir, META_FILE)) as f:
+        meta = json.load(f)
+    data = np.load(os.path.join(ckpt_dir, STATE_FILE))
+    leaves_t, treedef = jax.tree_util.tree_flatten(target)
+    n = meta["n_leaves"]
+    if n != len(leaves_t):
+        raise ValueError(
+            f"checkpoint has {n} leaves but target state has {len(leaves_t)} — "
+            f"model/optimizer structure changed since save")
+    new_leaves = []
+    for i, tgt in enumerate(leaves_t):
+        arr = data[f"leaf_{i:05d}"]
+        if tuple(arr.shape) != tuple(np.shape(tgt)):
+            raise ValueError(
+                f"checkpoint leaf {i} shape {arr.shape} != target {np.shape(tgt)}")
+        new_leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves), meta
+
+
+def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
+                    client_state: Optional[dict] = None, save_latest: bool = True) -> str:
+    """Write a full training checkpoint. Rank 0 writes (single-controller)."""
+    tag = tag or _tag_for(engine)
+    ckpt_dir = os.path.join(save_dir, tag)
+    extra = {
+        "global_steps": engine.global_steps,
+        "global_samples": engine.global_samples,
+        "skipped_steps": engine.skipped_steps,
+        "client_state": client_state or {},
+        "config": engine.config.to_dict(),
+    }
+    if jax.process_index() == 0:
+        save_state_tree(engine.state, ckpt_dir, extra_meta=extra)
+        if save_latest:
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(tag)
+    log_dist(f"saved checkpoint {ckpt_dir}")
+    return ckpt_dir
+
+
+def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
+                    load_optimizer_states: bool = True,
+                    load_module_only: bool = False) -> Tuple[Optional[str], dict]:
+    """Restore engine state, re-placing leaves onto the engine's (possibly
+    different-shaped) mesh — elastic resume needs no conversion step.
+    Returns (ckpt_path, client_state); (None, {}) when nothing to load."""
+    if tag is None:
+        latest_path = os.path.join(load_dir, LATEST_FILE)
+        if not os.path.exists(latest_path):
+            logger.warning(f"no '{LATEST_FILE}' file in {load_dir}; nothing loaded")
+            return None, {}
+        with open(latest_path) as f:
+            tag = f.read().strip()
+    ckpt_dir = os.path.join(load_dir, tag)
+    state, meta = load_state_tree(ckpt_dir, engine.state)
+
+    if load_module_only or not load_optimizer_states:
+        state = engine.state._replace(params=state.params, step=state.step)
+
+    # re-shard onto this engine's mesh (may differ from the saving mesh)
+    engine.state = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(jax.numpy.asarray(x), s),
+        state, engine._state_shardings)
+    engine.global_steps = int(meta.get("global_steps", 0))
+    engine.global_samples = int(meta.get("global_samples", 0))
+    engine.skipped_steps = int(meta.get("skipped_steps", 0))
+    log_dist(f"loaded checkpoint {ckpt_dir} (global_step {engine.global_steps})")
+    return ckpt_dir, meta.get("client_state", {})
+
+
+def export_fp32_params(engine) -> Dict[str, np.ndarray]:
+    """Flatten params to a {path: fp32 ndarray} dict — the analogue of the
+    reference's ``zero_to_fp32.py`` offline consolidation, but online (the
+    mesh-agnostic format makes offline consolidation unnecessary)."""
+    flat = {}
+
+    def visit(path, leaf):
+        key = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        flat[key] = np.asarray(jax.device_get(leaf), dtype=np.float32)
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, engine.state.params)
+    return flat

@@ -1,0 +1,2 @@
+from .config import Config, ConfigError, MeshConfig, ZeroConfig, FP16Config, BF16Config
+from .config_utils import AUTO, ConfigModel, is_auto

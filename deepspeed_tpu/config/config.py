@@ -1,0 +1,447 @@
+"""The framework config tree.
+
+JSON-surface-compatible analogue of the reference's ``DeepSpeedConfig``
+(``runtime/config.py:706``): one JSON/dict tree → typed sub-configs, the same
+top-level key names (``train_batch_size``, ``optimizer``, ``scheduler``,
+``fp16``/``bf16``, ``zero_optimization``, ``gradient_clipping``, monitors,
+``flops_profiler`` …), the same batch-size resolution invariant
+``train_batch == micro_batch × grad_accum × dp_world``, and ``"auto"`` values
+resolved at engine-build time.
+
+TPU-specific additions live under ``mesh`` (axis sizes over ICI/DCN) — the
+declarative replacement for the reference's process-group zoo
+(``deepspeed/utils/groups.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Union
+
+from .config_utils import AUTO, ConfigModel, is_auto
+from ..utils.logging import logger
+
+
+class ConfigError(Exception):
+    pass
+
+
+# --------------------------------------------------------------------------- #
+# Precision
+# --------------------------------------------------------------------------- #
+
+@dataclass
+class FP16Config(ConfigModel):
+    """fp16 + dynamic loss scaling (reference runtime/fp16/loss_scaler.py)."""
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0          # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+
+
+@dataclass
+class BF16Config(ConfigModel):
+    enabled: bool = False
+    # immediate fp32 grad accumulation (reference bf16_optimizer immediate mode)
+    accumulate_grads_in_fp32: bool = True
+
+
+@dataclass
+class DataTypesConfig(ConfigModel):
+    grad_accum_dtype: Optional[str] = None   # "fp32" | "bf16" | "fp16"
+
+
+# --------------------------------------------------------------------------- #
+# Optimizer / scheduler
+# --------------------------------------------------------------------------- #
+
+@dataclass
+class OptimizerConfig(ConfigModel):
+    type: str = "AdamW"
+    params: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class SchedulerConfig(ConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = field(default_factory=dict)
+
+
+# --------------------------------------------------------------------------- #
+# ZeRO
+# --------------------------------------------------------------------------- #
+
+@dataclass
+class OffloadConfig(ConfigModel):
+    """offload_optimizer / offload_param sub-trees (reference zero/config.py)."""
+    device: str = "none"             # none | cpu | nvme
+    nvme_path: Optional[str] = None
+    pin_memory: bool = True
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    max_in_cpu: int = 1_000_000_000
+    ratio: float = 1.0
+
+
+@dataclass
+class ZeroConfig(ConfigModel):
+    """zero_optimization sub-tree (reference runtime/zero/config.py:335).
+
+    On TPU the stages are *sharding declarations* over the ``data`` mesh axis:
+      stage 0 — replicated params/grads/opt-state (plain DP)
+      stage 1 — optimizer state sharded
+      stage 2 — + gradients reduce-scattered into shards
+      stage 3 — + parameters sharded, gathered per-layer by XLA
+    Bucket-size / overlap knobs from the reference are accepted (the XLA
+    scheduler owns overlap; the values inform latency-hiding hints only).
+    """
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: Union[int, str] = 500_000_000
+    allgather_partitions: bool = True
+    allgather_bucket_size: Union[int, str] = 500_000_000
+    overlap_comm: Optional[bool] = None
+    offload_optimizer: OffloadConfig = field(default_factory=OffloadConfig)
+    offload_param: OffloadConfig = field(default_factory=OffloadConfig)
+    sub_group_size: int = 1_000_000_000
+    stage3_max_live_parameters: Union[int, str] = 1_000_000_000
+    stage3_max_reuse_distance: Union[int, str] = 1_000_000_000
+    stage3_prefetch_bucket_size: Union[int, str] = 50_000_000
+    stage3_param_persistence_threshold: Union[int, str] = 100_000
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    # ZeRO++ knobs
+    zero_hpz_partition_size: int = 1
+    zero_quantized_weights: bool = False
+    zero_quantized_gradients: bool = False
+    mics_shard_size: int = -1
+    mics_hierarchical_params_gather: bool = False
+    ignore_unused_parameters: bool = True
+    round_robin_gradients: bool = False
+
+    def __post_init__(self):
+        if self.stage not in (0, 1, 2, 3):
+            raise ConfigError(f"zero_optimization.stage must be 0-3, got {self.stage}")
+
+
+# --------------------------------------------------------------------------- #
+# Activation checkpointing
+# --------------------------------------------------------------------------- #
+
+@dataclass
+class ActivationCheckpointingConfig(ConfigModel):
+    """activation_checkpointing sub-tree. On TPU this drives jax.checkpoint
+    (remat) policies rather than manual tensor stashing."""
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-native: named remat policy ("nothing_saveable", "dots_saveable",
+    # "checkpoint_dots", "checkpoint_dots_no_batch_dims", …)
+    policy: Optional[str] = None
+
+
+# --------------------------------------------------------------------------- #
+# Monitors / profiling
+# --------------------------------------------------------------------------- #
+
+@dataclass
+class TensorBoardConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+
+
+@dataclass
+class WandbConfig(ConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed_tpu"
+
+
+@dataclass
+class CSVConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+
+
+@dataclass
+class CometConfig(ConfigModel):
+    enabled: bool = False
+    samples_log_interval: int = 100
+    project: Optional[str] = None
+    workspace: Optional[str] = None
+    api_key: Optional[str] = None
+    experiment_name: Optional[str] = None
+    experiment_key: Optional[str] = None
+    online: Optional[bool] = None
+    mode: Optional[str] = None
+
+
+@dataclass
+class FlopsProfilerConfig(ConfigModel):
+    enabled: bool = False
+    recompute_fwd_factor: float = 0.0
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+@dataclass
+class CommsLoggerConfig(ConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = field(default_factory=list)
+
+
+# --------------------------------------------------------------------------- #
+# Mesh / parallelism (TPU-native)
+# --------------------------------------------------------------------------- #
+
+@dataclass
+class MeshConfig(ConfigModel):
+    """Named mesh axis sizes. ``data`` of "auto" absorbs remaining devices.
+
+    This replaces the reference's process-group factory
+    (``deepspeed/utils/groups.py``): every parallel form is an axis of ONE
+    ``jax.sharding.Mesh`` laid out over ICI (with DCN as outer dims when
+    multi-slice).
+    """
+    data: Union[int, str] = AUTO
+    model: int = 1        # tensor parallel
+    pipe: int = 1         # pipeline parallel
+    seq: int = 1          # Ulysses / ring sequence parallel
+    expert: int = 1       # expert parallel (MoE)
+    # axis ordering innermost-last; ICI-heavy axes should be innermost
+    axis_order: List[str] = field(default_factory=lambda: ["pipe", "data", "expert", "seq", "model"])
+
+
+@dataclass
+class PipelineConfig(ConfigModel):
+    stages: Union[int, str] = AUTO
+    partition_method: str = "parameters"
+    activation_checkpoint_interval: int = 0
+    pipe_partitioned: bool = True
+    grad_partitioned: bool = True
+
+
+# --------------------------------------------------------------------------- #
+# Aux subsystems
+# --------------------------------------------------------------------------- #
+
+@dataclass
+class GradientCompressionConfig(ConfigModel):
+    """1-bit-class error-compensated compressed gradient allreduce."""
+    enabled: bool = False
+    bits: int = 1
+    warmup_steps: int = 100
+
+
+@dataclass
+class CurriculumLearningConfig(ConfigModel):
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class CheckpointConfig(ConfigModel):
+    tag_validation: str = "Warn"      # Ignore | Warn | Fail
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: Dict[str, Any] = field(default_factory=dict)
+    # TPU-native: async checkpointing via a background commit thread
+    async_save: bool = False
+
+
+@dataclass
+class AioConfig(ConfigModel):
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+@dataclass
+class ElasticityConfig(ConfigModel):
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.2
+    ignore_non_elastic_batch_info: bool = False
+    num_gpus_per_node: int = 1
+    model_parallel_size: int = 1
+
+
+# --------------------------------------------------------------------------- #
+# Top-level
+# --------------------------------------------------------------------------- #
+
+@dataclass
+class Config(ConfigModel):
+    """Top-level config. Key names mirror ds_config JSON."""
+
+    train_batch_size: Union[int, str, None] = None
+    train_micro_batch_size_per_gpu: Union[int, str, None] = None
+    gradient_accumulation_steps: Union[int, str, None] = None
+
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+
+    fp16: FP16Config = field(default_factory=FP16Config)
+    bf16: BF16Config = field(default_factory=BF16Config)
+    data_types: DataTypesConfig = field(default_factory=DataTypesConfig)
+
+    zero_optimization: ZeroConfig = field(default_factory=ZeroConfig)
+    gradient_clipping: float = 0.0
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    sparse_gradients: bool = False
+    communication_data_type: Optional[str] = None
+
+    activation_checkpointing: ActivationCheckpointingConfig = field(
+        default_factory=ActivationCheckpointingConfig)
+
+    steps_per_print: int = 10
+    wall_clock_breakdown: bool = False
+    memory_breakdown: bool = False
+    dump_state: bool = False
+
+    tensorboard: TensorBoardConfig = field(default_factory=TensorBoardConfig)
+    wandb: WandbConfig = field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = field(default_factory=CSVConfig)
+    comet: CometConfig = field(default_factory=CometConfig)
+    flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
+    comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
+
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    pipeline: PipelineConfig = field(default_factory=PipelineConfig)
+    gradient_compression: GradientCompressionConfig = field(
+        default_factory=GradientCompressionConfig)
+    curriculum_learning: CurriculumLearningConfig = field(
+        default_factory=CurriculumLearningConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    aio: AioConfig = field(default_factory=AioConfig)
+    elasticity: ElasticityConfig = field(default_factory=ElasticityConfig)
+
+    # misc parity keys
+    seed: int = 1234
+    disable_allgather: bool = False
+    prescale_gradients_factor: float = 1.0
+    zero_allow_untested_optimizer: bool = True
+    compile: bool = True              # jit on/off (debugging)
+
+    DEPRECATED_ALIASES = {"train_micro_batch_size": "train_micro_batch_size_per_gpu"}
+
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def load(cls, config: Union[str, Dict[str, Any], "Config", None]) -> "Config":
+        if config is None:
+            return cls()
+        if isinstance(config, Config):
+            return config
+        if isinstance(config, str):
+            with open(config) as f:
+                config = json.load(f)
+        if not isinstance(config, dict):
+            raise ConfigError(f"config must be a dict, JSON path, or Config; got {type(config)}")
+        return cls.from_dict(config)
+
+    # ------------------------------------------------------------------ #
+    # batch-size resolution: train_batch = micro * gas * dp_world
+    # (reference runtime/config.py _batch_assertion / _set_batch_related_parameters)
+    # ------------------------------------------------------------------ #
+
+    def resolve_batch_sizes(self, dp_world_size: int) -> None:
+        tb = None if is_auto(self.train_batch_size) else self.train_batch_size
+        mb = None if is_auto(self.train_micro_batch_size_per_gpu) else self.train_micro_batch_size_per_gpu
+        gas = None if is_auto(self.gradient_accumulation_steps) else self.gradient_accumulation_steps
+
+        if tb is not None and mb is not None and gas is not None:
+            if tb != mb * gas * dp_world_size:
+                raise ConfigError(
+                    f"train_batch_size ({tb}) != train_micro_batch_size_per_gpu ({mb}) * "
+                    f"gradient_accumulation_steps ({gas}) * dp_world_size ({dp_world_size})")
+        elif tb is not None and mb is not None:
+            gas, rem = divmod(tb, mb * dp_world_size)
+            if rem:
+                raise ConfigError(
+                    f"train_batch_size ({tb}) not divisible by micro_batch*dp "
+                    f"({mb}*{dp_world_size})")
+        elif tb is not None and gas is not None:
+            mb, rem = divmod(tb, gas * dp_world_size)
+            if rem:
+                raise ConfigError(
+                    f"train_batch_size ({tb}) not divisible by gas*dp ({gas}*{dp_world_size})")
+        elif mb is not None:
+            gas = gas or 1
+            tb = mb * gas * dp_world_size
+        elif tb is not None:
+            gas = 1
+            mb, rem = divmod(tb, dp_world_size)
+            if rem:
+                raise ConfigError(
+                    f"train_batch_size ({tb}) not divisible by dp_world_size ({dp_world_size})")
+        elif gas is not None:
+            raise ConfigError(
+                "gradient_accumulation_steps alone is not enough — also set "
+                "train_batch_size or train_micro_batch_size_per_gpu")
+        else:
+            # nothing specified: default micro batch 1
+            mb, gas = 1, 1
+            tb = dp_world_size
+            logger.warning("No batch sizes specified; defaulting micro_batch=1, gas=1")
+
+        self.train_batch_size = int(tb)
+        self.train_micro_batch_size_per_gpu = int(mb)
+        self.gradient_accumulation_steps = int(gas)
+        for name, v in (("train_batch_size", tb), ("train_micro_batch_size_per_gpu", mb),
+                        ("gradient_accumulation_steps", gas)):
+            if int(v) <= 0:
+                raise ConfigError(f"{name} must be positive, got {v}")
+
+    # convenience accessors used throughout the engine ------------------- #
+
+    @property
+    def precision_dtype(self) -> str:
+        if self.fp16.enabled and self.bf16.enabled:
+            raise ConfigError("fp16 and bf16 cannot both be enabled")
+        if self.fp16.enabled:
+            return "float16"
+        if self.bf16.enabled:
+            return "bfloat16"
+        return "float32"
+
+    @property
+    def loss_scale_static(self) -> float:
+        return self.fp16.loss_scale
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.fp16.enabled and self.fp16.loss_scale == 0.0
+
+
+def dataclass_to_json(cfg: Config) -> str:
+    return json.dumps(cfg.to_dict(), indent=2, default=str)

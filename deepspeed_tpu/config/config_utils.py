@@ -1,0 +1,90 @@
+"""Typed config base machinery.
+
+Analogue of the reference's ``runtime/config_utils.py`` (`DeepSpeedConfigModel`):
+every sub-config is a dataclass built from a (possibly partial) JSON dict, with
+support for the literal string ``"auto"`` meaning "resolve me later", unknown-key
+warnings, and deprecated-field aliasing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Type, TypeVar
+
+from ..utils.logging import logger
+
+AUTO = "auto"
+
+T = TypeVar("T", bound="ConfigModel")
+
+
+def is_auto(value: Any) -> bool:
+    return isinstance(value, str) and value.lower() == AUTO
+
+
+@dataclasses.dataclass
+class ConfigModel:
+    """Base for all sub-configs. Subclasses are plain dataclasses; fields whose
+    declared default is a ConfigModel subclass are recursively constructed from
+    nested dicts."""
+
+    #: map of old_key -> new_key accepted with a deprecation warning
+    _deprecated_aliases: Dict[str, str] = dataclasses.field(
+        default_factory=dict, repr=False, compare=False
+    )
+
+    @classmethod
+    def from_dict(cls: Type[T], data: Optional[Dict[str, Any]] = None, path: str = "") -> T:
+        data = dict(data or {})
+        field_map = {f.name: f for f in dataclasses.fields(cls) if not f.name.startswith("_")}
+        # resolve deprecated aliases declared on the class
+        aliases = getattr(cls, "DEPRECATED_ALIASES", {})
+        for old, new in aliases.items():
+            if old in data:
+                logger.warning(f"Config key '{path}{old}' is deprecated; use '{new}'")
+                data.setdefault(new, data.pop(old))
+        kwargs = {}
+        for key, value in data.items():
+            if key not in field_map:
+                logger.warning(f"Unknown config key '{path}{key}' — ignored")
+                continue
+            f = field_map[key]
+            sub_cls = _nested_config_class(f)
+            if sub_cls is not None and isinstance(value, dict):
+                kwargs[key] = sub_cls.from_dict(value, path=f"{path}{key}.")
+            elif sub_cls is not None and isinstance(value, bool):
+                # shorthand: "bf16": true  ==  "bf16": {"enabled": true}
+                kwargs[key] = sub_cls.from_dict({"enabled": value}, path=f"{path}{key}.")
+            else:
+                kwargs[key] = value
+        return cls(**kwargs)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {}
+        for f in dataclasses.fields(self):
+            if f.name.startswith("_"):
+                continue
+            v = getattr(self, f.name)
+            out[f.name] = v.to_dict() if isinstance(v, ConfigModel) else v
+        return out
+
+    def resolve_auto(self, **resolved: Any) -> None:
+        """Replace any field still set to "auto" with the supplied value."""
+        for name, value in resolved.items():
+            if hasattr(self, name) and is_auto(getattr(self, name)):
+                setattr(self, name, value)
+
+
+def _nested_config_class(f: dataclasses.Field) -> Optional[Type[ConfigModel]]:
+    """If the field's default_factory builds a ConfigModel, return that class."""
+    if f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+        factory = f.default_factory  # type: ignore[misc]
+        if isinstance(factory, type) and issubclass(factory, ConfigModel):
+            return factory
+    if isinstance(f.default, ConfigModel):
+        return type(f.default)
+    return None
+
+
+def get_scalar_param(d: Dict[str, Any], key: str, default: Any) -> Any:
+    return d.get(key, default)

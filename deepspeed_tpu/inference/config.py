@@ -1,0 +1,72 @@
+"""Inference config.
+
+JSON-surface analogue of the reference's ``DeepSpeedInferenceConfig``
+(``deepspeed/inference/config.py``, 311 LoC): same key names where they make
+sense on TPU (``dtype``, ``tensor_parallel.tp_size``, ``max_out_tokens``,
+``replace_with_kernel_inject`` → here "use the fused TPU decode path",
+``enable_cuda_graph`` → jit, which is always on).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Union
+
+from ..config.config_utils import ConfigModel
+
+
+@dataclass
+class TensorParallelConfig(ConfigModel):
+    tp_size: int = 1
+    tp_grain_size: int = 1
+
+
+@dataclass
+class QuantConfig(ConfigModel):
+    enabled: bool = False
+    num_bits: int = 8
+    group_size: int = 64
+
+
+@dataclass
+class InferenceConfig(ConfigModel):
+    dtype: str = "bfloat16"           # reference default fp16; bf16 on TPU
+    tensor_parallel: TensorParallelConfig = field(default_factory=TensorParallelConfig)
+    max_out_tokens: int = 1024
+    min_out_tokens: int = 1
+    max_tokens: int = 1024
+    replace_with_kernel_inject: bool = True   # fused decode path on/off
+    enable_cuda_graph: bool = False           # accepted, jit covers it
+    checkpoint: Optional[str] = None
+    quant: QuantConfig = field(default_factory=QuantConfig)
+    replace_method: str = "auto"
+    injection_policy: Optional[Dict[str, Any]] = None
+    greedy: bool = True
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+
+    @classmethod
+    def load(cls, config: Union[str, Dict, "InferenceConfig", None] = None,
+             **kwargs) -> "InferenceConfig":
+        if isinstance(config, InferenceConfig):
+            if not kwargs:
+                return config
+            data = config.to_dict()     # kwargs still override a built config
+        else:
+            if isinstance(config, str):
+                with open(config) as f:
+                    config = json.load(f)
+            data = dict(config or {})
+        # kwarg parity: init_inference(..., dtype=..., tensor_parallel={...})
+        data.update(kwargs)
+        if "tp_size" in data:
+            tp = data.get("tensor_parallel")
+            if isinstance(tp, TensorParallelConfig):
+                tp = tp.to_dict()
+            tp = dict(tp or {})
+            tp["tp_size"] = data.pop("tp_size")
+            data["tensor_parallel"] = tp
+        return cls.from_dict(data)

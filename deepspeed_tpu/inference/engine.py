@@ -1,0 +1,120 @@
+"""Inference engine (v1-parity entry point).
+
+Analogue of the reference's ``InferenceEngine`` (``inference/engine.py:41``):
+wraps a model for serving — TP sharding, dtype conversion, compiled forward,
+and a ``generate`` loop. The reference's CUDA-graph capture/replay
+(``:519``) is subsumed by jit; kernel injection maps to the fused TPU decode
+path (KV-cache decode lives in ``deepspeed_tpu/inference/v2`` as the
+FastGen-class engine; this class is the simple wrap-a-model surface).
+
+Model contract: ``apply_fn(params, tokens) -> logits`` (``[B, T] -> [B, T, V]``),
+plus the params pytree. Flax users: ``lambda p, t: module.apply({'params': p}, t)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config.config import MeshConfig
+from ..parallel.topology import Topology, build_mesh
+from ..utils.dtypes import cast_floating, resolve_dtype
+from ..utils.logging import log_dist
+from .config import InferenceConfig
+
+
+class InferenceEngine:
+    def __init__(self, model: Any, config: Optional[InferenceConfig] = None,
+                 params: Any = None, topology: Optional[Topology] = None,
+                 tp_specs: Any = None):
+        self.config = config or InferenceConfig()
+        apply_fn, model_params = _unpack_model(model, params)
+        self.apply_fn = apply_fn
+
+        tp = self.config.tensor_parallel.tp_size
+        self.topology = topology or build_mesh(MeshConfig(model=tp))
+        model_params = cast_floating(model_params, resolve_dtype(self.config.dtype))
+
+        # TP placement: rule-engine specs when given, else replicated
+        if tp_specs is not None:
+            from jax.sharding import NamedSharding
+            shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.topology.mesh, s), tp_specs,
+                is_leaf=lambda x: hasattr(x, "index_sharding") or type(x).__name__ == "PartitionSpec")
+            self.params = jax.tree_util.tree_map(jax.device_put, model_params, shardings)
+        else:
+            repl = self.topology.replicated()
+            self.params = jax.tree_util.tree_map(
+                lambda p: jax.device_put(p, repl), model_params)
+
+        self._forward = jax.jit(self.apply_fn)
+        self._generate = self._build_generate()
+        log_dist(f"InferenceEngine ready: tp={tp}, dtype={self.config.dtype}")
+
+    # ------------------------------------------------------------------ #
+
+    def forward(self, tokens: jnp.ndarray) -> jnp.ndarray:
+        return self._forward(self.params, tokens)
+
+    __call__ = forward
+
+    def _build_generate(self):
+        apply_fn = self.apply_fn
+        greedy = self.config.greedy
+        temperature = self.config.temperature
+
+        def sample(logits, rng):
+            if greedy:
+                return jnp.argmax(logits, axis=-1)
+            return jax.random.categorical(rng, logits / temperature, axis=-1)
+
+        def generate(params, tokens, prompt_len, max_new_tokens: int, rng):
+            """Fixed-shape scan: tokens is a [B, T_max] buffer, prompt_len the
+            filled prefix length. Full-context forward per step (the KV-cache
+            decode path is the v2 engine's job)."""
+            B, T_max = tokens.shape
+
+            def body(carry, i):
+                buf, r = carry
+                logits = apply_fn(params, buf)                    # [B, T, V]
+                pos = prompt_len + i - 1
+                step_logits = jax.lax.dynamic_slice_in_dim(
+                    logits, pos, 1, axis=1)[:, 0, :]
+                r, sub = jax.random.split(r)
+                nxt = sample(step_logits, sub)
+                buf = jax.lax.dynamic_update_slice_in_dim(
+                    buf, nxt[:, None].astype(buf.dtype), pos + 1, axis=1)
+                return (buf, r), nxt
+
+            (buf, _), _ = jax.lax.scan(body, (tokens, rng),
+                                       jnp.arange(max_new_tokens))
+            return buf
+
+        return jax.jit(generate, static_argnums=(3,))
+
+    def generate(self, tokens: jnp.ndarray, max_new_tokens: int = 32,
+                 rng: Optional[jax.Array] = None) -> jnp.ndarray:
+        """Append up to ``max_new_tokens`` greedy/sampled tokens.
+        ``tokens``: [B, prompt_len] int32. Returns [B, prompt_len + max_new_tokens]."""
+        if rng is None:
+            rng = jax.random.PRNGKey(self.config.seed)
+        B, prompt_len = tokens.shape
+        buf = jnp.zeros((B, prompt_len + max_new_tokens), tokens.dtype)
+        buf = buf.at[:, :prompt_len].set(tokens)
+        return self._generate(self.params, buf, prompt_len, max_new_tokens, rng)
+
+
+def _unpack_model(model: Any, params: Any) -> Tuple[Callable, Any]:
+    if isinstance(model, tuple) and len(model) == 2:
+        return model[0], model[1]
+    if isinstance(model, dict) and "apply_fn" in model:
+        return model["apply_fn"], model.get("params", params)
+    if callable(model) and params is not None:
+        return model, params
+    if hasattr(model, "apply_fn") and hasattr(model, "params"):
+        return model.apply_fn, model.params
+    raise ValueError(
+        "init_inference expects (apply_fn, params), {'apply_fn':..., 'params':...}, "
+        "or a callable model= plus params=")

@@ -1,0 +1,152 @@
+"""GPT-2-style causal transformer (flax.linen).
+
+The in-repo flagship model for tests and benchmarks — the analogue of the
+reference's toy/test models (``tests/unit/simple_model.py``) and the GPT-2
+configurations used for its ZeRO headline numbers (BASELINE.md: GPT-2-1.3B
+ZeRO-3 bf16 is the north-star metric).
+
+TPU-first choices: bf16 compute with fp32 params; all matmuls shaped for the
+MXU (head_dim multiples of 128 at real sizes); optional ``jax.checkpoint``
+remat per block; param names stable so tensor-parallel rules
+(``deepspeed_tpu/parallel/tp_rules.py``) can target qkv/mlp projections.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    hidden_size: int = 768
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16          # compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    use_bias: bool = True
+
+    @staticmethod
+    def tiny(**kw):
+        return GPT2Config(vocab_size=512, max_seq_len=128, num_layers=2,
+                          num_heads=4, hidden_size=64, **kw)
+
+    @staticmethod
+    def small(**kw):   # GPT-2 124M
+        return GPT2Config(**kw)
+
+    @staticmethod
+    def xl_1p3b(**kw):  # GPT-2 1.3B class (the BASELINE.md metric model)
+        return GPT2Config(num_layers=24, num_heads=32, hidden_size=2048,
+                          max_seq_len=2048, **kw)
+
+
+class CausalSelfAttention(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.cfg
+        B, T, C = x.shape
+        H, D = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        qkv = nn.Dense(3 * C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       use_bias=cfg.use_bias, name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, H, D)
+        v = v.reshape(B, T, H, D)
+        # jax.nn.dot_product_attention lowers to a fused attention on TPU
+        y = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+        y = y.reshape(B, T, C)
+        y = nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     use_bias=cfg.use_bias, name="c_proj")(y)
+        if cfg.dropout > 0:
+            y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return y
+
+
+class MLP(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.cfg
+        h = nn.Dense(cfg.mlp_ratio * cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, use_bias=cfg.use_bias,
+                     name="c_fc")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, use_bias=cfg.use_bias,
+                     name="c_proj")(h)
+        if cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return h
+
+
+class Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.cfg
+        x = x + CausalSelfAttention(cfg, name="attn")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x), deterministic)
+        x = x + MLP(cfg, name="mlp")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x), deterministic)
+        return x
+
+
+class GPT2(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, tokens, deterministic: bool = True):
+        cfg = self.cfg
+        B, T = tokens.shape
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                       dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="wte")
+        wpe = nn.Embed(cfg.max_seq_len, cfg.hidden_size,
+                       dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="wpe")
+        x = wte(tokens) + wpe(jnp.arange(T)[None, :])
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, static_argnums=(2,))
+        for i in range(cfg.num_layers):
+            x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        # tied embedding unembed (GPT-2 ties wte)
+        logits = wte.attend(x.astype(jnp.float32))
+        return logits
+
+
+def make_model(cfg: GPT2Config):
+    """Returns (init_fn, loss_fn) — loss_fn matches the engine signature
+    ``(params, batch, rng) -> loss`` where batch = {"tokens": [B, T+1] int32}
+    (next-token LM loss)."""
+    model = GPT2(cfg)
+
+    def init_fn(rng, batch_size: int = 2, seq_len: Optional[int] = None):
+        T = seq_len or min(cfg.max_seq_len, 64)
+        tokens = jnp.zeros((batch_size, T), jnp.int32)
+        return model.init(rng, tokens)["params"]
+
+    def loss_fn(params, batch, rng):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = model.apply({"params": params}, inputs,
+                             deterministic=cfg.dropout == 0,
+                             rngs={"dropout": rng} if cfg.dropout > 0 else None)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return nll.mean()
+
+    return model, init_fn, loss_fn

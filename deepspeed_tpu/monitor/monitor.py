@@ -1,0 +1,124 @@
+"""Experiment monitors.
+
+Analogue of the reference's ``deepspeed/monitor/`` (`MonitorMaster`
+``monitor/monitor.py:30`` fanning out to TensorBoard/W&B/CSV/Comet writers).
+Same event shape: ``write_events([(tag, value, step), ...])``, rank-0 only.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+from ..config.config import Config
+from ..utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, cfg):
+        self.enabled = False
+        try:
+            from torch.utils.tensorboard import SummaryWriter  # torch cpu is baked in
+            path = os.path.join(cfg.output_path or "runs", cfg.job_name)
+            self.summary_writer = SummaryWriter(log_dir=path)
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"TensorBoard monitor unavailable: {e}")
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for tag, value, step in events:
+            self.summary_writer.add_scalar(tag, value, step)
+        self.summary_writer.flush()
+
+
+class CSVMonitor(Monitor):
+    def __init__(self, cfg):
+        self.output_path = os.path.join(cfg.output_path or "csv_logs", cfg.job_name)
+        os.makedirs(self.output_path, exist_ok=True)
+        self._files = {}
+        self.enabled = True
+
+    def write_events(self, events: List[Event]) -> None:
+        for tag, value, step in events:
+            fname = os.path.join(self.output_path, tag.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", tag])
+                w.writerow([step, value])
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, cfg):
+        self.enabled = False
+        try:
+            import wandb
+            wandb.init(project=cfg.project, group=cfg.group, entity=cfg.team)
+            self._wandb = wandb
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"wandb monitor unavailable: {e}")
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for tag, value, step in events:
+            self._wandb.log({tag: value}, step=step)
+
+
+class CometMonitor(Monitor):
+    def __init__(self, cfg):
+        self.enabled = False
+        try:
+            import comet_ml
+            self.experiment = comet_ml.Experiment(
+                api_key=cfg.api_key, project_name=cfg.project, workspace=cfg.workspace)
+            if cfg.experiment_name:
+                self.experiment.set_name(cfg.experiment_name)
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"comet monitor unavailable: {e}")
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for tag, value, step in events:
+            self.experiment.log_metric(tag, value, step=step)
+
+
+class MonitorMaster(Monitor):
+    """Multiplexes events to every enabled writer (reference monitor.py:30)."""
+
+    def __init__(self, config: Config):
+        self.writers: List[Monitor] = []
+        import jax
+        if jax.process_index() != 0:
+            self.enabled = False
+            return
+        if config.tensorboard.enabled:
+            self.writers.append(TensorBoardMonitor(config.tensorboard))
+        if config.csv_monitor.enabled:
+            self.writers.append(CSVMonitor(config.csv_monitor))
+        if config.wandb.enabled:
+            self.writers.append(WandbMonitor(config.wandb))
+        if config.comet.enabled:
+            self.writers.append(CometMonitor(config.comet))
+        self.enabled = any(w.enabled for w in self.writers)
+
+    def write_events(self, events: List[Event]) -> None:
+        for w in self.writers:
+            if w.enabled:
+                w.write_events(events)

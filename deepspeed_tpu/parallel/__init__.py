@@ -1,0 +1,4 @@
+from .topology import (Topology, build_mesh, get_topology, set_topology, has_topology,
+                       get_data_parallel_world_size, get_model_parallel_world_size,
+                       get_sequence_parallel_world_size, get_expert_parallel_world_size,
+                       get_pipe_parallel_world_size)

@@ -1,0 +1,193 @@
+"""Mesh construction and axis registry.
+
+TPU-native replacement for the reference's process-group factory
+(``deepspeed/utils/groups.py``, ~40 ``_get_*`` accessors over NCCL groups) and
+pipeline grid (``runtime/pipe/topology.py``): here every form of parallelism is
+a *named axis of one* ``jax.sharding.Mesh``:
+
+    data    — data parallel (and the ZeRO sharding axis)
+    model   — tensor parallel
+    pipe    — pipeline stages
+    seq     — Ulysses / ring sequence parallel
+    expert  — expert parallel (MoE)
+
+Collectives ride ICI when the communicating axis is innermost on the physical
+topology; ``MeshConfig.axis_order`` controls that layout (model/seq innermost
+by default — they carry per-layer collectives; pipe outermost — it only does
+neighbor ppermute).
+
+Multi-host: JAX SPMD means one process per host and a global mesh over all
+devices; ``build_mesh`` uses ``jax.devices()`` (global), matching how the
+reference's launcher-assigned ranks compose into the world group.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config.config import ConfigError, MeshConfig
+from ..config.config_utils import is_auto
+from ..utils.logging import log_dist
+
+AXIS_NAMES = ("pipe", "data", "expert", "seq", "model")
+
+#: canonical name of the batch-sharded mesh axes (ZeRO shards over these)
+DATA_AXES = ("data",)
+
+
+@dataclasses.dataclass
+class Topology:
+    """A built mesh plus axis metadata. The single source of truth for
+    "who is parallel over what" — the analogue of the reference's
+    ``PipelineParallelGrid`` + ``groups.py`` accessors combined."""
+
+    mesh: Mesh
+    axis_sizes: Dict[str, int]
+
+    # ------------------------- size accessors -------------------------- #
+    @property
+    def world_size(self) -> int:
+        return int(np.prod(list(self.axis_sizes.values())))
+
+    def axis_size(self, name: str) -> int:
+        return self.axis_sizes.get(name, 1)
+
+    @property
+    def dp_world_size(self) -> int:
+        return self.axis_size("data")
+
+    @property
+    def tp_world_size(self) -> int:
+        return self.axis_size("model")
+
+    @property
+    def pp_world_size(self) -> int:
+        return self.axis_size("pipe")
+
+    @property
+    def sp_world_size(self) -> int:
+        return self.axis_size("seq")
+
+    @property
+    def ep_world_size(self) -> int:
+        return self.axis_size("expert")
+
+    # ZeRO partitions over the fused seq×data group, mirroring the reference
+    # passing seq_data_parallel_group as dp_process_group (engine.py:1572)
+    @property
+    def zero_axes(self) -> Sequence[str]:
+        return tuple(a for a in ("seq", "data") if self.axis_size(a) > 1) or ("data",)
+
+    @property
+    def zero_world_size(self) -> int:
+        return self.axis_size("data") * self.axis_size("seq")
+
+    # ------------------------- sharding helpers ------------------------ #
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharding(self, extra_batch_axes: Sequence[str] = ()) -> NamedSharding:
+        """Sharding for [batch, ...] arrays: batch over data (+seq if fused)."""
+        axes = tuple(a for a in ("data", *extra_batch_axes) if self.axis_size(a) > 1)
+        if not axes:
+            return self.replicated()
+        return NamedSharding(self.mesh, P(axes))
+
+    def __repr__(self):
+        sizes = ", ".join(f"{k}={v}" for k, v in self.axis_sizes.items())
+        return f"Topology({sizes})"
+
+
+def build_mesh(
+    cfg: Optional[MeshConfig] = None,
+    devices: Optional[Sequence] = None,
+) -> Topology:
+    """Construct the device mesh from config.
+
+    ``data: "auto"`` absorbs all devices not claimed by the other axes.
+    Raises if the product of axis sizes doesn't divide the device count.
+    """
+    cfg = cfg or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+
+    sizes = {
+        "pipe": int(cfg.pipe),
+        "expert": int(cfg.expert),
+        "seq": int(cfg.seq),
+        "model": int(cfg.model),
+    }
+    fixed = int(np.prod(list(sizes.values())))
+    if is_auto(cfg.data) or cfg.data in (None, -1):
+        if n % fixed != 0:
+            raise ConfigError(
+                f"device count {n} not divisible by model*pipe*seq*expert={fixed}")
+        sizes["data"] = n // fixed
+    else:
+        sizes["data"] = int(cfg.data)
+        if fixed * sizes["data"] != n:
+            raise ConfigError(
+                f"mesh axis product {fixed * sizes['data']} != device count {n} "
+                f"(axes: data={sizes['data']}, {sizes})")
+
+    order = list(cfg.axis_order)
+    if sorted(order) != sorted(AXIS_NAMES):
+        raise ConfigError(f"mesh.axis_order must be a permutation of {AXIS_NAMES}, got {order}")
+
+    shape = [sizes[a] for a in order]
+    dev_array = np.asarray(devices).reshape(shape)
+    mesh = Mesh(dev_array, axis_names=tuple(order))
+    topo = Topology(mesh=mesh, axis_sizes={a: sizes[a] for a in order})
+    log_dist(f"Built mesh: {topo} over {n} devices", ranks=[0])
+    return topo
+
+
+# --------------------------------------------------------------------------- #
+# groups.py-compatible module-level registry
+# --------------------------------------------------------------------------- #
+
+_TOPOLOGY: Optional[Topology] = None
+
+
+def set_topology(topo: Topology) -> None:
+    global _TOPOLOGY
+    _TOPOLOGY = topo
+
+
+def get_topology() -> Topology:
+    if _TOPOLOGY is None:
+        raise RuntimeError("Topology not initialized — call initialize() or build_mesh() first")
+    return _TOPOLOGY
+
+
+def has_topology() -> bool:
+    return _TOPOLOGY is not None
+
+
+def get_data_parallel_world_size() -> int:
+    return get_topology().dp_world_size
+
+
+def get_model_parallel_world_size() -> int:
+    return get_topology().tp_world_size
+
+
+def get_sequence_parallel_world_size() -> int:
+    return get_topology().sp_world_size
+
+
+def get_expert_parallel_world_size() -> int:
+    return get_topology().ep_world_size
+
+
+def get_pipe_parallel_world_size() -> int:
+    return get_topology().pp_world_size

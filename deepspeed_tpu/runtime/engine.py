@@ -1,0 +1,426 @@
+"""The training engine.
+
+TPU-native analogue of the reference's ``DeepSpeedEngine``
+(``runtime/engine.py:182``). The reference is an eager ``nn.Module`` wrapper
+with hook-driven ZeRO and hand-managed comm streams; here the whole
+micro-step — gradient accumulation (``lax.scan`` over micro-batches), loss
+scaling, gradient clipping, optimizer update, and every ZeRO collective — is
+ONE compiled XLA program over the device mesh, with sharding declarations
+(``runtime/zero/sharding.py``) standing in for the reference's partitioning
+machinery.
+
+API parity (reference engine.py):
+  ``train_batch`` / ``eval_batch``      — pipeline-engine-style one-call step
+  ``forward`` / ``backward`` / ``step`` — the classic trio, implemented as a
+        micro-batch queue that executes the compiled step at the
+        grad-accumulation boundary
+  ``save_checkpoint`` / ``load_checkpoint``, ``get_lr``, ``get_loss_scale``,
+  ``global_steps``, ``global_samples``, config accessors.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..config.config import Config, ConfigError
+from ..ops.optimizers import build_optimizer
+from ..parallel.topology import Topology, build_mesh, set_topology
+from ..utils.logging import log_dist, logger, see_memory_usage
+from ..utils.dtypes import cast_floating, resolve_dtype
+from ..utils.timer import (
+    TRAIN_BATCH_TIMER, NoopTimer, SynchronizedWallClockTimer, ThroughputTimer,
+)
+from . import loss_scaler as ls
+from .lr_schedules import build_schedule
+from .zero.sharding import ZeroShardingPlan
+
+
+class TrainState(NamedTuple):
+    """Everything the compiled step reads+writes. A pytree, so it shards."""
+    step: jnp.ndarray          # i32 global step counter
+    params: Any                # master weights (fp32 unless configured)
+    opt_state: Any
+    scale_state: ls.LossScaleState
+    rng: jax.Array
+
+
+class StepMetrics(NamedTuple):
+    loss: jnp.ndarray
+    grad_norm: jnp.ndarray
+    lr: jnp.ndarray
+    loss_scale: jnp.ndarray
+    skipped: jnp.ndarray       # bool: overflow-skipped step (fp16)
+
+
+LossFn = Callable[..., Any]    # (params, batch, rng) -> loss | (loss, aux)
+
+
+class Engine:
+    def __init__(
+        self,
+        loss_fn: LossFn,
+        params: Any,
+        config: Config,
+        topology: Optional[Topology] = None,
+        eval_fn: Optional[Callable] = None,
+        tp_specs: Any = None,
+        rng: Optional[jax.Array] = None,
+        dataloader: Any = None,
+    ):
+        self.config = config
+        self.topology = topology or build_mesh(config.mesh)
+        set_topology(self.topology)
+        self.loss_fn = loss_fn
+        self.eval_fn = eval_fn
+        self.dataloader = dataloader
+
+        # batch divides over DP only: sequence-parallel ranks share the same
+        # samples and split the sequence dimension (Ulysses semantics)
+        config.resolve_batch_sizes(self.topology.dp_world_size)
+        self.micro_batch_size = config.train_micro_batch_size_per_gpu
+        self.gradient_accumulation_steps = config.gradient_accumulation_steps
+
+        self.compute_dtype = resolve_dtype(config.precision_dtype)
+        self._grad_accum_dtype = (
+            resolve_dtype(config.data_types.grad_accum_dtype)
+            if config.data_types.grad_accum_dtype else jnp.float32)
+
+        # LR schedule + optimizer ------------------------------------------------
+        base_lr = config.optimizer.params.get("lr", 1e-3)
+        self.lr_schedule = build_schedule(
+            config.scheduler.type, config.scheduler.params, base_lr=base_lr)
+        self.optimizer = build_optimizer(
+            config.optimizer.type, config.optimizer.params,
+            learning_rate=self.lr_schedule)
+
+        # ZeRO plan --------------------------------------------------------------
+        self.zero_plan = ZeroShardingPlan(config.zero_optimization, self.topology,
+                                          tp_specs=tp_specs)
+        log_dist(self.zero_plan.memory_summary(params))
+
+        # timers / telemetry -----------------------------------------------------
+        self.timers = SynchronizedWallClockTimer() if config.wall_clock_breakdown else NoopTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=config.train_batch_size,
+            steps_per_output=config.steps_per_print)
+        self.monitor = self._build_monitor()
+        self.flops_profiler = None
+        if config.flops_profiler.enabled:
+            from ..profiling.flops_profiler import FlopsProfiler
+            self.flops_profiler = FlopsProfiler(self, config.flops_profiler)
+
+        # state ------------------------------------------------------------------
+        rng = rng if rng is not None else jax.random.PRNGKey(config.seed)
+        self.state = self._init_state(params, rng)
+        self._state_shardings = self._compute_state_shardings(self.state)
+        self.state = self._place_state(self.state)
+
+        self._train_step = self._build_train_step()
+        self._eval_step = self._build_eval_step() if (eval_fn or loss_fn) else None
+
+        # forward/backward/step emulation queue
+        self._micro_queue = []
+        self._last_metrics: Optional[StepMetrics] = None
+        self.global_steps = 0
+        self.global_samples = 0
+        self.skipped_steps = 0
+
+    # ------------------------------------------------------------------ #
+    # construction helpers
+    # ------------------------------------------------------------------ #
+
+    def _build_monitor(self):
+        try:
+            from ..monitor.monitor import MonitorMaster
+            return MonitorMaster(self.config)
+        except Exception as e:
+            logger.warning(f"monitor disabled: {e}")
+            return None
+
+    def _init_state(self, params: Any, rng: jax.Array) -> TrainState:
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        opt_state = self.optimizer.init(params)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            scale_state=ls.init_state(self.config.fp16),
+            rng=rng,
+        )
+
+    def _compute_state_shardings(self, state: TrainState) -> TrainState:
+        repl = self.topology.replicated()
+        return TrainState(
+            step=repl,
+            params=self.zero_plan.param_shardings(state.params),
+            opt_state=self.zero_plan.opt_state_shardings(state.opt_state),
+            scale_state=jax.tree_util.tree_map(lambda _: repl, state.scale_state),
+            rng=repl,
+        )
+
+    def _place_state(self, state: TrainState) -> TrainState:
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s), state, self._state_shardings)
+
+    def _batch_sharding(self) -> NamedSharding:
+        return self.topology.batch_sharding()
+
+    # ------------------------------------------------------------------ #
+    # the compiled step
+    # ------------------------------------------------------------------ #
+
+    def _loss_and_aux(self, params, micro_batch, rng):
+        out = self.loss_fn(params, micro_batch, rng)
+        if isinstance(out, tuple):
+            return out[0], out[1:]
+        return out, ()
+
+    def _build_train_step(self):
+        cfg = self.config
+        gas = self.gradient_accumulation_steps
+        fp16 = cfg.fp16.enabled
+        clip = float(cfg.gradient_clipping or 0.0)
+        plan = self.zero_plan
+        compute_dtype = self.compute_dtype
+        accum_dtype = self._grad_accum_dtype
+        batch_sharding = self._batch_sharding()
+
+        def micro_grads(params, micro_batch, rng, scale_state):
+            cparams = cast_floating(params, compute_dtype)
+
+            def scaled_loss(cp):
+                loss, _aux = self._loss_and_aux(cp, micro_batch, rng)
+                return ls.scale_loss(loss, scale_state) if fp16 else loss, loss
+
+            grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
+            (_scaled, loss), grads = grad_fn(cparams)
+            grads = jax.tree_util.tree_map(lambda g: g.astype(accum_dtype), grads)
+            return loss, grads
+
+        def step_fn(state: TrainState, batch: Any) -> Tuple[TrainState, StepMetrics]:
+            # [B_total, ...] -> [gas, micro_global, ...]
+            def to_micro(x):
+                x = jnp.asarray(x)
+                mb = x.shape[0] // gas
+                x = x.reshape((gas, mb) + x.shape[1:])
+                return jax.lax.with_sharding_constraint(
+                    x, NamedSharding(batch_sharding.mesh,
+                                     P(None, *batch_sharding.spec)))
+            micro_batches = jax.tree_util.tree_map(to_micro, batch)
+
+            rngs = jax.random.split(state.rng, gas + 1)
+            new_rng, micro_rngs = rngs[0], rngs[1:]
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, accum_dtype), state.params)
+
+            def scan_body(carry, xs):
+                grad_acc, loss_acc = carry
+                mb, r = xs
+                loss, grads = micro_grads(state.params, mb, r, state.scale_state)
+                grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
+                if plan.stage >= 2:
+                    grad_acc = plan.constrain_grads(grad_acc, state.params)
+                return (grad_acc, loss_acc + loss), None
+
+            if gas == 1:
+                mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)
+                loss, grads = micro_grads(state.params, mb, micro_rngs[0], state.scale_state)
+                loss_sum = loss
+            else:
+                (grads, loss_sum), _ = jax.lax.scan(
+                    scan_body, (zeros, jnp.zeros((), jnp.float32)),
+                    (micro_batches, micro_rngs))
+            mean_loss = (loss_sum / gas).astype(jnp.float32)
+
+            # unscale + mean over gas
+            grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) / gas, grads)
+            if fp16:
+                grads = ls.unscale_grads(grads, state.scale_state)
+            if plan.stage >= 2:
+                grads = plan.constrain_grads(grads, state.params)
+
+            finite = ls.grads_finite(grads) if fp16 else jnp.asarray(True)
+
+            # global grad norm + clip (reference engine clip_grad_norm path)
+            leaves = jax.tree_util.tree_leaves(grads)
+            grad_norm = jnp.sqrt(sum(jnp.vdot(g, g).real for g in leaves)).astype(jnp.float32)
+            if clip > 0.0:
+                factor = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+                grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
+
+            updates, new_opt_state = self.optimizer.update(
+                grads, state.opt_state, state.params)
+            new_params = jax.tree_util.tree_map(
+                lambda p, u: p + u.astype(p.dtype), state.params, updates)
+
+            # overflow gate: keep old params/opt-state on non-finite grads
+            def select(new, old):
+                return jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(finite, n, o), new, old)
+            new_params = select(new_params, state.params)
+            new_opt_state = select(new_opt_state, state.opt_state)
+
+            new_scale = ls.update_state(state.scale_state, finite, cfg.fp16)
+            new_step = state.step + jnp.where(finite, 1, 0).astype(jnp.int32)
+
+            lr = jnp.asarray(self.lr_schedule(state.step), jnp.float32)
+            metrics = StepMetrics(
+                loss=mean_loss, grad_norm=grad_norm, lr=lr,
+                loss_scale=state.scale_state.scale,
+                skipped=jnp.logical_not(finite))
+            new_state = TrainState(step=new_step, params=new_params,
+                                   opt_state=new_opt_state,
+                                   scale_state=new_scale, rng=new_rng)
+            return new_state, metrics
+
+        if not cfg.compile:
+            return step_fn
+        return jax.jit(
+            step_fn,
+            in_shardings=(self._state_shardings, None),
+            out_shardings=(self._state_shardings, None),
+            donate_argnums=(0,),
+        )
+
+    def _build_eval_step(self):
+        fn = self.eval_fn or self.loss_fn
+        compute_dtype = self.compute_dtype
+
+        def eval_fn(state: TrainState, batch: Any, rng: jax.Array):
+            return fn(cast_floating(state.params, compute_dtype), batch, rng)
+
+        if not self.config.compile:
+            return eval_fn
+        return jax.jit(eval_fn, in_shardings=(self._state_shardings, None, None))
+
+    # ------------------------------------------------------------------ #
+    # public API
+    # ------------------------------------------------------------------ #
+
+    @property
+    def params(self):
+        return self.state.params
+
+    @property
+    def mesh(self):
+        return self.topology.mesh
+
+    def train_batch(self, batch: Any) -> jnp.ndarray:
+        """Run one full global step (micro_batch × GAS samples) and return the
+        mean loss. The one-call equivalent of forward+backward+step."""
+        self.tput_timer.start()
+        self.timers(TRAIN_BATCH_TIMER).start()
+        expected = self.config.train_batch_size
+        lead = jax.tree_util.tree_leaves(batch)[0].shape[0]
+        if lead != expected:
+            raise ConfigError(
+                f"train_batch expects leading dim == train_batch_size ({expected}), got {lead}")
+
+        if self.flops_profiler is not None:
+            self.flops_profiler.maybe_start(self.global_steps, batch)
+        self.state, metrics = self._train_step(self.state, batch)
+        self._last_metrics = metrics
+
+        self.global_steps += 1
+        self.global_samples += expected
+        self.timers(TRAIN_BATCH_TIMER).stop(barrier_value=metrics.loss)
+        self.tput_timer.stop(global_step=True, report_speed=True)
+        self._maybe_log(metrics)
+        if self.flops_profiler is not None:
+            self.flops_profiler.maybe_stop(self.global_steps, metrics)
+        return metrics.loss
+
+    def eval_batch(self, batch: Any, rng: Optional[jax.Array] = None):
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return self._eval_step(self.state, batch, rng)
+
+    # --- forward/backward/step trio (API parity) ----------------------- #
+
+    def forward(self, micro_batch: Any):
+        """Queue a micro-batch. Returns the previous step's loss estimate
+        (the compiled step computes the true loss at the GAS boundary)."""
+        self._micro_queue.append(micro_batch)
+        return self._last_metrics.loss if self._last_metrics is not None else jnp.zeros(())
+
+    def backward(self, loss=None):
+        return loss
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        return len(self._micro_queue) >= self.gradient_accumulation_steps
+
+    def step(self):
+        """Execute the compiled step once GAS micro-batches are queued."""
+        if not self.is_gradient_accumulation_boundary():
+            return None
+        batch = jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate([jnp.asarray(x) for x in xs], axis=0),
+            *self._micro_queue)
+        self._micro_queue = []
+        return self.train_batch(batch)
+
+    # --- telemetry ----------------------------------------------------- #
+
+    def _maybe_log(self, metrics: StepMetrics):
+        if self.global_steps % self.config.steps_per_print == 0:
+            loss = float(metrics.loss)
+            log_dist(
+                f"step={self.global_steps} loss={loss:.4f} "
+                f"lr={float(metrics.lr):.3e} grad_norm={float(metrics.grad_norm):.3f} "
+                f"loss_scale={float(metrics.loss_scale):.1f}")
+            if self.config.wall_clock_breakdown:
+                self.timers.log([TRAIN_BATCH_TIMER],
+                                normalizer=self.config.steps_per_print)
+        # only fp16 can overflow; the host read would otherwise force a
+        # device sync on every step and stall async dispatch
+        if self.config.fp16.enabled and bool(metrics.skipped):
+            self.skipped_steps += 1
+            log_dist(f"step={self.global_steps}: OVERFLOW — step skipped, "
+                     f"loss scale now {float(self.state.scale_state.scale)}")
+        if self.monitor is not None and self.monitor.enabled:
+            self.monitor.write_events([
+                ("Train/Samples/train_loss", float(metrics.loss), self.global_samples),
+                ("Train/Samples/lr", float(metrics.lr), self.global_samples),
+            ])
+            if self.config.fp16.enabled:
+                self.monitor.write_events([
+                    ("Train/Samples/loss_scale", float(metrics.loss_scale), self.global_samples)])
+
+    def get_lr(self):
+        return [float(self.lr_schedule(self.state.step))]
+
+    def get_loss_scale(self) -> float:
+        return float(self.state.scale_state.scale)
+
+    def get_global_grad_norm(self) -> Optional[float]:
+        return float(self._last_metrics.grad_norm) if self._last_metrics else None
+
+    def train_micro_batch_size_per_gpu(self) -> int:
+        return self.micro_batch_size
+
+    def train_batch_size_(self) -> int:
+        return self.config.train_batch_size
+
+    # --- checkpointing (delegates to checkpoint module) ---------------- #
+
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
+                        client_state: Optional[dict] = None, save_latest: bool = True):
+        from ..checkpoint.engine_checkpoint import save_checkpoint as _save
+        return _save(self, save_dir, tag=tag, client_state=client_state,
+                     save_latest=save_latest)
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
+                        load_optimizer_states: bool = True,
+                        load_lr_scheduler_states: bool = True,
+                        load_module_only: bool = False):
+        from ..checkpoint.engine_checkpoint import load_checkpoint as _load
+        return _load(self, load_dir, tag=tag,
+                     load_optimizer_states=load_optimizer_states,
+                     load_module_only=load_module_only)

@@ -1,0 +1,95 @@
+"""Static + dynamic loss scaling, jit-compatible.
+
+Analogue of the reference's ``runtime/fp16/loss_scaler.py`` (`LossScaler:67`,
+`DynamicLossScaler:91`, `CreateLossScaler:208`). The reference checks overflow
+on the host and skips the step in Python; here the scaler state is a small
+pytree carried through the compiled train step, and the skip is a
+``jnp.where`` gate — no host round-trip, no recompile.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config.config import FP16Config
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray            # f32 scalar
+    growth_tracker: jnp.ndarray   # i32: consecutive non-overflow steps
+    hysteresis: jnp.ndarray       # i32: remaining overflow tolerance
+    overflows: jnp.ndarray        # i32: total skipped steps (telemetry)
+
+
+def init_state(cfg: FP16Config) -> LossScaleState:
+    if not cfg.enabled:
+        scale = 1.0
+    elif cfg.loss_scale != 0.0:
+        scale = float(cfg.loss_scale)
+    else:
+        scale = float(2.0 ** cfg.initial_scale_power)
+    return LossScaleState(
+        scale=jnp.asarray(scale, jnp.float32),
+        growth_tracker=jnp.zeros((), jnp.int32),
+        hysteresis=jnp.asarray(cfg.hysteresis, jnp.int32),
+        overflows=jnp.zeros((), jnp.int32),
+    )
+
+
+def grads_finite(grads: Any) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.asarray(True)
+    finite = [jnp.all(jnp.isfinite(g)) for g in leaves]
+    return jnp.stack(finite).all()
+
+
+def update_state(state: LossScaleState, finite: jnp.ndarray,
+                 cfg: FP16Config) -> LossScaleState:
+    """Dynamic loss-scale update (reference DynamicLossScaler.update_scale):
+    overflow → consume hysteresis, then halve; `loss_scale_window` clean steps
+    → double. Static scale (loss_scale != 0) passes through unchanged."""
+    if not cfg.enabled:
+        return state
+    if cfg.loss_scale != 0.0:   # static
+        return state._replace(overflows=state.overflows + jnp.where(finite, 0, 1))
+
+    min_scale = jnp.asarray(cfg.min_loss_scale, jnp.float32)
+    full_hyst = jnp.asarray(cfg.hysteresis, jnp.int32)
+
+    def on_overflow(s: LossScaleState) -> LossScaleState:
+        # hysteresis > 1: consume tolerance, keep scale; at 1: halve, keep
+        # hysteresis at 1 so further consecutive overflows keep halving
+        spent = s.hysteresis <= 1
+        new_scale = jnp.where(spent, jnp.maximum(s.scale / 2.0, min_scale), s.scale)
+        new_hyst = jnp.where(spent, s.hysteresis, s.hysteresis - 1)
+        return LossScaleState(scale=new_scale, growth_tracker=jnp.zeros((), jnp.int32),
+                              hysteresis=new_hyst, overflows=s.overflows + 1)
+
+    def on_clean(s: LossScaleState) -> LossScaleState:
+        tracker = s.growth_tracker + 1
+        grow = tracker >= cfg.loss_scale_window
+        new_scale = jnp.where(grow, s.scale * 2.0, s.scale)
+        tracker = jnp.where(grow, 0, tracker)
+        # consecutive_hysteresis: any clean step restores tolerance;
+        # otherwise tolerance is only restored when the scale grows
+        if cfg.consecutive_hysteresis:
+            hyst = full_hyst
+        else:
+            hyst = jnp.where(grow, full_hyst, s.hysteresis)
+        return LossScaleState(scale=new_scale, growth_tracker=tracker,
+                              hysteresis=hyst, overflows=s.overflows)
+
+    return jax.lax.cond(finite, on_clean, on_overflow, state)
+
+
+def scale_loss(loss: jnp.ndarray, state: LossScaleState) -> jnp.ndarray:
+    return loss * state.scale.astype(loss.dtype)
+
+
+def unscale_grads(grads: Any, state: LossScaleState) -> Any:
+    inv = 1.0 / state.scale
+    return jax.tree_util.tree_map(lambda g: (g.astype(jnp.float32) * inv), grads)

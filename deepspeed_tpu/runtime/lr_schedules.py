@@ -1,0 +1,148 @@
+"""LR schedules.
+
+Functional (optax-style ``step -> lr``) implementations of the reference's
+schedule zoo (``runtime/lr_schedules.py``): LRRangeTest (:273), OneCycle
+(:371), WarmupLR (:633), WarmupDecayLR (:723), WarmupCosineLR (:774). Same
+names, same parameter keys, so a ds_config ``scheduler`` block drops in.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+Schedule = Callable[[Any], Any]   # step (int or traced int) -> lr
+
+WARMUP_LOG_RATE = "log"
+WARMUP_LINEAR_RATE = "linear"
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000, warmup_type: str = WARMUP_LOG_RATE,
+              **_unused) -> Schedule:
+    """WarmupLR: warm up then hold at warmup_max_lr."""
+    warmup_num_steps = max(2, warmup_num_steps)
+    delta = warmup_max_lr - warmup_min_lr
+
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        if warmup_type == WARMUP_LOG_RATE:
+            frac = jnp.log1p(step) / math.log(warmup_num_steps)
+        else:
+            frac = step / warmup_num_steps
+        frac = jnp.clip(frac, 0.0, 1.0)
+        return warmup_min_lr + delta * frac
+
+    return sched
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                    warmup_type: str = WARMUP_LOG_RATE, **_unused) -> Schedule:
+    """WarmupDecayLR: warmup then linear decay to 0 at total_num_steps."""
+    base = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+    warmup_num_steps = max(2, warmup_num_steps)
+
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        w = base(step)
+        decay = jnp.clip(
+            (total_num_steps - step) / max(1.0, total_num_steps - warmup_num_steps),
+            0.0, 1.0)
+        return jnp.where(step < warmup_num_steps, w, warmup_max_lr * decay)
+
+    return sched
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_min_ratio: float = 0.0,
+                     warmup_num_steps: int = 1000, cos_min_ratio: float = 0.0001,
+                     warmup_type: str = WARMUP_LINEAR_RATE, base_lr: float = 0.001,
+                     **_unused) -> Schedule:
+    """WarmupCosineLR: ratio-based warmup then cosine decay (reference :774)."""
+    warmup_num_steps = max(2, warmup_num_steps)
+
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        if warmup_type == WARMUP_LOG_RATE:
+            wfrac = jnp.log1p(step) / math.log(warmup_num_steps)
+        else:
+            wfrac = step / warmup_num_steps
+        wfrac = jnp.clip(wfrac, 0.0, 1.0)
+        warm_ratio = warmup_min_ratio + (1.0 - warmup_min_ratio) * wfrac
+        progress = jnp.clip((step - warmup_num_steps)
+                            / max(1.0, total_num_steps - warmup_num_steps), 0.0, 1.0)
+        cos_ratio = cos_min_ratio + (1.0 - cos_min_ratio) * 0.5 * (1.0 + jnp.cos(math.pi * progress))
+        ratio = jnp.where(step < warmup_num_steps, warm_ratio, cos_ratio)
+        return base_lr * ratio
+
+    return sched
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float,
+              cycle_first_step_size: int = 2000, cycle_second_step_size: Optional[int] = None,
+              cycle_first_stair_count: int = 0, cycle_second_stair_count: Optional[int] = None,
+              decay_step_size: int = 0, decay_lr_rate: float = 0.0,
+              post_cycle_decay: bool = True, **_unused) -> Schedule:
+    """OneCycle (reference :371): linear up, linear down, then optional decay."""
+    second = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+    total_cycle = cycle_first_step_size + second
+
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        up = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * (step / cycle_first_step_size)
+        down = cycle_max_lr - (cycle_max_lr - cycle_min_lr) * ((step - cycle_first_step_size) / max(second, 1))
+        in_cycle = jnp.where(step < cycle_first_step_size, up, jnp.maximum(down, cycle_min_lr))
+        if decay_step_size > 0 and decay_lr_rate > 0:
+            decay_steps = jnp.floor((step - total_cycle) / decay_step_size)
+            decayed = cycle_min_lr / (1.0 + decay_lr_rate * jnp.maximum(decay_steps, 0.0))
+            return jnp.where(step >= total_cycle, decayed, in_cycle)
+        return in_cycle
+
+    return sched
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3, lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0, lr_range_test_staircase: bool = False,
+                  **_unused) -> Schedule:
+    """LRRangeTest (reference :273): lr = min_lr * (1 + rate * interval)."""
+
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        interval = step / lr_range_test_step_size
+        if lr_range_test_staircase:
+            interval = jnp.floor(interval)
+        return lr_range_test_min_lr * (1.0 + lr_range_test_step_rate * interval)
+
+    return sched
+
+
+def constant_lr(lr: float = 0.001, **_unused) -> Schedule:
+    def sched(step):
+        return jnp.asarray(lr, jnp.float32)
+    return sched
+
+
+SCHEDULES: Dict[str, Callable[..., Schedule]] = {
+    "WarmupLR": warmup_lr,
+    "WarmupDecayLR": warmup_decay_lr,
+    "WarmupCosineLR": warmup_cosine_lr,
+    "OneCycle": one_cycle,
+    "LRRangeTest": lr_range_test,
+    "Constant": constant_lr,
+}
+
+
+def build_schedule(sched_type: Optional[str], params: Dict[str, Any],
+                   base_lr: Optional[float] = None) -> Schedule:
+    """Build a schedule from a ds_config ``scheduler`` block. If no scheduler
+    configured, holds the optimizer's base lr constant."""
+    if sched_type is None:
+        return constant_lr(lr=base_lr if base_lr is not None else 0.001)
+    if sched_type not in SCHEDULES:
+        raise ValueError(f"Unknown scheduler type '{sched_type}'. Known: {sorted(SCHEDULES)}")
+    params = dict(params)
+    if sched_type == "WarmupCosineLR" and base_lr is not None:
+        params.setdefault("base_lr", base_lr)
+    return SCHEDULES[sched_type](**params)

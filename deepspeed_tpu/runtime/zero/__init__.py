@@ -1,0 +1,1 @@
+from .sharding import ZeroShardingPlan, choose_shard_dim

@@ -1,0 +1,200 @@
+"""ZeRO as declarative sharding.
+
+The reference implements ZeRO imperatively: flattened partitions, gradient
+hooks, bucketed reduce-scatter, a parameter coordinator with trace-driven
+prefetch (``runtime/zero/stage_1_and_2.py``, ``stage3.py``,
+``partitioned_param_coordinator.py`` — ~11k LoC). On TPU the same memory
+states are *sharding declarations* over the ``data`` (× ``seq``) mesh axes,
+and XLA's SPMD partitioner schedules the all-gathers/reduce-scatters that the
+reference hand-manages on side streams:
+
+  stage 0 — params/grads/opt-state replicated; grad psum (plain DP)
+  stage 1 — optimizer state sharded over data     (opt-state partitioning)
+  stage 2 — + gradients constrained to the same shards (reduce-scatter)
+  stage 3 — + parameters sharded; XLA inserts per-layer all-gathers
+            (the coordinator's prefetch/release becomes compiler scheduling)
+
+`stage3_param_persistence_threshold` keeps small params replicated, exactly
+like the reference's persistent-parameter set (stage3.py persistence logic).
+ZeRO++ hpZ (secondary shards within a node) maps to sharding params over an
+inner mesh sub-axis only; qwZ/qgZ map to quantized collectives (see
+``deepspeed_tpu/ops/quantization.py``).
+
+Offload: ``offload_optimizer.device == "cpu"`` places optimizer-state shards
+in host memory (``memory_kind="pinned_host"``); XLA streams them in/out of the
+update. NVMe offload is layered on the aio host library (``deepspeed_tpu/io``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...config.config import ZeroConfig
+from ...parallel.topology import Topology
+from ...utils.logging import log_dist, logger
+
+
+def _axis_product(topo: Topology, axes: Sequence[str]) -> int:
+    out = 1
+    for a in axes:
+        out *= topo.axis_size(a)
+    return out
+
+
+def choose_shard_dim(shape: Tuple[int, ...], n_shards: int,
+                     taken_dims: Sequence[int] = ()) -> Optional[int]:
+    """Pick the dimension to shard: the largest dim divisible by ``n_shards``
+    that isn't already sharded by another axis. None if nothing divides."""
+    candidates = [
+        (size, dim) for dim, size in enumerate(shape)
+        if dim not in taken_dims and size % n_shards == 0 and size >= n_shards
+    ]
+    if not candidates:
+        return None
+    return max(candidates)[1]
+
+
+def _merge_axes_into_spec(spec: Optional[P], shape: Tuple[int, ...],
+                          axes: Sequence[str], n_shards: int) -> P:
+    """Add ``axes`` (as one sharding group) to an existing PartitionSpec on the
+    best free dimension. Returns the original spec when nothing divides."""
+    base = tuple(spec) if spec is not None else ()
+    base = base + (None,) * (len(shape) - len(base))
+    taken = [i for i, s in enumerate(base) if s is not None]
+    dim = choose_shard_dim(shape, n_shards, taken_dims=taken)
+    if dim is None:
+        return P(*base) if any(s is not None for s in base) else P()
+    new = list(base)
+    new[dim] = axes[0] if len(axes) == 1 else tuple(axes)
+    return P(*new)
+
+
+class ZeroShardingPlan:
+    """Computes NamedShardings for params / grads / optimizer state.
+
+    ``tp_specs`` (optional) is a params-shaped pytree of PartitionSpecs from
+    the tensor-parallel rule engine; ZeRO composes with it by sharding a
+    different dimension.
+    """
+
+    def __init__(self, cfg: ZeroConfig, topo: Topology, tp_specs: Any = None):
+        self.cfg = cfg
+        self.topo = topo
+        self.tp_specs = tp_specs
+        self.zero_axes = tuple(topo.zero_axes)
+        self.n_shards = _axis_product(topo, self.zero_axes)
+        self.stage = cfg.stage
+        if self.n_shards == 1 and self.stage > 0:
+            log_dist("ZeRO enabled but data-parallel world size is 1; sharding is a no-op")
+
+    # -------------------------------------------------------------- #
+
+    def _tp_spec_for(self, path, leaf) -> Optional[P]:
+        if self.tp_specs is None:
+            return None
+        try:
+            sub = self.tp_specs
+            for k in path:
+                key = getattr(k, "key", getattr(k, "idx", None))
+                sub = sub[key]
+            return sub if isinstance(sub, P) else None
+        except (KeyError, IndexError, TypeError):
+            return None
+
+    def _sharded_spec(self, path, leaf, threshold: int = 0) -> P:
+        tp = self._tp_spec_for(path, leaf)
+        shape = tuple(np.shape(leaf))
+        if self.n_shards == 1 or int(np.prod(shape or (1,))) <= threshold:
+            return tp if tp is not None else P()
+        return _merge_axes_into_spec(tp, shape, self.zero_axes, self.n_shards)
+
+    def _replicated_spec(self, path, leaf) -> P:
+        tp = self._tp_spec_for(path, leaf)
+        return tp if tp is not None else P()
+
+    # ------------------------- public specs ------------------------ #
+
+    def param_specs(self, params: Any) -> Any:
+        """PartitionSpec pytree for model parameters."""
+        if self.stage >= 3:
+            threshold = int(self.cfg.stage3_param_persistence_threshold) \
+                if not isinstance(self.cfg.stage3_param_persistence_threshold, str) else 100_000
+            return jax.tree_util.tree_map_with_path(
+                functools.partial(self._sharded_spec, threshold=threshold), params)
+        return jax.tree_util.tree_map_with_path(self._replicated_spec, params)
+
+    def grad_specs(self, params: Any) -> Any:
+        """PartitionSpec pytree for gradients (stage>=2 → sharded)."""
+        if self.stage >= 2:
+            return jax.tree_util.tree_map_with_path(
+                functools.partial(self._sharded_spec, threshold=0), params)
+        return jax.tree_util.tree_map_with_path(self._replicated_spec, params)
+
+    def opt_state_specs(self, opt_state: Any) -> Any:
+        """PartitionSpec pytree for optimizer state (stage>=1 → sharded).
+
+        Any leaf with a shardable dim gets sharded over the zero axes; scalars
+        (e.g. step counts) stay replicated. This covers optax states (mu/nu
+        mirror param shapes) without needing the param tree structure.
+        """
+
+        def spec_for(leaf):
+            shape = tuple(np.shape(leaf))
+            if self.stage < 1 or self.n_shards == 1 or len(shape) == 0:
+                return P()
+            return _merge_axes_into_spec(None, shape, self.zero_axes, self.n_shards)
+
+        return jax.tree_util.tree_map(spec_for, opt_state)
+
+    # ---------------------- NamedSharding trees -------------------- #
+
+    def _to_sharding(self, specs: Any, memory_kind: Optional[str] = None) -> Any:
+        mesh = self.topo.mesh
+
+        def mk(spec):
+            if memory_kind is not None:
+                try:
+                    return NamedSharding(mesh, spec, memory_kind=memory_kind)
+                except (ValueError, TypeError):
+                    return NamedSharding(mesh, spec)  # backend without memories
+            return NamedSharding(mesh, spec)
+
+        return jax.tree_util.tree_map(mk, specs,
+                                      is_leaf=lambda x: isinstance(x, P))
+
+    def param_shardings(self, params: Any) -> Any:
+        kind = None
+        if self.cfg.offload_param.device == "cpu":
+            kind = "pinned_host"
+        return self._to_sharding(self.param_specs(params), memory_kind=kind)
+
+    def grad_shardings(self, params: Any) -> Any:
+        return self._to_sharding(self.grad_specs(params))
+
+    def opt_state_shardings(self, opt_state: Any) -> Any:
+        kind = None
+        if self.cfg.offload_optimizer.device == "cpu":
+            kind = "pinned_host"
+        return self._to_sharding(self.opt_state_specs(opt_state), memory_kind=kind)
+
+    # -------------------------------------------------------------- #
+
+    def constrain_grads(self, grads: Any, params: Any) -> Any:
+        """Apply with_sharding_constraint to gradients inside jit (stage>=2:
+        forces the DP reduction to materialize as reduce-scatter shards)."""
+        specs = self.grad_specs(params)
+        return jax.tree_util.tree_map(
+            lambda g, s: jax.lax.with_sharding_constraint(g, NamedSharding(self.topo.mesh, s)),
+            grads, specs, is_leaf=lambda x: isinstance(x, P))
+
+    def memory_summary(self, params: Any) -> str:
+        n_params = sum(int(np.prod(np.shape(p))) for p in jax.tree_util.tree_leaves(params))
+        shard = 1.0 / self.n_shards if self.stage >= 3 else 1.0
+        return (f"ZeRO stage {self.stage}: {n_params / 1e6:.1f}M params, "
+                f"{self.n_shards} shards over axes {self.zero_axes}, "
+                f"param residency {shard * 100:.0f}%")

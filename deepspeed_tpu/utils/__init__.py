@@ -1,0 +1,2 @@
+from .logging import log_dist, logger, see_memory_usage
+from .timer import SynchronizedWallClockTimer, ThroughputTimer, NoopTimer

@@ -1,0 +1,33 @@
+"""Shared dtype-name mapping and precision-cast helpers."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+DTYPES = {
+    "float32": jnp.float32, "fp32": jnp.float32,
+    "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+    "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+}
+
+
+def resolve_dtype(name: Any) -> Any:
+    if not isinstance(name, str):
+        return name
+    try:
+        return DTYPES[name.lower()]
+    except KeyError:
+        raise ValueError(f"Unknown dtype '{name}'. Known: {sorted(DTYPES)}")
+
+
+def cast_floating(tree: Any, dtype) -> Any:
+    """Cast floating-point leaves of a pytree to ``dtype``; others unchanged."""
+    if dtype == jnp.float32:
+        return tree
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else jnp.asarray(p),
+        tree)

@@ -1,0 +1,90 @@
+"""Rank-aware logging.
+
+TPU-native analogue of the reference's ``deepspeed/utils/logging.py``:
+``logger`` plus ``log_dist`` which only emits on the requested process
+indices (JAX is one process per host, so "rank" here means host index).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+@functools.lru_cache(None)
+def _create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+    logger_ = logging.getLogger(name)
+    logger_.setLevel(level)
+    logger_.propagate = False
+    if not logger_.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(
+            logging.Formatter(
+                "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s",
+                datefmt="%Y-%m-%d %H:%M:%S",
+            )
+        )
+        logger_.addHandler(handler)
+    return logger_
+
+
+logger = _create_logger(
+    level=LOG_LEVELS.get(os.environ.get("DSTPU_LOG_LEVEL", "info").lower(), logging.INFO)
+)
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # jax not initialized yet
+        return 0
+
+
+def log_dist(message: str, ranks=None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given host ranks (default: rank 0 only).
+
+    ``ranks=[-1]`` logs on every host. Mirrors the semantics of the reference
+    ``log_dist`` (deepspeed/utils/logging.py).
+    """
+    my_rank = _process_index()
+    ranks = ranks if ranks is not None else [0]
+    if -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message: str) -> None:
+    _warn_once_impl(message)
+
+
+@functools.lru_cache(None)
+def _warn_once_impl(message: str) -> None:
+    logger.warning(message)
+
+
+def see_memory_usage(message: str, force: bool = False) -> None:
+    """Log live/peak device memory. Analogue of utils/logging.py:see_memory_usage."""
+    if not force:
+        return
+    try:
+        import jax
+
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats() or {}
+        in_use = stats.get("bytes_in_use", 0) / (1024**3)
+        peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
+        limit = stats.get("bytes_limit", 0) / (1024**3)
+        logger.info(f"{message} | MA {in_use:.2f} GB | Peak {peak:.2f} GB | Limit {limit:.2f} GB")
+    except Exception as e:  # CPU backend has no memory_stats
+        logger.info(f"{message} | (memory stats unavailable: {e})")

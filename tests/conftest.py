@@ -1,0 +1,37 @@
+"""Test harness configuration.
+
+The analogue of the reference's ``tests/unit/common.py`` ``DistributedTest``:
+the reference forks N real processes per test class; in JAX SPMD the same
+multi-device coverage comes from a virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``) in ONE process — every
+DP/TP/SP/EP/PP configuration is exercised as real SPMD sharding over those
+devices (SURVEY.md §4 implication).
+"""
+
+import os
+
+# jax may already be imported (but not backend-initialized) by the session
+# environment, so plain env vars can be too late; jax.config wins either way.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_topology():
+    """Each test builds its own mesh; clear the module-level registry."""
+    yield
+    from deepspeed_tpu.parallel import topology
+    topology._TOPOLOGY = None
+
+
+@pytest.fixture
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,90 @@
+"""Config tests — mirrors reference tests/unit/runtime/test_ds_config_dict.py."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.config import Config, ConfigError
+
+
+def test_defaults():
+    cfg = Config.load(None)
+    assert cfg.zero_optimization.stage == 0
+    assert cfg.precision_dtype == "float32"
+    assert cfg.gradient_clipping == 0.0
+
+
+def test_ds_config_surface():
+    cfg = Config.load({
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "weight_decay": 0.1}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 100}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2, "reduce_bucket_size": 1000},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 5,
+    })
+    assert cfg.optimizer.type == "AdamW"
+    assert cfg.optimizer.params["lr"] == 3e-4
+    assert cfg.scheduler.type == "WarmupLR"
+    assert cfg.bf16.enabled and cfg.precision_dtype == "bfloat16"
+    assert cfg.zero_optimization.stage == 2
+    assert cfg.gradient_clipping == 1.0
+
+
+def test_bool_shorthand():
+    cfg = Config.load({"bf16": True})
+    assert cfg.bf16.enabled
+
+
+def test_json_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_micro_batch_size_per_gpu": 4, "fp16": {"enabled": True}}))
+    cfg = Config.load(str(p))
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.precision_dtype == "float16"
+
+
+def test_batch_resolution_invariant():
+    cfg = Config.load({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2})
+    cfg.resolve_batch_sizes(dp_world_size=4)
+    assert cfg.gradient_accumulation_steps == 4
+    assert cfg.train_batch_size == 2 * 4 * 4
+
+
+def test_batch_resolution_micro_only():
+    cfg = Config.load({"train_micro_batch_size_per_gpu": 3, "gradient_accumulation_steps": 2})
+    cfg.resolve_batch_sizes(dp_world_size=8)
+    assert cfg.train_batch_size == 3 * 2 * 8
+
+
+def test_batch_mismatch_raises():
+    cfg = Config.load({"train_batch_size": 10, "train_micro_batch_size_per_gpu": 3,
+                       "gradient_accumulation_steps": 1})
+    with pytest.raises(ConfigError):
+        cfg.resolve_batch_sizes(dp_world_size=2)
+
+
+def test_fp16_bf16_conflict():
+    cfg = Config.load({"fp16": {"enabled": True}, "bf16": {"enabled": True}})
+    with pytest.raises(ConfigError):
+        _ = cfg.precision_dtype
+
+
+def test_invalid_zero_stage():
+    with pytest.raises(ConfigError):
+        Config.load({"zero_optimization": {"stage": 5}})
+
+
+def test_unknown_key_warns_not_raises(caplog):
+    cfg = Config.load({"definitely_not_a_key": 1})
+    assert isinstance(cfg, Config)
+
+
+def test_roundtrip():
+    cfg = Config.load({"zero_optimization": {"stage": 3}})
+    d = cfg.to_dict()
+    assert d["zero_optimization"]["stage"] == 3
+    cfg2 = Config.from_dict(d)
+    assert cfg2.zero_optimization.stage == 3

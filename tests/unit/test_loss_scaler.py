@@ -1,0 +1,88 @@
+"""Dynamic loss scaler semantics — mirrors reference runtime/fp16/loss_scaler.py
+behavior: hysteresis consumption, halving, window growth, restore rules."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.config.config import FP16Config
+from deepspeed_tpu.runtime import loss_scaler as ls
+
+CFG = FP16Config(enabled=True, initial_scale_power=8, loss_scale_window=4,
+                 hysteresis=2, min_loss_scale=1.0)
+
+
+def _run(cfg, pattern):
+    """pattern: string of 'c' (clean) / 'o' (overflow). Returns scale history."""
+    state = ls.init_state(cfg)
+    scales = []
+    for ch in pattern:
+        state = ls.update_state(state, jnp.asarray(ch == "c"), cfg)
+        scales.append(float(state.scale))
+    return scales, state
+
+
+def test_initial_scale():
+    state = ls.init_state(CFG)
+    assert float(state.scale) == 2.0 ** 8
+
+
+def test_hysteresis_consumed_then_halve():
+    # first overflow: consume hysteresis (scale unchanged); second: halve
+    scales, _ = _run(CFG, "oo")
+    assert scales == [256.0, 128.0]
+
+
+def test_consecutive_overflows_keep_halving():
+    scales, _ = _run(CFG, "oooo")
+    assert scales == [256.0, 128.0, 64.0, 32.0]
+
+
+def test_nonconsecutive_overflows_still_halve():
+    """consecutive_hysteresis=False: clean steps do NOT restore hysteresis,
+    so alternating overflow/clean eventually halves (reference semantics)."""
+    scales, _ = _run(CFG, "ococ")
+    # o: hyst 2->1; c: no restore; o: hyst==1 -> halve
+    assert scales[-1] < 256.0
+
+
+def test_consecutive_hysteresis_true_restores():
+    cfg = FP16Config(enabled=True, initial_scale_power=8, loss_scale_window=100,
+                     hysteresis=2, consecutive_hysteresis=True)
+    scales, _ = _run(cfg, "ococococ")
+    # every clean step restores hysteresis to 2, so scale never halves
+    assert scales[-1] == 256.0
+
+
+def test_growth_after_window():
+    scales, _ = _run(CFG, "cccc")
+    assert scales == [256.0, 256.0, 256.0, 512.0]
+
+
+def test_growth_resets_tracker():
+    scales, _ = _run(CFG, "cccccccc")
+    assert scales[-1] == 1024.0
+
+
+def test_overflow_resets_growth_tracker():
+    # 3 clean, 1 overflow, 3 clean -> no growth yet (tracker reset)
+    scales, _ = _run(CFG, "cccoccc")
+    assert scales[-1] == 256.0
+
+
+def test_min_scale_floor():
+    cfg = FP16Config(enabled=True, initial_scale_power=2, hysteresis=1,
+                     min_loss_scale=2.0)
+    scales, _ = _run(cfg, "ooooo")
+    assert scales[-1] == 2.0
+
+
+def test_static_scale_never_changes():
+    cfg = FP16Config(enabled=True, loss_scale=128.0)
+    scales, state = _run(cfg, "ococcc")
+    assert all(s == 128.0 for s in scales)
+    assert int(state.overflows) == 2
+
+
+def test_overflow_counter():
+    _, state = _run(CFG, "ooccco")
+    assert int(state.overflows) == 3

@@ -1,0 +1,42 @@
+"""Mesh/topology tests — analogue of reference tests/unit/runtime/pipe topology tests."""
+
+import pytest
+
+from deepspeed_tpu.config import Config, ConfigError, MeshConfig
+from deepspeed_tpu.parallel import build_mesh
+
+
+def test_auto_data_axis(devices8):
+    topo = build_mesh(MeshConfig())
+    assert topo.dp_world_size == 8
+    assert topo.world_size == 8
+
+
+def test_mixed_axes(devices8):
+    topo = build_mesh(MeshConfig(model=2, seq=2))
+    assert topo.tp_world_size == 2
+    assert topo.sp_world_size == 2
+    assert topo.dp_world_size == 2
+    assert topo.world_size == 8
+
+
+def test_zero_axes_fuse_seq_and_data(devices8):
+    topo = build_mesh(MeshConfig(seq=2))
+    assert set(topo.zero_axes) == {"seq", "data"}
+    assert topo.zero_world_size == 8
+
+
+def test_indivisible_raises(devices8):
+    with pytest.raises(ConfigError):
+        build_mesh(MeshConfig(model=3))
+
+
+def test_explicit_mismatch_raises(devices8):
+    with pytest.raises(ConfigError):
+        build_mesh(MeshConfig(data=3, model=2))
+
+
+def test_batch_sharding_spec(devices8):
+    topo = build_mesh(MeshConfig(model=2))
+    s = topo.batch_sharding()
+    assert s.spec == ("data",) or tuple(s.spec) == (("data",),)
