@@ -1,0 +1,2 @@
+from .layer import MoE, Experts
+from .sharded_moe import top1gating, top2gating, topkgating, capacity

@@ -1,0 +1,154 @@
+"""MoE layer: gate + expert-parallel dispatch + experts.
+
+Analogue of the reference's ``deepspeed/moe/layer.py`` (``MoE:17`` =
+``TopKGate`` + ``MOELayer:533`` + ``Experts``) with ``_AllToAll`` dispatch
+(``sharded_moe.py:96``) and PR-MoE residual mode (``use_residual``).
+
+TPU-native design: experts live as ONE stacked tensor ``[E, ...]`` sharded
+over the ``expert`` mesh axis; dispatch/combine are einsums against the
+capacity-one-hot tensors from ``sharded_moe``; the expert-parallel exchange is
+``jax.lax.all_to_all`` inside ``shard_map`` — each (data, expert) device
+routes its local tokens' expert slices to the devices owning those experts
+and back. The layer returns ``(output, l_aux)``; the caller's loss adds
+``l_aux * aux_weight``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import sharded_moe
+
+EXPERT_AXIS = "expert"
+DATA_AXIS = "data"
+
+
+class Experts(nn.Module):
+    """Standalone stacked-FFN experts [E, T, M] → [E, T, M] — the reference's
+    ``Experts`` (moe/experts.py:13) as one vmapped dense block (MXU-friendly)."""
+    num_experts: int
+    hidden: int
+    d_model: int
+    dtype: jnp.dtype = jnp.float32
+    activation: Callable = nn.gelu
+
+    @nn.compact
+    def __call__(self, x):
+        wi = self.param("wi", nn.initializers.lecun_normal(),
+                        (self.num_experts, self.d_model, self.hidden), jnp.float32)
+        wo = self.param("wo", nn.initializers.lecun_normal(),
+                        (self.num_experts, self.hidden, self.d_model), jnp.float32)
+        h = jnp.einsum("etm,emh->eth", x, wi.astype(self.dtype))
+        h = self.activation(h)
+        return jnp.einsum("eth,ehm->etm", h, wo.astype(self.dtype))
+
+
+def _ffn(dispatched, wi, wo, activation, dtype):
+    h = jnp.einsum("etm,emh->eth", dispatched, wi.astype(dtype))
+    h = activation(h)
+    return jnp.einsum("eth,ehm->etm", h, wo.astype(dtype))
+
+
+class MoE(nn.Module):
+    """Drop-in MoE block: ``y, l_aux = MoE(...)(x)`` with x ``[B, T, M]``.
+
+    ``ep_mesh``: device mesh when expert parallelism is active (``expert``
+    axis size > 1); None = single expert group. With EP active the caller
+    must shard the batch over ``("data", "expert")`` — EP ranks are carved
+    out of the data-parallel world exactly like the reference's
+    expert-data-parallel decomposition (utils/groups.py:117).
+    """
+    d_model: int
+    num_experts: int = 8
+    k: int = 1
+    hidden: Optional[int] = None
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_residual: bool = False            # PR-MoE
+    ep_mesh: Optional[Mesh] = None
+    dtype: jnp.dtype = jnp.float32
+    activation: Callable = nn.gelu
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        B, T, M = x.shape
+        E = self.num_experts
+        hidden = self.hidden or 4 * M
+        ep = self.ep_mesh.shape[EXPERT_AXIS] if self.ep_mesh is not None else 1
+        if E % ep != 0:
+            raise ValueError(f"num_experts ({E}) must divide by expert axis ({ep})")
+
+        wg = self.param("gate", nn.initializers.lecun_normal(), (M, E), jnp.float32)
+        wi = self.param("wi", nn.initializers.lecun_normal(),
+                        (E, M, hidden), jnp.float32)
+        wo = self.param("wo", nn.initializers.lecun_normal(),
+                        (E, hidden, M), jnp.float32)
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        rng = self.make_rng("gating") if (train and self.noisy_gate_policy) else None
+        act, dtype = self.activation, self.dtype
+
+        def route_and_run(tokens, expert_apply):
+            """tokens [S, M] → (out [S, M], l_aux)."""
+            logits = tokens.astype(jnp.float32) @ wg
+            l_aux, combine, dispatch = sharded_moe.gate(
+                logits, k=self.k, capacity_factor=cf,
+                min_capacity=self.min_capacity, rng=rng,
+                noisy_gate_policy=self.noisy_gate_policy,
+                drop_tokens=self.drop_tokens)
+            dispatched = jnp.einsum("sec,sm->ecm",
+                                    dispatch.astype(tokens.dtype), tokens)
+            expert_out = expert_apply(dispatched)            # [E, C, M]
+            out = jnp.einsum("sec,ecm->sm", combine.astype(tokens.dtype),
+                             expert_out.astype(tokens.dtype))
+            return out, l_aux
+
+        tokens = x.reshape(B * T, M)
+        if ep <= 1:
+            out, l_aux = route_and_run(
+                tokens, lambda d: _ffn(d, wi, wo, act, dtype))
+        else:
+            def body(tokens_local, wi_local, wo_local):
+                """One (data, expert) device: tokens_local [S_loc, M];
+                wi/wo are this device's expert shards [E/ep, ...]."""
+                def expert_apply(dispatched):
+                    # [E, C, M] → a2a → [E/ep, ep*C, M]: tokens meet their experts
+                    d = jax.lax.all_to_all(dispatched, EXPERT_AXIS,
+                                           split_axis=0, concat_axis=1, tiled=True)
+                    eo = _ffn(d, wi_local, wo_local, act, dtype)
+                    # inverse a2a → [E, C, M]: results return to their tokens
+                    return jax.lax.all_to_all(eo, EXPERT_AXIS,
+                                              split_axis=1, concat_axis=0, tiled=True)
+
+                out, l_aux = route_and_run(tokens_local, expert_apply)
+                return out, jax.lax.pmean(
+                    jax.lax.pmean(l_aux, EXPERT_AXIS), DATA_AXIS)
+
+            out, l_aux = shard_map(
+                body, mesh=self.ep_mesh,
+                in_specs=(P((DATA_AXIS, EXPERT_AXIS)), P(EXPERT_AXIS),
+                          P(EXPERT_AXIS)),
+                out_specs=(P((DATA_AXIS, EXPERT_AXIS)), P()),
+                check_vma=False)(tokens, wi, wo)
+        out = out.reshape(B, T, M)
+
+        if self.use_residual:
+            # PR-MoE: dense residual MLP mixed by a learned coefficient
+            res = nn.Dense(hidden, dtype=self.dtype, name="residual_fc1")(x)
+            res = self.activation(res)
+            res = nn.Dense(M, dtype=self.dtype, name="residual_fc2")(res)
+            coef = nn.Dense(2, dtype=jnp.float32, name="coefficient")(
+                x.astype(jnp.float32))
+            coef = jax.nn.softmax(coef, axis=-1)
+            out = out * coef[..., 0:1].astype(out.dtype) \
+                + res * coef[..., 1:2].astype(out.dtype)
+
+        return out, l_aux

@@ -1,0 +1,183 @@
+"""MoE gating + dispatch math.
+
+Analogue of the reference's ``deepspeed/moe/sharded_moe.py`` (``top1gating:183``,
+``top2gating:290``, ``topkgating:374``, ``_capacity:161``, gumbel RTS ``:79``,
+einsum-mask dispatch ``MOELayer:533``), re-expressed as pure JAX on static
+shapes: capacity-bounded one-hot dispatch/combine tensors computed with
+cumsum positions — the GShard formulation, which XLA maps onto the MXU.
+
+All functions return ``(l_aux, combine_weights [S,E,C], dispatch_mask [S,E,C])``
+for a flat token group ``[S, M]`` — the layer handles batching and the
+expert-parallel all-to-all.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+             min_capacity: int) -> int:
+    """Tokens each expert can accept (reference _capacity:161)."""
+    cap = int(math.ceil(num_tokens / num_experts * capacity_factor))
+    return max(cap, min_capacity)
+
+
+def _gumbel(rng, shape):
+    return -jnp.log(-jnp.log(jax.random.uniform(rng, shape, minval=1e-9, maxval=1.0 - 1e-9)))
+
+
+def _one_hot(x, n):
+    return jax.nn.one_hot(jnp.asarray(x, jnp.int32), n, dtype=jnp.float32)
+
+
+def _positions_in_expert(mask: jnp.ndarray) -> jnp.ndarray:
+    """Queue position of each routed token within its expert.
+    mask [S, E] one-hot; returns [S] int positions."""
+    positions = jnp.cumsum(mask, axis=0) - 1.0
+    return (positions * mask).sum(axis=-1)
+
+
+def top1gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
+               min_capacity: int = 4, rng: Optional[jax.Array] = None,
+               noisy_gate_policy: Optional[str] = None,
+               used_token_mask: Optional[jnp.ndarray] = None,
+               drop_tokens: bool = True,
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Switch-style top-1 gating with capacity drop + RTS
+    (reference top1gating:183). logits [S, E]."""
+    S, E = logits.shape
+    C = capacity(S, E, capacity_factor, min_capacity) if drop_tokens else S
+
+    gates = jax.nn.softmax(logits, axis=-1)
+    select_logits = logits
+    if noisy_gate_policy == "RSample" and rng is not None:
+        select_logits = logits + _gumbel(rng, logits.shape)
+    elif noisy_gate_policy == "Jitter" and rng is not None:
+        select_logits = logits * jax.random.uniform(
+            rng, logits.shape, minval=0.98, maxval=1.02)
+
+    idx = jnp.argmax(select_logits, axis=-1)                   # [S]
+    mask1 = _one_hot(idx, E)                                   # [S, E]
+    if used_token_mask is not None:
+        mask1 = mask1 * used_token_mask[:, None]
+
+    # load-balancing aux loss (before capacity drop, reference semantics)
+    me = gates.mean(axis=0)
+    ce = mask1.mean(axis=0)
+    l_aux = (me * ce).sum() * E
+
+    pos = _positions_in_expert(mask1)                          # [S]
+    keep = (pos < C).astype(jnp.float32)
+    mask1 = mask1 * keep[:, None]
+
+    gate_val = (gates * mask1).sum(axis=-1)                    # [S]
+    combine = (gate_val[:, None, None] * mask1[:, :, None]
+               * _one_hot(pos, C)[:, None, :])                 # [S, E, C]
+    dispatch = combine > 0
+    return l_aux, combine, dispatch
+
+
+def top2gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
+               min_capacity: int = 4, rng: Optional[jax.Array] = None,
+               top2_2nd_expert_sampling: bool = True,
+               drop_tokens: bool = True,
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """GShard top-2 gating (reference top2gating:290). logits [S, E]."""
+    S, E = logits.shape
+    C = capacity(S, E, 2 * capacity_factor, min_capacity) if drop_tokens else S
+
+    gates = jax.nn.softmax(logits, axis=-1)
+    idx1 = jnp.argmax(logits, axis=-1)
+    mask1 = _one_hot(idx1, E)
+
+    second_logits = logits
+    if top2_2nd_expert_sampling and rng is not None:
+        second_logits = logits + _gumbel(rng, logits.shape)
+    second_logits = jnp.where(mask1 > 0, -jnp.inf, second_logits)
+    idx2 = jnp.argmax(second_logits, axis=-1)
+    mask2 = _one_hot(idx2, E)
+
+    me = gates.mean(axis=0)
+    ce = mask1.mean(axis=0)
+    l_aux = (me * ce).sum() * E
+
+    pos1 = _positions_in_expert(mask1)
+    # second-choice tokens queue behind all first choices for that expert
+    offset = mask1.sum(axis=0, keepdims=True)                  # [1, E]
+    pos2_grid = jnp.cumsum(mask2, axis=0) - 1.0 + offset
+    pos2 = (pos2_grid * mask2).sum(axis=-1)
+
+    mask1 = mask1 * (pos1 < C).astype(jnp.float32)[:, None]
+    mask2 = mask2 * (pos2 < C).astype(jnp.float32)[:, None]
+
+    g1 = (gates * mask1).sum(axis=-1)
+    g2 = (gates * mask2).sum(axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    combine = (g1[:, None, None] * mask1[:, :, None] * _one_hot(pos1, C)[:, None, :]
+               + g2[:, None, None] * mask2[:, :, None] * _one_hot(pos2, C)[:, None, :])
+    dispatch = combine > 0
+    return l_aux, combine, dispatch
+
+
+def topkgating(logits: jnp.ndarray, k: int, capacity_factor: float = 1.0,
+               min_capacity: int = 4, drop_tokens: bool = True,
+               normalize_weights: bool = True,
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Generic top-k gating (reference topkgating:374). logits [S, E]."""
+    S, E = logits.shape
+    C = capacity(S, E, k * capacity_factor, min_capacity) if drop_tokens else S
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    masked = logits
+    combine = jnp.zeros((S, E, C), jnp.float32)
+    total_mask = jnp.zeros((S, E), jnp.float32)
+    offset = jnp.zeros((1, E), jnp.float32)
+    gsum = jnp.zeros((S,), jnp.float32)
+    picks = []
+    for _ in range(k):                                 # k is small + static
+        idx = jnp.argmax(masked, axis=-1)
+        mask = _one_hot(idx, E)
+        pos_grid = jnp.cumsum(mask, axis=0) - 1.0 + offset
+        pos = (pos_grid * mask).sum(axis=-1)
+        mask_kept = mask * (pos < C).astype(jnp.float32)[:, None]
+        g = (gates * mask_kept).sum(axis=-1)
+        picks.append((mask_kept, pos, g))
+        gsum = gsum + g
+        total_mask = total_mask + mask
+        offset = offset + mask.sum(axis=0, keepdims=True)
+        masked = jnp.where(mask > 0, -jnp.inf, masked)
+
+    me = gates.mean(axis=0)
+    ce = (total_mask / k).mean(axis=0)
+    l_aux = (me * ce).sum() * E
+
+    denom = jnp.maximum(gsum, 1e-9) if normalize_weights else 1.0
+    for mask_kept, pos, g in picks:
+        w = g / denom if normalize_weights else g
+        combine = combine + (w[:, None, None] * mask_kept[:, :, None]
+                             * _one_hot(pos, C)[:, None, :])
+    dispatch = combine > 0
+    return l_aux, combine, dispatch
+
+
+def gate(logits: jnp.ndarray, k: int = 1, **kwargs):
+    """Dispatch to the right gating fn by k (TopKGate.forward analogue)."""
+    if k == 1:
+        kwargs.pop("top2_2nd_expert_sampling", None)
+        return top1gating(logits, **kwargs)
+    if k == 2:
+        kwargs.pop("noisy_gate_policy", None)
+        kwargs.pop("used_token_mask", None)
+        return top2gating(logits, **kwargs)
+    kwargs.pop("noisy_gate_policy", None)
+    kwargs.pop("used_token_mask", None)
+    kwargs.pop("rng", None)
+    kwargs.pop("top2_2nd_expert_sampling", None)
+    return topkgating(logits, k, **kwargs)

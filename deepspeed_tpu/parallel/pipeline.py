@@ -1,0 +1,187 @@
+"""Pipeline parallelism.
+
+Analogue of the reference's ``runtime/pipe/`` (4,379 LoC: ``PipelineModule``
+with ``LayerSpec``/``TiedLayerSpec`` partitioning, ``PipelineEngine`` running
+a 1F1B instruction stream through ``_INSTRUCTION_MAP`` with torch p2p
+send/recv between stage ranks). The TPU-native inversion (SURVEY.md §7):
+instead of an interpreter dispatching host-side instructions per microbatch,
+the ENTIRE pipeline schedule is one compiled program — ``shard_map`` over the
+``pipe`` mesh axis, stage params sharded on their leading dim, and a
+``lax.scan`` GPipe loop whose inter-stage sends are ``ppermute`` (neighbor
+ICI hops). Backward flows through the same loop via autodiff — the reverse
+schedule the reference hand-codes (``_exec_backward_pass``/SendGrad/RecvGrad)
+falls out of ``jax.grad``.
+
+Activation memory is managed with ``jax.checkpoint`` on the stage function
+(``remat``), which is what 1F1B's early-backward buys on GPUs.
+
+Host-side ``LayerSpec`` / ``partition_layers`` mirror the reference's model
+description and ``parameters``/``uniform``/``type:regex`` partition methods
+(``runtime/pipe/module.py:391``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+
+
+# --------------------------------------------------------------------- #
+# model description (host-side parity surface)
+# --------------------------------------------------------------------- #
+
+@dataclasses.dataclass
+class LayerSpec:
+    """Deferred layer description (reference pipe/module.py:30)."""
+    module_class: Any
+    args: tuple = ()
+    kwargs: dict = dataclasses.field(default_factory=dict)
+    param_count: int = 0     # used by partition_method="parameters"
+
+    def build(self):
+        return self.module_class(*self.args, **self.kwargs)
+
+    @property
+    def typename(self) -> str:
+        return getattr(self.module_class, "__name__", str(self.module_class))
+
+
+@dataclasses.dataclass
+class TiedLayerSpec(LayerSpec):
+    """Layer sharing params with another by key (reference pipe/module.py:77).
+    In JAX, tying = reusing the same param subtree; the spec records intent."""
+    key: str = ""
+
+
+def partition_layers(layers: Sequence[LayerSpec], num_stages: int,
+                     method: str = "uniform") -> List[int]:
+    """Return stage boundary indices (len num_stages+1), reference
+    _partition_layers (pipe/module.py:391) semantics:
+      "uniform"     — equal layer counts
+      "parameters"  — balance summed param_count
+      "type:regex"  — equal counts of layers whose typename matches regex
+    """
+    n = len(layers)
+    if method == "uniform":
+        weights = [1.0] * n
+    elif method == "parameters":
+        weights = [max(float(s.param_count), 0.0) for s in layers]
+        if sum(weights) == 0:
+            weights = [1.0] * n
+    elif method.startswith("type:"):
+        pat = re.compile(method[len("type:"):], re.IGNORECASE)
+        weights = [1.0 if pat.search(s.typename) else 0.0 for s in layers]
+        if sum(weights) == 0:
+            raise ValueError(f"no layer matches partition regex {method!r}")
+    else:
+        raise ValueError(f"unknown partition method {method!r}")
+
+    # greedy prefix-sum balance
+    total = sum(weights)
+    cum = np.cumsum([0.0] + list(weights))
+    bounds = [0]
+    for s in range(1, num_stages):
+        target = total * s / num_stages
+        idx = int(np.searchsorted(cum, target))
+        idx = max(bounds[-1] + 1, min(idx, n - (num_stages - s)))
+        bounds.append(idx)
+    bounds.append(n)
+    return bounds
+
+
+# --------------------------------------------------------------------- #
+# the compiled pipeline
+# --------------------------------------------------------------------- #
+
+def stack_stage_params(block_params: Any, num_stages: int) -> Any:
+    """Reshape stacked block params [L, ...] → [P, L/P, ...] so the leading
+    dim shards over the ``pipe`` axis (one group of L/P blocks per stage)."""
+
+    def reshape(leaf):
+        L = leaf.shape[0]
+        if L % num_stages != 0:
+            raise ValueError(
+                f"layer count {L} must divide pipeline stages {num_stages}")
+        return leaf.reshape(num_stages, L // num_stages, *leaf.shape[1:])
+
+    return jax.tree_util.tree_map(reshape, block_params)
+
+
+def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jnp.ndarray,
+                   mesh: Mesh, num_microbatches: int,
+                   pipe_axis: str = PIPE_AXIS,
+                   shard_batch_over_data: bool = True,
+                   remat: bool = True) -> jnp.ndarray:
+    """Run ``x`` through a ``pipe``-sharded stack of stages with a GPipe
+    fill/drain schedule compiled into one program.
+
+    stage_fn(params_local, h) -> h' where params_local has the [L/P, ...]
+    per-stage leaves and h is one microbatch of activations [mb, ...].
+    x: [B, ...] with B divisible by num_microbatches.
+    Differentiable end-to-end.
+    """
+    n_stages = mesh.shape[pipe_axis]
+    if n_stages == 1:
+        squeezed = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+        return stage_fn(squeezed, x)
+
+    B = x.shape[0]
+    m = num_microbatches
+    if B % m != 0:
+        raise ValueError(f"batch {B} not divisible by microbatches {m}")
+    micro = x.reshape(m, B // m, *x.shape[1:])
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def body(params_local, micro_local):
+        params_local = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        idx = jax.lax.axis_index(pipe_axis)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        total_steps = m + n_stages - 1
+
+        buf = jnp.zeros_like(micro_local[0])
+        outputs = jnp.zeros_like(micro_local)
+
+        def step(carry, t):
+            buf_in, outputs = carry
+            # stage 0 feeds microbatch t (clamped in drain phase; the result
+            # is masked out by the last stage's write gate)
+            x_t = jax.lax.dynamic_index_in_dim(
+                micro_local, jnp.clip(t, 0, m - 1), keepdims=False)
+            inp = jnp.where(idx == 0, x_t, buf_in)
+            out = fn(params_local, inp)
+            # last stage owns microbatch t-(P-1) at step t
+            out_t = t - (n_stages - 1)
+            write = jnp.logical_and(idx == n_stages - 1, out_t >= 0)
+            updated = jax.lax.dynamic_update_index_in_dim(
+                outputs, out, jnp.clip(out_t, 0, m - 1), axis=0)
+            outputs = jnp.where(write, updated, outputs)
+            buf_next = jax.lax.ppermute(out, pipe_axis, perm)
+            return (buf_next, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(step, (buf, outputs),
+                                       jnp.arange(total_steps))
+        # results live on the last stage; psum broadcasts them everywhere
+        outputs = jax.lax.psum(
+            jnp.where(idx == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+            pipe_axis)
+        return outputs
+
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    batch_spec = P(None, DATA_AXIS) if (
+        shard_batch_over_data and dp > 1 and (B // m) % dp == 0) else P()
+    param_spec = jax.tree_util.tree_map(lambda _: P(pipe_axis), stage_params)
+
+    y = shard_map(body, mesh=mesh,
+                  in_specs=(param_spec, batch_spec),
+                  out_specs=batch_spec, check_vma=False)(stage_params, micro)
+    return y.reshape(B, *y.shape[2:])

@@ -1,0 +1,92 @@
+"""Ring attention (context parallelism).
+
+The reference has NO ring attention (SURVEY.md §2.4: long context is Ulysses
+only). This is the TPU-native extension the survey prescribes: KV blocks
+rotate around the ``seq`` mesh axis via ``ppermute`` (nearest-neighbor ICI
+traffic) while each device keeps its Q shard and accumulates attention with
+an online-softmax, so sequence length scales linearly with the ring size and
+full T×T scores never materialize.
+
+Algorithm (blockwise attention / Liu et al. RingAttention):
+  each of the sp steps: partial = softmax-accumulate(Q_local, K_rot, V_rot)
+  with running (max, denominator, numerator); then ppermute K/V to the next
+  ring neighbor. Causal masking uses global block indices.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+SEQ_AXIS = "seq"
+NEG_INF = -1e30
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool, sm_scale: float):
+    """Runs inside shard_map. q/k/v: [B, T_loc, H, D] local shards."""
+    sp = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, T_loc, H, D = q.shape
+
+    qf = q.astype(jnp.float32) * sm_scale
+    # accumulators for online softmax
+    numer = jnp.zeros((B, T_loc, H, D), jnp.float32)
+    denom = jnp.zeros((B, T_loc, H), jnp.float32)
+    row_max = jnp.full((B, T_loc, H), NEG_INF, jnp.float32)
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(carry, r):
+        numer, denom, row_max, k_blk, v_blk = carry
+        # the block we hold at round r originated on device (my_idx - r) mod sp
+        src = (my_idx - r) % sp
+        # scores [B, T_loc, H, T_loc]
+        scores = jnp.einsum("bqhd,bkhd->bqhk", qf, k_blk.astype(jnp.float32))
+        if causal:
+            q_pos = my_idx * T_loc + jnp.arange(T_loc)[:, None]       # [Tq,1]
+            k_pos = src * T_loc + jnp.arange(T_loc)[None, :]          # [1,Tk]
+            mask = (k_pos <= q_pos)[None, :, None, :]                 # [1,Tq,1,Tk]
+            scores = jnp.where(mask, scores, NEG_INF)
+        blk_max = scores.max(axis=-1)                                  # [B,Tq,H]
+        new_max = jnp.maximum(row_max, blk_max)
+        # guard fully-masked rows (new_max == NEG_INF)
+        safe_max = jnp.where(new_max <= NEG_INF / 2, 0.0, new_max)
+        correction = jnp.exp(row_max - safe_max)
+        correction = jnp.where(row_max <= NEG_INF / 2, 0.0, correction)
+        p = jnp.exp(scores - safe_max[..., None])
+        p = jnp.where(scores <= NEG_INF / 2, 0.0, p)
+        numer = numer * correction[..., None] + jnp.einsum(
+            "bqhk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+        denom = denom * correction + p.sum(axis=-1)
+        # rotate KV to the next ring neighbor
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (numer, denom, new_max, k_blk, v_blk), None
+
+    (numer, denom, _, _, _), _ = jax.lax.scan(
+        step, (numer, denom, row_max, k, v), jnp.arange(sp))
+    out = numer / jnp.maximum(denom, 1e-20)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(query: jnp.ndarray, key: jnp.ndarray, value: jnp.ndarray,
+                   mesh: Mesh, seq_axis: str = SEQ_AXIS, causal: bool = True,
+                   sm_scale: Optional[float] = None) -> jnp.ndarray:
+    """Context-parallel attention. q/k/v: [B, T, H, D] with T sharded over
+    ``seq``; returns [B, T, H, D] with the same sharding."""
+    D = query.shape[-1]
+    sm_scale = sm_scale if sm_scale is not None else 1.0 / (D ** 0.5)
+    sp = mesh.shape[seq_axis]
+    if sp == 1:
+        return jax.nn.dot_product_attention(query, key, value, is_causal=causal)
+
+    spec = P(None, seq_axis, None, None)
+    fn = functools.partial(_ring_attention_local, axis_name=seq_axis,
+                           causal=causal, sm_scale=sm_scale)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(query, key, value)

@@ -1,0 +1,152 @@
+"""Tensor-parallel sharding rules.
+
+TPU-native replacement for the reference's AutoTP
+(``module_inject/auto_tp.py:189``: parse an HF module tree, classify each
+Linear as column- or row-parallel, slice weights with
+``ReplaceWithTensorSlicing``) and for Megatron-style mpu pass-through. Here a
+*rule* is a regex over the parameter path mapped to a ``PartitionSpec`` using
+the ``model`` mesh axis — no weight copying: ``pjit`` shards the original
+arrays and XLA inserts the (all-reduce at row-parallel outputs) collectives.
+
+``infer_tp_specs`` is the AutoTP analogue: given only a params pytree it
+classifies projection matrices by shape/name heuristics — fused qkv and MLP
+up-projections are column-parallel (shard output dim), attention/MLP output
+projections are row-parallel (shard input dim), embeddings shard the vocab
+dim, everything else replicates.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..utils.logging import log_dist
+
+MODEL_AXIS = "model"
+
+#: default name patterns, mirroring the reference's policy vocabulary
+#: (module_inject/containers/*: qkv/dense/h_to_4h/4h_to_h, HF: c_attn/c_proj/c_fc)
+COLUMN_PATTERNS = [r"c_attn", r"qkv", r"query", r"key", r"value", r"q_proj",
+                   r"k_proj", r"v_proj", r"c_fc", r"up_proj", r"gate_proj",
+                   r"h_to_4h", r"fc1", r"wi"]
+ROW_PATTERNS = [r"c_proj", r"o_proj", r"out_proj", r"dense(?!_h)", r"4h_to_h",
+                r"fc2", r"wo", r"down_proj"]
+EMBED_PATTERNS = [r"wte", r"embed_tokens", r"word_embeddings", r"embedding\b",
+                  r"lm_head"]
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+def _spec_col(shape: Tuple[int, ...]) -> P:
+    """Column parallel: shard the LAST dim (kernel [in, out] → out)."""
+    spec = [None] * len(shape)
+    if len(shape) >= 1:
+        spec[-1] = MODEL_AXIS
+    return P(*spec)
+
+
+def _spec_row(shape: Tuple[int, ...]) -> P:
+    """Row parallel: shard the second-to-last dim (kernel [in, out] → in).
+    1-D leaves (bias of a row-parallel matmul) replicate — the matmul output
+    is all-reduced first, then bias added once."""
+    if len(shape) < 2:
+        return P()
+    spec = [None] * len(shape)
+    spec[-2] = MODEL_AXIS
+    return P(*spec)
+
+
+def _spec_embed(shape: Tuple[int, ...]) -> P:
+    """Embedding [vocab, hidden]: shard vocab (dim 0)."""
+    spec = [None] * len(shape)
+    if len(shape) >= 2:
+        spec[0] = MODEL_AXIS
+    return P(*spec)
+
+
+class TPRules:
+    """Ordered (regex, kind) rules; first match wins.
+
+    kind: "column" | "row" | "embed" | "replicate" | an explicit PartitionSpec.
+    """
+
+    def __init__(self, rules: Optional[Sequence[Tuple[str, Any]]] = None):
+        self.rules: List[Tuple[re.Pattern, Any]] = [
+            (re.compile(pat), kind) for pat, kind in (rules or [])]
+
+    def add(self, pattern: str, kind: Any) -> "TPRules":
+        self.rules.append((re.compile(pattern), kind))
+        return self
+
+    def spec_for(self, path: str, shape: Tuple[int, ...], tp_size: int) -> P:
+        for pat, kind in self.rules:
+            if pat.search(path):
+                return _kind_to_spec(kind, shape, tp_size)
+        return P()
+
+    def specs_for_tree(self, params: Any, tp_size: int) -> Any:
+        """Params-shaped pytree of PartitionSpecs."""
+        if tp_size <= 1:
+            return jax.tree_util.tree_map(lambda _: P(), params)
+
+        def mk(path, leaf):
+            return self.spec_for(_path_str(path), tuple(np.shape(leaf)), tp_size)
+
+        return jax.tree_util.tree_map_with_path(mk, params)
+
+
+def _kind_to_spec(kind: Any, shape: Tuple[int, ...], tp_size: int) -> P:
+    if isinstance(kind, P):
+        return kind
+    if kind == "replicate":
+        return P()
+    dim_for = {"column": len(shape) - 1, "row": len(shape) - 2, "embed": 0}
+    builder = {"column": _spec_col, "row": _spec_row, "embed": _spec_embed}[kind]
+    d = dim_for[kind]
+    # only shard when the dim exists and divides evenly
+    if d < 0 or d >= len(shape) or shape[d] % tp_size != 0:
+        return P()
+    return builder(shape)
+
+
+#: ready-made rules for the in-repo GPT-2 (models/gpt2.py param names)
+GPT2_TP_RULES = TPRules([
+    (r"attn/c_attn", "column"),
+    (r"attn/c_proj", "row"),
+    (r"mlp/c_fc", "column"),
+    (r"mlp/c_proj", "row"),
+    (r"wte/embedding", "embed"),
+])
+
+
+def default_rules() -> TPRules:
+    """AutoTP-style generic rules from the shared pattern vocabulary."""
+    rules = TPRules()
+    for pat in COLUMN_PATTERNS:
+        rules.add(pat, "column")
+    for pat in ROW_PATTERNS:
+        rules.add(pat, "row")
+    for pat in EMBED_PATTERNS:
+        rules.add(pat, "embed")
+    return rules
+
+
+def infer_tp_specs(params: Any, tp_size: int,
+                   rules: Optional[TPRules] = None) -> Any:
+    """The AutoTP entry point: produce TP PartitionSpecs for any params tree
+    using name-pattern classification (reference auto_tp.py tp_parser
+    analogue — instead of module introspection, path-pattern matching)."""
+    rules = rules or default_rules()
+    specs = rules.specs_for_tree(params, tp_size)
+    n_sharded = sum(1 for s in jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P))
+        if any(e is not None for e in tuple(s)))
+    log_dist(f"AutoTP: sharded {n_sharded} param tensors over '{MODEL_AXIS}' "
+             f"axis (tp={tp_size})")
+    return specs

@@ -1,0 +1,111 @@
+"""Ulysses sequence parallelism.
+
+Analogue of the reference's DeepSpeed-Ulysses
+(``deepspeed/sequence/layer.py``: ``DistributedAttention:271`` wrapping any
+local attention with ``_SeqAllToAll:216`` head-scatter/seq-gather, and the
+SP vocab cross-entropy ``sequence/cross_entropy.py``). On TPU the all-to-all
+rides the ICI ``seq`` mesh axis inside ``shard_map``:
+
+    inputs  [B, T/sp, H, D]  (sequence sharded)
+    a2a  →  [B, T, H/sp, D]  (heads sharded, full sequence)   — attention here
+    a2a  →  [B, T/sp, H, D]  back
+
+GQA/uneven heads: heads must divide sp (the reference's uneven-head path
+``uneven_heads_all2all:43`` is a padding fallback; here we require divisibility
+and document it — pad heads to a multiple of sp upstream).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+SEQ_AXIS = "seq"
+
+
+def _a2a_scatter_heads(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """[B, T_local, H, D] -> [B, T_full, H/sp, D] (inside shard_map)."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def _a2a_gather_heads(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """[B, T_full, H/sp, D] -> [B, T_local, H, D] (inside shard_map)."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+class DistributedAttention:
+    """Wraps a local attention fn ``(q, k, v) -> out`` (all ``[B, T, H, D]``)
+    so it runs with the sequence dimension sharded over the ``seq`` mesh axis.
+
+    Reference parity: ``deepspeed/sequence/layer.py:271`` (scatter_idx=2 /
+    gather_idx=1 default layout).
+    """
+
+    def __init__(self, local_attention: Callable, mesh: Mesh,
+                 seq_axis: str = SEQ_AXIS):
+        self.local_attn = local_attention
+        self.mesh = mesh
+        self.seq_axis = seq_axis
+
+    def __call__(self, query: jnp.ndarray, key: jnp.ndarray,
+                 value: jnp.ndarray) -> jnp.ndarray:
+        sp = self.mesh.shape[self.seq_axis]
+        if sp == 1:
+            return self.local_attn(query, key, value)
+        H = query.shape[2]
+        if H % sp != 0:
+            raise ValueError(
+                f"num heads ({H}) must be divisible by seq-parallel degree "
+                f"({sp}); pad heads upstream for GQA/uneven layouts")
+
+        axis = self.seq_axis
+        attn = self.local_attn
+
+        def inner(q, k, v):
+            q = _a2a_scatter_heads(q, axis)
+            k = _a2a_scatter_heads(k, axis)
+            v = _a2a_scatter_heads(v, axis)
+            o = attn(q, k, v)
+            return _a2a_gather_heads(o, axis)
+
+        spec = P(None, axis, None, None)
+        return shard_map(inner, mesh=self.mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(query, key, value)
+
+
+def sp_cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray, mesh: Mesh,
+                     seq_axis: str = SEQ_AXIS) -> jnp.ndarray:
+    """Mean next-token NLL with the sequence dim sharded over ``seq`` —
+    analogue of reference ``sequence/cross_entropy.py:vocab_sequence_parallel_cross_entropy``.
+    logits [B, T, V], targets [B, T]; returns scalar mean over the FULL sequence."""
+    sp = mesh.shape[seq_axis]
+
+    def local_loss(lg, tg):
+        logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, tg[..., None], axis=-1)[..., 0]
+        # mean over the full (global) sequence = psum of local sums / global count
+        total = jax.lax.psum(nll.sum(), seq_axis)
+        count = jax.lax.psum(jnp.asarray(nll.size, jnp.float32), seq_axis)
+        return total / count
+
+    if sp == 1:
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0].mean()
+
+    return shard_map(local_loss, mesh=mesh,
+                     in_specs=(P(None, seq_axis, None), P(None, seq_axis)),
+                     out_specs=P(), check_vma=False)(logits, targets)
+
+
+def ulysses_attention(query, key, value, mesh: Mesh,
+                      local_attention: Optional[Callable] = None,
+                      seq_axis: str = SEQ_AXIS, causal: bool = True):
+    """Functional one-shot form of DistributedAttention."""
+    attn = local_attention or functools.partial(
+        jax.nn.dot_product_attention, is_causal=causal)
+    return DistributedAttention(attn, mesh, seq_axis)(query, key, value)

@@ -144,7 +144,10 @@ class Engine:
             return None
 
     def _init_state(self, params: Any, rng: jax.Array) -> TrainState:
-        params = jax.tree_util.tree_map(jnp.asarray, params)
+        # copy=True: the compiled step donates (deletes) state buffers, so the
+        # engine must own them — never alias the caller's arrays
+        params = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), params)
+        rng = jnp.array(rng, copy=True)
         opt_state = self.optimizer.init(params)
         return TrainState(
             step=jnp.zeros((), jnp.int32),
