@@ -1,0 +1,133 @@
+"""Comms volume/bandwidth accounting.
+
+Parity with the reference's ``CommsLogger`` (``utils/comms_logging.py:67``)
+and its ``calc_bw_log`` (``:34``): per-op, per-message-size counters with
+algorithmic-bandwidth math. The reference times each eager NCCL call via
+``@timed_op``; under XLA the collectives are compiled into the step, so the
+logger records *trace-time* volume (exact) and, when a host-side wall time is
+supplied (non-jit usage or whole-step timing), computes the same algo/bus
+bandwidth numbers.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.logging import log_dist, logger
+
+
+def calc_bw_log(comm_op: str, size_bytes: int, duration_s: float,
+                n: int) -> Tuple[float, float]:
+    """(algo_bw, bus_bw) in GB/s for a collective moving ``size_bytes`` over
+    ``n`` participants, mirroring reference ``utils/comms_logging.py:34``."""
+    if duration_s <= 0:
+        return 0.0, 0.0
+    size = float(size_bytes)
+    if comm_op in ("all_to_all_single", "all_to_all"):
+        algo = size / duration_s
+        bus = algo * (n - 1) / n if n else algo
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter",
+                     "reduce_scatter_tensor"):
+        size *= n
+        algo = size / duration_s
+        bus = algo * (n - 1) / n if n else algo
+    elif comm_op in ("all_reduce", "inference_all_reduce"):
+        size *= 2
+        algo = size / duration_s
+        bus = algo * (n - 1) / n if n else algo
+    else:  # send/recv/broadcast/ppermute: point-to-point
+        algo = size / duration_s
+        bus = algo
+    return algo / 1e9, bus / 1e9
+
+
+class CommsLogger:
+    """Size-bucketed per-op records; ``log_summary`` prints the reference's
+    table (op → msg size → count, total latency, avg latency, bw)."""
+
+    def __init__(self, enabled: bool = False, verbose: bool = False,
+                 prof_all: bool = True, prof_ops: Optional[List[str]] = None,
+                 debug: bool = False):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.prof_all = prof_all
+        self.prof_ops = prof_ops or []
+        self.debug = debug
+        # op -> msg_size -> [count, total_lat_ms, total_algo_bw, total_bus_bw]
+        self.comms_dict: Dict[str, Dict[int, List[float]]] = defaultdict(dict)
+
+    def configure(self, enabled=None, verbose=None, prof_all=None,
+                  prof_ops=None, debug=None):
+        if enabled is not None:
+            self.enabled = enabled
+        if verbose is not None:
+            self.verbose = verbose
+        if prof_all is not None:
+            self.prof_all = prof_all
+        if prof_ops is not None:
+            self.prof_ops = prof_ops
+        if debug is not None:
+            self.debug = debug
+
+    def _should_log(self, op_name: str, log_name: Optional[str]) -> bool:
+        if not self.enabled:
+            return False
+        if self.prof_all:
+            return True
+        return bool(log_name and log_name in self.prof_ops) or op_name in self.prof_ops
+
+    def append(self, op_name: str, size_bytes: int, n_participants: int,
+               duration_s: float = 0.0, log_name: Optional[str] = None):
+        if not self._should_log(op_name, log_name):
+            return
+        algo_bw, bus_bw = calc_bw_log(op_name, size_bytes, duration_s,
+                                      n_participants)
+        lat_ms = duration_s * 1e3
+        rec = self.comms_dict[op_name].setdefault(size_bytes, [0, 0.0, 0.0, 0.0])
+        rec[0] += 1
+        rec[1] += lat_ms
+        rec[2] += algo_bw
+        rec[3] += bus_bw
+        if self.verbose:
+            log_dist(
+                f"comm op: {op_name} | msg size: {size_bytes} | "
+                f"time (ms): {lat_ms:.2f} | algbw (Gbps): {algo_bw * 8:.2f} | "
+                f"busbw (Gbps): {bus_bw * 8:.2f}", ranks=[0])
+
+    def log_summary(self, show_straggler: bool = False) -> str:
+        lines = []
+        header = (f"{'Comm. Op':<25}{'Message Size':<20}{'Count':<10}"
+                  f"{'Total Latency(ms)':<20}{'Avg Latency(ms)':<20}"
+                  f"{'tput_avg (GB/s)':<20}{'busbw_avg (GB/s)':<20}")
+        lines.append(header)
+        for op, sizes in sorted(self.comms_dict.items()):
+            lines.append(op)
+            for size, (count, tot_ms, algo, bus) in sorted(sizes.items()):
+                avg = tot_ms / count if count else 0.0
+                lines.append(
+                    f"{'':<25}{_fmt_size(size):<20}{count:<10}"
+                    f"{tot_ms:<20.2f}{avg:<20.2f}"
+                    f"{algo / max(count, 1):<20.2f}{bus / max(count, 1):<20.2f}")
+        out = "\n".join(lines)
+        logger.info(out)
+        return out
+
+    def reset(self):
+        self.comms_dict.clear()
+
+
+def _fmt_size(num: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(num) < 1024.0:
+            return f"{num:.1f} {unit}"
+        num /= 1024.0
+    return f"{num:.1f} PB"
+
+
+_LOGGER = CommsLogger()
+
+
+def get_comms_logger() -> CommsLogger:
+    return _LOGGER

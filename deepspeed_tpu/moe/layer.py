@@ -24,9 +24,16 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from . import sharded_moe
+from .. import comm
 
 EXPERT_AXIS = "expert"
 DATA_AXIS = "data"
+
+
+def _ffn(dispatched, wi, wo, activation, dtype):
+    h = jnp.einsum("etm,emh->eth", dispatched, wi.astype(dtype))
+    h = activation(h)
+    return jnp.einsum("eth,ehm->etm", h, wo.astype(dtype))
 
 
 class Experts(nn.Module):
@@ -44,15 +51,7 @@ class Experts(nn.Module):
                         (self.num_experts, self.d_model, self.hidden), jnp.float32)
         wo = self.param("wo", nn.initializers.lecun_normal(),
                         (self.num_experts, self.hidden, self.d_model), jnp.float32)
-        h = jnp.einsum("etm,emh->eth", x, wi.astype(self.dtype))
-        h = self.activation(h)
-        return jnp.einsum("eth,ehm->etm", h, wo.astype(self.dtype))
-
-
-def _ffn(dispatched, wi, wo, activation, dtype):
-    h = jnp.einsum("etm,emh->eth", dispatched, wi.astype(dtype))
-    h = activation(h)
-    return jnp.einsum("eth,ehm->etm", h, wo.astype(dtype))
+        return _ffn(x, wi, wo, self.activation, self.dtype)
 
 
 class MoE(nn.Module):
@@ -72,6 +71,7 @@ class MoE(nn.Module):
     eval_capacity_factor: float = 1.0
     min_capacity: int = 4
     noisy_gate_policy: Optional[str] = None
+    top2_2nd_expert_sampling: bool = True   # reference top2gating default ON
     drop_tokens: bool = True
     use_residual: bool = False            # PR-MoE
     ep_mesh: Optional[Mesh] = None
@@ -93,16 +93,20 @@ class MoE(nn.Module):
         wo = self.param("wo", nn.initializers.lecun_normal(),
                         (E, hidden, M), jnp.float32)
         cf = self.capacity_factor if train else self.eval_capacity_factor
-        rng = self.make_rng("gating") if (train and self.noisy_gate_policy) else None
+        needs_rng = train and (
+            self.noisy_gate_policy
+            or (self.k == 2 and self.top2_2nd_expert_sampling))
+        rng = self.make_rng("gating") if needs_rng else None
         act, dtype = self.activation, self.dtype
 
-        def route_and_run(tokens, expert_apply):
+        def route_and_run(tokens, expert_apply, rng):
             """tokens [S, M] → (out [S, M], l_aux)."""
             logits = tokens.astype(jnp.float32) @ wg
             l_aux, combine, dispatch = sharded_moe.gate(
                 logits, k=self.k, capacity_factor=cf,
                 min_capacity=self.min_capacity, rng=rng,
                 noisy_gate_policy=self.noisy_gate_policy,
+                top2_2nd_expert_sampling=self.top2_2nd_expert_sampling,
                 drop_tokens=self.drop_tokens)
             dispatched = jnp.einsum("sec,sm->ecm",
                                     dispatch.astype(tokens.dtype), tokens)
@@ -114,21 +118,30 @@ class MoE(nn.Module):
         tokens = x.reshape(B * T, M)
         if ep <= 1:
             out, l_aux = route_and_run(
-                tokens, lambda d: _ffn(d, wi, wo, act, dtype))
+                tokens, lambda d: _ffn(d, wi, wo, act, dtype), rng)
         else:
             def body(tokens_local, wi_local, wo_local):
                 """One (data, expert) device: tokens_local [S_loc, M];
                 wi/wo are this device's expert shards [E/ep, ...]."""
                 def expert_apply(dispatched):
                     # [E, C, M] → a2a → [E/ep, ep*C, M]: tokens meet their experts
-                    d = jax.lax.all_to_all(dispatched, EXPERT_AXIS,
-                                           split_axis=0, concat_axis=1, tiled=True)
+                    d = comm.all_to_all_single(dispatched, axis_name=EXPERT_AXIS,
+                                               split_axis=0, concat_axis=1,
+                                               log_name="moe_dispatch")
                     eo = _ffn(d, wi_local, wo_local, act, dtype)
                     # inverse a2a → [E, C, M]: results return to their tokens
-                    return jax.lax.all_to_all(eo, EXPERT_AXIS,
-                                              split_axis=1, concat_axis=0, tiled=True)
+                    return comm.all_to_all_single(eo, axis_name=EXPERT_AXIS,
+                                                  split_axis=1, concat_axis=0,
+                                                  log_name="moe_combine")
 
-                out, l_aux = route_and_run(tokens_local, expert_apply)
+                # decorrelate gating noise across shards: each (data, expert)
+                # device draws from an independent fold of the layer rng
+                local_rng = rng
+                if rng is not None:
+                    shard_id = (jax.lax.axis_index(DATA_AXIS) * ep
+                                + jax.lax.axis_index(EXPERT_AXIS))
+                    local_rng = jax.random.fold_in(rng, shard_id)
+                out, l_aux = route_and_run(tokens_local, expert_apply, local_rng)
                 return out, jax.lax.pmean(
                     jax.lax.pmean(l_aux, EXPERT_AXIS), DATA_AXIS)
 

@@ -32,6 +32,8 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .. import comm
+
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
 
@@ -72,6 +74,10 @@ def partition_layers(layers: Sequence[LayerSpec], num_stages: int,
       "type:regex"  — equal counts of layers whose typename matches regex
     """
     n = len(layers)
+    if num_stages > n:
+        raise ValueError(
+            f"cannot partition {n} layers into {num_stages} stages "
+            f"(every stage needs at least one layer)")
     if method == "uniform":
         weights = [1.0] * n
     elif method == "parameters":
@@ -165,7 +171,8 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jnp.ndarray,
             updated = jax.lax.dynamic_update_index_in_dim(
                 outputs, out, jnp.clip(out_t, 0, m - 1), axis=0)
             outputs = jnp.where(write, updated, outputs)
-            buf_next = jax.lax.ppermute(out, pipe_axis, perm)
+            buf_next = comm.ppermute(out, perm, axis_name=pipe_axis,
+                                     log_name="pipe_send_activations")
             return (buf_next, outputs), None
 
         (_, outputs), _ = jax.lax.scan(step, (buf, outputs),

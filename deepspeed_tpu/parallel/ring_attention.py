@@ -23,7 +23,10 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from .. import comm
+
 SEQ_AXIS = "seq"
+DATA_AXIS = "data"
 NEG_INF = -1e30
 
 
@@ -64,8 +67,8 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool, sm_scale: float
             "bqhk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
         denom = denom * correction + p.sum(axis=-1)
         # rotate KV to the next ring neighbor
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        k_blk = comm.ppermute(k_blk, perm, axis_name=axis_name)
+        v_blk = comm.ppermute(v_blk, perm, axis_name=axis_name)
         return (numer, denom, new_max, k_blk, v_blk), None
 
     (numer, denom, _, _, _), _ = jax.lax.scan(
@@ -83,9 +86,14 @@ def ring_attention(query: jnp.ndarray, key: jnp.ndarray, value: jnp.ndarray,
     sm_scale = sm_scale if sm_scale is not None else 1.0 / (D ** 0.5)
     sp = mesh.shape[seq_axis]
     if sp == 1:
-        return jax.nn.dot_product_attention(query, key, value, is_causal=causal)
+        return jax.nn.dot_product_attention(query, key, value, is_causal=causal,
+                                            scale=sm_scale)
 
-    spec = P(None, seq_axis, None, None)
+    # batch dim rides the data axis when the mesh has one (avoids replicating
+    # a DP-sharded batch across data groups)
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    batch_axis = DATA_AXIS if dp > 1 and query.shape[0] % dp == 0 else None
+    spec = P(batch_axis, seq_axis, None, None)
     fn = functools.partial(_ring_attention_local, axis_name=seq_axis,
                            causal=causal, sm_scale=sm_scale)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),

@@ -25,17 +25,22 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from .. import comm
+
 SEQ_AXIS = "seq"
+DATA_AXIS = "data"
 
 
 def _a2a_scatter_heads(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     """[B, T_local, H, D] -> [B, T_full, H/sp, D] (inside shard_map)."""
-    return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    return comm.all_to_all_single(x, axis_name=axis_name, split_axis=2,
+                                  concat_axis=1, log_name="ulysses_qkv")
 
 
 def _a2a_gather_heads(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     """[B, T_full, H/sp, D] -> [B, T_local, H, D] (inside shard_map)."""
-    return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    return comm.all_to_all_single(x, axis_name=axis_name, split_axis=1,
+                                  concat_axis=2, log_name="ulysses_out")
 
 
 class DistributedAttention:
@@ -73,7 +78,9 @@ class DistributedAttention:
             o = attn(q, k, v)
             return _a2a_gather_heads(o, axis)
 
-        spec = P(None, axis, None, None)
+        dp = self.mesh.shape.get(DATA_AXIS, 1)
+        batch_axis = DATA_AXIS if dp > 1 and query.shape[0] % dp == 0 else None
+        spec = P(batch_axis, axis, None, None)
         return shard_map(inner, mesh=self.mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(query, key, value)
 

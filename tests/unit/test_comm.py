@@ -1,0 +1,126 @@
+"""Tests for deepspeed_tpu.comm — facade collectives inside shard_map over
+the 8-device virtual mesh (the analogue of the reference's
+``tests/unit/comm/test_dist.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu import comm
+from deepspeed_tpu.comm.comms_logging import calc_bw_log, get_comms_logger
+
+
+@pytest.fixture
+def mesh(devices8):
+    return Mesh(np.asarray(devices8), ("data",))
+
+
+def test_all_reduce_sum(mesh):
+    x = jnp.arange(8.0)
+
+    f = shard_map(lambda v: comm.all_reduce(v, "sum", axis_name="data"),
+                  mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    out = f(x)
+    np.testing.assert_allclose(out, np.full(8, x.sum()))
+
+
+def test_all_reduce_avg_and_max(mesh):
+    x = jnp.arange(8.0)
+    favg = shard_map(lambda v: comm.all_reduce(v, "avg", axis_name="data"),
+                     mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    np.testing.assert_allclose(favg(x), np.full(8, x.mean()))
+    fmax = shard_map(lambda v: comm.all_reduce(v, "max", axis_name="data"),
+                     mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    np.testing.assert_allclose(fmax(x), np.full(8, 7.0))
+
+
+def test_all_gather_reduce_scatter_roundtrip(mesh):
+    x = jnp.arange(16.0).reshape(8, 2)
+
+    def body(v):  # v: [1, 2] per rank
+        g = comm.all_gather(v, axis_name="data", axis=0)   # [8, 2]
+        return comm.reduce_scatter(g, axis_name="data", axis=0)  # [1, 2]
+
+    f = shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    out = f(x)
+    # reduce_scatter(all_gather(x)) = 8 * x
+    np.testing.assert_allclose(out, 8.0 * np.asarray(x))
+
+
+def test_all_to_all_single(mesh):
+    # each rank holds a row of 8 values; a2a transposes rank/col blocks
+    x = jnp.arange(64.0).reshape(8, 8)
+
+    def body(v):  # [1, 8]
+        return comm.all_to_all_single(v[0], axis_name="seq", split_axis=0,
+                                      concat_axis=0)[None]
+
+    m = Mesh(np.asarray(jax.devices()), ("seq",))
+    f = shard_map(body, mesh=m, in_specs=P("seq"), out_specs=P("seq"))
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(out, np.asarray(x).reshape(8, 8).T)
+
+
+def test_broadcast(mesh):
+    x = jnp.arange(8.0)
+    f = shard_map(lambda v: comm.broadcast(v, src=3, axis_name="data"),
+                  mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    np.testing.assert_allclose(f(x), np.full(8, 3.0))
+
+
+def test_ppermute_ring(mesh):
+    x = jnp.arange(8.0)
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+    f = shard_map(lambda v: comm.ppermute(v, perm, axis_name="data"),
+                  mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    np.testing.assert_allclose(f(x), np.roll(np.arange(8.0), 1))
+
+
+def test_comms_logger_records_and_summarizes(mesh):
+    lg = get_comms_logger()
+    lg.reset()
+    lg.configure(enabled=True, prof_all=True)
+    x = jnp.arange(8.0)
+    f = shard_map(lambda v: comm.all_reduce(v, "sum", axis_name="data"),
+                  mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    f(x)  # trace records volume
+    assert "all_reduce" in lg.comms_dict
+    summary = comm.log_summary()
+    assert "all_reduce" in summary
+    lg.configure(enabled=False)
+    lg.reset()
+
+
+def test_calc_bw_log_math():
+    # allreduce: 2x size, bus = algo*(n-1)/n
+    algo, bus = calc_bw_log("all_reduce", 1 << 30, 1.0, 8)
+    assert algo == pytest.approx(2 * (1 << 30) / 1e9)
+    assert bus == pytest.approx(algo * 7 / 8)
+    # allgather: n x size
+    algo, bus = calc_bw_log("all_gather", 1 << 20, 0.5, 4)
+    assert algo == pytest.approx(4 * (1 << 20) / 0.5 / 1e9)
+    # p2p
+    algo, bus = calc_bw_log("ppermute", 1000, 1.0, 8)
+    assert algo == bus == pytest.approx(1000 / 1e9)
+
+
+def test_init_distributed_single_host_noop():
+    comm.init_distributed()
+    assert comm.is_initialized()
+    assert comm.get_world_size() == 8
+    assert comm.get_rank() == 0
+    assert comm.get_local_rank() == 0
+
+
+def test_mpi_discovery_env(monkeypatch):
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "3")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "16")
+    monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
+    monkeypatch.setenv("MASTER_PORT", "1234")
+    found = comm.mpi_discovery()
+    assert found == {"process_id": 3, "num_processes": 16,
+                     "coordinator_address": "10.0.0.1",
+                     "coordinator_port": 1234}
