@@ -34,6 +34,9 @@ class GPT2Config:
     param_dtype: Any = jnp.float32
     remat: bool = False
     use_bias: bool = True
+    # "auto": Pallas flash attention on TPU, XLA fused attention elsewhere;
+    # "flash" / "xla" force one path.
+    attention_impl: str = "auto"
 
     @staticmethod
     def tiny(**kw):
@@ -64,8 +67,27 @@ class CausalSelfAttention(nn.Module):
         q = q.reshape(B, T, H, D)
         k = k.reshape(B, T, H, D)
         v = v.reshape(B, T, H, D)
-        # jax.nn.dot_product_attention lowers to a fused attention on TPU
-        y = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+        impl = cfg.attention_impl
+        if impl == "auto":
+            # Pallas custom calls carry no GSPMD partitioning rules: under a
+            # multi-device jit, XLA would replicate q/k/v around the kernel.
+            # Auto therefore picks flash only for single-device TPU; sharded
+            # meshes keep the XLA fused attention (which GSPMD partitions),
+            # and the SP paths (ulysses/ring) invoke the kernel inside their
+            # own shard_map where shapes are already local.
+            single_dev = jax.device_count() == 1
+            impl = "flash" if (jax.default_backend() == "tpu"
+                               and single_dev) else "xla"
+        if impl == "flash":
+            from deepspeed_tpu.ops.kernels import flash_attention
+            y = flash_attention(q, k, v, causal=True, layout="BTHD")
+        elif impl == "xla":
+            # jax.nn.dot_product_attention lowers to a fused attention on TPU
+            y = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+        else:
+            raise ValueError(
+                f"attention_impl must be 'auto', 'flash' or 'xla', "
+                f"got {cfg.attention_impl!r}")
         y = y.reshape(B, T, C)
         y = nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                      use_bias=cfg.use_bias, name="c_proj")(y)

@@ -1,0 +1,410 @@
+"""Flash attention (Pallas TPU) — forward + full backward.
+
+TPU-native replacement for the capability class of the reference's fused
+attention kernels (``csrc/transformer/`` softmax/attention fusions and the
+training transformer block, SURVEY.md §2.6): online-softmax tiling keeps the
+S×S score matrix out of HBM, so activation memory is O(S) and the matmuls
+stay MXU-shaped (block_q × d, block_k × d tiles).
+
+Layout: kernels operate on (batch, heads, seq, head_dim). The public wrapper
+accepts BTHD (flax convention) or BHTD, pads sequence lengths to block
+multiples (masked), and broadcasts GQA KV heads.
+
+Backward follows the standard FlashAttention-2 recipe: forward additionally
+emits logsumexp; dq is accumulated over KV blocks, dk/dv over Q blocks, with
+delta = rowsum(dO * O) precomputed outside the kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float("-inf")
+_LANES = 128
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, sm_scale, causal, block_q, block_k, kv_len, causal_offset):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, _NEG_INF, m_scr.dtype)
+        l_scr[:] = jnp.zeros(l_scr.shape, l_scr.dtype)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, acc_scr.dtype)
+
+    run = True
+    if causal:
+        # skip blocks strictly above the (bottom-right-aligned) diagonal
+        run = ki * block_k <= qi * block_q + (block_q - 1) + causal_offset
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+        col = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = col < kv_len
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, row + causal_offset >= col)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:]                                   # (bq, LANES)
+        l_prev = l_scr[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)           # (bq, 1)
+        m_next = jnp.maximum(m_prev, m_cur)                 # (bq, LANES)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next[:, :1])                      # (bq, bk)
+        l_scr[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[:] = m_next
+
+        v = v_ref[0, 0]
+        pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        # fully-masked padded rows have l == 0; emit zeros, lse = -inf
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scr[:, 0] + jnp.log(jnp.where(l[:, 0] == 0.0,
+                                                         1.0, l[:, 0])))
+
+
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len, causal_offset,
+         interpret):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    nq, nk = tq // block_q, tk // block_k
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, kv_len=kv_len,
+        causal_offset=causal_offset)
+    grid = (b, h, nq, nk)
+    out_shape = [
+        jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
+        jax.ShapeDtypeStruct((b, h, tq), jnp.float32),
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, sm_scale, causal, block_q, block_k, kv_len,
+                   causal_offset):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros(dq_scr.shape, dq_scr.dtype)
+
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + (block_q - 1) + causal_offset
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0].reshape(block_q, 1)
+        delta = delta_ref[0, 0].reshape(block_q, 1)
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        col = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = col < kv_len
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, row + causal_offset >= col)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, sm_scale, causal, block_q, block_k, kv_len,
+                    causal_offset):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros(dk_scr.shape, dk_scr.dtype)
+        dv_scr[:] = jnp.zeros(dv_scr.shape, dv_scr.dtype)
+
+    run = True
+    if causal:
+        run = qi * block_q + (block_q - 1) + causal_offset >= ki * block_k
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0].reshape(block_q, 1)
+        delta = delta_ref[0, 0].reshape(block_q, 1)
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        col = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = col < kv_len
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, row + causal_offset >= col)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)            # (bq, bk)
+        dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale                      # (bq, bk)
+        dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset, interpret,
+         res, g):
+    q, k, v, o, lse = res
+    do = g[0]
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    nq, nk = tq // block_q, tk // block_k
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                   # (b, h, tq)
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, j, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, i, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, j))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, kv_len=kv_len,
+                          causal_offset=causal_offset),
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, kv_len=kv_len,
+                          causal_offset=causal_offset),
+        grid=(b, h, nk, nq),
+        in_specs=[
+            q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, kv_len, causal_offset,
+           interpret):
+    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len,
+                causal_offset, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len,
+               causal_offset, interpret):
+    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len,
+                  causal_offset, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset,
+               interpret, res, g):
+    return _bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset,
+                interpret, res, (g,))
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public wrapper
+# ---------------------------------------------------------------------------
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    causal: bool = True, sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    layout: str = "BTHD",
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Tiled online-softmax attention; differentiable (custom VJP).
+
+    Args:
+      q: (B, T, H, D) [layout="BTHD", flax convention] or (B, H, T, D).
+      k, v: same layout; KV head count may divide H (GQA — heads broadcast).
+      causal: lower-triangular mask.
+      sm_scale: softmax scale, default 1/sqrt(D).
+      interpret: run the Pallas interpreter (defaults to True off-TPU).
+    """
+    if interpret is None:
+        from . import default_interpret
+        interpret = default_interpret()
+    if layout == "BTHD":
+        q, k, v = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    elif layout != "BHTD":
+        raise ValueError(f"unknown layout {layout!r}")
+
+    b, h, tq, d = q.shape
+    hk = k.shape[1]
+    if hk != h:
+        if h % hk:
+            raise ValueError(f"GQA requires q_heads % kv_heads == 0 ({h}/{hk})")
+        # TODO(perf): broadcast via a h -> h // group BlockSpec index map
+        # instead of materializing repeated K/V (needs a grouped dk/dv
+        # accumulation order in the backward kernel).
+        k = jnp.repeat(k, h // hk, axis=1)
+        v = jnp.repeat(v, h // hk, axis=1)
+    tk = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+
+    block_q = min(block_q, _round_up(tq, _LANES))
+    block_k = min(block_k, _round_up(tk, _LANES))
+    tq_p, tk_p = _round_up(tq, block_q), _round_up(tk, block_k)
+    pad_q, pad_k = tq_p - tq, tk_p - tk
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+
+    # bottom-right-aligned causal diagonal (matches jnp.tril(..., k=tk-tq)
+    # and jax.nn.dot_product_attention): decode-style tq < tk attends the
+    # whole prefix.
+    o = _flash(q, k, v, causal, float(sm_scale), block_q, block_k, tk,
+               tk - tq, interpret)
+    if pad_q:
+        o = o[:, :, :tq, :]
+    if layout == "BTHD":
+        o = jnp.swapaxes(o, 1, 2)
+    return o
+
+
+def attention_reference(q, k, v, *, causal=True, sm_scale=None,
+                        layout="BTHD"):
+    """Pure-jnp reference used by the kernel parity tests."""
+    if layout == "BTHD":
+        q, k, v = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    b, h, tq, d = q.shape
+    hk = k.shape[1]
+    if hk != h:
+        k = jnp.repeat(k, h // hk, axis=1)
+        v = jnp.repeat(v, h // hk, axis=1)
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        tk = k.shape[2]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    o = o.astype(q.dtype)
+    if layout == "BTHD":
+        o = jnp.swapaxes(o, 1, 2)
+    return o

@@ -1,0 +1,115 @@
+"""Fused AdamW update kernel — the multi-tensor-apply analogue.
+
+Capability parity with the reference's ``FusedAdam``
+(``csrc/adam/multi_tensor_adam.cu``, SURVEY.md §2.6): one kernel pass updates
+param/m/v in place (``input_output_aliases``) from a flat f32 buffer, with
+bias correction and decoupled weight decay. The engine's default optimizer
+path is optax (XLA already emits one fused loop per dtype); this kernel is
+the explicit-VMEM alternative for flat-buffer optimizer paths (e.g. offloaded
+ZeRO partitions), validated against optax.adamw in the kernel tests. It is
+not yet wired into the ``optimizer.type`` dispatch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_ROW = 8
+
+
+def _adamw_kernel(hyper_ref, p_ref, g_ref, m_ref, v_ref,
+                  po_ref, mo_ref, vo_ref):
+    lr = hyper_ref[0]
+    b1 = hyper_ref[1]
+    b2 = hyper_ref[2]
+    eps = hyper_ref[3]
+    wd = hyper_ref[4]
+    c1 = hyper_ref[5]          # 1 / (1 - b1^t)
+    c2 = hyper_ref[6]          # 1 / (1 - b2^t)
+
+    g = g_ref[:].astype(jnp.float32)
+    m = b1 * m_ref[:] + (1.0 - b1) * g
+    v = b2 * v_ref[:] + (1.0 - b2) * g * g
+    mo_ref[:] = m
+    vo_ref[:] = v
+    update = (m * c1) / (jnp.sqrt(v * c2) + eps)
+    p = p_ref[:]
+    po_ref[:] = p - lr * (update + wd * p)
+
+
+def fused_adamw_update(
+    p: jnp.ndarray, g: jnp.ndarray, m: jnp.ndarray, v: jnp.ndarray,
+    step: jnp.ndarray, *, lr, b1: float = 0.9, b2: float = 0.999,
+    eps: float = 1e-8, weight_decay: float = 0.0,
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One fused AdamW step over flat f32 buffers.
+
+    Args:
+      p, m, v: flat f32 param / first-moment / second-moment buffers.
+      g: flat gradient buffer (any float dtype; cast to f32 in-kernel).
+      step: 1-based step count (traced scalar ok) for bias correction.
+      lr: learning rate (float or traced scalar).
+    Returns: (new_p, new_m, new_v).
+    """
+    if interpret is None:
+        from . import default_interpret
+        interpret = default_interpret()
+    n = p.shape[0]
+    width = _ROW * _LANES
+    pad = (-n) % width
+    if pad:
+        p, g, m, v = (jnp.pad(x, (0, pad)) for x in (p, g, m, v))
+    rows = (n + pad) // _LANES
+    p2, g2, m2, v2 = (x.reshape(rows, _LANES) for x in (p, g, m, v))
+
+    t = jnp.asarray(step, jnp.float32)
+    hyper = jnp.stack([
+        jnp.asarray(lr, jnp.float32),
+        jnp.asarray(b1, jnp.float32),
+        jnp.asarray(b2, jnp.float32),
+        jnp.asarray(eps, jnp.float32),
+        jnp.asarray(weight_decay, jnp.float32),
+        1.0 / (1.0 - jnp.asarray(b1, jnp.float32) ** t),
+        1.0 / (1.0 - jnp.asarray(b2, jnp.float32) ** t),
+        jnp.float32(0.0),
+    ])
+
+    br = _ROW
+    for cand in (512, 256, 64, 32, 16, 8):
+        if rows % cand == 0:
+            br = cand
+            break
+    row = pl.BlockSpec((br, _LANES), lambda i: (i, 0))
+    po, mo, vo = pl.pallas_call(
+        _adamw_kernel,
+        grid=(rows // br,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  row, row, row, row],
+        out_specs=[row, row, row],
+        out_shape=[jax.ShapeDtypeStruct((rows, _LANES), jnp.float32)] * 3,
+        input_output_aliases={1: 0, 3: 1, 4: 2},
+        interpret=interpret,
+    )(hyper, p2, g2, m2, v2)
+    po, mo, vo = (x.reshape(-1)[:n] for x in (po, mo, vo))
+    return po, mo, vo
+
+
+def adamw_reference(p, g, m, v, step, *, lr, b1=0.9, b2=0.999, eps=1e-8,
+                    weight_decay=0.0):
+    """jnp reference for the parity tests."""
+    g = g.astype(jnp.float32)
+    t = jnp.asarray(step, jnp.float32)
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    mhat = m / (1 - b1 ** t)
+    vhat = v / (1 - b2 ** t)
+    p = p - lr * (mhat / (jnp.sqrt(vhat) + eps) + weight_decay * p)
+    return p, m, v

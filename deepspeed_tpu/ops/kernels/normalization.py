@@ -1,0 +1,159 @@
+"""Fused LayerNorm / RMSNorm Pallas kernels.
+
+Capability parity with the reference's norm kernels
+(``csrc/transformer/inference/csrc/layer_norm.cu`` / ``rms_norm.cu``,
+SURVEY.md §2.6): a single VMEM pass computes statistics and the normalized
+output, keeping the row resident on-chip. Backward is hand-derived jnp (one
+XLA fusion) via ``jax.custom_vjp`` — on TPU the bwd is bandwidth-bound either
+way, so the win is the explicit fwd fusion plus f32 statistics under bf16 IO.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _row_block(n_rows: int) -> int:
+    for cand in (256, 128, 64, 32, 16, 8):
+        if n_rows % cand == 0:
+            return cand
+    return n_rows
+
+
+# ---------------------------------------------------------------------------
+# RMSNorm
+# ---------------------------------------------------------------------------
+
+
+def _rms_kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(var + eps)
+    o_ref[:] = (y * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _rms_fwd_pallas(x2d, w, eps, interpret):
+    rows, hidden = x2d.shape
+    br = _row_block(rows)
+    return pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps),
+        grid=(rows // br,),
+        in_specs=[pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+                  pl.BlockSpec((hidden,), lambda i: (0,))],
+        out_specs=pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+        interpret=interpret,
+    )(x2d, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms(x2d, w, eps, interpret):
+    return _rms_fwd_pallas(x2d, w, eps, interpret)
+
+
+def _rms_fwd(x2d, w, eps, interpret):
+    return _rms_fwd_pallas(x2d, w, eps, interpret), (x2d, w)
+
+
+def _rms_bwd(eps, interpret, res, g):
+    x, w = res
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    rstd = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    xhat = xf * rstd
+    dw = jnp.sum(gf * xhat, axis=0).astype(w.dtype)
+    gw = gf * wf
+    dx = rstd * (gw - xhat * jnp.mean(gw * xhat, axis=-1, keepdims=True))
+    return dx.astype(x.dtype), dw
+
+
+_rms.defvjp(_rms_fwd, _rms_bwd)
+
+
+def fused_rms_norm(x: jnp.ndarray, weight: jnp.ndarray, *, eps: float = 1e-6,
+                   interpret: Optional[bool] = None) -> jnp.ndarray:
+    """RMSNorm over the last axis; f32 statistics regardless of input dtype."""
+    if interpret is None:
+        from . import default_interpret
+        interpret = default_interpret()
+    shape = x.shape
+    x2d = x.reshape(-1, shape[-1])
+    return _rms(x2d, weight, float(eps), interpret).reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# LayerNorm
+# ---------------------------------------------------------------------------
+
+
+def _ln_kernel(x_ref, w_ref, b_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    y = xc * jax.lax.rsqrt(var + eps)
+    o_ref[:] = (y * w_ref[:].astype(jnp.float32)
+                + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _ln_fwd_pallas(x2d, w, b, eps, interpret):
+    rows, hidden = x2d.shape
+    br = _row_block(rows)
+    return pl.pallas_call(
+        functools.partial(_ln_kernel, eps=eps),
+        grid=(rows // br,),
+        in_specs=[pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+                  pl.BlockSpec((hidden,), lambda i: (0,)),
+                  pl.BlockSpec((hidden,), lambda i: (0,))],
+        out_specs=pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+        interpret=interpret,
+    )(x2d, w, b)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ln(x2d, w, b, eps, interpret):
+    return _ln_fwd_pallas(x2d, w, b, eps, interpret)
+
+
+def _ln_fwd(x2d, w, b, eps, interpret):
+    return _ln_fwd_pallas(x2d, w, b, eps, interpret), (x2d, w)
+
+
+def _ln_bwd(eps, interpret, res, g):
+    x, w = res
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    xc = xf - mu
+    rstd = jax.lax.rsqrt(jnp.mean(xc * xc, axis=-1, keepdims=True) + eps)
+    xhat = xc * rstd
+    dw = jnp.sum(gf * xhat, axis=0).astype(w.dtype)
+    db = jnp.sum(gf, axis=0).astype(w.dtype)
+    gw = gf * wf
+    dx = rstd * (gw - jnp.mean(gw, axis=-1, keepdims=True)
+                 - xhat * jnp.mean(gw * xhat, axis=-1, keepdims=True))
+    return dx.astype(x.dtype), dw, db
+
+
+_ln.defvjp(_ln_fwd, _ln_bwd)
+
+
+def fused_layer_norm(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray,
+                     *, eps: float = 1e-5,
+                     interpret: Optional[bool] = None) -> jnp.ndarray:
+    """LayerNorm over the last axis; f32 statistics regardless of input dtype."""
+    if interpret is None:
+        from . import default_interpret
+        interpret = default_interpret()
+    shape = x.shape
+    x2d = x.reshape(-1, shape[-1])
+    return _ln(x2d, weight, bias, float(eps), interpret).reshape(shape)

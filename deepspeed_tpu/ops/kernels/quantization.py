@@ -1,0 +1,134 @@
+"""Group quantization kernels — int8 / int4, symmetric / asymmetric.
+
+Capability parity with the reference's ``csrc/quantization/`` family
+(SURVEY.md §2.6): group-wise quantize/dequantize used by ZeRO++ (quantized
+weights qwZ, quantized gradients qgZ), MoQ, and inference WOQ. A fused
+``quant_dequant`` provides the fake-quant path (MoQ training, qgZ
+dequant-reduce-requant emulation on the CPU mesh).
+
+Layout: input is reshaped to (num_groups, group_size); per-group statistics
+are computed in f32. int4 values are packed two-per-int8 (low nibble first).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+class QuantizedTensor(NamedTuple):
+    """Packed group-quantized tensor. ``values`` is int8 (packed for 4-bit),
+    ``scale``/``zero`` are (num_groups, 1) f32; ``shape``/``bits``/``group``
+    record how to undo the packing."""
+    values: jnp.ndarray
+    scale: jnp.ndarray
+    zero: Optional[jnp.ndarray]
+    shape: Tuple[int, ...]
+    bits: int
+    group_size: int
+
+
+def _reshape_groups(x: jnp.ndarray, group_size: int) -> jnp.ndarray:
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    if n % group_size:
+        flat = jnp.pad(flat, (0, group_size - n % group_size))
+    return flat.reshape(-1, group_size)
+
+
+def _quant_kernel(x_ref, v_ref, s_ref, *, qmax):
+    x = x_ref[:].astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-12) / qmax
+    s_ref[:] = scale
+    v_ref[:] = jnp.clip(jnp.round(x / scale), -qmax, qmax).astype(jnp.int8)
+
+
+def _quant_asym_kernel(x_ref, v_ref, s_ref, z_ref, *, qmax):
+    x = x_ref[:].astype(jnp.float32)
+    lo = jnp.min(x, axis=-1, keepdims=True)
+    hi = jnp.max(x, axis=-1, keepdims=True)
+    scale = jnp.maximum(hi - lo, 1e-12) / (2 * qmax)
+    s_ref[:] = scale
+    z_ref[:] = lo
+    v_ref[:] = jnp.clip(jnp.round((x - lo) / scale) - qmax,
+                        -qmax, qmax).astype(jnp.int8)
+
+
+def quantize_blockwise(x: jnp.ndarray, *, bits: int = 8, group_size: int = 256,
+                       symmetric: bool = True,
+                       interpret: Optional[bool] = None) -> QuantizedTensor:
+    """Group-quantize ``x`` to int8/int4 with per-group f32 scales."""
+    assert bits in (8, 4), bits
+    if interpret is None:
+        from . import default_interpret
+        interpret = default_interpret()
+    groups = _reshape_groups(x, group_size)
+    ng, gs = groups.shape
+    qmax = float(2 ** (bits - 1) - 1)
+    gb = min(256, ng)
+    while ng % gb:
+        gb //= 2
+    gb = max(gb, 1)
+    grid = (ng // gb,)
+    row = pl.BlockSpec((gb, gs), lambda i: (i, 0))
+    stat = pl.BlockSpec((gb, 1), lambda i: (i, 0))
+    if symmetric:
+        v, s = pl.pallas_call(
+            functools.partial(_quant_kernel, qmax=qmax),
+            grid=grid, in_specs=[row], out_specs=[row, stat],
+            out_shape=[jax.ShapeDtypeStruct((ng, gs), jnp.int8),
+                       jax.ShapeDtypeStruct((ng, 1), jnp.float32)],
+            interpret=interpret,
+        )(groups)
+        z = None
+    else:
+        v, s, z = pl.pallas_call(
+            functools.partial(_quant_asym_kernel, qmax=qmax),
+            grid=grid, in_specs=[row], out_specs=[row, stat, stat],
+            out_shape=[jax.ShapeDtypeStruct((ng, gs), jnp.int8),
+                       jax.ShapeDtypeStruct((ng, 1), jnp.float32),
+                       jax.ShapeDtypeStruct((ng, 1), jnp.float32)],
+            interpret=interpret,
+        )(groups)
+    if bits == 4:
+        # pack adjacent pairs: low nibble = even index, high nibble = odd
+        lo = v[:, 0::2].astype(jnp.int32) & 0xF
+        hi = v[:, 1::2].astype(jnp.int32) & 0xF
+        v = (lo | (hi << 4)).astype(jnp.int8)
+    return QuantizedTensor(v, s, z, tuple(x.shape), bits, group_size)
+
+
+def dequantize_blockwise(qt: QuantizedTensor,
+                         dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of :func:`quantize_blockwise` (jnp; XLA fuses the unpack)."""
+    v = qt.values
+    if qt.bits == 4:
+        raw = v.astype(jnp.int32) & 0xFF
+        lo = (raw & 0xF).astype(jnp.int8)
+        hi = ((raw >> 4) & 0xF).astype(jnp.int8)
+        # sign-extend 4-bit two's complement
+        lo = jnp.where(lo > 7, lo - 16, lo)
+        hi = jnp.where(hi > 7, hi - 16, hi)
+        v = jnp.stack([lo, hi], axis=-1).reshape(v.shape[0], -1)
+    x = v.astype(jnp.float32) * qt.scale
+    if qt.zero is not None:
+        qmax = float(2 ** (qt.bits - 1) - 1)
+        x = x + qt.zero + qmax * qt.scale
+    n = 1
+    for d in qt.shape:
+        n *= d
+    return x.reshape(-1)[:n].reshape(qt.shape).astype(dtype)
+
+
+def quant_dequant(x: jnp.ndarray, *, bits: int = 8, group_size: int = 256,
+                  symmetric: bool = True,
+                  interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Fake-quant round trip (straight-through in callers that need grads)."""
+    qt = quantize_blockwise(x, bits=bits, group_size=group_size,
+                            symmetric=symmetric, interpret=interpret)
+    return dequantize_blockwise(qt, dtype=x.dtype)

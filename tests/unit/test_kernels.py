@@ -1,0 +1,238 @@
+"""Pallas kernel parity tests (interpret mode on the CPU mesh) — the analogue
+of the reference's per-op numerical tests under ``tests/unit/ops/``."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.kernels import (
+    dequantize_blockwise,
+    flash_attention,
+    fused_adamw_update,
+    fused_layer_norm,
+    fused_rms_norm,
+    quant_dequant,
+    quantize_blockwise,
+)
+from deepspeed_tpu.ops.kernels.flash_attention import attention_reference
+from deepspeed_tpu.ops.kernels.fused_optimizer import adamw_reference
+
+
+def _rand(key, shape, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("t", [128, 80])  # 80 exercises padding+mask
+    def test_forward_parity(self, causal, t):
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = _rand(k1, (2, t, 2, 32))
+        k = _rand(k2, (2, t, 2, 32))
+        v = _rand(k3, (2, t, 2, 32))
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_gqa_forward(self):
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(1), 3)
+        q = _rand(k1, (1, 128, 4, 16))
+        k = _rand(k2, (1, 128, 2, 16))
+        v = _rand(k3, (1, 128, 2, 16))
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_gradient_parity(self, causal):
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(2), 3)
+        q = _rand(k1, (1, 128, 2, 16))
+        k = _rand(k2, (1, 128, 2, 16))
+        v = _rand(k3, (1, 128, 2, 16))
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=causal, interpret=True)
+            return jnp.sum(jnp.sin(o))
+
+        def loss_ref(q, k, v):
+            return jnp.sum(jnp.sin(attention_reference(q, k, v, causal=causal)))
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4)
+
+    def test_gradient_parity_padded(self):
+        """Padded (non-multiple-of-block) sequence: grads must match too."""
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(3), 3)
+        q = _rand(k1, (1, 72, 2, 16))
+        k = _rand(k2, (1, 72, 2, 16))
+        v = _rand(k3, (1, 72, 2, 16))
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           interpret=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4)
+
+    @pytest.mark.parametrize("tq,tk", [(1, 128), (64, 256), (96, 160)])
+    def test_causal_decode_alignment(self, tq, tk):
+        """q_len != kv_len: causal diagonal is bottom-right aligned (decode
+        over a prefix attends the whole prefix)."""
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(7), 3)
+        q = _rand(k1, (1, tq, 2, 16))
+        k = _rand(k2, (1, tk, 2, 16))
+        v = _rand(k3, (1, tk, 2, 16))
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_attention_impl_validation(self):
+        from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+        cfg = GPT2Config.tiny(attention_impl="typo", dtype=jnp.float32)
+        model = GPT2(cfg)
+        toks = jnp.zeros((1, 8), jnp.int32)
+        with pytest.raises(ValueError, match="attention_impl"):
+            model.init(jax.random.PRNGKey(0), toks)
+
+    def test_multi_block(self):
+        """Sequence spanning several KV blocks (online-softmax accumulation)."""
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(4), 3)
+        q = _rand(k1, (1, 256, 1, 16))
+        k = _rand(k2, (1, 256, 1, 16))
+        v = _rand(k3, (1, 256, 1, 16))
+        out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                              interpret=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+class TestNorms:
+    def test_rms_norm(self):
+        x = _rand(jax.random.PRNGKey(0), (64, 256))
+        w = 1.0 + 0.1 * _rand(jax.random.PRNGKey(1), (256,))
+        out = fused_rms_norm(x, w, interpret=True)
+        ref = (x / jnp.sqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6)) * w
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_rms_norm_grad(self):
+        x = _rand(jax.random.PRNGKey(2), (32, 128))
+        w = 1.0 + 0.1 * _rand(jax.random.PRNGKey(3), (128,))
+
+        def f_fused(x, w):
+            return jnp.sum(fused_rms_norm(x, w, interpret=True) ** 2)
+
+        def f_ref(x, w):
+            y = (x / jnp.sqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6)) * w
+            return jnp.sum(y ** 2)
+
+        gx1, gw1 = jax.grad(f_fused, (0, 1))(x, w)
+        gx2, gw2 = jax.grad(f_ref, (0, 1))(x, w)
+        np.testing.assert_allclose(gx1, gx2, atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(gw1, gw2, atol=1e-4, rtol=1e-4)
+
+    def test_layer_norm(self):
+        x = _rand(jax.random.PRNGKey(4), (48, 192))
+        w = 1.0 + 0.1 * _rand(jax.random.PRNGKey(5), (192,))
+        b = 0.1 * _rand(jax.random.PRNGKey(6), (192,))
+        out = fused_layer_norm(x, w, b, interpret=True)
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        ref = (x - mu) / jnp.sqrt(var + 1e-5) * w + b
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_layer_norm_grad(self):
+        x = _rand(jax.random.PRNGKey(7), (16, 128))
+        w = 1.0 + 0.1 * _rand(jax.random.PRNGKey(8), (128,))
+        b = 0.1 * _rand(jax.random.PRNGKey(9), (128,))
+
+        def f_fused(x, w, b):
+            return jnp.sum(jnp.cos(fused_layer_norm(x, w, b, interpret=True)))
+
+        def f_ref(x, w, b):
+            mu = jnp.mean(x, -1, keepdims=True)
+            y = (x - mu) / jnp.sqrt(jnp.var(x, -1, keepdims=True) + 1e-5)
+            return jnp.sum(jnp.cos(y * w + b))
+
+        g1 = jax.grad(f_fused, (0, 1, 2))(x, w, b)
+        g2 = jax.grad(f_ref, (0, 1, 2))(x, w, b)
+        for a, c in zip(g1, g2):
+            np.testing.assert_allclose(a, c, atol=1e-4, rtol=1e-4)
+
+    def test_bf16_io_f32_stats(self):
+        x = _rand(jax.random.PRNGKey(10), (32, 128)).astype(jnp.bfloat16)
+        w = jnp.ones((128,), jnp.bfloat16)
+        out = fused_rms_norm(x, w, interpret=True)
+        assert out.dtype == jnp.bfloat16
+
+
+class TestQuantization:
+    @pytest.mark.parametrize("bits,tol", [(8, 0.02), (4, 0.35)])
+    @pytest.mark.parametrize("symmetric", [True, False])
+    def test_round_trip(self, bits, tol, symmetric):
+        x = _rand(jax.random.PRNGKey(0), (1024,)) * 3.0
+        qt = quantize_blockwise(x, bits=bits, group_size=128,
+                                symmetric=symmetric, interpret=True)
+        out = dequantize_blockwise(qt)
+        err = float(jnp.max(jnp.abs(out - x)))
+        scale_mag = float(jnp.max(jnp.abs(x)))
+        assert err < tol * scale_mag, err
+
+    def test_non_divisible_length(self):
+        x = _rand(jax.random.PRNGKey(1), (1000,))
+        out = quant_dequant(x, bits=8, group_size=128, interpret=True)
+        assert out.shape == x.shape
+        assert float(jnp.max(jnp.abs(out - x))) < 0.1
+
+    def test_shape_preserved(self):
+        x = _rand(jax.random.PRNGKey(2), (8, 32, 16))
+        out = quant_dequant(x, bits=8, group_size=64, interpret=True)
+        assert out.shape == x.shape
+
+    def test_int4_packing_halves_bytes(self):
+        x = _rand(jax.random.PRNGKey(3), (512,))
+        q8 = quantize_blockwise(x, bits=8, group_size=128, interpret=True)
+        q4 = quantize_blockwise(x, bits=4, group_size=128, interpret=True)
+        assert q4.values.size == q8.values.size // 2
+
+
+class TestFusedAdamW:
+    @pytest.mark.parametrize("n", [1024, 1000])  # 1000 exercises padding
+    def test_parity_with_reference(self, n):
+        keys = jax.random.split(jax.random.PRNGKey(0), 4)
+        p = _rand(keys[0], (n,))
+        g = _rand(keys[1], (n,))
+        m = 0.1 * _rand(keys[2], (n,))
+        v = jnp.abs(0.1 * _rand(keys[3], (n,)))
+        kw = dict(lr=1e-3, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+        p1, m1, v1 = fused_adamw_update(p, g, m, v, 3, interpret=True, **kw)
+        p2, m2, v2 = adamw_reference(p, g, m, v, 3, **kw)
+        np.testing.assert_allclose(p1, p2, atol=1e-6, rtol=1e-6)
+        np.testing.assert_allclose(m1, m2, atol=1e-6, rtol=1e-6)
+        np.testing.assert_allclose(v1, v2, atol=1e-6, rtol=1e-6)
+
+    def test_multi_step_matches_optax_adamw(self):
+        import optax
+        n = 512
+        p = _rand(jax.random.PRNGKey(1), (n,))
+        tx = optax.adamw(1e-3, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+        state = tx.init(p)
+        p_opx = p
+        p_fused = p
+        m = jnp.zeros((n,))
+        v = jnp.zeros((n,))
+        for t in range(1, 4):
+            g = _rand(jax.random.PRNGKey(10 + t), (n,))
+            upd, state = tx.update(g, state, p_opx)
+            p_opx = optax.apply_updates(p_opx, upd)
+            p_fused, m, v = fused_adamw_update(
+                p_fused, g, m, v, t, lr=1e-3, weight_decay=0.01,
+                interpret=True)
+        np.testing.assert_allclose(p_fused, p_opx, atol=1e-5, rtol=1e-5)
