@@ -1,0 +1,24 @@
+"""FastGen-class ragged inference engine (v2).
+
+TPU-native re-design of the reference's ``deepspeed/inference/v2/``
+(``InferenceEngineV2`` ``v2/engine_v2.py:30``, ragged state
+``v2/ragged/``): continuous batching over a paged (blocked) KV cache with a
+Dynamic-SplitFuse-style token scheduler. The TPU twist (SURVEY.md §7 hard
+part 3): the scheduler emits a *fixed-shape* ragged batch — ``max_seqs``
+slots × ``chunk_size`` tokens — so every decode/prefill step reuses ONE
+compiled XLA program; raggedness lives in host-side metadata (block tables,
+lengths), never in array shapes.
+"""
+
+from .blocked_allocator import BlockedAllocator
+from .config import RaggedInferenceConfig
+from .engine_v2 import InferenceEngineV2
+from .kv_cache import BlockedKVCache
+from .sequence import SequenceDescriptor, SequenceStatus
+from .state_manager import StateManager
+
+__all__ = [
+    "BlockedAllocator", "BlockedKVCache", "InferenceEngineV2",
+    "RaggedInferenceConfig", "SequenceDescriptor", "SequenceStatus",
+    "StateManager",
+]

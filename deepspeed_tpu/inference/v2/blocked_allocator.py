@@ -1,0 +1,46 @@
+"""Paged KV block allocator.
+
+Analogue of the reference's ``BlockedAllocator``
+(``inference/v2/ragged/blocked_allocator.py``): a free-list over a fixed pool
+of KV blocks. Host-side only — block ids flow into device block tables; the
+cache itself never moves.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+
+class OutOfBlocksError(RuntimeError):
+    pass
+
+
+class BlockedAllocator:
+    def __init__(self, num_blocks: int):
+        if num_blocks <= 0:
+            raise ValueError(f"num_blocks must be positive, got {num_blocks}")
+        self._num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+
+    @property
+    def num_blocks(self) -> int:
+        return self._num_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise OutOfBlocksError(
+                f"requested {n} blocks, only {len(self._free)} free")
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if not 0 <= b < self._num_blocks:
+                raise ValueError(f"block id {b} out of range")
+        self._free.extend(blocks)
+        if len(self._free) > self._num_blocks:
+            raise RuntimeError("double free detected")

@@ -1,0 +1,44 @@
+"""Ragged engine configuration.
+
+Analogue of the reference's ``RaggedInferenceEngineConfig``
+(``inference/v2/config_v2.py``): state-manager sizing + scheduler knobs. The
+shape-defining fields (``max_seqs``, ``chunk_size``, ``max_blocks_per_seq``)
+are compile-time constants — one XLA program serves every step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ...config.config_utils import ConfigModel
+
+
+@dataclass
+class RaggedInferenceConfig(ConfigModel):
+    # scheduler shape (static): slots per batch × max tokens per slot per step
+    max_seqs: int = 8                 # reference: max_ragged_sequence_count
+    chunk_size: int = 128             # Dynamic-SplitFuse token chunk per seq
+    # KV pool
+    block_size: int = 64              # reference KVCacheConfig block granularity
+    num_blocks: int = 256             # pool size (blocks of block_size tokens)
+    max_blocks_per_seq: int = 32      # static width of the block table
+    dtype: str = "bfloat16"
+
+    # sampling defaults for the built-in generate loop
+    greedy: bool = True
+    temperature: float = 1.0
+    top_k: int = 0
+
+    def __post_init__(self):
+        if self.max_seqs <= 0 or self.chunk_size <= 0:
+            raise ValueError("max_seqs and chunk_size must be positive")
+        if self.block_size <= 0 or self.num_blocks <= 0:
+            raise ValueError("block_size and num_blocks must be positive")
+
+    @property
+    def max_context(self) -> int:
+        return self.max_blocks_per_seq * self.block_size
+
+    @property
+    def token_budget(self) -> int:
+        return self.max_seqs * self.chunk_size

@@ -1,0 +1,178 @@
+"""InferenceEngineV2 — continuous-batching ragged engine.
+
+Analogue of the reference's ``InferenceEngineV2`` (``inference/v2/
+engine_v2.py:30``): ``put(batch_uids, batch_tokens)`` feeds tokens for any
+mix of new prompts and decode continuations, runs one fixed-shape forward
+over whatever the SplitFuse scheduler picked, and returns last-token logits
+for every sequence that completed its pending work this step. ``query`` /
+``can_schedule`` expose KV-pressure hints; ``flush`` releases sequence state.
+A built-in ``generate`` drives the put-loop with sampling for convenience.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ...utils.dtypes import resolve_dtype
+from ...utils.logging import log_dist
+from ..config import InferenceConfig
+from .config import RaggedInferenceConfig
+from .kv_cache import BlockedKVCache
+from .model_runner import GPT2RaggedRunner, RaggedBatch
+from .scheduler import SplitFuseScheduler
+from .sequence import SequenceStatus
+from .state_manager import StateManager
+
+
+class InferenceEngineV2:
+    def __init__(self, model_cfg: Any, params: Any,
+                 config: Optional[RaggedInferenceConfig] = None,
+                 runner: Any = None):
+        """``model_cfg``: a model config understood by a ragged runner
+        (GPT2Config here; llama-family runners register the same interface).
+        ``params``: the matching param pytree."""
+        self.config = config or RaggedInferenceConfig()
+        self.params = params
+        self.runner = runner or GPT2RaggedRunner(model_cfg, self.config)
+        self.kv_cache = BlockedKVCache(
+            self.config, self.runner.num_layers, self.runner.kv_heads,
+            self.runner.head_dim, dtype=resolve_dtype(self.config.dtype))
+        self.state = StateManager(self.config, self.kv_cache)
+        self.scheduler = SplitFuseScheduler(self.config, self.state)
+        self._kv_data = self.kv_cache.data
+        log_dist(
+            f"InferenceEngineV2 ready: {self.config.max_seqs} slots x "
+            f"{self.config.chunk_size} tokens, "
+            f"{self.config.num_blocks} KV blocks x {self.config.block_size}")
+
+    # ------------------------------------------------------------------ #
+    # reference-parity surface
+    # ------------------------------------------------------------------ #
+
+    def put(self, batch_uids: Sequence[int],
+            batch_tokens: Sequence[Sequence[int]]) -> Dict[int, np.ndarray]:
+        """Feed tokens, run scheduled steps until all fed work is consumed,
+        return {uid: last-token logits} for sequences with no pending work."""
+        for uid, toks in zip(batch_uids, batch_tokens):
+            self.state.put_tokens(uid, toks)
+        done: Dict[int, np.ndarray] = {}
+        while any(s.in_flight for s in self.state.sequences.values()):
+            n_scheduled, step_done = self._run_step()
+            if n_scheduled == 0:
+                # nothing schedulable but work remains -> KV pool exhausted
+                raise RuntimeError(
+                    "scheduler starved: KV pool too small for pending work "
+                    f"(free blocks={self.kv_cache.free_blocks})")
+            done.update(step_done)
+        return done
+
+    def query(self, uid: int) -> Tuple[int, int]:
+        """(tokens seen, max additional tokens before block exhaustion)."""
+        seq = self.state.get_or_create(uid)
+        free_local = self.config.max_blocks_per_seq - len(seq.kv_blocks)
+        free = min(free_local, self.kv_cache.free_blocks)
+        slack = len(seq.kv_blocks) * self.config.block_size - seq.seen_tokens
+        return seq.seen_tokens, slack + free * self.config.block_size
+
+    def can_schedule(self, uid: int, n_tokens: int) -> bool:
+        return self.state.can_schedule(uid, n_tokens)
+
+    def flush(self, uid: int) -> None:
+        self.state.flush(uid)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.kv_cache.free_blocks
+
+    # ------------------------------------------------------------------ #
+
+    def _run_step(self) -> Tuple[int, Dict[int, np.ndarray]]:
+        sched = self.scheduler.schedule()
+        if not sched:
+            return 0, {}
+        cfg = self.config
+        S, C, MAXB = cfg.max_seqs, cfg.chunk_size, cfg.max_blocks_per_seq
+        tokens = np.zeros((S, C), np.int32)
+        start = np.zeros((S,), np.int32)
+        ntok = np.zeros((S,), np.int32)
+        tables = np.zeros((S, MAXB), np.int32)
+        for i, item in enumerate(sched):
+            tokens[i, :len(item.tokens)] = item.tokens
+            start[i] = item.start_pos
+            ntok[i] = len(item.tokens)
+            tables[i, :len(item.seq.kv_blocks)] = item.seq.kv_blocks
+        batch = RaggedBatch(
+            tokens=jax.numpy.asarray(tokens),
+            start_pos=jax.numpy.asarray(start),
+            n_tokens=jax.numpy.asarray(ntok),
+            block_tables=jax.numpy.asarray(tables))
+        logits, self._kv_data = self.runner.step(self.params, self._kv_data,
+                                                 batch)
+        logits = np.asarray(logits)
+        out: Dict[int, np.ndarray] = {}
+        for i, item in enumerate(sched):
+            if item.is_last_chunk:
+                out[item.seq.uid] = logits[i]
+                item.seq.status = SequenceStatus.WAITING
+        return len(sched), out
+
+    # ------------------------------------------------------------------ #
+    # convenience generate loop
+    # ------------------------------------------------------------------ #
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None,
+                 sampling: Optional[InferenceConfig] = None,
+                 seed: int = 0) -> List[List[int]]:
+        """Continuous-batching generation: prompts enter the scheduler
+        together; decode steps fuse with any remaining prefill chunks."""
+        rng = np.random.default_rng(seed)
+        uids = list(range(len(prompts)))
+        live = set(uids)
+        outputs: Dict[int, List[int]] = {u: [] for u in uids}
+        logits = self.put(uids, [list(p) for p in prompts])
+        for _ in range(max_new_tokens):
+            feeds_u, feeds_t = [], []
+            for u in list(live):
+                if u not in logits:
+                    continue
+                nxt = self._sample(logits[u], sampling, rng)
+                outputs[u].append(nxt)
+                if (eos_token_id is not None and nxt == eos_token_id) or \
+                        len(outputs[u]) >= max_new_tokens:
+                    live.discard(u)
+                    self.flush(u)
+                else:
+                    feeds_u.append(u)
+                    feeds_t.append([nxt])
+            if not feeds_u:
+                break
+            logits = self.put(feeds_u, feeds_t)
+        for u in list(live):
+            self.flush(u)
+        return [outputs[u] for u in uids]
+
+    @staticmethod
+    def _sample(logits: np.ndarray, cfg: Optional[InferenceConfig],
+                rng: np.random.Generator) -> int:
+        if cfg is None or cfg.greedy:
+            return int(np.argmax(logits))
+        x = logits.astype(np.float64) / max(cfg.temperature, 1e-6)
+        if cfg.top_k > 0:
+            kth = np.partition(x, -cfg.top_k)[-cfg.top_k]
+            x = np.where(x < kth, -np.inf, x)
+        if cfg.top_p < 1.0:
+            order = np.argsort(-x)
+            probs = np.exp(x[order] - x[order[0]])
+            probs /= probs.sum()
+            keep = np.cumsum(probs) <= cfg.top_p
+            keep[0] = True
+            cut = order[~keep]
+            x[cut] = -np.inf
+        p = np.exp(x - x.max())
+        p /= p.sum()
+        return int(rng.choice(len(p), p=p))

@@ -1,0 +1,47 @@
+"""Blocked (paged) KV cache.
+
+Analogue of the reference's ``BlockedKVCache``
+(``inference/v2/ragged/kv_cache.py:40``): a fixed device-resident pool of KV
+blocks addressed through per-sequence block tables. Stored flat —
+``[layers, 2 (k/v), num_blocks * block_size, kv_heads, head_dim]`` — so KV
+append is one scatter and context gather is one take per step; block
+granularity exists only in the allocator and the block tables.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from .blocked_allocator import BlockedAllocator
+from .config import RaggedInferenceConfig
+
+
+class BlockedKVCache:
+    def __init__(self, cfg: RaggedInferenceConfig, num_layers: int,
+                 kv_heads: int, head_dim: int, dtype: Any = None):
+        self.cfg = cfg
+        self.num_layers = num_layers
+        self.kv_heads = kv_heads
+        self.head_dim = head_dim
+        self.dtype = dtype or jnp.bfloat16
+        self.allocator = BlockedAllocator(cfg.num_blocks)
+        # +1 trash slot: padded query positions scatter there, so they can
+        # never corrupt a live sequence's KV (see model_runner).
+        slots = cfg.num_blocks * cfg.block_size + 1
+        self.data = jnp.zeros(
+            (num_layers, 2, slots, kv_heads, head_dim), self.dtype)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.allocator.free_blocks
+
+    def reserve(self, n: int):
+        return self.allocator.allocate(n)
+
+    def free(self, blocks) -> None:
+        self.allocator.free(blocks)
+
+    def memory_bytes(self) -> int:
+        return self.data.size * self.data.dtype.itemsize

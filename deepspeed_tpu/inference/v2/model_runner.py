@@ -1,0 +1,143 @@
+"""Ragged model runners — paged-KV forward passes over fixed-shape batches.
+
+Analogue of the reference's v2 model implementations + ragged kernels
+(``inference/v2/model_implementations/``, ``inference/v2/kernels/ragged_ops/``:
+kv rotary/copy, blocked flash, logits_gather). One jitted ``step`` does, per
+layer: KV append (one scatter into the flat blocked cache), context gather
+through the block table (one take), masked attention, MLP — then gathers
+logits for each slot's last scheduled token only (the reference's
+``logits_gather``).
+
+Shapes are compile-time constant: ``[max_seqs, chunk_size]`` queries against
+``[max_seqs, max_context]`` gathered KV. Padded query positions scatter into
+a dedicated trash slot (the last cache row) so they can never corrupt live
+sequences' KV.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ...models.gpt2 import GPT2Config
+from .config import RaggedInferenceConfig
+
+
+class RaggedBatch(NamedTuple):
+    """Device-side view of one scheduled step (all shapes static)."""
+    tokens: jnp.ndarray        # [S, C] int32 (padded with 0)
+    start_pos: jnp.ndarray     # [S] int32 — absolute pos of tokens[s, 0]
+    n_tokens: jnp.ndarray      # [S] int32 — valid tokens this step (0 = idle)
+    block_tables: jnp.ndarray  # [S, MAXB] int32 (padded with 0)
+
+
+def _layer_norm(x, p, eps=1e-6):   # flax nn.LayerNorm default epsilon
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+
+
+class GPT2RaggedRunner:
+    """Paged-KV decode/prefill over the flax ``GPT2`` param tree
+    (``deepspeed_tpu/models/gpt2.py`` naming: wte/wpe/h_i/ln_f)."""
+
+    def __init__(self, model_cfg: GPT2Config, cfg: RaggedInferenceConfig,
+                 compute_dtype: Any = None):
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.compute_dtype = compute_dtype or model_cfg.dtype
+        self.num_layers = model_cfg.num_layers
+        self.kv_heads = model_cfg.num_heads
+        self.head_dim = model_cfg.hidden_size // model_cfg.num_heads
+        self._step = jax.jit(functools.partial(_gpt2_ragged_step,
+                                               model_cfg=model_cfg,
+                                               cfg=cfg,
+                                               dtype=self.compute_dtype))
+
+    def step(self, params, kv_data, batch: RaggedBatch):
+        """Returns (last_token_logits [S, V] f32, new kv_data)."""
+        return self._step(params, kv_data, batch)
+
+
+def _gpt2_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: GPT2Config,
+                      cfg: RaggedInferenceConfig, dtype):
+    S, C = batch.tokens.shape
+    H = model_cfg.num_heads
+    D = model_cfg.hidden_size // H
+    bs = cfg.block_size
+    ctx_max = cfg.max_context
+    n_slots = kv.shape[2]              # num_blocks*block_size + 1 (trash)
+    trash = n_slots - 1
+    scale = 1.0 / (D ** 0.5)
+
+    # absolute positions of this step's queries
+    pos = batch.start_pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+    valid_q = jnp.arange(C, dtype=jnp.int32)[None, :] < batch.n_tokens[:, None]
+    pos_c = jnp.minimum(pos, model_cfg.max_seq_len - 1)
+
+    # KV slot for each query token through the block table; trash if padded
+    blk = jnp.take_along_axis(batch.block_tables,
+                              jnp.minimum(pos // bs, cfg.max_blocks_per_seq - 1),
+                              axis=1)                       # [S, C]
+    write_idx = jnp.where(valid_q, blk * bs + pos % bs, trash)
+
+    # context gather indices: absolute position j -> cache slot
+    j = jnp.arange(ctx_max, dtype=jnp.int32)
+    ctx_idx = batch.block_tables[:, j // bs] * bs + j % bs  # [S, ctx_max]
+
+    wte = params["wte"]["embedding"]
+    wpe = params["wpe"]["embedding"]
+    x = (wte[batch.tokens] + wpe[pos_c]).astype(dtype)      # [S, C, E]
+
+    for li in range(model_cfg.num_layers):
+        p = params[f"h_{li}"]
+        h = _layer_norm(x.astype(jnp.float32), p["ln_1"]).astype(dtype)
+        qkv = h @ p["attn"]["c_attn"]["kernel"].astype(dtype)
+        if "bias" in p["attn"]["c_attn"]:
+            qkv = qkv + p["attn"]["c_attn"]["bias"].astype(dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(S, C, H, D)
+        k = k.reshape(S, C, H, D)
+        v = v.reshape(S, C, H, D)
+
+        # append this step's KV (padded tokens land in the trash slot)
+        kv = kv.at[li, 0, write_idx.reshape(-1)].set(
+            k.reshape(S * C, H, D).astype(kv.dtype))
+        kv = kv.at[li, 1, write_idx.reshape(-1)].set(
+            v.reshape(S * C, H, D).astype(kv.dtype))
+
+        # gather each slot's context through its block table
+        k_ctx = kv[li, 0][ctx_idx].astype(dtype)            # [S, ctx, H, D]
+        v_ctx = kv[li, 1][ctx_idx].astype(dtype)
+
+        s_att = jnp.einsum("schd,skhd->shck", q, k_ctx) * scale
+        mask = j[None, None, None, :] <= pos[:, None, :, None]  # causal
+        s_att = jnp.where(mask, s_att.astype(jnp.float32), -jnp.inf)
+        p_att = jax.nn.softmax(s_att, axis=-1).astype(dtype)
+        y = jnp.einsum("shck,skhd->schd", p_att, v_ctx).reshape(S, C, H * D)
+
+        y = y @ p["attn"]["c_proj"]["kernel"].astype(dtype)
+        if "bias" in p["attn"]["c_proj"]:
+            y = y + p["attn"]["c_proj"]["bias"].astype(dtype)
+        x = x + y
+
+        h = _layer_norm(x.astype(jnp.float32), p["ln_2"]).astype(dtype)
+        m = h @ p["mlp"]["c_fc"]["kernel"].astype(dtype)
+        if "bias" in p["mlp"]["c_fc"]:
+            m = m + p["mlp"]["c_fc"]["bias"].astype(dtype)
+        m = jax.nn.gelu(m)
+        m = m @ p["mlp"]["c_proj"]["kernel"].astype(dtype)
+        if "bias" in p["mlp"]["c_proj"]:
+            m = m + p["mlp"]["c_proj"]["bias"].astype(dtype)
+        x = x + m
+
+    x = _layer_norm(x.astype(jnp.float32), params["ln_f"])
+
+    # logits_gather: only each slot's last valid token
+    last = jnp.maximum(batch.n_tokens - 1, 0)               # [S]
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    logits = x_last.astype(jnp.float32) @ wte.T.astype(jnp.float32)
+    return logits, kv

@@ -1,0 +1,63 @@
+"""Dynamic-SplitFuse token scheduler.
+
+Analogue of the reference's FastGen scheduling (``put``/``query``/
+``can_schedule``, ``inference/v2/engine_v2.py:107-184`` + the Dynamic
+SplitFuse policy from the FastGen blog): long prompts are split into fixed
+chunks and fused with decode tokens so every forward consumes a near-constant
+token budget. Here the budget is *exactly* constant — ``max_seqs`` slots of
+up to ``chunk_size`` tokens, padded — which is what keeps one compiled
+program serving all traffic (static shapes; SURVEY.md §7 hard part 3).
+
+Decode sequences (1 pending token) are scheduled first — they bound
+per-token latency; remaining slots are filled with prefill chunks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+from .config import RaggedInferenceConfig
+from .sequence import SequenceDescriptor, SequenceStatus
+from .state_manager import StateManager
+
+
+@dataclass
+class ScheduledSeq:
+    seq: SequenceDescriptor
+    tokens: List[int]          # tokens this step (<= chunk_size)
+    start_pos: int             # absolute position of tokens[0]
+    is_last_chunk: bool        # True -> logits of final token are meaningful
+
+
+class SplitFuseScheduler:
+    def __init__(self, cfg: RaggedInferenceConfig, state: StateManager):
+        self.cfg = cfg
+        self.state = state
+
+    def schedule(self) -> List[ScheduledSeq]:
+        """Pick up to ``max_seqs`` sequences with pending tokens."""
+        cfg = self.cfg
+        pending = [s for s in self.state.sequences.values()
+                   if s.in_flight > 0 and s.status is not SequenceStatus.FINISHED]
+        # decode (1 token) first: latency-bound; then longest prefills first
+        # (they need the most chunks, start them early)
+        decode = [s for s in pending if s.in_flight == 1]
+        prefill = sorted((s for s in pending if s.in_flight > 1),
+                         key=lambda s: -s.in_flight)
+        out: List[ScheduledSeq] = []
+        for seq in decode + prefill:
+            if len(out) == cfg.max_seqs:
+                break
+            n = min(seq.in_flight, cfg.chunk_size)
+            if not self.state.can_schedule(seq.uid, n):
+                continue                       # KV pressure: leave waiting
+            self.state.ensure_blocks(seq, n)
+            tokens = seq.pending_tokens[:n]
+            del seq.pending_tokens[:n]
+            out.append(ScheduledSeq(
+                seq=seq, tokens=tokens, start_pos=seq.seen_tokens,
+                is_last_chunk=seq.in_flight == 0))
+            seq.seen_tokens += n
+            seq.status = SequenceStatus.RUNNING
+        return out

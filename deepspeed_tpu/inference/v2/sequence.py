@@ -1,0 +1,39 @@
+"""Sequence descriptors for the ragged engine.
+
+Analogue of the reference's ``DSSequenceDescriptor``
+(``inference/v2/ragged/sequence_descriptor.py``): per-sequence host state —
+tokens seen by the model, KV blocks owned, tokens still waiting to be
+prefilled, and scheduling status.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import List
+
+
+class SequenceStatus(enum.Enum):
+    WAITING = "waiting"        # has pending tokens, not yet scheduled
+    RUNNING = "running"        # scheduled in the current/last batch
+    FINISHED = "finished"      # flushed / EOS'd by the caller
+
+
+@dataclass
+class SequenceDescriptor:
+    uid: int
+    pending_tokens: List[int] = field(default_factory=list)
+    seen_tokens: int = 0                  # tokens whose KV is in cache
+    kv_blocks: List[int] = field(default_factory=list)
+    status: SequenceStatus = SequenceStatus.WAITING
+    generated: List[int] = field(default_factory=list)
+
+    @property
+    def in_flight(self) -> int:
+        return len(self.pending_tokens)
+
+    def blocks_needed(self, new_tokens: int, block_size: int) -> int:
+        """KV blocks to allocate so `seen_tokens + new_tokens` fit."""
+        total = self.seen_tokens + new_tokens
+        needed = -(-total // block_size)          # ceil
+        return max(0, needed - len(self.kv_blocks))

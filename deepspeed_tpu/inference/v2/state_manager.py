@@ -1,0 +1,77 @@
+"""Sequence state manager.
+
+Analogue of the reference's ``DSStateManager``
+(``inference/v2/ragged/ragged_manager.py:19``): tracks live sequences,
+grows their KV block allocations as tokens arrive, and frees state on flush.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from .blocked_allocator import OutOfBlocksError
+from .config import RaggedInferenceConfig
+from .kv_cache import BlockedKVCache
+from .sequence import SequenceDescriptor, SequenceStatus
+
+
+class StateManager:
+    def __init__(self, cfg: RaggedInferenceConfig, kv_cache: BlockedKVCache):
+        self.cfg = cfg
+        self.kv_cache = kv_cache
+        self._seqs: Dict[int, SequenceDescriptor] = {}
+
+    # ------------------------------------------------------------------ #
+
+    def get_or_create(self, uid: int) -> SequenceDescriptor:
+        if uid not in self._seqs:
+            self._seqs[uid] = SequenceDescriptor(uid=uid)
+        return self._seqs[uid]
+
+    def get(self, uid: int) -> Optional[SequenceDescriptor]:
+        return self._seqs.get(uid)
+
+    @property
+    def sequences(self) -> Dict[int, SequenceDescriptor]:
+        return self._seqs
+
+    def put_tokens(self, uid: int, tokens: Iterable[int]) -> SequenceDescriptor:
+        seq = self.get_or_create(uid)
+        seq.pending_tokens.extend(int(t) for t in tokens)
+        if seq.status is not SequenceStatus.RUNNING:
+            seq.status = SequenceStatus.WAITING
+        total = seq.seen_tokens + seq.in_flight
+        if total > self.cfg.max_context:
+            raise ValueError(
+                f"sequence {uid}: {total} tokens exceeds max_context "
+                f"{self.cfg.max_context} (raise max_blocks_per_seq)")
+        return seq
+
+    # ------------------------------------------------------------------ #
+
+    def can_schedule(self, uid: int, n_tokens: int) -> bool:
+        """Scheduling hint (reference ``engine_v2.py:158-184``): would
+        `n_tokens` more tokens fit in blocks we can still allocate?"""
+        seq = self.get_or_create(uid)
+        need = seq.blocks_needed(n_tokens, self.cfg.block_size)
+        return (need <= self.kv_cache.free_blocks
+                and len(seq.kv_blocks) + need <= self.cfg.max_blocks_per_seq)
+
+    def ensure_blocks(self, seq: SequenceDescriptor, n_tokens: int) -> None:
+        need = seq.blocks_needed(n_tokens, self.cfg.block_size)
+        if need:
+            if len(seq.kv_blocks) + need > self.cfg.max_blocks_per_seq:
+                raise OutOfBlocksError(
+                    f"sequence {seq.uid} exceeds max_blocks_per_seq "
+                    f"({self.cfg.max_blocks_per_seq})")
+            seq.kv_blocks.extend(self.kv_cache.reserve(need))
+
+    def flush(self, uid: int) -> None:
+        """Release a sequence and its KV blocks (reference ``flush``)."""
+        seq = self._seqs.pop(uid, None)
+        if seq is not None and seq.kv_blocks:
+            self.kv_cache.free(seq.kv_blocks)
+
+    def flush_all(self) -> None:
+        for uid in list(self._seqs):
+            self.flush(uid)

@@ -72,9 +72,10 @@ class CausalSelfAttention(nn.Module):
             # Pallas custom calls carry no GSPMD partitioning rules: under a
             # multi-device jit, XLA would replicate q/k/v around the kernel.
             # Auto therefore picks flash only for single-device TPU; sharded
-            # meshes keep the XLA fused attention (which GSPMD partitions),
-            # and the SP paths (ulysses/ring) invoke the kernel inside their
-            # own shard_map where shapes are already local.
+            # meshes keep the XLA fused attention (which GSPMD partitions).
+            # (The SP paths in parallel/{ulysses,ring_attention}.py currently
+            # use XLA attention too; moving their local attention onto this
+            # kernel inside shard_map is a planned perf step.)
             single_dev = jax.device_count() == 1
             impl = "flash" if (jax.default_backend() == "tpu"
                                and single_dev) else "xla"

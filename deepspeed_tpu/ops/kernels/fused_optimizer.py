@@ -83,7 +83,7 @@ def fused_adamw_update(
     ])
 
     br = _ROW
-    for cand in (512, 256, 64, 32, 16, 8):
+    for cand in (512, 256, 128, 64, 32, 16, 8):
         if rows % cand == 0:
             br = cand
             break

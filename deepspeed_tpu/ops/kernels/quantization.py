@@ -64,6 +64,9 @@ def quantize_blockwise(x: jnp.ndarray, *, bits: int = 8, group_size: int = 256,
                        interpret: Optional[bool] = None) -> QuantizedTensor:
     """Group-quantize ``x`` to int8/int4 with per-group f32 scales."""
     assert bits in (8, 4), bits
+    if bits == 4 and group_size % 2:
+        raise ValueError(f"4-bit packing requires even group_size, "
+                         f"got {group_size}")
     if interpret is None:
         from . import default_interpret
         interpret = default_interpret()
