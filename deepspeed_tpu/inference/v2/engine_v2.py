@@ -27,6 +27,15 @@ from .sequence import SequenceStatus
 from .state_manager import StateManager
 
 
+def _runner_for(model_cfg: Any, cfg: RaggedInferenceConfig):
+    """Arch dispatch (the reference's policy map, ``engine_factory.py:92``)."""
+    from ...models.llama import LlamaConfig
+    if isinstance(model_cfg, LlamaConfig):   # includes MixtralConfig
+        from .llama_runner import LlamaRaggedRunner
+        return LlamaRaggedRunner(model_cfg, cfg)
+    return GPT2RaggedRunner(model_cfg, cfg)
+
+
 class InferenceEngineV2:
     def __init__(self, model_cfg: Any, params: Any,
                  config: Optional[RaggedInferenceConfig] = None,
@@ -36,7 +45,7 @@ class InferenceEngineV2:
         ``params``: the matching param pytree."""
         self.config = config or RaggedInferenceConfig()
         self.params = params
-        self.runner = runner or GPT2RaggedRunner(model_cfg, self.config)
+        self.runner = runner or _runner_for(model_cfg, self.config)
         self.kv_cache = BlockedKVCache(
             self.config, self.runner.num_layers, self.runner.kv_heads,
             self.runner.head_dim, dtype=resolve_dtype(self.config.dtype))

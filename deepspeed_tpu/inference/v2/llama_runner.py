@@ -1,0 +1,153 @@
+"""Ragged paged-KV runner for the Llama family (and Mixtral MoE).
+
+Analogue of the reference's llama_v2 / mistral / mixtral v2 containers
+(``inference/v2/model_implementations/{llama_v2,mistral,mixtral}/``): RoPE
+applied at each token's absolute position, GQA KV stored at kv-head width,
+SwiGLU MLP (or top-k routed MoE for Mixtral), RMSNorm, last-token logits.
+Shares the fixed-shape RaggedBatch contract of ``model_runner.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ...models.llama import LlamaConfig, apply_rope
+from ...models.mixtral import MixtralConfig
+from .config import RaggedInferenceConfig
+from .model_runner import RaggedBatch
+
+
+def _rms(x, scale, eps):
+    xf = x.astype(jnp.float32)
+    y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
+    return y * scale
+
+
+class LlamaRaggedRunner:
+    def __init__(self, model_cfg: LlamaConfig, cfg: RaggedInferenceConfig,
+                 compute_dtype: Any = None):
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.compute_dtype = compute_dtype or model_cfg.dtype
+        self.num_layers = model_cfg.num_layers
+        self.kv_heads = model_cfg.num_kv_heads
+        self.head_dim = model_cfg.head_dim
+        self._step = jax.jit(functools.partial(
+            _llama_ragged_step, model_cfg=model_cfg, cfg=cfg,
+            dtype=self.compute_dtype))
+
+    def step(self, params, kv_data, batch: RaggedBatch):
+        return self._step(params, kv_data, batch)
+
+
+def _moe_mlp(p_moe, h, cfg: MixtralConfig, dtype):
+    """Dense-compute MoE for the ragged path: every expert runs, outputs are
+    combined with renormalized top-k router weights (exact for top-k routing
+    without capacity drop — mixtral's configuration)."""
+    S, C, M = h.shape
+    logits = h.astype(jnp.float32).reshape(S * C, M) @ p_moe["gate"]
+    k = cfg.experts_top_k
+    top_vals, _ = jax.lax.top_k(logits, k)
+    thresh = top_vals[:, -1:]
+    keep = logits >= thresh                                   # [SC, E]
+    w = jax.nn.softmax(jnp.where(keep, logits, -jnp.inf), axis=-1)
+    x = h.reshape(S * C, M)
+    wi = p_moe["wi"].astype(dtype)                            # [E, M, I]
+    wo = p_moe["wo"].astype(dtype)                            # [E, I, M]
+    up = jnp.einsum("sm,emi->esi", x, wi)
+    act = jax.nn.silu(up)
+    outs = jnp.einsum("esi,eim->esm", act, wo)                # [E, SC, M]
+    y = jnp.einsum("se,esm->sm", w.astype(dtype), outs)
+    return y.reshape(S, C, M)
+
+
+def _llama_ragged_step(params, kv, batch: RaggedBatch, *,
+                       model_cfg: LlamaConfig, cfg: RaggedInferenceConfig,
+                       dtype):
+    S, C = batch.tokens.shape
+    H = model_cfg.num_heads
+    KV = model_cfg.num_kv_heads
+    D = model_cfg.head_dim
+    bs = cfg.block_size
+    ctx_max = cfg.max_context
+    trash = kv.shape[2] - 1
+    scale = 1.0 / (D ** 0.5)
+    is_moe = isinstance(model_cfg, MixtralConfig)
+
+    pos = batch.start_pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+    valid_q = jnp.arange(C, dtype=jnp.int32)[None, :] < batch.n_tokens[:, None]
+    blk = jnp.take_along_axis(
+        batch.block_tables,
+        jnp.minimum(pos // bs, cfg.max_blocks_per_seq - 1), axis=1)
+    write_idx = jnp.where(valid_q, blk * bs + pos % bs, trash)
+    j = jnp.arange(ctx_max, dtype=jnp.int32)
+    ctx_idx = batch.block_tables[:, j // bs] * bs + j % bs
+
+    x = params["embed"]["embedding"][batch.tokens].astype(dtype)
+
+    for li in range(model_cfg.num_layers):
+        p = params[f"layer_{li}"]
+        h = _rms(x, p["input_norm"]["scale"],
+                 model_cfg.rms_eps).astype(dtype)
+        pa = p["attn"]
+        q = (h @ pa["q_proj"]["kernel"].astype(dtype))
+        k = (h @ pa["k_proj"]["kernel"].astype(dtype))
+        v = (h @ pa["v_proj"]["kernel"].astype(dtype))
+        if model_cfg.qkv_bias:
+            q = q + pa["q_proj"]["bias"].astype(dtype)
+            k = k + pa["k_proj"]["bias"].astype(dtype)
+            v = v + pa["v_proj"]["bias"].astype(dtype)
+        q = q.reshape(S, C, H, D)
+        k = k.reshape(S, C, KV, D)
+        v = v.reshape(S, C, KV, D)
+        q = apply_rope(q, pos, model_cfg.rope_theta)
+        k = apply_rope(k, pos, model_cfg.rope_theta)
+
+        kv = kv.at[li, 0, write_idx.reshape(-1)].set(
+            k.reshape(S * C, KV, D).astype(kv.dtype))
+        kv = kv.at[li, 1, write_idx.reshape(-1)].set(
+            v.reshape(S * C, KV, D).astype(kv.dtype))
+
+        k_ctx = kv[li, 0][ctx_idx].astype(dtype)              # [S, ctx, KV, D]
+        v_ctx = kv[li, 1][ctx_idx].astype(dtype)
+        if KV != H:
+            k_ctx = jnp.repeat(k_ctx, H // KV, axis=2)
+            v_ctx = jnp.repeat(v_ctx, H // KV, axis=2)
+
+        s_att = jnp.einsum("schd,skhd->shck", q, k_ctx) * scale
+        mask = j[None, None, None, :] <= pos[:, None, :, None]
+        if model_cfg.sliding_window is not None:
+            mask = jnp.logical_and(
+                mask,
+                j[None, None, None, :] > pos[:, None, :, None]
+                - model_cfg.sliding_window)
+        s_att = jnp.where(mask, s_att.astype(jnp.float32), -jnp.inf)
+        p_att = jax.nn.softmax(s_att, axis=-1).astype(dtype)
+        y = jnp.einsum("shck,skhd->schd", p_att, v_ctx).reshape(S, C, H * D)
+        y = y @ pa["o_proj"]["kernel"].astype(dtype)
+        x = x + y
+
+        h = _rms(x, p["post_attn_norm"]["scale"],
+                 model_cfg.rms_eps).astype(dtype)
+        if is_moe:
+            x = x + _moe_mlp(p["moe"], h, model_cfg, dtype)
+        else:
+            pm = p["mlp"]
+            gate = h @ pm["gate_proj"]["kernel"].astype(dtype)
+            up = h @ pm["up_proj"]["kernel"].astype(dtype)
+            m = jax.nn.silu(gate) * up
+            x = x + m @ pm["down_proj"]["kernel"].astype(dtype)
+
+    x = _rms(x, params["final_norm"]["scale"], model_cfg.rms_eps)
+    last = jnp.maximum(batch.n_tokens - 1, 0)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    if model_cfg.tie_embeddings:
+        w_out = params["embed"]["embedding"].T
+    else:
+        w_out = params["lm_head"]["kernel"]
+    logits = x_last.astype(jnp.float32) @ w_out.astype(jnp.float32)
+    return logits, kv

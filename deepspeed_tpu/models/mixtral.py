@@ -1,0 +1,117 @@
+"""Mixtral-style MoE transformer: Llama block with the dense MLP swapped for
+the framework's expert-parallel ``MoE`` layer.
+
+Parity target: the reference's mixtral / qwen_v2_moe containers
+(``inference/v2/model_implementations/mixtral/``) and the training-side MoE
+integration (``deepspeed/moe/layer.py:17``). The MoE block here is the same
+``deepspeed_tpu.moe.MoE`` used standalone, so EP sharding, capacity gating,
+and the aux-loss plumbing behave identically in both places.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..moe.layer import MoE
+from .llama import LlamaAttention, LlamaConfig, RMSNorm
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    num_experts: int = 8
+    experts_top_k: int = 2
+    capacity_factor: float = 2.0
+    drop_tokens: bool = False          # mixtral routes all tokens
+    router_aux_loss_coef: float = 0.02
+
+    @staticmethod
+    def tiny(**kw):
+        kw.setdefault("vocab_size", 512)
+        kw.setdefault("max_seq_len", 128)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("num_kv_heads", 2)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("num_experts", 4)
+        return MixtralConfig(**kw)
+
+    @staticmethod
+    def mixtral_8x7b(**kw):
+        kw.setdefault("vocab_size", 32000)
+        kw.setdefault("max_seq_len", 32768)
+        kw.setdefault("num_kv_heads", 8)
+        kw.setdefault("intermediate_size", 14336)
+        kw.setdefault("rope_theta", 1e6)
+        return MixtralConfig(**kw)
+
+
+class MixtralBlock(nn.Module):
+    cfg: MixtralConfig
+    ep_mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        x = x + LlamaAttention(cfg, name="attn")(
+            RMSNorm(cfg.rms_eps, cfg.dtype, name="input_norm")(x))
+        h = RMSNorm(cfg.rms_eps, cfg.dtype, name="post_attn_norm")(x)
+        y, l_aux = MoE(
+            d_model=cfg.hidden_size, num_experts=cfg.num_experts,
+            k=cfg.experts_top_k, hidden=cfg.intermediate_size,
+            capacity_factor=cfg.capacity_factor,
+            eval_capacity_factor=cfg.capacity_factor,
+            drop_tokens=cfg.drop_tokens, ep_mesh=self.ep_mesh,
+            dtype=cfg.dtype, activation=nn.silu, name="moe")(x=h, train=train)
+        self.sow("losses", "moe_aux", l_aux)
+        return x + y
+
+
+class Mixtral(nn.Module):
+    cfg: MixtralConfig
+    ep_mesh: Any = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        cfg = self.cfg
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="embed")
+        x = embed(tokens)
+        for i in range(cfg.num_layers):
+            x = MixtralBlock(cfg, self.ep_mesh, name=f"layer_{i}")(x, train)
+        x = RMSNorm(cfg.rms_eps, jnp.float32, name="final_norm")(x)
+        head = nn.Dense(cfg.vocab_size, dtype=jnp.float32,
+                        param_dtype=cfg.param_dtype, use_bias=False,
+                        name="lm_head")
+        return head(x.astype(jnp.float32))
+
+
+def make_model(cfg: MixtralConfig, ep_mesh=None):
+    """(model, init_fn, loss_fn); the LM loss adds the router aux loss scaled
+    by ``router_aux_loss_coef`` (the reference folds l_aux the same way)."""
+    model = Mixtral(cfg, ep_mesh)
+
+    def init_fn(rng, batch_size: int = 2, seq_len: Optional[int] = None):
+        T = seq_len or min(cfg.max_seq_len, 64)
+        variables = model.init({"params": rng, "gating": rng},
+                               jnp.zeros((batch_size, T), jnp.int32))
+        return variables["params"]
+
+    def loss_fn(params, batch, rng):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits, aux = model.apply(
+            {"params": params}, inputs, rngs={"gating": rng},
+            mutable=["losses"])
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        moe_aux = sum(jnp.sum(v) for v in
+                      jax.tree_util.tree_leaves(aux.get("losses", {})))
+        return nll.mean() + cfg.router_aux_loss_coef * moe_aux
+
+    return model, init_fn, loss_fn

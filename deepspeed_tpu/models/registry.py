@@ -1,0 +1,115 @@
+"""Model-architecture registry + HF config mapping.
+
+Analogue of the reference's arch→policy map in ``build_hf_engine``
+(``inference/v2/engine_factory.py:69``) and the container registry
+(``module_inject/replace_policy.py``): maps an architecture name (or a raw
+HuggingFace config dict's ``model_type``) to this framework's model config /
+module classes, so checkpoints and serving configs can be resolved by name.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple
+
+from .bert import Bert, BertConfig
+from .bert import make_model as make_bert
+from .gpt2 import GPT2, GPT2Config
+from .gpt2 import make_model as make_gpt2
+from .llama import Llama, LlamaConfig
+from .llama import make_model as make_llama
+from .mixtral import Mixtral, MixtralConfig
+from .mixtral import make_model as make_mixtral
+
+
+class ArchEntry(NamedTuple):
+    config_cls: type
+    model_cls: type
+    make_model: Callable
+    from_hf: Callable[[Dict[str, Any]], Any]
+
+
+def _hf_llama(d: Dict[str, Any], **extra) -> LlamaConfig:
+    base = dict(
+        vocab_size=d.get("vocab_size", 32000),
+        max_seq_len=d.get("max_position_embeddings", 4096),
+        num_layers=d.get("num_hidden_layers", 32),
+        num_heads=d.get("num_attention_heads", 32),
+        num_kv_heads=d.get("num_key_value_heads",
+                           d.get("num_attention_heads", 32)),
+        hidden_size=d.get("hidden_size", 4096),
+        intermediate_size=d.get("intermediate_size", 11008),
+        rope_theta=d.get("rope_theta", 10000.0),
+        rms_eps=d.get("rms_norm_eps", 1e-5),
+        tie_embeddings=d.get("tie_word_embeddings", False),
+    )
+    base.update(extra)
+    return base
+
+
+def _entry_llama(d):
+    return LlamaConfig(**_hf_llama(d))
+
+
+def _entry_mistral(d):
+    return LlamaConfig(**_hf_llama(d, sliding_window=d.get("sliding_window")))
+
+
+def _entry_qwen2(d):
+    return LlamaConfig(**_hf_llama(d, qkv_bias=True))
+
+
+def _entry_mixtral(d):
+    return MixtralConfig(**_hf_llama(
+        d,
+        num_experts=d.get("num_local_experts", 8),
+        experts_top_k=d.get("num_experts_per_tok", 2),
+        router_aux_loss_coef=d.get("router_aux_loss_coef", 0.02)))
+
+
+def _entry_gpt2(d):
+    return GPT2Config(
+        vocab_size=d.get("vocab_size", 50257),
+        max_seq_len=d.get("n_positions", 1024),
+        num_layers=d.get("n_layer", 12),
+        num_heads=d.get("n_head", 12),
+        hidden_size=d.get("n_embd", 768))
+
+
+def _entry_bert(d):
+    return BertConfig(
+        vocab_size=d.get("vocab_size", 30522),
+        max_seq_len=d.get("max_position_embeddings", 512),
+        type_vocab_size=d.get("type_vocab_size", 2),
+        num_layers=d.get("num_hidden_layers", 12),
+        num_heads=d.get("num_attention_heads", 12),
+        hidden_size=d.get("hidden_size", 768),
+        intermediate_size=d.get("intermediate_size", 3072),
+        layer_norm_eps=d.get("layer_norm_eps", 1e-12))
+
+
+ARCHITECTURES: Dict[str, ArchEntry] = {
+    "gpt2": ArchEntry(GPT2Config, GPT2, make_gpt2, _entry_gpt2),
+    "llama": ArchEntry(LlamaConfig, Llama, make_llama, _entry_llama),
+    "mistral": ArchEntry(LlamaConfig, Llama, make_llama, _entry_mistral),
+    "qwen2": ArchEntry(LlamaConfig, Llama, make_llama, _entry_qwen2),
+    "mixtral": ArchEntry(MixtralConfig, Mixtral, make_mixtral, _entry_mixtral),
+    "bert": ArchEntry(BertConfig, Bert, make_bert, _entry_bert),
+}
+
+
+def get_arch(name: str) -> ArchEntry:
+    try:
+        return ARCHITECTURES[name.lower()]
+    except KeyError:
+        raise ValueError(f"unknown architecture {name!r}; known: "
+                         f"{sorted(ARCHITECTURES)}")
+
+
+def config_from_hf(hf_config: Dict[str, Any]):
+    """Build this framework's model config from a HuggingFace config dict
+    (e.g. json.load of config.json). Returns (arch_name, config)."""
+    mt = hf_config.get("model_type")
+    if mt is None:
+        raise ValueError("hf config missing 'model_type'")
+    entry = get_arch(mt)
+    return mt.lower(), entry.from_hf(hf_config)

@@ -1,0 +1,215 @@
+"""Model zoo tests — llama family (RoPE/GQA/SwiGLU/sliding window), mixtral
+MoE, BERT MLM, HF config mapping, and ragged-runner parity for llama/mixtral.
+Mirrors the reference's per-arch container tests
+(``tests/unit/inference/test_inference.py`` model zoo sweep) at tiny scale."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.inference.v2 import InferenceEngineV2, RaggedInferenceConfig
+from deepspeed_tpu.models import bert, llama, mixtral
+from deepspeed_tpu.models.registry import config_from_hf, get_arch
+
+
+class TestLlama:
+    def test_forward_shapes_gqa(self):
+        cfg = llama.LlamaConfig.tiny(dtype=jnp.float32)
+        model, init_fn, _ = llama.make_model(cfg)
+        params = init_fn(jax.random.PRNGKey(0))
+        logits = model.apply({"params": params},
+                             jnp.zeros((2, 16), jnp.int32))
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        # GQA: k_proj is narrower than q_proj
+        l0 = params["layer_0"]["attn"]
+        assert l0["k_proj"]["kernel"].shape[1] == \
+            cfg.num_kv_heads * cfg.head_dim
+        assert l0["q_proj"]["kernel"].shape[1] == \
+            cfg.num_heads * cfg.head_dim
+
+    def test_rope_properties(self):
+        """RoPE is a rotation (norm-preserving) and relative (scores depend
+        only on position deltas)."""
+        rng = jax.random.PRNGKey(0)
+        q = jax.random.normal(rng, (1, 6, 2, 16))
+        k = jax.random.normal(jax.random.fold_in(rng, 1), (1, 6, 2, 16))
+        pos = jnp.arange(6)[None, :]
+        qr = llama.apply_rope(q, pos, 10000.0)
+        kr = llama.apply_rope(k, pos, 10000.0)
+        np.testing.assert_allclose(np.linalg.norm(np.asarray(qr), axis=-1),
+                                   np.linalg.norm(np.asarray(q), axis=-1),
+                                   atol=1e-5)
+        # shifting both positions by s leaves q_i . k_j unchanged
+        qs = llama.apply_rope(q, pos + 11, 10000.0)
+        ks = llama.apply_rope(k, pos + 11, 10000.0)
+        s1 = jnp.einsum("bthd,bshd->bhts", qr, kr)
+        s2 = jnp.einsum("bthd,bshd->bhts", qs, ks)
+        np.testing.assert_allclose(s1, s2, atol=1e-4)
+        # absolute rotation is position-dependent
+        assert not np.allclose(qr[0, 0], qr[0, 5], atol=1e-3)
+
+    def test_sliding_window_masks_distant_tokens(self):
+        cfg = llama.LlamaConfig.tiny(dtype=jnp.float32, sliding_window=4)
+        model, init_fn, _ = llama.make_model(cfg)
+        params = init_fn(jax.random.PRNGKey(0), seq_len=16)
+        rng = np.random.default_rng(0)
+        a = rng.integers(1, 512, 16)
+        b = a.copy()
+        b[0] = (b[0] + 1) % 512    # mutate a token far outside the window
+        la = model.apply({"params": params}, jnp.asarray([a], jnp.int32))
+        lb = model.apply({"params": params}, jnp.asarray([b], jnp.int32))
+        # last position (15) can only see positions 12..15 -> identical
+        np.testing.assert_allclose(la[0, -1], lb[0, -1], atol=1e-5)
+        assert not np.allclose(la[0, 2], lb[0, 2], atol=1e-4)
+
+    def test_trains_through_engine(self):
+        cfg = llama.LlamaConfig.tiny(dtype=jnp.float32)
+        model, init_fn, loss_fn = llama.make_model(cfg)
+        params = init_fn(jax.random.PRNGKey(0), batch_size=4, seq_len=17)
+        engine, _, _, _ = dstpu.initialize(
+            loss_fn=loss_fn, params=params,
+            config={"train_micro_batch_size_per_gpu": 8,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+                    "zero_optimization": {"stage": 2}})
+        rng = np.random.default_rng(0)
+        losses = []
+        for _ in range(12):
+            start = rng.integers(0, 40, (engine.train_batch_size_(),))
+            toks = (start[:, None] + np.arange(18)[None, :]) % 512
+            losses.append(float(engine.train_batch(
+                {"tokens": jnp.asarray(toks, jnp.int32)})))
+        assert losses[-1] < losses[0]
+
+
+class TestMixtral:
+    def test_forward_and_loss(self):
+        cfg = mixtral.MixtralConfig.tiny(dtype=jnp.float32)
+        model, init_fn, loss_fn = mixtral.make_model(cfg)
+        params = init_fn(jax.random.PRNGKey(0))
+        assert "moe" in params["layer_0"]
+        assert params["layer_0"]["moe"]["wi"].shape[0] == cfg.num_experts
+        loss = loss_fn(params,
+                       {"tokens": jnp.ones((2, 17), jnp.int32)},
+                       jax.random.PRNGKey(1))
+        assert np.isfinite(float(loss))
+
+    def test_experts_contribute(self):
+        """Zeroing expert weights must change the output."""
+        cfg = mixtral.MixtralConfig.tiny(dtype=jnp.float32)
+        model, init_fn, _ = mixtral.make_model(cfg)
+        params = init_fn(jax.random.PRNGKey(0))
+        toks = jnp.asarray([[5, 9, 2, 14]], jnp.int32)
+        out1 = model.apply({"params": params}, toks, False)
+        params2 = jax.tree_util.tree_map(lambda x: x, params)
+        params2["layer_0"]["moe"]["wo"] = jnp.zeros_like(
+            params2["layer_0"]["moe"]["wo"])
+        out2 = model.apply({"params": params2}, toks, False)
+        assert not np.allclose(out1, out2, atol=1e-5)
+
+
+class TestBert:
+    def test_mlm_forward_and_mask(self):
+        cfg = bert.BertConfig.tiny(dtype=jnp.float32)
+        model, init_fn, loss_fn = bert.make_model(cfg)
+        params = init_fn(jax.random.PRNGKey(0))
+        logits = model.apply({"params": params},
+                             jnp.zeros((2, 12), jnp.int32))
+        assert logits.shape == (2, 12, cfg.vocab_size)
+        loss = loss_fn(params, {"tokens": jnp.ones((2, 12), jnp.int32)},
+                       jax.random.PRNGKey(1))
+        assert np.isfinite(float(loss))
+
+    def test_bidirectional(self):
+        """Changing a later token must affect earlier positions (no causal
+        mask) — the opposite of the llama test."""
+        cfg = bert.BertConfig.tiny(dtype=jnp.float32)
+        model, init_fn, _ = bert.make_model(cfg)
+        params = init_fn(jax.random.PRNGKey(0))
+        a = np.ones(10, np.int32) * 5
+        b = a.copy()
+        b[-1] = 9
+        la = model.apply({"params": params}, jnp.asarray([a]))
+        lb = model.apply({"params": params}, jnp.asarray([b]))
+        assert not np.allclose(la[0, 0], lb[0, 0], atol=1e-5)
+
+    def test_attention_mask_excludes_padding(self):
+        cfg = bert.BertConfig.tiny(dtype=jnp.float32)
+        model, init_fn, _ = bert.make_model(cfg)
+        params = init_fn(jax.random.PRNGKey(0))
+        toks = np.ones((1, 10), np.int32) * 3
+        am = np.ones((1, 10), np.int32)
+        am[0, 6:] = 0
+        la = model.apply({"params": params}, jnp.asarray(toks),
+                         attention_mask=jnp.asarray(am))
+        toks2 = toks.copy()
+        toks2[0, 7] = 99           # mutate masked-out position
+        lb = model.apply({"params": params}, jnp.asarray(toks2),
+                         attention_mask=jnp.asarray(am))
+        np.testing.assert_allclose(la[0, :6], lb[0, :6], atol=1e-5)
+
+
+class TestRegistry:
+    def test_hf_llama_mapping(self):
+        name, cfg = config_from_hf({
+            "model_type": "llama", "vocab_size": 1000, "hidden_size": 128,
+            "num_hidden_layers": 3, "num_attention_heads": 8,
+            "num_key_value_heads": 2, "intermediate_size": 256,
+            "rope_theta": 500000.0, "rms_norm_eps": 1e-6})
+        assert name == "llama"
+        assert cfg.num_kv_heads == 2 and cfg.rope_theta == 500000.0
+
+    def test_hf_mixtral_mapping(self):
+        _, cfg = config_from_hf({
+            "model_type": "mixtral", "num_local_experts": 4,
+            "num_experts_per_tok": 2})
+        assert cfg.num_experts == 4 and cfg.experts_top_k == 2
+
+    def test_hf_mistral_qwen(self):
+        _, m = config_from_hf({"model_type": "mistral", "sliding_window": 1024})
+        assert m.sliding_window == 1024
+        _, q = config_from_hf({"model_type": "qwen2"})
+        assert q.qkv_bias is True
+
+    def test_unknown_arch_raises(self):
+        with pytest.raises(ValueError, match="unknown architecture"):
+            get_arch("not_a_model")
+
+
+class TestLlamaRaggedParity:
+    def _setup(self, mcfg):
+        cfg = RaggedInferenceConfig(max_seqs=2, chunk_size=8, block_size=4,
+                                    num_blocks=64, max_blocks_per_seq=16,
+                                    dtype="float32")
+        if isinstance(mcfg, mixtral.MixtralConfig):
+            model, init_fn, _ = mixtral.make_model(mcfg)
+        else:
+            model, init_fn, _ = llama.make_model(mcfg)
+        params = init_fn(jax.random.PRNGKey(0), seq_len=16)
+        return cfg, model, params
+
+    def test_llama_prefill_decode_parity(self):
+        mcfg = llama.LlamaConfig.tiny(dtype=jnp.float32)
+        cfg, model, params = self._setup(mcfg)
+        eng = InferenceEngineV2(mcfg, params, cfg)
+        prompt = list(np.random.default_rng(0).integers(1, 512, 13))
+        gen = eng.generate([prompt], max_new_tokens=4)[0]
+        toks = list(prompt)
+        for _ in range(4):
+            logits = model.apply({"params": params},
+                                 jnp.asarray([toks], jnp.int32))
+            nxt = int(jnp.argmax(logits[0, -1]))
+            toks.append(nxt)
+        assert gen == toks[len(prompt):]
+
+    def test_mixtral_prefill_parity(self):
+        mcfg = mixtral.MixtralConfig.tiny(dtype=jnp.float32)
+        cfg, model, params = self._setup(mcfg)
+        eng = InferenceEngineV2(mcfg, params, cfg)
+        prompt = list(np.random.default_rng(1).integers(1, 512, 11))
+        out = eng.put([0], [prompt])
+        full = model.apply({"params": params},
+                           jnp.asarray([prompt], jnp.int32), False)
+        np.testing.assert_allclose(out[0], np.asarray(full)[0, -1],
+                                   atol=3e-4, rtol=3e-4)
