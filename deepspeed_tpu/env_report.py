@@ -1,0 +1,86 @@
+"""``dstpu_report`` — environment / capability report.
+
+Parity with the reference's ``ds_report`` CLI (``deepspeed/env_report.py``):
+versions, device inventory, and a feature-compatibility matrix. Where the
+reference checks which CUDA op builders compile, this checks which Pallas
+kernel families and subsystems import and whether compiled (vs interpreted)
+kernels are available on the current backend.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+from typing import List, Tuple
+
+
+def _try(modname: str) -> Tuple[bool, str]:
+    try:
+        m = importlib.import_module(modname)
+        return True, getattr(m, "__version__", "ok")
+    except Exception as e:            # noqa: BLE001 - report, don't crash
+        return False, f"{type(e).__name__}: {e}"
+
+
+KERNEL_FAMILIES = [
+    ("flash_attention", "deepspeed_tpu.ops.kernels.flash_attention"),
+    ("fused_norms", "deepspeed_tpu.ops.kernels.normalization"),
+    ("quantization", "deepspeed_tpu.ops.kernels.quantization"),
+    ("fused_optimizer", "deepspeed_tpu.ops.kernels.fused_optimizer"),
+]
+
+SUBSYSTEMS = [
+    ("engine", "deepspeed_tpu.runtime.engine"),
+    ("zero", "deepspeed_tpu.runtime.zero.sharding"),
+    ("pipeline", "deepspeed_tpu.parallel.pipeline"),
+    ("moe", "deepspeed_tpu.moe.layer"),
+    ("ulysses_sp", "deepspeed_tpu.parallel.ulysses"),
+    ("ring_attention", "deepspeed_tpu.parallel.ring_attention"),
+    ("inference_v2", "deepspeed_tpu.inference.v2"),
+    ("checkpoint", "deepspeed_tpu.checkpoint.engine_checkpoint"),
+    ("monitor", "deepspeed_tpu.monitor.monitor"),
+]
+
+
+def collect_report() -> List[str]:
+    lines = ["-" * 64, "deepspeed_tpu environment report", "-" * 64]
+    import deepspeed_tpu
+    lines.append(f"deepspeed_tpu ............ {deepspeed_tpu.__version__}")
+    lines.append(f"python ................... {sys.version.split()[0]}")
+    for dep in ("jax", "jaxlib", "flax", "optax", "numpy"):
+        ok, ver = _try(dep)
+        lines.append(f"{dep:<24} {'.' * 1} {ver if ok else 'MISSING: ' + ver}")
+    lines.append("-" * 64)
+    try:
+        import jax
+        backend = jax.default_backend()
+        devs = jax.devices()
+        lines.append(f"backend .................. {backend}")
+        lines.append(f"devices .................. {len(devs)} x "
+                     f"{devs[0].device_kind if devs else '?'}")
+        compiled = backend == "tpu"
+        mode = "compiled (Mosaic)" if compiled else "interpreter (non-TPU)"
+        lines.append(f"pallas kernel mode ....... {mode}")
+    except Exception as e:            # noqa: BLE001
+        lines.append(f"backend .................. UNAVAILABLE ({e})")
+    lines.append("-" * 64)
+    lines.append(f"{'kernel family':<28}{'status'}")
+    for name, mod in KERNEL_FAMILIES:
+        ok, msg = _try(mod)
+        lines.append(f"{name:<28}{'[OKAY]' if ok else '[FAIL] ' + msg}")
+    lines.append("-" * 64)
+    lines.append(f"{'subsystem':<28}{'status'}")
+    for name, mod in SUBSYSTEMS:
+        ok, msg = _try(mod)
+        lines.append(f"{name:<28}{'[OKAY]' if ok else '[FAIL] ' + msg}")
+    lines.append("-" * 64)
+    return lines
+
+
+def main() -> int:
+    print("\n".join(collect_report()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+"""Launcher CLI + multinode runners (parity: reference ``launcher/``)."""
+from .hostfile import HostfileError, filter_hosts, parse_hostfile
+from .multinode_runner import RUNNERS, MultiNodeRunner

@@ -1,0 +1,81 @@
+"""Hostfile parsing + host filtering.
+
+Parity with the reference launcher's hostfile handling
+(``launcher/runner.py:213`` ``parse_resource_filter`` /
+``parse_inclusion_exclusion``): lines of ``hostname slots=N``, filtered by
+``--include``/``--exclude`` expressions like ``worker-0:0,2@worker-1`` —
+except on TPU a "slot" is a host-process (one per host, SPMD), so slot
+filters select hosts, not GPUs.
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+from typing import Dict, List, Optional
+
+
+class HostfileError(ValueError):
+    pass
+
+
+def parse_hostfile(text: str) -> "collections.OrderedDict[str, int]":
+    """``host slots=N`` per line; '#' comments; returns {host: slots}."""
+    hosts: "collections.OrderedDict[str, int]" = collections.OrderedDict()
+    for ln, raw in enumerate(text.splitlines(), 1):
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        m = re.match(r"^(\S+)(?:\s+slots=(\d+))?$", line)
+        if not m:
+            raise HostfileError(f"hostfile line {ln}: cannot parse {raw!r}")
+        host, slots = m.group(1), int(m.group(2) or 1)
+        if host in hosts:
+            raise HostfileError(f"hostfile line {ln}: duplicate host {host}")
+        hosts[host] = slots
+    if not hosts:
+        raise HostfileError("hostfile is empty")
+    return hosts
+
+
+def _parse_filter(expr: str) -> Dict[str, Optional[List[int]]]:
+    """``host1:0,2@host2`` -> {host1: [0, 2], host2: None (all slots)}."""
+    out: Dict[str, Optional[List[int]]] = {}
+    for part in filter(None, expr.split("@")):
+        if ":" in part:
+            host, slots = part.split(":", 1)
+            out[host] = sorted(int(s) for s in slots.split(","))
+        else:
+            out[part] = None
+    return out
+
+
+def filter_hosts(hosts: "collections.OrderedDict[str, int]",
+                 include: str = "", exclude: str = ""
+                 ) -> "collections.OrderedDict[str, int]":
+    if include and exclude:
+        raise HostfileError("--include and --exclude are mutually exclusive")
+    result = collections.OrderedDict(hosts)
+    if include:
+        inc = _parse_filter(include)
+        unknown = set(inc) - set(hosts)
+        if unknown:
+            raise HostfileError(f"--include references unknown hosts {unknown}")
+        result = collections.OrderedDict(
+            (h, len(s) if s is not None else hosts[h])
+            for h, s in ((h, inc[h]) for h in hosts if h in inc))
+    elif exclude:
+        exc = _parse_filter(exclude)
+        unknown = set(exc) - set(hosts)
+        if unknown:
+            raise HostfileError(f"--exclude references unknown hosts {unknown}")
+        for h, slots in exc.items():
+            if slots is None:
+                result.pop(h, None)
+            else:
+                remaining = hosts[h] - len(slots)
+                if remaining <= 0:
+                    result.pop(h, None)
+                else:
+                    result[h] = remaining
+    return result

@@ -1,0 +1,106 @@
+"""Multi-node runner command builders.
+
+Parity with the reference's runner zoo (``launcher/multinode_runner.py``:
+``PDSHRunner:51``, ``OpenMPIRunner:118``, ``SlurmRunner:336`` …), re-targeted
+at SPMD JAX: one worker *process per host* (not per accelerator), each given
+``DSTPU_COORDINATOR`` / ``DSTPU_NUM_PROCESSES`` / ``DSTPU_PROCESS_ID`` which
+``deepspeed_tpu.comm.init_distributed`` feeds to
+``jax.distributed.initialize``. Builders return argv lists so they are
+testable without SSH/MPI present.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import sys
+from typing import Dict, List, Sequence
+
+ENV_COORD = "DSTPU_COORDINATOR"
+ENV_NPROC = "DSTPU_NUM_PROCESSES"
+ENV_PID = "DSTPU_PROCESS_ID"
+
+
+class MultiNodeRunner:
+    name = "base"
+
+    def __init__(self, hosts: Sequence[str], coordinator: str,
+                 user_script: str, user_args: Sequence[str],
+                 export_env: Dict[str, str] | None = None):
+        self.hosts = list(hosts)
+        self.coordinator = coordinator
+        self.user_script = user_script
+        self.user_args = list(user_args)
+        self.export_env = dict(export_env or {})
+
+    def _worker_cmd(self, pid: int) -> str:
+        env = {ENV_COORD: self.coordinator,
+               ENV_NPROC: str(len(self.hosts)),
+               ENV_PID: str(pid), **self.export_env}
+        exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+        args = " ".join(shlex.quote(a) for a in self.user_args)
+        return f"env {exports} {sys.executable} -u {shlex.quote(self.user_script)} {args}".rstrip()
+
+    def commands(self) -> List[List[str]]:
+        """One argv per host."""
+        raise NotImplementedError
+
+
+class PDSHRunner(MultiNodeRunner):
+    name = "pdsh"
+
+    def commands(self) -> List[List[str]]:
+        # pdsh fans out one command; rank comes from matching %h is not
+        # possible per-rank, so emit one pdsh invocation per host
+        return [["pdsh", "-S", "-w", host, self._worker_cmd(pid)]
+                for pid, host in enumerate(self.hosts)]
+
+
+class SSHRunner(MultiNodeRunner):
+    name = "ssh"
+
+    def commands(self) -> List[List[str]]:
+        return [["ssh", "-o", "StrictHostKeyChecking=no", host,
+                 self._worker_cmd(pid)]
+                for pid, host in enumerate(self.hosts)]
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    """mpirun path: ranks discovered via OMPI env (comm.mpi_discovery), so a
+    single mpirun handles rank assignment."""
+    name = "openmpi"
+
+    def commands(self) -> List[List[str]]:
+        cmd = ["mpirun", "-np", str(len(self.hosts)),
+               "--host", ",".join(f"{h}:1" for h in self.hosts),
+               "-x", f"{ENV_COORD}={self.coordinator}"]
+        for k, v in self.export_env.items():
+            cmd += ["-x", f"{k}={v}"]
+        cmd += [sys.executable, "-u", self.user_script, *self.user_args]
+        return [cmd]
+
+
+class SlurmRunner(MultiNodeRunner):
+    """srun path: SLURM_PROCID/SLURM_NTASKS are read by init_distributed's
+    discovery, so one srun covers all ranks."""
+    name = "slurm"
+
+    def commands(self) -> List[List[str]]:
+        cmd = ["srun", "-N", str(len(self.hosts)),
+               "--ntasks-per-node=1",
+               f"--nodelist={','.join(self.hosts)}",
+               f"--export=ALL,{ENV_COORD}={self.coordinator}"]
+        cmd += [sys.executable, "-u", self.user_script, *self.user_args]
+        return [cmd]
+
+
+RUNNERS = {r.name: r for r in
+           (PDSHRunner, SSHRunner, OpenMPIRunner, SlurmRunner)}
+
+
+def local_worker_env(pid: int, nproc: int, coordinator: str) -> Dict[str, str]:
+    """Env for a locally spawned worker (testing / single-host multiproc)."""
+    env = dict(os.environ)
+    env.update({ENV_COORD: coordinator, ENV_NPROC: str(nproc),
+                ENV_PID: str(pid)})
+    return env

@@ -1,0 +1,102 @@
+"""``dstpu`` CLI — the launcher front-end.
+
+Parity with the reference's ``deepspeed`` CLI (``launcher/runner.py:419``):
+resolve the host set (hostfile / --include / --exclude / --num_nodes), pick a
+multinode runner, and fan the user script out — or run locally. SPMD note
+(SURVEY.md §7 stage 1): JAX wants ONE process per host; there is no per-GPU
+process tree to manage, so the per-node spawner (reference ``launch.py:133``)
+reduces to env setup + exec for the common case, and local multi-process
+spawning exists for CPU-mesh testing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+from .hostfile import filter_hosts, parse_hostfile
+from .multinode_runner import RUNNERS, local_worker_env
+
+DEFAULT_COORD_PORT = 7777
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dstpu",
+        description="deepspeed_tpu launcher: run a training script across "
+                    "one or more TPU hosts")
+    p.add_argument("--hostfile", type=str, default=None,
+                   help="path to a 'host slots=N' hostfile")
+    p.add_argument("--include", type=str, default="",
+                   help="host filter, e.g. 'worker-0@worker-1:0'")
+    p.add_argument("--exclude", type=str, default="",
+                   help="inverse host filter")
+    p.add_argument("--num_nodes", type=int, default=-1,
+                   help="cap the number of hosts used")
+    p.add_argument("--num_procs", type=int, default=1,
+                   help="local processes to spawn when no hostfile is given "
+                        "(CPU-mesh testing)")
+    p.add_argument("--master_addr", type=str, default=None,
+                   help="coordinator address (default: first host)")
+    p.add_argument("--master_port", type=int, default=DEFAULT_COORD_PORT)
+    p.add_argument("--launcher", type=str, default="ssh",
+                   choices=sorted(RUNNERS))
+    p.add_argument("--export", action="append", default=[],
+                   metavar="K=V", help="extra env to export to workers")
+    p.add_argument("user_script", type=str)
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def resolve_hosts(args) -> Optional[List[str]]:
+    if args.hostfile is None:
+        return None
+    with open(args.hostfile) as f:
+        hosts = parse_hostfile(f.read())
+    hosts = filter_hosts(hosts, args.include, args.exclude)
+    names = list(hosts)
+    if args.num_nodes > 0:
+        names = names[:args.num_nodes]
+    return names
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    export_env = dict(kv.split("=", 1) for kv in args.export)
+    hosts = resolve_hosts(args)
+
+    if hosts is None or len(hosts) <= 1:
+        # single host: spawn num_procs local workers (1 = plain exec)
+        if args.num_procs <= 1:
+            env = dict(os.environ)
+            env.update(export_env)
+            cmd = [sys.executable, "-u", args.user_script, *args.user_args]
+            return subprocess.call(cmd, env=env)
+        coord = f"localhost:{args.master_port}"
+        procs = []
+        for pid in range(args.num_procs):
+            env = local_worker_env(pid, args.num_procs, coord)
+            env.update(export_env)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-u", args.user_script, *args.user_args],
+                env=env))
+        rc = 0
+        for proc in procs:
+            rc = proc.wait() or rc
+        return rc
+
+    coordinator = f"{args.master_addr or hosts[0]}:{args.master_port}"
+    runner = RUNNERS[args.launcher](hosts, coordinator, args.user_script,
+                                    args.user_args, export_env)
+    procs = [subprocess.Popen(cmd) for cmd in runner.commands()]
+    rc = 0
+    for proc in procs:
+        rc = proc.wait() or rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

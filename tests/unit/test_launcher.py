@@ -1,0 +1,136 @@
+"""Launcher tests — hostfile parsing, filters, runner command construction,
+local spawn env; mirrors the reference's ``tests/unit/launcher/``."""
+
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.env_report import collect_report
+from deepspeed_tpu.launcher.hostfile import (
+    HostfileError,
+    filter_hosts,
+    parse_hostfile,
+)
+from deepspeed_tpu.launcher.multinode_runner import (
+    OpenMPIRunner,
+    PDSHRunner,
+    SlurmRunner,
+    SSHRunner,
+)
+from deepspeed_tpu.launcher.runner import build_parser, resolve_hosts
+
+
+HOSTFILE = """
+# cluster
+worker-0 slots=4
+worker-1 slots=4
+worker-2
+"""
+
+
+class TestHostfile:
+    def test_parse(self):
+        hosts = parse_hostfile(HOSTFILE)
+        assert hosts == {"worker-0": 4, "worker-1": 4, "worker-2": 1}
+
+    def test_duplicate_rejected(self):
+        with pytest.raises(HostfileError, match="duplicate"):
+            parse_hostfile("a slots=1\na slots=2")
+
+    def test_bad_line_rejected(self):
+        with pytest.raises(HostfileError):
+            parse_hostfile("host slots=banana")
+
+    def test_empty_rejected(self):
+        with pytest.raises(HostfileError, match="empty"):
+            parse_hostfile("# nothing\n")
+
+    def test_include_filter(self):
+        hosts = parse_hostfile(HOSTFILE)
+        out = filter_hosts(hosts, include="worker-0@worker-2")
+        assert list(out) == ["worker-0", "worker-2"]
+
+    def test_include_slots(self):
+        hosts = parse_hostfile(HOSTFILE)
+        out = filter_hosts(hosts, include="worker-0:0,2")
+        assert out == {"worker-0": 2}
+
+    def test_exclude_filter(self):
+        hosts = parse_hostfile(HOSTFILE)
+        out = filter_hosts(hosts, exclude="worker-1")
+        assert list(out) == ["worker-0", "worker-2"]
+
+    def test_include_exclude_conflict(self):
+        with pytest.raises(HostfileError, match="mutually exclusive"):
+            filter_hosts(parse_hostfile(HOSTFILE), include="a", exclude="b")
+
+    def test_unknown_host_rejected(self):
+        with pytest.raises(HostfileError, match="unknown"):
+            filter_hosts(parse_hostfile(HOSTFILE), include="nope")
+
+
+class TestRunners:
+    def _mk(self, cls):
+        return cls(["h0", "h1"], "h0:7777", "train.py", ["--lr", "0.1"],
+                   {"FOO": "bar"})
+
+    def test_ssh_one_cmd_per_host_with_rank(self):
+        cmds = self._mk(SSHRunner).commands()
+        assert len(cmds) == 2
+        assert cmds[0][0] == "ssh" and cmds[0][-2] == "h0"
+        assert "DSTPU_PROCESS_ID=0" in cmds[0][-1]
+        assert "DSTPU_PROCESS_ID=1" in cmds[1][-1]
+        assert "DSTPU_NUM_PROCESSES=2" in cmds[0][-1]
+        assert "DSTPU_COORDINATOR=h0:7777" in cmds[0][-1]
+        assert "FOO=bar" in cmds[0][-1]
+
+    def test_pdsh(self):
+        cmds = self._mk(PDSHRunner).commands()
+        assert cmds[0][0] == "pdsh" and "-w" in cmds[0]
+
+    def test_openmpi_single_cmd(self):
+        cmds = self._mk(OpenMPIRunner).commands()
+        assert len(cmds) == 1
+        assert cmds[0][0] == "mpirun"
+        assert "-np" in cmds[0] and "2" in cmds[0]
+
+    def test_slurm_single_cmd(self):
+        cmds = self._mk(SlurmRunner).commands()
+        assert len(cmds) == 1 and cmds[0][0] == "srun"
+        assert "--nodelist=h0,h1" in cmds[0]
+
+
+class TestRunnerCLI:
+    def test_parser_defaults(self):
+        args = build_parser().parse_args(["train.py", "--x", "1"])
+        assert args.user_script == "train.py"
+        assert args.user_args == ["--x", "1"]
+        assert args.launcher == "ssh"
+
+    def test_resolve_hosts_num_nodes(self, tmp_path):
+        hf = tmp_path / "hosts"
+        hf.write_text(HOSTFILE)
+        args = build_parser().parse_args(
+            ["--hostfile", str(hf), "--num_nodes", "2", "t.py"])
+        assert resolve_hosts(args) == ["worker-0", "worker-1"]
+
+    def test_local_exec_roundtrip(self, tmp_path):
+        """`dstpu script.py` single-host path actually runs the script."""
+        script = tmp_path / "probe.py"
+        out = tmp_path / "out.txt"
+        script.write_text(f"open({str(out)!r}, 'w').write('ran')\n")
+        rc = subprocess.call(
+            [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+             str(script)])
+        assert rc == 0
+        assert out.read_text() == "ran"
+
+
+class TestEnvReport:
+    def test_report_collects(self):
+        lines = collect_report()
+        text = "\n".join(lines)
+        assert "deepspeed_tpu" in text
+        assert "flash_attention" in text
+        assert "[FAIL]" not in text
