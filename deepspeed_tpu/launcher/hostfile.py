@@ -39,15 +39,33 @@ def parse_hostfile(text: str) -> "collections.OrderedDict[str, int]":
 
 
 def _parse_filter(expr: str) -> Dict[str, Optional[List[int]]]:
-    """``host1:0,2@host2`` -> {host1: [0, 2], host2: None (all slots)}."""
+    """``host1:0,2@host2`` -> {host1: [0, 2], host2: None (all slots)}.
+    Slot lists are deduplicated; malformed entries raise HostfileError."""
     out: Dict[str, Optional[List[int]]] = {}
     for part in filter(None, expr.split("@")):
         if ":" in part:
             host, slots = part.split(":", 1)
-            out[host] = sorted(int(s) for s in slots.split(","))
+            try:
+                out[host] = sorted({int(s) for s in slots.split(",")})
+            except ValueError:
+                raise HostfileError(
+                    f"bad slot filter {part!r}: expected host:i,j,…")
         else:
             out[part] = None
     return out
+
+
+def _check_slot_indices(filt: Dict[str, Optional[List[int]]],
+                        hosts: "collections.OrderedDict[str, int]",
+                        flag: str):
+    for h, slots in filt.items():
+        if slots is None:
+            continue
+        bad = [s for s in slots if s < 0 or s >= hosts[h]]
+        if bad:
+            raise HostfileError(
+                f"{flag} slot indices {bad} out of range for host {h} "
+                f"(slots={hosts[h]})")
 
 
 def filter_hosts(hosts: "collections.OrderedDict[str, int]",
@@ -61,6 +79,7 @@ def filter_hosts(hosts: "collections.OrderedDict[str, int]",
         unknown = set(inc) - set(hosts)
         if unknown:
             raise HostfileError(f"--include references unknown hosts {unknown}")
+        _check_slot_indices(inc, hosts, "--include")
         result = collections.OrderedDict(
             (h, len(s) if s is not None else hosts[h])
             for h, s in ((h, inc[h]) for h in hosts if h in inc))
@@ -69,6 +88,7 @@ def filter_hosts(hosts: "collections.OrderedDict[str, int]",
         unknown = set(exc) - set(hosts)
         if unknown:
             raise HostfileError(f"--exclude references unknown hosts {unknown}")
+        _check_slot_indices(exc, hosts, "--exclude")
         for h, slots in exc.items():
             if slots is None:
                 result.pop(h, None)

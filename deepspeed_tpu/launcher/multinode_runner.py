@@ -39,7 +39,12 @@ class MultiNodeRunner:
                ENV_PID: str(pid), **self.export_env}
         exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
         args = " ".join(shlex.quote(a) for a in self.user_args)
-        return f"env {exports} {sys.executable} -u {shlex.quote(self.user_script)} {args}".rstrip()
+        # run in the launch directory on the remote side — sshd starts in
+        # $HOME, which would break relative script/data paths (the reference
+        # runner similarly prefixes `cd CWD`)
+        cwd = shlex.quote(os.getcwd())
+        return (f"cd {cwd} && env {exports} {sys.executable} -u "
+                f"{shlex.quote(self.user_script)} {args}").rstrip()
 
     def commands(self) -> List[List[str]]:
         """One argv per host."""
@@ -86,10 +91,13 @@ class SlurmRunner(MultiNodeRunner):
     name = "slurm"
 
     def commands(self) -> List[List[str]]:
+        exports = f"ALL,{ENV_COORD}={self.coordinator}"
+        for k, v in self.export_env.items():
+            exports += f",{k}={v}"
         cmd = ["srun", "-N", str(len(self.hosts)),
                "--ntasks-per-node=1",
                f"--nodelist={','.join(self.hosts)}",
-               f"--export=ALL,{ENV_COORD}={self.coordinator}"]
+               f"--export={exports}"]
         cmd += [sys.executable, "-u", self.user_script, *self.user_args]
         return [cmd]
 

@@ -68,7 +68,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     export_env = dict(kv.split("=", 1) for kv in args.export)
     hosts = resolve_hosts(args)
 
-    if hosts is None or len(hosts) <= 1:
+    # a hostfile naming a single REMOTE host still needs remote dispatch;
+    # only no-hostfile or an explicitly local host runs in-place
+    local_names = {"localhost", "127.0.0.1", os.uname().nodename}
+    if hosts is None or (len(hosts) == 1 and hosts[0] in local_names):
         # single host: spawn num_procs local workers (1 = plain exec)
         if args.num_procs <= 1:
             env = dict(os.environ)

@@ -1,1 +1,2 @@
 from .engine import Engine, TrainState, StepMetrics
+from . import activation_checkpointing

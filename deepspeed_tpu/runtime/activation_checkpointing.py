@@ -1,0 +1,253 @@
+"""Activation checkpointing (rematerialisation).
+
+TPU-native analogue of the reference's Megatron-style activation
+checkpointing (``runtime/activation_checkpointing/checkpointing.py:486``
+``CheckpointFunction``, ``configure()``, ``CudaRNGStatesTracker:124``).
+
+The reference manually stashes forward activations (optionally partitioned
+across MP ranks / moved to CPU / packed into contiguous buffers) and replays
+the forward in backward with a tracked RNG state. On TPU all of that is one
+compiler feature: ``jax.checkpoint`` (remat). The mapping:
+
+==============================  ==============================================
+reference knob                  TPU-native realisation
+==============================  ==============================================
+``checkpoint(fn, *args)``       ``jax.checkpoint(fn)(*args)`` with the
+                                configured policy
+``partition_activations``       saveable residuals carry their sharding —
+                                saved activations stay sharded over the mesh
+                                (``with_sharding_constraint`` inside the
+                                checkpointed fn); no manual scatter needed
+``cpu_checkpointing``           ``save_and_offload_only_these_names`` /
+                                ``offload_dot_products_to_host`` policies —
+                                XLA moves saved residuals to host memory
+``contiguous_memory_...``       XLA buffer assignment (automatic)
+``number_checkpoints``          ``checkpoint_interval``: remat every Nth
+                                block in ``checkpoint_sequential``
+RNG tracker                     explicit ``jax.random`` keys — a fn checkpointed
+                                with the same key replays dropout identically
+                                by construction; no mutable-state tracker
+==============================  ==============================================
+
+The functional surface mirrors the reference: module-level ``configure()``
+then ``checkpoint()``, plus ``checkpoint_sequential`` for layer stacks and
+``model_parallel_reshard`` for the partition_activations semantic.
+"""
+
+from __future__ import annotations
+
+import functools
+import zlib
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..config.config import ActivationCheckpointingConfig
+from ..utils.logging import log_dist
+
+# --------------------------------------------------------------------------- #
+# policy registry
+# --------------------------------------------------------------------------- #
+
+#: Named remat policies (reference: the implicit "save nothing, recompute all"
+#: vs partition/cpu variants become explicit XLA policies here).
+_POLICIES = {
+    # recompute everything (classic checkpointing; reference default)
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    # keep matmul outputs resident, recompute the cheap elementwise tail —
+    # the usual best trade on TPU (MXU results are expensive to recompute)
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "checkpoint_dots": jax.checkpoint_policies.checkpoint_dots,
+    "dots_with_no_batch_dims_saveable":
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "checkpoint_dots_with_no_batch_dims":
+        jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    "everything_saveable": jax.checkpoint_policies.everything_saveable,
+}
+
+
+def resolve_policy(cfg: ActivationCheckpointingConfig):
+    """Config → jax.checkpoint policy callable (or None = save nothing)."""
+    if cfg.policy is not None:
+        try:
+            return _POLICIES[cfg.policy]
+        except KeyError:
+            raise ValueError(
+                f"unknown activation_checkpointing.policy {cfg.policy!r}; "
+                f"known: {sorted(_POLICIES)}")
+    if cfg.cpu_checkpointing:
+        # reference moves stashed activations to CPU (checkpointing.py CPU
+        # path); XLA equivalent: offload saved dot products to host memory
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
+    # reference default: stash only the block inputs, recompute the rest
+    return jax.checkpoint_policies.nothing_saveable
+
+
+# --------------------------------------------------------------------------- #
+# module-level configuration (API parity with reference configure())
+# --------------------------------------------------------------------------- #
+
+_CONFIG = ActivationCheckpointingConfig()
+
+
+def configure(config: Optional[ActivationCheckpointingConfig] = None, **kwargs):
+    """Set the module-level checkpointing behavior.
+
+    Parity: reference ``configure(mpu_, deepspeed_config, ...)`` — here the
+    mesh comes from the global topology, so only the policy knobs remain.
+    """
+    global _CONFIG
+    if config is not None:
+        _CONFIG = config
+    for k, v in kwargs.items():
+        if not hasattr(_CONFIG, k):
+            raise ValueError(f"unknown activation checkpointing option {k!r}")
+        setattr(_CONFIG, k, v)
+    if _CONFIG.profile:
+        log_dist(f"activation checkpointing configured: {_CONFIG}")
+    return _CONFIG
+
+
+def get_config() -> ActivationCheckpointingConfig:
+    return _CONFIG
+
+
+def is_configured() -> bool:
+    return _CONFIG is not None
+
+
+# --------------------------------------------------------------------------- #
+# the checkpoint APIs
+# --------------------------------------------------------------------------- #
+
+def checkpoint(function: Callable, *args,
+               policy=None, static_argnums: Sequence[int] = (), **fn_kwargs):
+    """Checkpoint ``function(*args)``: recompute its activations in backward.
+
+    Drop-in shape of the reference ``checkpoint(function, *args)``
+    (``checkpointing.py:1003``): returns the function outputs; gradients
+    through it rematerialise the forward. Unlike the reference there is no
+    RNG tracker — pass ``jax.random`` keys as ordinary args and determinism
+    is automatic.
+    """
+    pol = policy if policy is not None else resolve_policy(_CONFIG)
+    fn = jax.checkpoint(functools.partial(function, **fn_kwargs)
+                        if fn_kwargs else function,
+                        policy=pol, static_argnums=tuple(static_argnums))
+    return fn(*args)
+
+
+def checkpoint_wrapper(function: Callable, policy=None,
+                       static_argnums: Sequence[int] = ()) -> Callable:
+    """Return a rematerialising version of ``function`` (decorator form)."""
+    pol = policy if policy is not None else resolve_policy(_CONFIG)
+    return jax.checkpoint(function, policy=pol,
+                          static_argnums=tuple(static_argnums))
+
+
+def checkpoint_sequential(block_fn: Callable, stacked_params: Any, x: Any,
+                          *, interval: Optional[int] = None,
+                          policy=None) -> Any:
+    """Apply a stack of identical blocks with every ``interval``-th block
+    checkpointed, scanning over the leading (layer) axis of
+    ``stacked_params``.
+
+    Parity: reference ``activation_checkpoint_interval`` over a
+    ``PipelineModule`` layer list (``runtime/pipe/module.py`` forward), made
+    compiler-friendly: one ``lax.scan`` over layers, blocks remat'd inside.
+
+    ``block_fn(params_i, x) -> x``.
+    """
+    interval = interval if interval is not None else (_CONFIG.number_checkpoints or 1)
+    pol = policy if policy is not None else resolve_policy(_CONFIG)
+
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if interval <= 1:
+        body_fn = jax.checkpoint(lambda h, p: (block_fn(p, h), None), policy=pol)
+        out, _ = jax.lax.scan(body_fn, x, stacked_params)
+        return out
+
+    # group `interval` layers per remat unit: scan over groups, inner scan
+    # over the layers of a group — only group boundaries are saved
+    if n_layers % interval != 0:
+        raise ValueError(
+            f"number of layers ({n_layers}) must divide by checkpoint "
+            f"interval ({interval}) for the scanned remat grouping")
+
+    def regroup(p):
+        return p.reshape((n_layers // interval, interval) + p.shape[1:])
+    grouped = jax.tree_util.tree_map(regroup, stacked_params)
+
+    @functools.partial(jax.checkpoint, policy=pol)
+    def group_fn(h, group_params):
+        def inner(h, p):
+            return block_fn(p, h), None
+        h, _ = jax.lax.scan(inner, h, group_params)
+        return h
+
+    out, _ = jax.lax.scan(lambda h, g: (group_fn(h, g), None), x, grouped)
+    return out
+
+
+def model_parallel_reshard(x: jax.Array, spec) -> jax.Array:
+    """The ``partition_activations`` semantic: constrain a saved activation's
+    sharding so each model-parallel rank stores only its slice.
+
+    In the reference this is an explicit scatter/gather of the stashed tensor
+    across MP ranks (``checkpointing.py`` partition path); under pjit it is a
+    sharding constraint the compiler honors for the saved residual.
+    """
+    from ..parallel.topology import get_topology
+    topo = get_topology()
+    if topo is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(topo.mesh, spec))
+
+
+class CheckpointableRNG:
+    """Explicit-key stand-in for the reference ``CudaRNGStatesTracker``
+    (``checkpointing.py:124``). Holds named keys; ``fork(name)`` returns a
+    fresh subkey deterministically so checkpoint replay sees identical
+    randomness. Provided for API familiarity — idiomatic JAX code should just
+    thread keys."""
+
+    def __init__(self, seed: int = 0):
+        self._keys = {}
+        self._root = jax.random.PRNGKey(seed)
+        self._counter = 0
+
+    def add(self, name: str, seed: int):
+        if name in self._keys:
+            raise ValueError(f"RNG state {name!r} already present")
+        self._keys[name] = jax.random.PRNGKey(seed)
+
+    def get_states(self):
+        return dict(self._keys)
+
+    def set_states(self, states):
+        self._keys = dict(states)
+
+    def fork(self, name: str = "model-parallel-rng") -> jax.Array:
+        if name not in self._keys:
+            # stable digest, NOT hash(): PYTHONHASHSEED randomization would
+            # desynchronize "shared" RNG streams across SPMD hosts
+            self.add(name, zlib.crc32(name.encode()) % (2**31))
+        self._keys[name], sub = jax.random.split(self._keys[name])
+        return sub
+
+
+_MODEL_PARALLEL_RNG = CheckpointableRNG()
+
+
+def get_cuda_rng_tracker() -> CheckpointableRNG:  # name kept for familiarity
+    return _MODEL_PARALLEL_RNG
+
+
+def reset():
+    """Drop module-level state (tests)."""
+    global _CONFIG, _MODEL_PARALLEL_RNG
+    _CONFIG = ActivationCheckpointingConfig()
+    _MODEL_PARALLEL_RNG = CheckpointableRNG()

@@ -1,0 +1,3 @@
+from .curriculum_scheduler import CurriculumScheduler
+from .data_sampler import DeepSpeedDataSampler
+from . import random_ltd

@@ -1,0 +1,115 @@
+"""Curriculum learning scheduler.
+
+Behavioral parity with the reference's curriculum scheduler
+(``runtime/data_pipeline/curriculum_scheduler.py``): a difficulty value
+(e.g. sequence length) as a function of the global step, with
+``fixed_linear`` / ``fixed_root`` / ``fixed_discrete`` / ``custom``
+schedules. Difficulty steps are quantized to ``difficulty_step`` (the
+reference uses 8 so curricula stay MXU/tensor-core friendly — even more
+important on TPU where the lane width is 128).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+from ...config.config import CurriculumLearningConfig
+
+
+class CurriculumScheduler:
+    def __init__(self, config: CurriculumLearningConfig | Dict[str, Any]):
+        if isinstance(config, dict):
+            config = CurriculumLearningConfig.from_dict(config)
+        self.config = config
+        self.schedule_type = config.schedule_type
+        self.min_difficulty = int(config.min_difficulty)
+        self.max_difficulty = int(config.max_difficulty)
+        sc = dict(config.schedule_config)
+        self._custom_fn: Optional[Callable[[int], int]] = None
+
+        if self.schedule_type in ("fixed_linear", "fixed_root"):
+            self.total_curriculum_step = int(sc.get("total_curriculum_step", 1000))
+            self.difficulty_step = int(sc.get("difficulty_step", 8))
+            self.root_degree = int(sc.get("root_degree", 2)) \
+                if self.schedule_type == "fixed_root" else 1
+            if self.difficulty_step <= 0:
+                raise ValueError("difficulty_step must be positive")
+        elif self.schedule_type == "fixed_discrete":
+            self.difficulties = list(sc.get("difficulty", [self.max_difficulty]))
+            self.max_steps = list(sc.get("max_step", []))
+            if len(self.max_steps) != len(self.difficulties) - 1:
+                raise ValueError(
+                    "fixed_discrete needs len(max_step) == len(difficulty) - 1")
+        elif self.schedule_type == "custom":
+            pass  # set via set_custom_get_difficulty
+        else:
+            raise ValueError(f"unknown curriculum schedule_type "
+                             f"{self.schedule_type!r}")
+
+        self.current_difficulty = (self.min_difficulty
+                                   if self.schedule_type == "custom"
+                                   else self.get_difficulty(0))
+
+    # -- parity API -------------------------------------------------------- #
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]):
+        self._custom_fn = fn
+
+    def get_current_difficulty(self) -> int:
+        return self.current_difficulty
+
+    def set_current_difficulty(self, difficulty: int):
+        self.current_difficulty = int(difficulty)
+
+    def update_difficulty(self, global_step: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_step)
+        return self.current_difficulty
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"current_difficulty": self.current_difficulty}
+
+    def set_state(self, state: Dict[str, Any]):
+        self.current_difficulty = int(state["current_difficulty"])
+
+    # -- schedule math ----------------------------------------------------- #
+    def get_difficulty(self, global_step: int) -> int:
+        if self.schedule_type == "fixed_linear":
+            frac = min(1.0, global_step / max(1, self.total_curriculum_step))
+        elif self.schedule_type == "fixed_root":
+            frac = min(1.0, global_step / max(1, self.total_curriculum_step))
+            frac = frac ** (1.0 / self.root_degree)
+        elif self.schedule_type == "fixed_discrete":
+            for difficulty, boundary in zip(self.difficulties, self.max_steps):
+                if global_step < boundary:
+                    return int(difficulty)
+            return int(self.difficulties[-1])
+        elif self.schedule_type == "custom":
+            if self._custom_fn is None:
+                raise RuntimeError("custom schedule requires "
+                                   "set_custom_get_difficulty() first")
+            return int(self._custom_fn(global_step))
+        else:  # pragma: no cover
+            raise AssertionError(self.schedule_type)
+
+        raw = self.min_difficulty + frac * (self.max_difficulty - self.min_difficulty)
+        # quantize UP to a multiple of difficulty_step (reference behavior:
+        # difficulty only presented in difficulty_step multiples)
+        diff = int(math.ceil(raw / self.difficulty_step) * self.difficulty_step)
+        return max(self.min_difficulty, min(self.max_difficulty, diff))
+
+
+def truncate_to_seqlen(batch: Dict[str, Any], seqlen: int,
+                       seq_keys=("tokens", "input_ids", "labels",
+                                 "attention_mask", "position_ids")):
+    """Apply a seqlen curriculum to a token batch: slice the sequence dim.
+
+    Parity: reference GPT curriculum truncates inputs to the scheduled
+    seqlen before the forward (engine data_post_process path). Static-shape
+    caveat on TPU: each distinct seqlen compiles once; quantized
+    ``difficulty_step`` bounds the number of compilations.
+    """
+    out = dict(batch)
+    for k in seq_keys:
+        if k in out and hasattr(out[k], "shape") and out[k].ndim >= 2:
+            out[k] = out[k][:, :seqlen]
+    return out

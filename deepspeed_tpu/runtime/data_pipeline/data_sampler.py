@@ -1,0 +1,113 @@
+"""Curriculum-aware data sampler.
+
+Analogue of the reference ``DeepSpeedDataSampler``
+(``runtime/data_pipeline/data_sampling/data_sampler.py``): samples are
+bucketed by a difficulty metric; at each step only buckets at-or-below the
+scheduler's current difficulty are eligible, and the sampler draws a global
+batch deterministically (seeded by step) then shards it across data-parallel
+ranks. State (step) is checkpointable for exact resume.
+
+The reference builds on-disk difficulty indexes (Megatron indexed datasets +
+``data_analyzer.py``); here the index is an in-memory int array the user
+supplies (or computes with ``analyze_difficulty``), which covers the same
+scheduling semantics without the storage format.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+def analyze_difficulty(dataset, metric_fn: Callable[[Any], int]) -> np.ndarray:
+    """Map a per-sample difficulty metric over a dataset (the in-memory
+    stand-in for the reference's offline ``DataAnalyzer`` map-reduce)."""
+    return np.asarray([metric_fn(dataset[i]) for i in range(len(dataset))],
+                      dtype=np.int64)
+
+
+class DeepSpeedDataSampler:
+    def __init__(self,
+                 difficulties: np.ndarray,
+                 batch_size: int,
+                 scheduler: CurriculumScheduler,
+                 num_replicas: int = 1,
+                 rank: int = 0,
+                 seed: int = 0,
+                 drop_last: bool = True):
+        if batch_size % num_replicas != 0:
+            raise ValueError("global batch_size must divide by num_replicas")
+        self.difficulties = np.asarray(difficulties)
+        self.batch_size = batch_size
+        self.scheduler = scheduler
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.seed = seed
+        self.drop_last = drop_last
+        self.global_step = 0
+        # without-replacement traversal state over the eligible prefix
+        # (parity: the reference sampler walks shuffled epochs, never i.i.d.)
+        self._cursor = 0
+        self._shuffle_epoch = 0
+        self._eligible_n = 0
+        # sort once; eligibility at difficulty d = prefix of this order
+        self._order = np.argsort(self.difficulties, kind="stable")
+        self._sorted_diff = self.difficulties[self._order]
+
+    def _eligible_count(self, difficulty: int) -> int:
+        return int(np.searchsorted(self._sorted_diff, difficulty, side="right"))
+
+    def _perm(self, n: int) -> np.ndarray:
+        return np.random.RandomState(
+            self.seed * 1000003 + self._shuffle_epoch).permutation(n)
+
+    def next_batch_indices(self) -> np.ndarray:
+        """Global-batch index draw for the current step (all ranks agree):
+        a shuffled without-replacement walk of the eligible prefix; when the
+        curriculum widens the prefix, the walk restarts over the new pool."""
+        difficulty = self.scheduler.update_difficulty(self.global_step)
+        n = self._eligible_count(difficulty)
+        if n == 0:
+            raise RuntimeError(
+                f"no samples at difficulty <= {difficulty}; lower "
+                f"min_difficulty or fix the difficulty index")
+        if n != self._eligible_n:
+            self._eligible_n, self._cursor = n, 0
+            self._shuffle_epoch += 1
+        picks = np.empty(self.batch_size, np.int64)
+        filled = 0
+        while filled < self.batch_size:
+            perm = self._perm(n)
+            take = min(self.batch_size - filled, n - self._cursor)
+            picks[filled:filled + take] = perm[self._cursor:self._cursor + take]
+            filled += take
+            self._cursor += take
+            if self._cursor >= n:
+                self._cursor = 0
+                self._shuffle_epoch += 1
+        self.global_step += 1
+        return self._order[picks]
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            global_idx = self.next_batch_indices()
+            per = self.batch_size // self.num_replicas
+            yield global_idx[self.rank * per:(self.rank + 1) * per]
+
+    # -- checkpointable state (parity: sampler state in engine checkpoints) -- #
+    def state_dict(self) -> Dict[str, Any]:
+        return {"global_step": self.global_step,
+                "cursor": self._cursor,
+                "shuffle_epoch": self._shuffle_epoch,
+                "eligible_n": self._eligible_n,
+                "scheduler": self.scheduler.get_state()}
+
+    def load_state_dict(self, state: Dict[str, Any]):
+        self.global_step = int(state["global_step"])
+        self._cursor = int(state["cursor"])
+        self._shuffle_epoch = int(state["shuffle_epoch"])
+        self._eligible_n = int(state["eligible_n"])
+        self.scheduler.set_state(state["scheduler"])

@@ -1,0 +1,130 @@
+"""Random layerwise token dropping (random-LTD).
+
+Analogue of the reference's random-LTD subsystem
+(``runtime/data_pipeline/data_routing/basic_layer.py`` RandomLayerTokenDrop,
+``data_routing/scheduler.py`` RandomLTDScheduler, CUDA gather/scatter in
+``csrc/random_ltd/``): middle transformer layers process only a random
+subset of tokens; the kept-token count grows over training.
+
+TPU-native realisation: the CUDA token-sort/gather/scatter kernels are XLA
+natives — ``jax.random.permutation`` + ``take_along_axis`` + scatter. The
+kept count is *static per compilation*; the scheduler quantizes it
+(``difficulty_step``-style) so the number of recompiles stays small.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# --------------------------------------------------------------------------- #
+# scheduler (host-side)
+# --------------------------------------------------------------------------- #
+
+class RandomLTDScheduler:
+    """Kept-token schedule: fixed_linear ramp from ``min_value`` to
+    ``max_value`` (= full seqlen) over ``schedule_steps``, quantized to
+    ``step_size`` multiples (reference ``data_routing/scheduler.py``)."""
+
+    def __init__(self, min_value: int, max_value: int,
+                 schedule_steps: int, step_size: int = 16):
+        if not (0 < min_value <= max_value):
+            raise ValueError("need 0 < min_value <= max_value")
+        self.min_value = min_value
+        self.max_value = max_value
+        self.schedule_steps = max(1, schedule_steps)
+        self.step_size = max(1, step_size)
+        self.current_value = min_value
+
+    def get_value(self, global_step: int) -> int:
+        frac = min(1.0, global_step / self.schedule_steps)
+        raw = self.min_value + frac * (self.max_value - self.min_value)
+        v = int(math.ceil(raw / self.step_size) * self.step_size)
+        return max(self.min_value, min(self.max_value, v))
+
+    def update(self, global_step: int) -> int:
+        self.current_value = self.get_value(global_step)
+        return self.current_value
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"current_value": self.current_value}
+
+    def load_state_dict(self, state: Dict[str, Any]):
+        self.current_value = int(state["current_value"])
+
+
+# --------------------------------------------------------------------------- #
+# functional token routing (inside jit)
+# --------------------------------------------------------------------------- #
+
+def sample_token_routing(key: jax.Array, seq_len: int, num_keep: int,
+                         batch_size: int) -> Tuple[jax.Array, jax.Array]:
+    """Per-sample random choice of ``num_keep`` token slots.
+
+    Returns ``(keep_idx [B, k] sorted ascending, drop_mask [B, S] bool)``.
+    Sorted keep order preserves causal ordering for decoder layers — the
+    reference sorts the sampled indices for the same reason (token_sort.cu).
+    """
+    perms = jax.vmap(lambda k: jax.random.permutation(k, seq_len))(
+        jax.random.split(key, batch_size))
+    keep_idx = jnp.sort(perms[:, :num_keep], axis=-1)
+    drop_mask = jnp.ones((batch_size, seq_len), bool).at[
+        jnp.arange(batch_size)[:, None], keep_idx].set(False)
+    return keep_idx, drop_mask
+
+
+def gather_tokens(hidden: jax.Array, keep_idx: jax.Array) -> jax.Array:
+    """[B, S, D] × [B, k] -> [B, k, D] (reference gather_tokens kernel)."""
+    return jnp.take_along_axis(hidden, keep_idx[:, :, None], axis=1)
+
+
+def scatter_tokens(full: jax.Array, processed: jax.Array,
+                   keep_idx: jax.Array) -> jax.Array:
+    """Write processed kept tokens back into the full sequence; dropped
+    tokens keep their input value (residual pass-through — reference
+    scatter_tokens kernel semantics)."""
+    b = jnp.arange(full.shape[0])[:, None]
+    return full.at[b, keep_idx].set(processed)
+
+
+def random_ltd_layer(layer_fn: Callable[[jax.Array], jax.Array],
+                     hidden: jax.Array, key: jax.Array,
+                     num_keep: int) -> jax.Array:
+    """Apply ``layer_fn`` to a random ``num_keep``-token subsequence.
+
+    ``num_keep`` must be static (Python int) — the scheduler quantizes it.
+    Equivalent of wrapping a layer in the reference RandomLayerTokenDrop.
+    """
+    B, S, _ = hidden.shape
+    if num_keep >= S:
+        return layer_fn(hidden)
+    keep_idx, _ = sample_token_routing(key, S, num_keep, B)
+    sub = gather_tokens(hidden, keep_idx)
+    out = layer_fn(sub)
+    return scatter_tokens(hidden, out, keep_idx)
+
+
+class RandomLTD:
+    """Stateful convenience wrapper pairing the scheduler with the routing,
+    mirroring the reference's engine integration: ``apply(layer_fn, h, key,
+    global_step)`` and checkpointable state."""
+
+    def __init__(self, min_keep: int, seq_len: int, schedule_steps: int,
+                 step_size: int = 16):
+        self.scheduler = RandomLTDScheduler(min_keep, seq_len,
+                                            schedule_steps, step_size)
+
+    def apply(self, layer_fn, hidden, key, global_step: int):
+        return random_ltd_layer(layer_fn, hidden, key,
+                                self.scheduler.update(global_step))
+
+    def state_dict(self):
+        return self.scheduler.state_dict()
+
+    def load_state_dict(self, state):
+        self.scheduler.load_state_dict(state)

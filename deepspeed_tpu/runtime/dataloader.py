@@ -1,0 +1,158 @@
+"""Data loading.
+
+TPU-native analogue of ``runtime/dataloader.py`` (``DeepSpeedDataLoader``,
+``RepeatingLoader``) and the distributed sampler it builds. The reference
+wraps ``torch.utils.data.DataLoader`` with a ``DistributedSampler``; here a
+loader is any iterable of numpy/JAX pytrees, and the framework supplies:
+
+- ``DistributedSampler`` — deterministic, epoch-seeded shard of indices per
+  data-parallel rank (drop_last / pad semantics like the torch sampler).
+- ``DeepSpeedTPULoader`` — batches an indexable dataset with a sampler,
+  collates to numpy, optionally feeds a curriculum/data-efficiency sampler.
+- ``RepeatingLoader`` — infinite cycling wrapper (parity:
+  ``runtime/dataloader.py`` RepeatingLoader).
+
+Under SPMD each *host* loads the global batch for its addressable devices;
+``jax.device_put`` with the batch sharding happens in the engine, so the
+loader stays framework-agnostic (plain numpy).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class DistributedSampler:
+    """Index shard for one data-parallel rank.
+
+    Mirrors torch's DistributedSampler semantics the reference relies on:
+    epoch-seeded shuffle, padding to a multiple of world size (or drop_last).
+    """
+
+    def __init__(self, dataset_len: int, num_replicas: int = 1, rank: int = 0,
+                 shuffle: bool = True, seed: int = 0, drop_last: bool = False):
+        if rank >= num_replicas or rank < 0:
+            raise ValueError(f"rank {rank} out of range for {num_replicas} replicas")
+        self.dataset_len = dataset_len
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        if drop_last:
+            self.num_samples = dataset_len // num_replicas
+        else:
+            self.num_samples = math.ceil(dataset_len / num_replicas)
+        self.total_size = self.num_samples * num_replicas
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        return self.num_samples
+
+    def __iter__(self) -> Iterator[int]:
+        if self.shuffle:
+            g = np.random.RandomState(self.seed + self.epoch)
+            indices = g.permutation(self.dataset_len)
+        else:
+            indices = np.arange(self.dataset_len)
+        if self.drop_last:
+            indices = indices[:self.total_size]
+        else:  # pad by wrapping
+            pad = self.total_size - len(indices)
+            if pad > 0:
+                indices = np.concatenate([indices, indices[:pad]])
+        return iter(indices[self.rank:self.total_size:self.num_replicas].tolist())
+
+
+def default_collate(samples: Sequence[Any]) -> Any:
+    """Stack a list of sample pytrees (dicts/tuples/arrays) into batch arrays."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: default_collate([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(default_collate([s[i] for s in samples])
+                           for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class DeepSpeedTPULoader:
+    """Batching loader over an indexable dataset.
+
+    Parity surface of ``DeepSpeedDataLoader``: ``__iter__``/``__len__``,
+    per-epoch resharding via the sampler, optional curriculum post-processing
+    hook (``data_post_process`` in the reference engine) applied per batch.
+    """
+
+    def __init__(self, dataset, batch_size: int,
+                 sampler: Optional[DistributedSampler] = None,
+                 collate_fn: Callable = default_collate,
+                 drop_last: bool = True,
+                 post_process_fn: Optional[Callable] = None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.sampler = sampler or DistributedSampler(len(dataset), shuffle=False)
+        self.collate_fn = collate_fn
+        self.drop_last = drop_last
+        self.post_process_fn = post_process_fn
+        self._epoch = 0
+
+    def __len__(self):
+        n = len(self.sampler)
+        return n // self.batch_size if self.drop_last else math.ceil(n / self.batch_size)
+
+    def set_epoch(self, epoch: int):
+        """Advance the shuffle epoch explicitly (checkpoint-resumable —
+        iterating does NOT mutate it, so replay/peeking is deterministic)."""
+        self._epoch = epoch
+
+    def state_dict(self):
+        return {"epoch": self._epoch}
+
+    def load_state_dict(self, state):
+        self._epoch = int(state["epoch"])
+
+    def __iter__(self):
+        self.sampler.set_epoch(self._epoch)
+        buf = []
+        for idx in self.sampler:
+            buf.append(self.dataset[idx])
+            if len(buf) == self.batch_size:
+                yield self._emit(buf)
+                buf = []
+        if buf and not self.drop_last:
+            yield self._emit(buf)
+
+    def _emit(self, buf):
+        batch = self.collate_fn(buf)
+        if self.post_process_fn is not None:
+            batch = self.post_process_fn(batch)
+        return batch
+
+
+class RepeatingLoader:
+    """Infinite cycling wrapper (reference ``RepeatingLoader``,
+    ``runtime/dataloader.py``): restart the underlying iterator on
+    StopIteration so pipeline/grad-accum code never sees epoch ends."""
+
+    def __init__(self, loader: Iterable):
+        self.loader = loader
+        self._iter = iter(loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._iter)
+        except StopIteration:
+            # advance the shuffle epoch on wrap so cycles see fresh order
+            if hasattr(self.loader, "set_epoch") and hasattr(self.loader, "_epoch"):
+                self.loader.set_epoch(self.loader._epoch + 1)
+            self._iter = iter(self.loader)
+            return next(self._iter)

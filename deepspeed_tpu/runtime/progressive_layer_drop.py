@@ -1,0 +1,59 @@
+"""Progressive layer drop (PLD).
+
+Analogue of the reference ``runtime/progressive_layer_drop.py`` + its engine
+hook (``engine.py:346,1871``): a global keep-probability theta that decays
+from 1.0 toward ``theta`` with rate ``gamma`` over steps; transformer blocks
+are stochastically skipped with depth-scaled keep probability
+(theta * (i+1)/L on layer i — "lower layers drop less" from the PLD paper).
+
+``stochastic_depth_block`` is the in-jit helper: ``lax.cond``-free — it
+blends via a 0/1 bernoulli multiplier so the program stays branchless and
+MXU-friendly (both paths are cheap relative to divergent compilation).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = float(theta)
+        self.gamma = float(gamma)
+        self.current_theta = 1.0
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = ((1.0 - self.theta)
+                              * math.exp(-self.gamma * global_step)
+                              + self.theta)
+        return self.current_theta
+
+    def get_state(self) -> Dict[str, float]:
+        return {"progressive_layer_drop": True, "pld_theta": self.current_theta}
+
+
+def layer_keep_prob(theta: jax.Array | float, layer_idx: int,
+                    num_layers: int) -> jax.Array:
+    """Depth-scaled keep probability: shallower layers keep more."""
+    return 1.0 - (1.0 - jnp.asarray(theta)) * (layer_idx + 1) / num_layers
+
+
+def stochastic_depth_block(block_fn: Callable[[jax.Array], jax.Array],
+                           h: jax.Array, key: jax.Array,
+                           theta: jax.Array | float,
+                           layer_idx: int, num_layers: int,
+                           deterministic: bool = False) -> jax.Array:
+    """Residual block with PLD: output = h + keep * f(h) / p (inverted
+    scaling keeps expectations unchanged, so eval needs no rescale)."""
+    p = layer_keep_prob(theta, layer_idx, num_layers)
+    if deterministic:
+        return h + block_fn(h)
+    keep = jax.random.bernoulli(key, p).astype(h.dtype)
+    return h + keep * block_fn(h) / jnp.maximum(p, 1e-6).astype(h.dtype)

@@ -1,0 +1,240 @@
+"""Dataloader + data-efficiency tests (curriculum, sampler, random-LTD, PLD).
+
+Mirrors the reference's ``tests/unit/runtime/test_data.py`` +
+data-efficiency unit tests: sampler sharding invariants, curriculum
+schedule math, random-LTD routing correctness.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.dataloader import (
+    DeepSpeedTPULoader, DistributedSampler, RepeatingLoader, default_collate)
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler, truncate_to_seqlen)
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+    DeepSpeedDataSampler, analyze_difficulty)
+from deepspeed_tpu.runtime.data_pipeline import random_ltd
+from deepspeed_tpu.runtime.progressive_layer_drop import (
+    ProgressiveLayerDrop, stochastic_depth_block)
+from deepspeed_tpu.config.config import CurriculumLearningConfig
+
+
+class TestDistributedSampler:
+    def test_partition_complete_and_disjoint(self):
+        n, world = 103, 4
+        seen = []
+        for r in range(world):
+            s = DistributedSampler(n, num_replicas=world, rank=r, shuffle=True)
+            idx = list(s)
+            assert len(idx) == s.num_samples
+            seen.extend(idx)
+        # padded to total_size; every real index appears at least once
+        assert set(seen) == set(range(n))
+
+    def test_drop_last(self):
+        s = DistributedSampler(103, num_replicas=4, rank=0, drop_last=True)
+        assert s.num_samples == 25
+
+    def test_epoch_reshuffle_deterministic(self):
+        s = DistributedSampler(50, num_replicas=2, rank=1, seed=3)
+        s.set_epoch(0); a = list(s)
+        s.set_epoch(1); b = list(s)
+        s.set_epoch(0); c = list(s)
+        assert a == c and a != b
+
+
+class TestLoader:
+    def _ds(self, n=20):
+        return [{"x": np.full((3,), i), "y": i} for i in range(n)]
+
+    def test_batches(self):
+        dl = DeepSpeedTPULoader(self._ds(), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == len(dl) == 5
+        assert batches[0]["x"].shape == (4, 3)
+
+    def test_post_process(self):
+        dl = DeepSpeedTPULoader(
+            self._ds(), batch_size=4,
+            post_process_fn=lambda b: {**b, "x": b["x"] * 0})
+        assert np.all(next(iter(dl))["x"] == 0)
+
+    def test_repeating(self):
+        dl = RepeatingLoader(DeepSpeedTPULoader(self._ds(8), batch_size=4))
+        out = [next(dl) for _ in range(5)]  # 2 batches/epoch, keeps going
+        assert len(out) == 5
+
+    def test_collate_tuples(self):
+        got = default_collate([(np.ones(2), 1), (np.zeros(2), 2)])
+        assert got[0].shape == (2, 2) and list(got[1]) == [1, 2]
+
+
+class TestCurriculum:
+    def test_fixed_linear(self):
+        s = CurriculumScheduler(CurriculumLearningConfig(
+            enabled=True, min_difficulty=8, max_difficulty=64,
+            schedule_type="fixed_linear",
+            schedule_config={"total_curriculum_step": 100, "difficulty_step": 8}))
+        assert s.get_difficulty(0) == 8
+        assert s.get_difficulty(100) == 64
+        assert s.get_difficulty(1000) == 64
+        mid = s.get_difficulty(50)
+        assert 8 < mid < 64 and mid % 8 == 0
+        # monotone
+        vals = [s.get_difficulty(t) for t in range(0, 101, 10)]
+        assert vals == sorted(vals)
+
+    def test_fixed_root(self):
+        s = CurriculumScheduler(CurriculumLearningConfig(
+            min_difficulty=8, max_difficulty=64, schedule_type="fixed_root",
+            schedule_config={"total_curriculum_step": 100, "difficulty_step": 8,
+                             "root_degree": 2}))
+        # sqrt ramp is ahead of linear mid-schedule
+        assert s.get_difficulty(25) >= 8 + 0.5 * 56 - 8
+
+    def test_fixed_discrete(self):
+        s = CurriculumScheduler(CurriculumLearningConfig(
+            schedule_type="fixed_discrete",
+            schedule_config={"difficulty": [16, 32, 64], "max_step": [10, 20]}))
+        assert s.get_difficulty(5) == 16
+        assert s.get_difficulty(15) == 32
+        assert s.get_difficulty(25) == 64
+
+    def test_custom_and_state(self):
+        s = CurriculumScheduler(CurriculumLearningConfig(schedule_type="custom"))
+        s.set_custom_get_difficulty(lambda t: 8 + t)
+        assert s.update_difficulty(4) == 12
+        st = s.get_state()
+        s.update_difficulty(100)
+        s.set_state(st)
+        assert s.get_current_difficulty() == 12
+
+    def test_truncate(self):
+        b = {"tokens": np.ones((2, 64)), "other": np.ones((2,))}
+        out = truncate_to_seqlen(b, 16)
+        assert out["tokens"].shape == (2, 16)
+        assert out["other"].shape == (2,)
+
+
+class TestDataSampler:
+    def test_difficulty_gating_and_resume(self):
+        diffs = np.arange(100)  # sample i has difficulty i
+        sched = CurriculumScheduler(CurriculumLearningConfig(
+            min_difficulty=10, max_difficulty=100,
+            schedule_type="fixed_linear",
+            schedule_config={"total_curriculum_step": 50, "difficulty_step": 10}))
+        samp = DeepSpeedDataSampler(diffs, batch_size=8, scheduler=sched,
+                                    num_replicas=2, rank=0, seed=1)
+        first = samp.next_batch_indices()
+        assert np.all(diffs[first] <= 10)
+        st = samp.state_dict()
+        a = samp.next_batch_indices()
+        samp.load_state_dict(st)
+        b = samp.next_batch_indices()
+        np.testing.assert_array_equal(a, b)
+
+    def test_rank_shard_agreement(self):
+        diffs = np.arange(40)
+        def mk(rank):
+            sched = CurriculumScheduler(CurriculumLearningConfig(
+                min_difficulty=40, max_difficulty=40,
+                schedule_type="fixed_linear",
+                schedule_config={"total_curriculum_step": 1}))
+            return DeepSpeedDataSampler(diffs, batch_size=8, scheduler=sched,
+                                        num_replicas=2, rank=rank, seed=5)
+        i0, i1 = iter(mk(0)), iter(mk(1))
+        a, b = next(i0), next(i1)
+        assert len(a) == len(b) == 4
+        assert not np.array_equal(a, b)
+
+    def test_without_replacement_coverage(self):
+        # fixed difficulty → the walk must cover every eligible sample
+        # exactly once per shuffle epoch (no duplicates within an epoch)
+        diffs = np.arange(32)
+        sched = CurriculumScheduler(CurriculumLearningConfig(
+            min_difficulty=32, max_difficulty=32,
+            schedule_type="fixed_linear",
+            schedule_config={"total_curriculum_step": 1}))
+        samp = DeepSpeedDataSampler(diffs, batch_size=8, scheduler=sched,
+                                    num_replicas=1, rank=0, seed=2)
+        epoch = np.concatenate([samp.next_batch_indices() for _ in range(4)])
+        assert sorted(epoch.tolist()) == list(range(32))
+
+    def test_analyze(self):
+        ds = [{"tokens": np.zeros(i + 1)} for i in range(5)]
+        d = analyze_difficulty(ds, lambda s: len(s["tokens"]))
+        np.testing.assert_array_equal(d, [1, 2, 3, 4, 5])
+
+
+class TestRandomLTD:
+    def test_scheduler_ramp(self):
+        s = random_ltd.RandomLTDScheduler(min_value=32, max_value=128,
+                                          schedule_steps=100, step_size=16)
+        assert s.get_value(0) == 32
+        assert s.get_value(100) == 128
+        v = s.get_value(50)
+        assert 32 < v <= 128 and v % 16 == 0
+
+    def test_routing_roundtrip(self):
+        h = jnp.arange(2 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 4)
+        key = jax.random.PRNGKey(0)
+        keep_idx, drop_mask = random_ltd.sample_token_routing(key, 8, 5, 2)
+        assert keep_idx.shape == (2, 5)
+        # sorted, unique per row
+        for r in range(2):
+            row = np.asarray(keep_idx[r])
+            assert np.all(np.diff(row) > 0)
+        assert int(drop_mask.sum()) == 2 * 3
+
+        # identity layer → scatter(gather(x)) == x on kept slots, x elsewhere
+        out = random_ltd.random_ltd_layer(lambda x: x, h, key, 5)
+        np.testing.assert_allclose(out, h)
+
+    def test_layer_applies_only_to_kept(self):
+        h = jnp.ones((1, 8, 2))
+        out = random_ltd.random_ltd_layer(lambda x: x * 2, h,
+                                          jax.random.PRNGKey(1), 3)
+        # 3 tokens doubled, 5 untouched
+        doubled = int((out[0, :, 0] == 2).sum())
+        assert doubled == 3
+
+    def test_full_keep_passthrough(self):
+        h = jnp.ones((1, 4, 2))
+        out = random_ltd.random_ltd_layer(lambda x: x * 3, h,
+                                          jax.random.PRNGKey(0), 4)
+        np.testing.assert_allclose(out, 3 * h)
+
+    def test_jit_compatible(self):
+        h = jnp.ones((2, 16, 4))
+        f = jax.jit(lambda h_, k: random_ltd.random_ltd_layer(
+            lambda x: x + 1, h_, k, 8))
+        out = f(h, jax.random.PRNGKey(0))
+        assert out.shape == h.shape
+
+
+class TestPLD:
+    def test_theta_decay(self):
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        assert pld.update_state(0) == pytest.approx(1.0)
+        assert pld.update_state(10**6) == pytest.approx(0.5)
+        mid = pld.update_state(100)
+        assert 0.5 < mid < 1.0
+        assert pld.get_state()["pld_theta"] == mid
+
+    def test_block_deterministic(self):
+        h = jnp.ones((2, 4))
+        out = stochastic_depth_block(lambda x: x * 2, h, jax.random.PRNGKey(0),
+                                     theta=0.5, layer_idx=0, num_layers=2,
+                                     deterministic=True)
+        np.testing.assert_allclose(out, 3 * h)
+
+    def test_block_expectation(self):
+        h = jnp.ones((1, 1))
+        keys = jax.random.split(jax.random.PRNGKey(0), 512)
+        outs = jax.vmap(lambda k: stochastic_depth_block(
+            lambda x: x * 2, h, k, theta=0.5, layer_idx=1, num_layers=2))(keys)
+        # E[out] = h + f(h) = 3 regardless of p (inverted scaling)
+        assert float(outs.mean()) == pytest.approx(3.0, abs=0.25)
