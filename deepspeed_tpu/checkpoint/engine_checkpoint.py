@@ -105,6 +105,7 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
 
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True,
+                    load_lr_scheduler_states: bool = True,
                     load_module_only: bool = False) -> Tuple[Optional[str], dict]:
     """Restore engine state, re-placing leaves onto the engine's (possibly
     different-shaped) mesh — elastic resume needs no conversion step.
@@ -121,6 +122,10 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
 
     if load_module_only or not load_optimizer_states:
         state = engine.state._replace(params=state.params, step=state.step)
+    if not load_lr_scheduler_states:
+        # the LR schedule is a pure function of the step counter; restarting
+        # the schedule fresh means restarting the counter
+        state = state._replace(step=jax.numpy.zeros((), jax.numpy.int32))
 
     # re-shard onto this engine's mesh (may differ from the saving mesh)
     engine.state = jax.tree_util.tree_map(

@@ -1,0 +1,10 @@
+"""Native host IO: async file IO + pinned buffers (reference csrc/aio/,
+ops/aio) and the JIT op build system (reference op_builder/)."""
+
+from .aio import AioHandle, PinnedBuffer, aio_available
+from .builder import ALL_OPS, AsyncIOBuilder, OpBuilder, get_op_builder
+
+__all__ = [
+    "AioHandle", "PinnedBuffer", "aio_available",
+    "OpBuilder", "AsyncIOBuilder", "ALL_OPS", "get_op_builder",
+]

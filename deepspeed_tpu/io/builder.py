@@ -1,0 +1,114 @@
+"""JIT build system for native host ops.
+
+TPU-native analogue of the reference's ``op_builder/`` (OpBuilder ABC,
+``op_builder/builder.py:109``; JIT ``.load()`` path ``builder.py:514``): each
+named builder compiles its C++ sources into a shared library on first use and
+caches the artifact keyed by a source hash. There is no CUDA arch matrix to
+manage on TPU — native code here is *host-side* (IO, schedulers), so the
+toolchain is plain g++ and the binding is ctypes, not torch cpp_extension.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from ..utils.logging import logger
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_CACHE_DIR = Path(
+    os.environ.get("DS_TPU_OP_CACHE",
+                   os.path.join(os.path.expanduser("~"), ".cache",
+                                "deepspeed_tpu", "ops")))
+
+_LOADED: Dict[str, ctypes.CDLL] = {}
+
+
+class OpBuilder:
+    """Compile C++ sources to a .so and load via ctypes.
+
+    Mirrors the reference ``OpBuilder`` surface that matters on TPU:
+    ``name``, ``sources()``, ``is_compatible()``, ``load()``.
+    """
+
+    NAME = "base"
+
+    def sources(self) -> List[Path]:
+        raise NotImplementedError
+
+    def extra_cxx_flags(self) -> List[str]:
+        return []
+
+    def extra_ld_flags(self) -> List[str]:
+        return []
+
+    def compiler(self) -> str:
+        return os.environ.get("CXX", "g++")
+
+    def is_compatible(self) -> bool:
+        from shutil import which
+        return which(self.compiler()) is not None
+
+    # ------------------------------------------------------------------ #
+
+    def _source_hash(self) -> str:
+        h = hashlib.sha256()
+        for src in self.sources():
+            h.update(src.read_bytes())
+        h.update(" ".join(self.extra_cxx_flags() + self.extra_ld_flags())
+                 .encode())
+        return h.hexdigest()[:16]
+
+    def artifact_path(self) -> Path:
+        return _CACHE_DIR / f"lib{self.NAME}_{self._source_hash()}.so"
+
+    def build(self) -> Path:
+        out = self.artifact_path()
+        if out.exists():
+            return out
+        out.parent.mkdir(parents=True, exist_ok=True)
+        tmp = out.with_suffix(".so.tmp")
+        cmd = ([self.compiler(), "-O3", "-fPIC", "-shared", "-std=c++17",
+                "-pthread"]
+               + self.extra_cxx_flags()
+               + [str(s) for s in self.sources()]
+               + ["-o", str(tmp)]
+               + self.extra_ld_flags())
+        logger.info("building native op %s: %s", self.NAME, " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native build of op '{self.NAME}' failed:\n{proc.stderr}")
+        os.replace(tmp, out)
+        return out
+
+    def load(self) -> ctypes.CDLL:
+        if self.NAME in _LOADED:
+            return _LOADED[self.NAME]
+        if not self.is_compatible():
+            raise RuntimeError(
+                f"op '{self.NAME}' is not compatible on this host "
+                f"(compiler '{self.compiler()}' not found)")
+        lib = ctypes.CDLL(str(self.build()))
+        _LOADED[self.NAME] = lib
+        return lib
+
+
+class AsyncIOBuilder(OpBuilder):
+    """Builds the aio host library (csrc/aio/ds_aio.cpp)."""
+
+    NAME = "ds_aio"
+
+    def sources(self) -> List[Path]:
+        return [_REPO_ROOT / "csrc" / "aio" / "ds_aio.cpp"]
+
+
+ALL_OPS = {b.NAME: b for b in [AsyncIOBuilder()]}
+
+
+def get_op_builder(name: str) -> Optional[OpBuilder]:
+    return ALL_OPS.get(name)

@@ -91,13 +91,14 @@ class SlurmRunner(MultiNodeRunner):
     name = "slurm"
 
     def commands(self) -> List[List[str]]:
-        exports = f"ALL,{ENV_COORD}={self.coordinator}"
-        for k, v in self.export_env.items():
-            exports += f",{k}={v}"
+        # env values go through `env` on the remote side, not --export:
+        # srun splits --export on commas, corrupting any value containing one
         cmd = ["srun", "-N", str(len(self.hosts)),
                "--ntasks-per-node=1",
                f"--nodelist={','.join(self.hosts)}",
-               f"--export={exports}"]
+               "--export=ALL"]
+        envs = {ENV_COORD: self.coordinator, **self.export_env}
+        cmd += ["env"] + [f"{k}={v}" for k, v in envs.items()]
         cmd += [sys.executable, "-u", self.user_script, *self.user_args]
         return [cmd]
 

@@ -90,6 +90,7 @@ def resolve_policy(cfg: ActivationCheckpointingConfig):
 # --------------------------------------------------------------------------- #
 
 _CONFIG = ActivationCheckpointingConfig()
+_CONFIGURED = False
 
 
 def configure(config: Optional[ActivationCheckpointingConfig] = None, **kwargs):
@@ -98,7 +99,8 @@ def configure(config: Optional[ActivationCheckpointingConfig] = None, **kwargs):
     Parity: reference ``configure(mpu_, deepspeed_config, ...)`` — here the
     mesh comes from the global topology, so only the policy knobs remain.
     """
-    global _CONFIG
+    global _CONFIG, _CONFIGURED
+    _CONFIGURED = True
     if config is not None:
         _CONFIG = config
     for k, v in kwargs.items():
@@ -115,7 +117,9 @@ def get_config() -> ActivationCheckpointingConfig:
 
 
 def is_configured() -> bool:
-    return _CONFIG is not None
+    """True once ``configure()`` has been called (reference semantics:
+    gate for one-time configuration)."""
+    return _CONFIGURED
 
 
 # --------------------------------------------------------------------------- #
@@ -160,10 +164,14 @@ def checkpoint_sequential(block_fn: Callable, stacked_params: Any, x: Any,
 
     ``block_fn(params_i, x) -> x``.
     """
-    interval = interval if interval is not None else (_CONFIG.number_checkpoints or 1)
     pol = policy if policy is not None else resolve_policy(_CONFIG)
 
     n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if interval is None:
+        # config carries the NUMBER of checkpoint regions (reference
+        # `number_checkpoints`); the per-region layer count is derived
+        n_regions = _CONFIG.number_checkpoints or n_layers
+        interval = max(1, n_layers // n_regions)
     if interval <= 1:
         body_fn = jax.checkpoint(lambda h, p: (block_fn(p, h), None), policy=pol)
         out, _ = jax.lax.scan(body_fn, x, stacked_params)
@@ -216,8 +224,7 @@ class CheckpointableRNG:
 
     def __init__(self, seed: int = 0):
         self._keys = {}
-        self._root = jax.random.PRNGKey(seed)
-        self._counter = 0
+        self._seed = seed  # folded into auto-created stream seeds
 
     def add(self, name: str, seed: int):
         if name in self._keys:
@@ -234,7 +241,7 @@ class CheckpointableRNG:
         if name not in self._keys:
             # stable digest, NOT hash(): PYTHONHASHSEED randomization would
             # desynchronize "shared" RNG streams across SPMD hosts
-            self.add(name, zlib.crc32(name.encode()) % (2**31))
+            self.add(name, (zlib.crc32(name.encode()) ^ self._seed) % (2**31))
         self._keys[name], sub = jax.random.split(self._keys[name])
         return sub
 

@@ -56,13 +56,21 @@ class DeepSpeedDataSampler:
         # sort once; eligibility at difficulty d = prefix of this order
         self._order = np.argsort(self.difficulties, kind="stable")
         self._sorted_diff = self.difficulties[self._order]
+        # permutations are O(n); cache per (n, shuffle_epoch) so steady-state
+        # steps only index into it
+        self._perm_key = None
+        self._perm_val = None
 
     def _eligible_count(self, difficulty: int) -> int:
         return int(np.searchsorted(self._sorted_diff, difficulty, side="right"))
 
     def _perm(self, n: int) -> np.ndarray:
-        return np.random.RandomState(
-            self.seed * 1000003 + self._shuffle_epoch).permutation(n)
+        key = (n, self._shuffle_epoch)
+        if self._perm_key != key:
+            self._perm_key = key
+            self._perm_val = np.random.RandomState(
+                self.seed * 1000003 + self._shuffle_epoch).permutation(n)
+        return self._perm_val
 
     def next_batch_indices(self) -> np.ndarray:
         """Global-batch index draw for the current step (all ranks agree):

@@ -63,10 +63,11 @@ class DistributedSampler:
             indices = np.arange(self.dataset_len)
         if self.drop_last:
             indices = indices[:self.total_size]
-        else:  # pad by wrapping
+        else:  # pad by wrapping (repeat as often as needed, torch semantics)
             pad = self.total_size - len(indices)
             if pad > 0:
-                indices = np.concatenate([indices, indices[:pad]])
+                reps = math.ceil(pad / len(indices))
+                indices = np.concatenate([indices] + [indices] * reps)[:self.total_size]
         return iter(indices[self.rank:self.total_size:self.num_replicas].tolist())
 
 
@@ -75,6 +76,9 @@ def default_collate(samples: Sequence[Any]) -> Any:
     first = samples[0]
     if isinstance(first, dict):
         return {k: default_collate([s[k] for s in samples]) for k in first}
+    if isinstance(first, tuple) and hasattr(first, "_fields"):  # namedtuple
+        return type(first)(*(default_collate([s[i] for s in samples])
+                             for i in range(len(first))))
     if isinstance(first, (tuple, list)):
         return type(first)(default_collate([s[i] for s in samples])
                            for i in range(len(first)))

@@ -121,6 +121,21 @@ class Engine:
         self._state_shardings = self._compute_state_shardings(self.state)
         self.state = self._place_state(self.state)
 
+        # Offloaded optimizer state lives off-HBM between steps — pinned host
+        # memory (cpu) or NVMe files through the native aio library (nvme)
+        # (reference: runtime/swap_tensor/partitioned_optimizer_swapper.py,
+        # stage_1_and_2.py CPU-offload path)
+        self._opt_swapper = None
+        offload_dev = config.zero_optimization.offload_optimizer.device
+        if offload_dev == "nvme":
+            from .zero.offload import NvmeOptimizerSwapper
+            self._opt_swapper = NvmeOptimizerSwapper(
+                config.zero_optimization.offload_optimizer)
+        elif offload_dev == "cpu":
+            from .zero.offload import CpuOptimizerSwapper
+            self._opt_swapper = CpuOptimizerSwapper(
+                self.zero_plan.opt_state_host_shardings(self.state.opt_state))
+
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step() if (eval_fn or loss_fn) else None
 
@@ -193,6 +208,7 @@ class Engine:
         compute_dtype = self.compute_dtype
         accum_dtype = self._grad_accum_dtype
         batch_sharding = self._batch_sharding()
+
 
         def micro_grads(params, micro_batch, rng, scale_state):
             cparams = cast_floating(params, compute_dtype)
@@ -328,7 +344,9 @@ class Engine:
 
         if self.flops_profiler is not None:
             self.flops_profiler.maybe_start(self.global_steps, batch)
+        self._ensure_opt_state_resident()
         self.state, metrics = self._train_step(self.state, batch)
+        self._evict_opt_state()
         self._last_metrics = metrics
 
         self.global_steps += 1
@@ -413,17 +431,36 @@ class Engine:
 
     # --- checkpointing (delegates to checkpoint module) ---------------- #
 
+    def _ensure_opt_state_resident(self):
+        """Swap optimizer state back in from NVMe if it is evicted."""
+        if self._opt_swapper is not None and self._opt_swapper.is_swapped_out:
+            self.state = self.state._replace(opt_state=self._opt_swapper.swap_in(
+                self._state_shardings.opt_state))
+
+    def _evict_opt_state(self):
+        """Swap optimizer state out to NVMe (async writes)."""
+        if self._opt_swapper is not None and not self._opt_swapper.is_swapped_out:
+            self.state = self.state._replace(
+                opt_state=self._opt_swapper.swap_out(self.state.opt_state))
+
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[dict] = None, save_latest: bool = True):
         from ..checkpoint.engine_checkpoint import save_checkpoint as _save
-        return _save(self, save_dir, tag=tag, client_state=client_state,
-                     save_latest=save_latest)
+        self._ensure_opt_state_resident()
+        out = _save(self, save_dir, tag=tag, client_state=client_state,
+                    save_latest=save_latest)
+        self._evict_opt_state()
+        return out
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True,
                         load_module_only: bool = False):
         from ..checkpoint.engine_checkpoint import load_checkpoint as _load
-        return _load(self, load_dir, tag=tag,
-                     load_optimizer_states=load_optimizer_states,
-                     load_module_only=load_module_only)
+        self._ensure_opt_state_resident()
+        out = _load(self, load_dir, tag=tag,
+                    load_optimizer_states=load_optimizer_states,
+                    load_lr_scheduler_states=load_lr_scheduler_states,
+                    load_module_only=load_module_only)
+        self._evict_opt_state()
+        return out

@@ -177,10 +177,29 @@ class ZeroShardingPlan:
         return self._to_sharding(self.grad_specs(params))
 
     def opt_state_shardings(self, opt_state: Any) -> Any:
-        kind = None
-        if self.cfg.offload_optimizer.device == "cpu":
-            kind = "pinned_host"
-        return self._to_sharding(self.opt_state_specs(opt_state), memory_kind=kind)
+        """Device-memory shardings used by the compiled step. CPU offload does
+        not change these: the engine stashes the state in host memory BETWEEN
+        steps (see ``runtime/zero/offload.py``) and restores it to these
+        shardings for the update — in-jit memory-kind staging trips the SPMD
+        partitioner on scalar leaves (optax step counts)."""
+        return self._to_sharding(self.opt_state_specs(opt_state))
+
+    def opt_state_host_shardings(self, opt_state: Any) -> Any:
+        """Pinned-host variant for the between-steps stash (CPU offload).
+        Scalar leaves keep device placement — they cost nothing resident."""
+        specs = self.opt_state_specs(opt_state)
+        mesh = self.topo.mesh
+
+        def mk(leaf, spec):
+            if np.ndim(leaf) >= 1:
+                try:
+                    return NamedSharding(mesh, spec, memory_kind="pinned_host")
+                except (ValueError, TypeError):
+                    return NamedSharding(mesh, spec)
+            return NamedSharding(mesh, spec)
+
+        return jax.tree_util.tree_map(mk, opt_state, specs,
+                                      is_leaf=lambda x: isinstance(x, P))
 
     # -------------------------------------------------------------- #
 
