@@ -92,8 +92,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         # fully-masked padded rows have l == 0; emit zeros, lse = -inf
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_scr[:, 0] + jnp.log(jnp.where(l[:, 0] == 0.0,
-                                                         1.0, l[:, 0])))
+        # lse carried as (..., tq, 1): a trailing unit lane dim keeps the
+        # block shape Mosaic-tileable ((block_q, 1) is legal; (1, block_q)
+        # as the last two dims of a 3-D block is not).
+        lse_ref[0, 0] = (m_scr[:, :1] + jnp.log(l_safe))
 
 
 def _fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len, causal_offset,
@@ -108,7 +110,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len, causal_offset,
     grid = (b, h, nq, nk)
     out_shape = [
         jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
-        jax.ShapeDtypeStruct((b, h, tq), jnp.float32),
+        jax.ShapeDtypeStruct((b, h, tq, 1), jnp.float32),
     ]
     o, lse = pl.pallas_call(
         kernel,
@@ -120,7 +122,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len, causal_offset,
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -162,8 +164,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0].reshape(block_q, 1)
-        delta = delta_ref[0, 0].reshape(block_q, 1)
+        lse = lse_ref[0, 0]                                   # (bq, 1)
+        delta = delta_ref[0, 0]                               # (bq, 1)
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
@@ -174,6 +176,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             row = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             mask = jnp.logical_and(mask, row + causal_offset >= col)
+        # padded q rows have lse == -inf; exp(s - lse) would be inf there
+        mask = jnp.logical_and(mask, jnp.isfinite(lse))
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -209,8 +213,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0].reshape(block_q, 1)
-        delta = delta_ref[0, 0].reshape(block_q, 1)
+        lse = lse_ref[0, 0]                                   # (bq, 1)
+        delta = delta_ref[0, 0]                               # (bq, 1)
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
@@ -221,6 +225,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             row = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             mask = jnp.logical_and(mask, row + causal_offset >= col)
+        mask = jnp.logical_and(mask, jnp.isfinite(lse))
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)            # (bq, bk)
         dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -245,11 +250,11 @@ def _bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset, interpret,
     nq, nk = tq // block_q, tk // block_k
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)                                   # (b, h, tq)
+                    axis=-1, keepdims=True)                    # (b, h, tq, 1)
 
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, j, 0))
     kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, i, 0))
-    row_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, j))
+    row_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, j, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, kv_len=kv_len,
@@ -260,8 +265,8 @@ def _bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset, interpret,
             pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda b, h, i, j: (b, h, i, 0)),

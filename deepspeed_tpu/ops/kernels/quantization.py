@@ -23,13 +23,26 @@ from jax.experimental import pallas as pl
 class QuantizedTensor(NamedTuple):
     """Packed group-quantized tensor. ``values`` is int8 (packed for 4-bit),
     ``scale``/``zero`` are (num_groups, 1) f32; ``shape``/``bits``/``group``
-    record how to undo the packing."""
+    record how to undo the packing.
+
+    Registered as a pytree whose ``shape``/``bits``/``group_size`` are static
+    aux data, so a QuantizedTensor can cross jit boundaries (qwZ holds
+    quantized weights between steps) without the metadata becoming tracers.
+    """
     values: jnp.ndarray
     scale: jnp.ndarray
     zero: Optional[jnp.ndarray]
     shape: Tuple[int, ...]
     bits: int
     group_size: int
+
+
+jax.tree_util.register_pytree_node(
+    QuantizedTensor,
+    lambda qt: ((qt.values, qt.scale, qt.zero),
+                (qt.shape, qt.bits, qt.group_size)),
+    lambda aux, children: QuantizedTensor(*children, *aux),
+)
 
 
 def _reshape_groups(x: jnp.ndarray, group_size: int) -> jnp.ndarray:
