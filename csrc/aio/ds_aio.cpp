@@ -38,6 +38,14 @@ struct Request {
     std::atomic<int> chunks_remaining{0};
     std::atomic<int> status{0};  // 0 ok, else -errno of first failure
     int fd = -1;
+    // buffered-retry state: when the primary fd is O_DIRECT and the kernel
+    // rejects a transfer (EINVAL — filesystem/device alignment stricter than
+    // ours), workers lazily open one shared buffered fd and retry there
+    std::string path;
+    int buffered_flags = 0;
+    bool direct = false;
+    std::atomic<int> fallback_fd{-1};
+    std::mutex fallback_mu;
     bool done() const { return chunks_remaining.load() == 0; }
 };
 
@@ -65,6 +73,8 @@ struct Handle {
         for (auto& t : workers_) t.join();
         for (auto& kv : requests_) {
             if (kv.second->fd >= 0) ::close(kv.second->fd);
+            if (kv.second->fallback_fd.load() >= 0)
+                ::close(kv.second->fallback_fd.load());
             delete kv.second;
         }
     }
@@ -72,8 +82,20 @@ struct Handle {
     int64_t submit(Op op, char* buf, int64_t nbytes, const char* path,
                    int64_t file_offset) {
         int flags = (op == Op::kRead) ? O_RDONLY : (O_WRONLY | O_CREAT);
+        // O_DIRECT demands sector alignment of buffer, length, and offset;
+        // only attempt it when the whole request (and hence every chunk —
+        // block_size_ is page-aligned or the single chunk spans it all)
+        // satisfies page alignment, else open buffered outright.
+        constexpr int64_t kAlign = 4096;
+        bool aligned = (reinterpret_cast<uintptr_t>(buf) % kAlign == 0) &&
+                       (nbytes % kAlign == 0) && (file_offset % kAlign == 0) &&
+                       (block_size_ % kAlign == 0 || nbytes <= block_size_);
+        bool direct = false;
         int fd = -1;
-        if (o_direct_) fd = ::open(path, flags | O_DIRECT, 0644);
+        if (o_direct_ && aligned) {
+            fd = ::open(path, flags | O_DIRECT, 0644);
+            direct = fd >= 0;
+        }
         if (fd < 0) fd = ::open(path, flags, 0644);  // buffered fallback
         if (fd < 0) {
             set_error(std::string("open(") + path + "): " + strerror(errno));
@@ -82,6 +104,9 @@ struct Handle {
 
         auto* req = new Request();
         req->fd = fd;
+        req->path = path;
+        req->buffered_flags = flags;
+        req->direct = direct;
         int64_t id;
         std::vector<Chunk> chunks;
         for (int64_t off = 0; off < nbytes; off += block_size_) {
@@ -111,6 +136,7 @@ struct Handle {
         cv_done_.wait(lk, [req] { return req->done(); });
         int status = req->status.load();
         if (req->fd >= 0) ::close(req->fd);
+        if (req->fallback_fd.load() >= 0) ::close(req->fallback_fd.load());
         requests_.erase(it);
         delete req;
         return status;
@@ -161,17 +187,35 @@ private:
         }
     }
 
+    // One shared buffered fd per request, opened on first O_DIRECT EINVAL.
+    int fallback_fd(Request* req) {
+        int fd = req->fallback_fd.load();
+        if (fd >= 0) return fd;
+        std::lock_guard<std::mutex> lk(req->fallback_mu);
+        fd = req->fallback_fd.load();
+        if (fd >= 0) return fd;
+        fd = ::open(req->path.c_str(), req->buffered_flags, 0644);
+        if (fd >= 0) req->fallback_fd.store(fd);
+        return fd;
+    }
+
     void run_chunk(const Chunk& c) {
         int64_t done = 0;
         int err = 0;
+        int fd = c.req->fd;
         while (done < c.nbytes) {
             ssize_t n = (c.op == Op::kRead)
-                ? ::pread(c.req->fd, c.buf + done, c.nbytes - done,
+                ? ::pread(fd, c.buf + done, c.nbytes - done,
                           c.file_offset + done)
-                : ::pwrite(c.req->fd, c.buf + done, c.nbytes - done,
+                : ::pwrite(fd, c.buf + done, c.nbytes - done,
                            c.file_offset + done);
             if (n < 0) {
                 if (errno == EINTR) continue;
+                if (errno == EINVAL && c.req->direct && fd == c.req->fd) {
+                    // device/fs rejected a direct transfer; retry buffered
+                    int bfd = fallback_fd(c.req);
+                    if (bfd >= 0) { fd = bfd; continue; }
+                }
                 err = -errno;
                 set_error(std::string(c.op == Op::kRead ? "pread" : "pwrite") +
                           ": " + strerror(errno));

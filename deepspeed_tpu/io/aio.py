@@ -171,6 +171,10 @@ class PinnedBuffer:
         dt = np.dtype(dtype)
         count = self.nbytes // dt.itemsize
         buf = (ctypes.c_char * self.nbytes).from_address(self._ptr)
+        # numpy keeps ``buf`` alive via arr.base; ``buf`` alone owns nothing,
+        # so anchor the PinnedBuffer on it — GC of this object must not
+        # munmap memory a returned array still views
+        buf._ds_pinned_owner = self
         arr = np.frombuffer(buf, dtype=dt, count=count)
         if shape is not None:
             arr = arr.reshape(shape)

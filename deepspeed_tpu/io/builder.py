@@ -14,6 +14,7 @@ import ctypes
 import hashlib
 import os
 import subprocess
+import tempfile
 from pathlib import Path
 from typing import Dict, List, Optional
 
@@ -71,19 +72,31 @@ class OpBuilder:
         if out.exists():
             return out
         out.parent.mkdir(parents=True, exist_ok=True)
-        tmp = out.with_suffix(".so.tmp")
+        # per-process temp name: concurrent first-use builds (multi-process
+        # launch, pytest-xdist) must not interleave writes to one .tmp file;
+        # os.replace publishes whichever finishes atomically
+        fd, tmp = tempfile.mkstemp(dir=out.parent,
+                                   prefix=f".{out.name}.", suffix=".tmp")
+        os.close(fd)
         cmd = ([self.compiler(), "-O3", "-fPIC", "-shared", "-std=c++17",
                 "-pthread"]
                + self.extra_cxx_flags()
                + [str(s) for s in self.sources()]
-               + ["-o", str(tmp)]
+               + ["-o", tmp]
                + self.extra_ld_flags())
         logger.info("building native op %s: %s", self.NAME, " ".join(cmd))
-        proc = subprocess.run(cmd, capture_output=True, text=True)
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"native build of op '{self.NAME}' failed:\n{proc.stderr}")
-        os.replace(tmp, out)
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"native build of op '{self.NAME}' failed:\n{proc.stderr}")
+            # mkstemp created the file 0600 and the linker preserves it;
+            # a shared cache dir needs the artifact world-readable
+            os.chmod(tmp, 0o755)
+            os.replace(tmp, out)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
         return out
 
     def load(self) -> ctypes.CDLL:

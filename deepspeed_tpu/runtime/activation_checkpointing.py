@@ -169,9 +169,14 @@ def checkpoint_sequential(block_fn: Callable, stacked_params: Any, x: Any,
     n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
     if interval is None:
         # config carries the NUMBER of checkpoint regions (reference
-        # `number_checkpoints`); the per-region layer count is derived
+        # `number_checkpoints`); derive the largest interval that divides
+        # n_layers with at least that many regions — the scanned grouping
+        # below requires exact divisibility, and a non-divisor count (e.g.
+        # 4 regions over 14 layers) must not be a hard error
         n_regions = _CONFIG.number_checkpoints or n_layers
         interval = max(1, n_layers // n_regions)
+        while n_layers % interval:
+            interval -= 1
     if interval <= 1:
         body_fn = jax.checkpoint(lambda h, p: (block_fn(p, h), None), policy=pol)
         out, _ = jax.lax.scan(body_fn, x, stacked_params)

@@ -312,12 +312,15 @@ class Engine:
         fn = self.eval_fn or self.loss_fn
         compute_dtype = self.compute_dtype
 
-        def eval_fn(state: TrainState, batch: Any, rng: jax.Array):
-            return fn(cast_floating(state.params, compute_dtype), batch, rng)
+        # takes params only (not the TrainState): eval must not touch
+        # opt_state, which may be evicted to host/NVMe between train steps
+        def eval_fn(params: Any, batch: Any, rng: jax.Array):
+            return fn(cast_floating(params, compute_dtype), batch, rng)
 
         if not self.config.compile:
             return eval_fn
-        return jax.jit(eval_fn, in_shardings=(self._state_shardings, None, None))
+        return jax.jit(
+            eval_fn, in_shardings=(self._state_shardings.params, None, None))
 
     # ------------------------------------------------------------------ #
     # public API
@@ -361,7 +364,7 @@ class Engine:
     def eval_batch(self, batch: Any, rng: Optional[jax.Array] = None):
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        return self._eval_step(self.state, batch, rng)
+        return self._eval_step(self.state.params, batch, rng)
 
     # --- forward/backward/step trio (API parity) ----------------------- #
 

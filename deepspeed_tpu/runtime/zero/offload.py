@@ -53,7 +53,13 @@ class NvmeOptimizerSwapper:
         base = swap_dir or cfg.nvme_path
         if base is None:
             base = tempfile.mkdtemp(prefix="ds_tpu_swap_")
-        self.swap_dir = os.path.join(base, "optimizer_swap")
+        # namespace by global process index: nvme_path may be shared between
+        # processes (multi-host launch, shared fs) and swap files from
+        # different ranks must never collide (the reference encodes rank into
+        # swap paths the same way). Rank-only — no pid — so restarts reuse
+        # and overwrite the same directory instead of leaking swap files.
+        rank = jax.process_index()
+        self.swap_dir = os.path.join(base, f"optimizer_swap_rank{rank}")
         os.makedirs(self.swap_dir, exist_ok=True)
         self.handle = AioHandle()
         self._meta: Optional[List[Tuple[str, np.dtype, Tuple[int, ...]]]] = None
