@@ -45,6 +45,36 @@ jax.tree_util.register_pytree_node(
 )
 
 
+# --------------------------------------------------------------------------- #
+# row-wise comm-precision helpers (shared with the ZeRO++ quantized
+# collectives, runtime/zero/quantized_collectives.py)
+# --------------------------------------------------------------------------- #
+
+
+def sym_quantize_rowwise(x: jnp.ndarray, bits: int):
+    """Symmetric per-row (last-dim) quantization to int8 storage.
+    Returns (q, scale) with scale shaped ``x.shape[:-1] + (1,)``."""
+    qmax = float(2 ** (bits - 1) - 1)
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-12) / qmax
+    q = jnp.clip(jnp.round(xf / scale), -qmax, qmax).astype(jnp.int8)
+    return q, scale
+
+
+def pack_int4(q: jnp.ndarray) -> jnp.ndarray:
+    """Pack int4 values (in int8 storage) two-per-byte, low nibble first."""
+    lo = q[..., 0::2] & 0x0F
+    hi = (q[..., 1::2] & 0x0F) << 4
+    return (lo | hi).astype(jnp.int8)
+
+
+def unpack_int4(p: jnp.ndarray) -> jnp.ndarray:
+    lo = (p << 4) >> 4                       # arithmetic shift sign-extends
+    hi = p >> 4
+    return jnp.stack([lo, hi], axis=-1).reshape(p.shape[:-1] + (-1,))
+
+
 def _reshape_groups(x: jnp.ndarray, group_size: int) -> jnp.ndarray:
     flat = x.reshape(-1)
     n = flat.shape[0]

@@ -40,6 +40,14 @@ AXIS_NAMES = ("pipe", "data", "expert", "seq", "model")
 #: canonical name of the batch-sharded mesh axes (ZeRO shards over these)
 DATA_AXES = ("data",)
 
+#: optional factorization of the data axis into (outer, inner) used by
+#: ZeRO++ hpZ (secondary param partition within a "node" group) and MiCS
+#: (sub-world shard groups): the inner axis is the shard group, the outer
+#: axis the replica group. reference: zero_hpz_partition_size
+#: (stage3.py/partition_parameters.py _partition_param_sec) and
+#: runtime/zero/mics.py shard groups.
+DATA_INNER_AXIS = "data_inner"
+
 
 @dataclasses.dataclass
 class Topology:
@@ -60,7 +68,7 @@ class Topology:
 
     @property
     def dp_world_size(self) -> int:
-        return self.axis_size("data")
+        return self.axis_size("data") * self.axis_size(DATA_INNER_AXIS)
 
     @property
     def tp_world_size(self) -> int:
@@ -82,11 +90,12 @@ class Topology:
     # passing seq_data_parallel_group as dp_process_group (engine.py:1572)
     @property
     def zero_axes(self) -> Sequence[str]:
-        return tuple(a for a in ("seq", "data") if self.axis_size(a) > 1) or ("data",)
+        return tuple(a for a in ("seq", "data", DATA_INNER_AXIS)
+                     if self.axis_size(a) > 1) or ("data",)
 
     @property
     def zero_world_size(self) -> int:
-        return self.axis_size("data") * self.axis_size("seq")
+        return self.dp_world_size * self.axis_size("seq")
 
     # ------------------------- sharding helpers ------------------------ #
     def sharding(self, *spec) -> NamedSharding:
@@ -97,7 +106,8 @@ class Topology:
 
     def batch_sharding(self, extra_batch_axes: Sequence[str] = ()) -> NamedSharding:
         """Sharding for [batch, ...] arrays: batch over data (+seq if fused)."""
-        axes = tuple(a for a in ("data", *extra_batch_axes) if self.axis_size(a) > 1)
+        axes = tuple(a for a in ("data", DATA_INNER_AXIS, *extra_batch_axes)
+                     if self.axis_size(a) > 1)
         if not axes:
             return self.replicated()
         return NamedSharding(self.mesh, P(axes))
@@ -110,10 +120,13 @@ class Topology:
 def build_mesh(
     cfg: Optional[MeshConfig] = None,
     devices: Optional[Sequence] = None,
+    inner_shard_size: int = 1,
 ) -> Topology:
     """Construct the device mesh from config.
 
     ``data: "auto"`` absorbs all devices not claimed by the other axes.
+    ``inner_shard_size`` factors the data axis into
+    (data, :data:`DATA_INNER_AXIS`) for hpZ/MiCS sub-group sharding.
     Raises if the product of axis sizes doesn't divide the device count.
     """
     cfg = cfg or MeshConfig()
@@ -142,6 +155,16 @@ def build_mesh(
     order = list(cfg.axis_order)
     if sorted(order) != sorted(AXIS_NAMES):
         raise ConfigError(f"mesh.axis_order must be a permutation of {AXIS_NAMES}, got {order}")
+
+    inner = int(inner_shard_size)
+    if inner > 1:
+        if sizes["data"] % inner != 0:
+            raise ConfigError(
+                f"inner shard size {inner} (hpZ/MiCS) must divide the data "
+                f"axis size {sizes['data']}")
+        sizes["data"] //= inner
+        sizes[DATA_INNER_AXIS] = inner
+        order.insert(order.index("data") + 1, DATA_INNER_AXIS)
 
     shape = [sizes[a] for a in order]
     dev_array = np.asarray(devices).reshape(shape)

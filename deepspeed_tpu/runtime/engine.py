@@ -74,7 +74,15 @@ class Engine:
         dataloader: Any = None,
     ):
         self.config = config
-        self.topology = topology or build_mesh(config.mesh)
+        # hpZ/MiCS factor the data axis into (replica, shard) sub-axes
+        zcfg = config.zero_optimization
+        inner = 1
+        if zcfg.mics_shard_size and zcfg.mics_shard_size > 0:
+            inner = int(zcfg.mics_shard_size)
+        elif zcfg.zero_hpz_partition_size > 1:
+            inner = int(zcfg.zero_hpz_partition_size)
+        self.topology = topology or build_mesh(config.mesh,
+                                               inner_shard_size=inner)
         set_topology(self.topology)
         self.loss_fn = loss_fn
         self.eval_fn = eval_fn
@@ -222,6 +230,8 @@ class Engine:
             grads = jax.tree_util.tree_map(lambda g: g.astype(accum_dtype), grads)
             return loss, grads
 
+        micro_grads = self._maybe_manual_micro_grads(micro_grads)
+
         def step_fn(state: TrainState, batch: Any) -> Tuple[TrainState, StepMetrics]:
             # [B_total, ...] -> [gas, micro_global, ...]
             def to_micro(x):
@@ -307,6 +317,79 @@ class Engine:
             out_shardings=(self._state_shardings, None),
             donate_argnums=(0,),
         )
+
+    def _maybe_manual_micro_grads(self, default_fn):
+        """ZeRO++ (qwZ/qgZ): swap the micro-grad computation for a manual
+        shard_map over the data axis with quantized gather / reduce-scatter
+        collectives (see runtime/zero/quantized_collectives.py). Under plain
+        pjit those collectives are XLA-placed and always full-precision, so
+        comm compression requires the manual seam."""
+        cfg = self.config
+        zcfg = cfg.zero_optimization
+        if not (zcfg.zero_quantized_weights or zcfg.zero_quantized_gradients):
+            return default_fn
+        plan = self.zero_plan
+        if plan.stage < 3:
+            logger.warning("ZeRO++ quantized collectives require stage 3; "
+                           "ignoring zero_quantized_weights/gradients")
+            return default_fn
+        if self.topology.axis_size("data") <= 1 or \
+                set(plan.param_axes) - {"data"}:
+            logger.warning(
+                "ZeRO++ quantized collectives need params sharded over the "
+                "'data' axis (dp>1, no seq-fused or hpZ/MiCS inner sharding); "
+                "falling back to automatic collectives")
+            return default_fn
+
+        from .zero.quantized_collectives import (
+            prep_params, shard_map, strip_to_manual)
+
+        mesh = self.topology.mesh
+        manual_axes = ("data",)
+        world = self.topology.axis_size("data")
+        wbits = 8 if zcfg.zero_quantized_weights else None
+        gbits = 8 if zcfg.zero_quantized_gradients else None
+        fp16 = cfg.fp16.enabled
+        compute_dtype = self.compute_dtype
+        accum_dtype = self._grad_accum_dtype
+
+        pspecs = plan.param_specs(self.state.params)
+        in_pspecs = jax.tree_util.tree_map(
+            lambda s, p: strip_to_manual(s, manual_axes, np.ndim(p)),
+            pspecs, self.state.params, is_leaf=lambda x: isinstance(x, P))
+
+        def local_fn(p_local, mb_local, rng, scale_state):
+            # distinct dropout/noise masks per DP rank (the automatic path
+            # draws masks over the global batch; fold_in restores that)
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(manual_axes))
+
+            def scaled_loss(pl):
+                pfull = prep_params(pl, pspecs, manual_axes, world,
+                                    wbits, gbits)
+                cp = cast_floating(pfull, compute_dtype)
+                loss, _aux = self._loss_and_aux(cp, mb_local, rng)
+                # each rank owns 1/world of the batch: sum over ranks of
+                # loss/world == the global-mean objective of automatic mode
+                obj = loss / world
+                return (ls.scale_loss(obj, scale_state) if fp16 else obj,
+                        loss)
+
+            grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
+            (_scaled, local_loss), grads = grad_fn(p_local)
+            loss = jax.lax.pmean(local_loss, manual_axes)
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(accum_dtype), grads)
+            return loss, grads
+
+        sm = shard_map(
+            local_fn, mesh,
+            in_specs=(in_pspecs, P(manual_axes), P(), P()),
+            out_specs=(P(), in_pspecs),
+            axis_names=manual_axes)
+        log_dist(
+            f"ZeRO++ manual collectives: qwZ={'int8' if wbits else 'off'}, "
+            f"qgZ={'int8' if gbits else 'off'} over data={world}")
+        return sm
 
     def _build_eval_step(self):
         fn = self.eval_fn or self.loss_fn

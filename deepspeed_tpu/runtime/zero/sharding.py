@@ -87,8 +87,35 @@ class ZeroShardingPlan:
         self.topo = topo
         self.tp_specs = tp_specs
         self.zero_axes = tuple(topo.zero_axes)
-        self.n_shards = _axis_product(topo, self.zero_axes)
         self.stage = cfg.stage
+
+        # hpZ / MiCS: shard within the inner (sub-group) axis only.
+        # hpZ (reference _partition_param_sec): params get a SECONDARY
+        # partition inside the group so gathers stay on fast links, while
+        # grads/opt-state shard over the full zero group. MiCS (mics.py):
+        # everything shards within the group; DP reduction across replica
+        # groups is the psum XLA inserts over the outer data axis.
+        self.param_axes = self.zero_axes
+        inner = ("data_inner",)
+        has_inner = topo.axis_size("data_inner") > 1
+        if cfg.mics_shard_size and cfg.mics_shard_size > 0:
+            if has_inner:
+                self.param_axes = inner
+                self.zero_axes = inner
+            else:
+                logger.warning(
+                    "mics_shard_size set but the mesh has no data_inner axis "
+                    "(topology built without inner_shard_size); ignoring MiCS")
+        elif cfg.zero_hpz_partition_size > 1 and self.stage >= 3:
+            if has_inner:
+                self.param_axes = inner
+            else:
+                logger.warning(
+                    "zero_hpz_partition_size set but the mesh has no "
+                    "data_inner axis; ignoring hpZ")
+
+        self.n_shards = _axis_product(topo, self.zero_axes)
+        self.n_param_shards = _axis_product(topo, self.param_axes)
         if self.n_shards == 1 and self.stage > 0:
             log_dist("ZeRO enabled but data-parallel world size is 1; sharding is a no-op")
 
@@ -106,12 +133,15 @@ class ZeroShardingPlan:
         except (KeyError, IndexError, TypeError):
             return None
 
-    def _sharded_spec(self, path, leaf, threshold: int = 0) -> P:
+    def _sharded_spec(self, path, leaf, threshold: int = 0,
+                      axes: Optional[Sequence[str]] = None) -> P:
         tp = self._tp_spec_for(path, leaf)
         shape = tuple(np.shape(leaf))
-        if self.n_shards == 1 or int(np.prod(shape or (1,))) <= threshold:
+        axes = tuple(axes) if axes is not None else self.zero_axes
+        n = _axis_product(self.topo, axes)
+        if n == 1 or int(np.prod(shape or (1,))) <= threshold:
             return tp if tp is not None else P()
-        return _merge_axes_into_spec(tp, shape, self.zero_axes, self.n_shards)
+        return _merge_axes_into_spec(tp, shape, axes, n)
 
     def _replicated_spec(self, path, leaf) -> P:
         tp = self._tp_spec_for(path, leaf)
@@ -125,7 +155,8 @@ class ZeroShardingPlan:
             threshold = int(self.cfg.stage3_param_persistence_threshold) \
                 if not isinstance(self.cfg.stage3_param_persistence_threshold, str) else 100_000
             return jax.tree_util.tree_map_with_path(
-                functools.partial(self._sharded_spec, threshold=threshold), params)
+                functools.partial(self._sharded_spec, threshold=threshold,
+                                  axes=self.param_axes), params)
         return jax.tree_util.tree_map_with_path(self._replicated_spec, params)
 
     def grad_specs(self, params: Any) -> Any:
@@ -147,6 +178,8 @@ class ZeroShardingPlan:
             shape = tuple(np.shape(leaf))
             if self.stage < 1 or self.n_shards == 1 or len(shape) == 0:
                 return P()
+            # MiCS shards opt-state within the group only (zero_axes is
+            # already reduced to the inner axis in that case)
             return _merge_axes_into_spec(None, shape, self.zero_axes, self.n_shards)
 
         return jax.tree_util.tree_map(spec_for, opt_state)
@@ -213,7 +246,10 @@ class ZeroShardingPlan:
 
     def memory_summary(self, params: Any) -> str:
         n_params = sum(int(np.prod(np.shape(p))) for p in jax.tree_util.tree_leaves(params))
-        shard = 1.0 / self.n_shards if self.stage >= 3 else 1.0
+        shard = 1.0 / self.n_param_shards if self.stage >= 3 else 1.0
+        extra = ""
+        if self.param_axes != self.zero_axes:
+            extra = f" (params over {self.param_axes})"
         return (f"ZeRO stage {self.stage}: {n_params / 1e6:.1f}M params, "
-                f"{self.n_shards} shards over axes {self.zero_axes}, "
+                f"{self.n_shards} shards over axes {self.zero_axes}{extra}, "
                 f"param residency {shard * 100:.0f}%")
