@@ -13,7 +13,7 @@ error-compensated compressed gradient communication, see
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Union
+from typing import Any, Callable, Dict, NamedTuple, Optional, Union
 
 import optax
 
@@ -41,7 +41,19 @@ def build_optimizer(
     eps = float(params.get("eps", 1e-8))
     name = opt_type.lower()
 
-    if name in ("adam", "fusedadam", "onebitadam", "zerooneadam", "muadam"):
+    if name in ("onebitadam", "zerooneadam"):
+        b1, b2 = _betas(params)
+        return onebit_adam(
+            lr, b1=b1, b2=b2, eps=eps, weight_decay=wd,
+            freeze_step=int(params.get("freeze_step", 100)),
+            var_update_interval=(int(params.get("var_update_scaler", 16))
+                                 if name == "zerooneadam" else 0))
+    if name == "onebitlamb":
+        b1, b2 = _betas(params)
+        return onebit_lamb(
+            lr, b1=b1, b2=b2, eps=eps, weight_decay=wd,
+            freeze_step=int(params.get("freeze_step", 100)))
+    if name in ("adam", "fusedadam", "muadam"):
         b1, b2 = _betas(params)
         # reference FusedAdam defaults adam_w_mode=True (decoupled decay);
         # adam_w_mode=False means classic L2 (decay folded into the gradient
@@ -78,3 +90,87 @@ ONEBIT_OPTIMIZERS = {"onebitadam", "onebitlamb", "zerooneadam"}
 
 def is_onebit(opt_type: str) -> bool:
     return opt_type.lower() in ONEBIT_OPTIMIZERS
+
+
+def onebit_freeze_step(opt_params: Dict[str, Any]) -> int:
+    return int(opt_params.get("freeze_step", 100))
+
+
+# --------------------------------------------------------------------------- #
+# 1-bit optimizer math (reference runtime/fp16/onebit/{adam,lamb,zoadam}.py):
+# standard moments during warmup; after freeze_step the second moment (and
+# its bias correction) is frozen so the update direction depends only on the
+# (compressed-communicated) first moment. ZeroOneAdam additionally refreshes
+# the variance on a fixed interval (simplification of its learning-rate /
+# variance update schedules).
+# --------------------------------------------------------------------------- #
+
+
+class _OnebitState(NamedTuple):
+    count: Any
+    mu: Any
+    nu: Any
+
+
+def _onebit_base(lr, b1, b2, eps, weight_decay, freeze_step,
+                 var_update_interval=0, trust_ratio=False):
+    """Shared 1-bit optimizer core; ``trust_ratio`` adds LAMB's layer
+    adaptation. Moments are updated with two independent tree_maps so
+    tuple-structured param trees work (no pair-splitting)."""
+    import jax
+    import jax.numpy as jnp
+
+    def init(params):
+        z = lambda p: jnp.zeros_like(p, dtype=jnp.float32)  # noqa: E731
+        return _OnebitState(count=jnp.zeros((), jnp.int32),
+                            mu=jax.tree_util.tree_map(z, params),
+                            nu=jax.tree_util.tree_map(z, params))
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        in_warmup = count <= freeze_step
+        if var_update_interval:
+            in_warmup = jnp.logical_or(in_warmup,
+                                       count % var_update_interval == 0)
+
+        mu = jax.tree_util.tree_map(
+            lambda g, m: b1 * m + (1 - b1) * g.astype(jnp.float32),
+            grads, state.mu)
+        nu = jax.tree_util.tree_map(
+            lambda g, n: jnp.where(
+                in_warmup,
+                b2 * n + (1 - b2) * jnp.square(g.astype(jnp.float32)), n),
+            grads, state.nu)
+
+        lr_t = lr(state.count) if callable(lr) else lr
+        c1 = 1 - b1 ** count.astype(jnp.float32)
+        # nu's bias correction freezes with nu itself
+        c2 = 1 - b2 ** jnp.minimum(count, freeze_step).astype(jnp.float32)
+
+        def upd(m, v, p):
+            u = (m / c1) / (jnp.sqrt(v / c2) + eps)
+            if weight_decay:
+                u = u + weight_decay * p.astype(jnp.float32)
+            if trust_ratio:
+                pn = jnp.linalg.norm(p.astype(jnp.float32).reshape(-1))
+                un = jnp.linalg.norm(u.reshape(-1))
+                u = jnp.where((pn > 0) & (un > 0), pn / un, 1.0) * u
+            return (-lr_t * u).astype(p.dtype)
+
+        updates = jax.tree_util.tree_map(upd, mu, nu, params)
+        return updates, _OnebitState(count=count, mu=mu, nu=nu)
+
+    import optax
+    return optax.GradientTransformation(init, update)
+
+
+def onebit_adam(lr, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
+                freeze_step=100, var_update_interval=0):
+    return _onebit_base(lr, b1, b2, eps, weight_decay, freeze_step,
+                        var_update_interval=var_update_interval)
+
+
+def onebit_lamb(lr, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
+                freeze_step=100):
+    return _onebit_base(lr, b1, b2, eps, weight_decay, freeze_step,
+                        trust_ratio=True)

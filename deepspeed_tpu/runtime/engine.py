@@ -30,7 +30,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config.config import Config, ConfigError
 from ..ops.optimizers import build_optimizer
-from ..parallel.topology import Topology, build_mesh, set_topology
+from ..parallel.topology import (
+    DATA_INNER_AXIS, Topology, build_mesh, set_topology)
 from ..utils.logging import log_dist, logger, see_memory_usage
 from ..utils.dtypes import cast_floating, resolve_dtype
 from ..utils.timer import (
@@ -48,6 +49,7 @@ class TrainState(NamedTuple):
     opt_state: Any
     scale_state: ls.LossScaleState
     rng: jax.Array
+    comm_state: Any = ()       # 1-bit allreduce error buffers (onebit opts)
 
 
 class StepMetrics(NamedTuple):
@@ -112,6 +114,27 @@ class Engine:
                                           tp_specs=tp_specs)
         log_dist(self.zero_plan.memory_summary(params))
 
+        # 1-bit optimizers: error-compensated compressed gradient allreduce
+        # after freeze_step (reference runtime/fp16/onebit/, runtime/comm/)
+        from ..ops.optimizers import is_onebit, onebit_freeze_step
+        self._onebit = None
+        if is_onebit(config.optimizer.type):
+            dp = self.topology.axis_size("data")
+            if dp > 1 and self.zero_plan.stage <= 1 and \
+                    self.topology.axis_size("seq") == 1 and \
+                    self.topology.axis_size(DATA_INNER_AXIS) == 1:
+                self._onebit = {
+                    "freeze_step": onebit_freeze_step(config.optimizer.params),
+                    "world": dp,
+                }
+                log_dist(f"1-bit compressed allreduce armed: warmup "
+                         f"{self._onebit['freeze_step']} steps, world {dp}")
+            else:
+                logger.warning(
+                    "1-bit optimizer requested but compressed allreduce needs "
+                    "dp>1, ZeRO stage<=1 and no seq/inner sharding; running "
+                    "with full-precision gradient communication")
+
         # timers / telemetry -----------------------------------------------------
         self.timers = SynchronizedWallClockTimer() if config.wall_clock_breakdown else NoopTimer()
         self.tput_timer = ThroughputTimer(
@@ -172,12 +195,19 @@ class Engine:
         params = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), params)
         rng = jnp.array(rng, copy=True)
         opt_state = self.optimizer.init(params)
+        comm_state = ()
+        self._comm_shardings = ()
+        if self._onebit is not None:
+            from .compressed_grads import init_comm_state
+            comm_state, self._comm_shardings = init_comm_state(
+                params, self._onebit["world"], self.topology.mesh)
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
             opt_state=opt_state,
             scale_state=ls.init_state(self.config.fp16),
             rng=rng,
+            comm_state=comm_state,
         )
 
     def _compute_state_shardings(self, state: TrainState) -> TrainState:
@@ -188,6 +218,7 @@ class Engine:
             opt_state=self.zero_plan.opt_state_shardings(state.opt_state),
             scale_state=jax.tree_util.tree_map(lambda _: repl, state.scale_state),
             rng=repl,
+            comm_state=self._comm_shardings,
         )
 
     def _place_state(self, state: TrainState) -> TrainState:
@@ -231,6 +262,7 @@ class Engine:
             return loss, grads
 
         micro_grads = self._maybe_manual_micro_grads(micro_grads)
+        onebit_grads = self._maybe_onebit_grads(micro_grads)
 
         def step_fn(state: TrainState, batch: Any) -> Tuple[TrainState, StepMetrics]:
             # [B_total, ...] -> [gas, micro_global, ...]
@@ -258,7 +290,12 @@ class Engine:
                     grad_acc = plan.constrain_grads(grad_acc, state.params)
                 return (grad_acc, loss_acc + loss), None
 
-            if gas == 1:
+            new_comm = state.comm_state
+            if onebit_grads is not None:
+                loss_sum, grads, new_comm = onebit_grads(
+                    state.params, micro_batches, micro_rngs,
+                    state.scale_state, state.comm_state, state.step)
+            elif gas == 1:
                 mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)
                 loss, grads = micro_grads(state.params, mb, micro_rngs[0], state.scale_state)
                 loss_sum = loss
@@ -295,6 +332,8 @@ class Engine:
                     lambda n, o: jnp.where(finite, n, o), new, old)
             new_params = select(new_params, state.params)
             new_opt_state = select(new_opt_state, state.opt_state)
+            if new_comm is not state.comm_state:
+                new_comm = select(new_comm, state.comm_state)
 
             new_scale = ls.update_state(state.scale_state, finite, cfg.fp16)
             new_step = state.step + jnp.where(finite, 1, 0).astype(jnp.int32)
@@ -306,7 +345,8 @@ class Engine:
                 skipped=jnp.logical_not(finite))
             new_state = TrainState(step=new_step, params=new_params,
                                    opt_state=new_opt_state,
-                                   scale_state=new_scale, rng=new_rng)
+                                   scale_state=new_scale, rng=new_rng,
+                                   comm_state=new_comm)
             return new_state, metrics
 
         if not cfg.compile:
@@ -390,6 +430,70 @@ class Engine:
             f"ZeRO++ manual collectives: qwZ={'int8' if wbits else 'off'}, "
             f"qgZ={'int8' if gbits else 'off'} over data={world}")
         return sm
+
+    def _maybe_onebit_grads(self, micro_grads):
+        """1-bit optimizers: run the whole grad-accumulation loop in a manual
+        shard_map over the data axis so per-rank gradients exist before any
+        reduction, then reduce with the error-compensated 1-bit allreduce
+        (after freeze_step) or a plain pmean (warmup). Returns
+        ``fn(params, micro_batches, micro_rngs, scale_state, comm, step) ->
+        (loss_sum, grads, new_comm)`` or None when not armed."""
+        if self._onebit is None:
+            return None
+        from .compressed_grads import comm_state_specs, reduce_grads_onebit
+        from .zero.quantized_collectives import shard_map
+
+        gas = self.gradient_accumulation_steps
+        world = self._onebit["world"]
+        freeze = self._onebit["freeze_step"]
+        accum_dtype = self._grad_accum_dtype
+        mesh = self.topology.mesh
+        manual_axes = ("data",)
+        comm_specs = comm_state_specs(self.state.params)
+
+        def local_fn(params, micro_batches, micro_rngs, scale_state, comm,
+                     step):
+            ridx = jax.lax.axis_index(manual_axes)
+
+            def mg(mb, r):
+                return micro_grads(params, mb,
+                                   jax.random.fold_in(r, ridx), scale_state)
+
+            if gas == 1:
+                mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)
+                loss_sum, grads = mg(mb, micro_rngs[0])
+            else:
+                zeros = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, accum_dtype), params)
+
+                def body(carry, xs):
+                    acc, lsum = carry
+                    mb, r = xs
+                    loss, g = mg(mb, r)
+                    return (jax.tree_util.tree_map(jnp.add, acc, g),
+                            lsum + loss), None
+
+                (grads, loss_sum), _ = jax.lax.scan(
+                    body, (zeros, jnp.zeros((), jnp.float32)),
+                    (micro_batches, micro_rngs))
+
+            def fp_reduce(g, c):
+                return jax.tree_util.tree_map(
+                    lambda x: jax.lax.pmean(x, manual_axes), g), c
+
+            def ob_reduce(g, c):
+                return reduce_grads_onebit(g, c, world, manual_axes)
+
+            grads, comm = jax.lax.cond(step >= freeze, ob_reduce, fp_reduce,
+                                       grads, comm)
+            loss_sum = jax.lax.pmean(loss_sum, manual_axes)
+            return loss_sum, grads, comm
+
+        return shard_map(
+            local_fn, mesh,
+            in_specs=(P(), P(None, manual_axes), P(), P(), comm_specs, P()),
+            out_specs=(P(), P(), comm_specs),
+            axis_names=manual_axes)
 
     def _build_eval_step(self):
         fn = self.eval_fn or self.loss_fn

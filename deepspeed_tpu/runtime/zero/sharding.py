@@ -95,9 +95,10 @@ class ZeroShardingPlan:
         # grads/opt-state shard over the full zero group. MiCS (mics.py):
         # everything shards within the group; DP reduction across replica
         # groups is the psum XLA inserts over the outer data axis.
+        from ...parallel.topology import DATA_INNER_AXIS
         self.param_axes = self.zero_axes
-        inner = ("data_inner",)
-        has_inner = topo.axis_size("data_inner") > 1
+        inner = (DATA_INNER_AXIS,)
+        has_inner = topo.axis_size(DATA_INNER_AXIS) > 1
         if cfg.mics_shard_size and cfg.mics_shard_size > 0:
             if has_inner:
                 self.param_axes = inner
