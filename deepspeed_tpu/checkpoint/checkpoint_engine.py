@@ -1,0 +1,113 @@
+"""Pluggable checkpoint backends.
+
+Parity with the reference's ``CheckpointEngine`` ABC
+(``runtime/checkpoint_engine/checkpoint_engine.py:9`` — create/save/load/
+commit) and its two implementations: the synchronous torch engine and the
+async Nebula engine (``nebula_checkpoint_engine.py``). Here:
+
+  - :class:`SyncCheckpointEngine` — write-through (the default).
+  - :class:`AsyncCheckpointEngine` — Nebula-class behavior: ``save`` hands
+    the (already host-gathered) state to a background thread and returns;
+    ``commit`` waits for the write and publishes ``latest`` only after the
+    tag's files are durable, so a crash mid-write never corrupts the newest
+    checkpoint pointer.
+
+Select via config: ``checkpoint: {"async_save": true}``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from typing import Any, Dict, Optional
+
+from ..utils.logging import log_dist, logger
+from .engine_checkpoint import LATEST_FILE, save_state_tree
+
+#: live async engines; flush_all_pending() lets a *different* engine instance
+#: (or process-wide teardown) wait out in-flight background writes before
+#: reading a checkpoint directory
+_LIVE_ASYNC = weakref.WeakSet()
+
+
+def flush_all_pending() -> None:
+    for eng in list(_LIVE_ASYNC):
+        eng.commit()
+
+
+def _write_latest(save_dir: str, tag: str) -> None:
+    with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+        f.write(tag)
+
+
+class CheckpointEngine:
+    """create → save → commit lifecycle, one tag at a time.
+
+    ``save`` persists the state under ``ckpt_dir``; when ``publish`` is
+    given as ``(save_dir, tag)``, the ``latest`` pointer is written only
+    after the tag's files are durable (crash mid-write never corrupts the
+    newest-checkpoint pointer)."""
+
+    def create(self, tag: str) -> None:  # noqa: D401 — reference API name
+        """Begin a checkpoint under ``tag``."""
+
+    def save(self, state: Any, ckpt_dir: str,
+             extra_meta: Optional[Dict] = None,
+             publish: Optional[tuple] = None) -> None:
+        raise NotImplementedError
+
+    def commit(self) -> None:
+        """Block until all pending saves are durable (reference: commit)."""
+
+
+class SyncCheckpointEngine(CheckpointEngine):
+    def save(self, state, ckpt_dir, extra_meta=None, publish=None):
+        save_state_tree(state, ckpt_dir, extra_meta=extra_meta)
+        if publish is not None:
+            _write_latest(*publish)
+
+
+class AsyncCheckpointEngine(CheckpointEngine):
+    """Background-thread writer (Nebula-class). State must already be host
+    memory (the engine checkpoint path device_gets before calling save), so
+    training continues while serialization and disk IO proceed off-thread."""
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        _LIVE_ASYNC.add(self)
+
+    def save(self, state, ckpt_dir, extra_meta=None, publish=None):
+        self.commit()
+
+        def _write():
+            try:
+                save_state_tree(state, ckpt_dir, extra_meta=extra_meta)
+                if publish is not None:
+                    _write_latest(*publish)
+            except BaseException as e:  # surfaced on next commit/save
+                self._error = e
+
+        self._thread = threading.Thread(target=_write, daemon=True,
+                                        name="ckpt-writer")
+        self._thread.start()
+        log_dist(f"async checkpoint write started -> {ckpt_dir}")
+
+    def commit(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(f"async checkpoint write failed: {err}") from err
+
+
+def build_checkpoint_engine(name: str) -> CheckpointEngine:
+    name = (name or "sync").lower()
+    if name in ("sync", "torch", "default"):
+        return SyncCheckpointEngine()
+    if name in ("async", "nebula"):
+        return AsyncCheckpointEngine()
+    logger.warning(f"unknown checkpoint engine '{name}', using sync")
+    return SyncCheckpointEngine()

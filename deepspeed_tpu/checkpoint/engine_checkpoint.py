@@ -40,18 +40,28 @@ def _tag_for(engine) -> str:
     return f"global_step{engine.global_steps}"
 
 
+def _path_str(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx",
+                    getattr(k, "name", k)))) for k in path)
+
+
 def save_state_tree(state: Any, ckpt_dir: str, extra_meta: Optional[Dict] = None) -> None:
-    """Save any pytree of arrays, fully gathered, with structure metadata."""
+    """Save any pytree of arrays, fully gathered, with structure metadata.
+    Leaf paths are recorded so offline tools (zero_to_fp32) can name params
+    without reconstructing the engine."""
     os.makedirs(ckpt_dir, exist_ok=True)
-    leaves, treedef = jax.tree_util.tree_flatten(state)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
     arrays = {}
-    for i, leaf in enumerate(leaves):
+    paths = []
+    for i, (path, leaf) in enumerate(flat):
         arrays[f"leaf_{i:05d}"] = np.asarray(jax.device_get(leaf))
+        paths.append(_path_str(path))
     np.savez(os.path.join(ckpt_dir, STATE_FILE), **arrays)
     meta = {
         "format_version": FORMAT_VERSION,
-        "n_leaves": len(leaves),
+        "n_leaves": len(flat),
         "treedef": str(treedef),
+        "paths": paths,
         "shapes": [list(np.shape(a)) for a in arrays.values()],
         "dtypes": [str(a.dtype) for a in arrays.values()],
     }
@@ -94,11 +104,23 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "client_state": client_state or {},
         "config": engine.config.to_dict(),
     }
+    # comm_state (1-bit error buffers) is mesh-shaped and transient — the
+    # reference likewise resets compression error buffers on load; dropping
+    # it keeps checkpoints mesh-agnostic
+    state = engine.state._replace(comm_state=())
     if jax.process_index() == 0:
-        save_state_tree(engine.state, ckpt_dir, extra_meta=extra)
-        if save_latest:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(tag)
+        ck = getattr(engine, "_ckpt_engine", None)
+        if ck is None:
+            from .checkpoint_engine import build_checkpoint_engine
+            ck = build_checkpoint_engine(
+                "async" if engine.config.checkpoint.async_save else "sync")
+            engine._ckpt_engine = ck
+        # gather to host eagerly so an async writer never touches live
+        # (donated) device buffers
+        host_state = jax.tree_util.tree_map(
+            lambda x: np.asarray(jax.device_get(x)), state)
+        ck.save(host_state, ckpt_dir, extra_meta=extra,
+                publish=(save_dir, tag) if save_latest else None)
     log_dist(f"saved checkpoint {ckpt_dir}")
     return ckpt_dir
 
@@ -110,6 +132,10 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     """Restore engine state, re-placing leaves onto the engine's (possibly
     different-shaped) mesh — elastic resume needs no conversion step.
     Returns (ckpt_path, client_state); (None, {}) when nothing to load."""
+    # flush in-flight async saves from ANY engine in this process (the
+    # writer may belong to a different engine instance than the loader)
+    from .checkpoint_engine import flush_all_pending
+    flush_all_pending()
     if tag is None:
         latest_path = os.path.join(load_dir, LATEST_FILE)
         if not os.path.exists(latest_path):
@@ -118,7 +144,9 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         with open(latest_path) as f:
             tag = f.read().strip()
     ckpt_dir = os.path.join(load_dir, tag)
-    state, meta = load_state_tree(ckpt_dir, engine.state)
+    state, meta = load_state_tree(
+        ckpt_dir, engine.state._replace(comm_state=()))
+    state = state._replace(comm_state=engine.state.comm_state)
 
     if load_module_only or not load_optimizer_states:
         state = engine.state._replace(params=state.params, step=state.step)

@@ -104,3 +104,80 @@ def test_tag_and_latest(tmp_path):
     e.save_checkpoint(str(tmp_path), tag="my_tag")
     assert (tmp_path / "my_tag").exists()
     assert (tmp_path / "latest").read_text() == "my_tag"
+
+
+def test_zero_to_fp32_cli(tmp_path):
+    """Offline consolidation: named fp32 params with no engine needed
+    (reference utils/zero_to_fp32.py + checkpoint/ds_to_universal.py)."""
+    from deepspeed_tpu.checkpoint import zero_to_fp32
+
+    e = _engine(stage=1)
+    e.train_batch(_batch(e))
+    e.save_checkpoint(str(tmp_path / "ck"))
+
+    out = tmp_path / "consolidated.npz"
+    rc = zero_to_fp32.main([str(tmp_path / "ck"), str(out)])
+    assert rc == 0
+    data = np.load(out)
+    live = export_fp32_params(e)
+    assert set(data.files) == set(live.keys())
+    for k in live:
+        np.testing.assert_allclose(data[k], live[k], rtol=1e-6)
+
+
+def test_async_checkpoint_engine(tmp_path):
+    """Nebula-class async save: publish happens after durability; loading
+    flushes in-flight writes (reference nebula_checkpoint_engine.py)."""
+    cfg_model = GPT2Config.tiny(dtype=jnp.float32)
+    model, init_fn, loss_fn = make_model(cfg_model)
+    params = init_fn(jax.random.PRNGKey(0), batch_size=2, seq_len=17)
+    engine, _, _, _ = dstpu.initialize(
+        loss_fn=loss_fn, params=params, config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "checkpoint": {"async_save": True},
+        })
+    engine.train_batch(_batch(engine))
+    ref_loss = float(engine.eval_batch(_batch(engine, 5)))
+    engine.save_checkpoint(str(tmp_path / "ck"))
+    engine.train_batch(_batch(engine, 1))          # training continues
+
+    e2 = _engine()
+    e2.load_checkpoint(str(tmp_path / "ck"))       # flushes async write
+    assert float(e2.eval_batch(_batch(e2, 5))) == pytest.approx(ref_loss, rel=1e-5)
+
+
+def test_onebit_comm_state_excluded_from_checkpoint(tmp_path, devices8):
+    """1-bit error buffers are mesh-shaped; checkpoints must stay
+    mesh-agnostic (reference resets compression buffers on load)."""
+    cfg_model = GPT2Config.tiny(dtype=jnp.float32)
+    model, init_fn, loss_fn = make_model(cfg_model)
+    params = init_fn(jax.random.PRNGKey(0), batch_size=2, seq_len=17)
+
+    def onebit_engine(n_dev):
+        topo = None
+        if n_dev < len(jax.devices()):
+            from deepspeed_tpu.parallel.topology import build_mesh
+            from deepspeed_tpu.config.config import MeshConfig
+            topo = build_mesh(MeshConfig(data=n_dev),
+                              devices=jax.devices()[:n_dev])
+        engine, _, _, _ = dstpu.initialize(
+            loss_fn=loss_fn, params=params, topology=topo, config={
+                "train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "OneBitAdam",
+                              "params": {"lr": 1e-2, "freeze_step": 1}},
+                "zero_optimization": {"stage": 1},
+            })
+        return engine
+
+    e8 = onebit_engine(8)
+    for i in range(3):                              # crosses freeze boundary
+        e8.train_batch({"tokens": jnp.asarray(
+            np.random.RandomState(i).randint(0, 512, size=(16, 18)), jnp.int32)})
+    e8.save_checkpoint(str(tmp_path / "ck"))
+
+    e4 = onebit_engine(4)                           # different world size
+    e4.load_checkpoint(str(tmp_path / "ck"))
+    loss = float(e4.train_batch({"tokens": jnp.asarray(
+        np.random.RandomState(9).randint(0, 512, size=(8, 18)), jnp.int32)}))
+    assert np.isfinite(loss)
