@@ -36,6 +36,12 @@ def flush_all_pending() -> None:
         eng.commit()
 
 
+# daemon writer threads die at interpreter shutdown; without this the LAST
+# checkpoint of a run could be silently truncated
+import atexit  # noqa: E402
+atexit.register(flush_all_pending)
+
+
 def _write_latest(save_dir: str, tag: str) -> None:
     with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
         f.write(tag)

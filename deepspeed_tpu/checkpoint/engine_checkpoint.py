@@ -30,7 +30,8 @@ import numpy as np
 
 from ..utils.logging import log_dist, logger
 
-FORMAT_VERSION = 1
+#: v2: leaf paths recorded; comm_state (1-bit error buffers) excluded
+FORMAT_VERSION = 2
 LATEST_FILE = "latest"
 STATE_FILE = "state.npz"
 META_FILE = "meta.json"
@@ -75,6 +76,12 @@ def load_state_tree(ckpt_dir: str, target: Any) -> Tuple[Any, Dict]:
     Returns (state, meta). Shape mismatches raise with the leaf index."""
     with open(os.path.join(ckpt_dir, META_FILE)) as f:
         meta = json.load(f)
+    version = int(meta.get("format_version", 0))
+    if version != FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint {ckpt_dir} has format_version {version}; this "
+            f"build reads version {FORMAT_VERSION} — re-save the checkpoint "
+            f"with the current framework")
     data = np.load(os.path.join(ckpt_dir, STATE_FILE))
     leaves_t, treedef = jax.tree_util.tree_flatten(target)
     n = meta["n_leaves"]
@@ -173,8 +180,8 @@ def export_fp32_params(engine) -> Dict[str, np.ndarray]:
     flat = {}
 
     def visit(path, leaf):
-        key = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
-        flat[key] = np.asarray(jax.device_get(leaf), dtype=np.float32)
+        flat[_path_str(path)] = np.asarray(jax.device_get(leaf),
+                                           dtype=np.float32)
         return leaf
 
     jax.tree_util.tree_map_with_path(visit, engine.state.params)

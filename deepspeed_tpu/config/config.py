@@ -375,6 +375,39 @@ class Config(ConfigModel):
     # ------------------------------------------------------------------ #
 
     def resolve_batch_sizes(self, dp_world_size: int) -> None:
+        if self.elasticity.enabled and not getattr(
+                self.elasticity, "_resolved", False):
+            # elastic mode: the batch configuration is COMPUTED, not given
+            # (reference elasticity.py compute_elastic_config + the engine's
+            # immutable-config enforcement)
+            from ..elasticity import (
+                compute_elastic_config, ensure_immutable_elastic_config)
+            ensure_immutable_elastic_config(self)
+            world = dp_world_size * int(self.elasticity.model_parallel_size)
+            tb, _counts, mb = compute_elastic_config(
+                self, world_size=world, return_microbatch=True)
+            if not self.elasticity.ignore_non_elastic_batch_info:
+                for key, got, want in (
+                        ("train_batch_size", self.train_batch_size, tb),
+                        ("train_micro_batch_size_per_gpu",
+                         self.train_micro_batch_size_per_gpu, mb),
+                        ("gradient_accumulation_steps",
+                         self.gradient_accumulation_steps,
+                         tb // (mb * dp_world_size))):
+                    if not is_auto(got) and got not in (None, want):
+                        raise ConfigError(
+                            f"elasticity is enabled: {key} must be left "
+                            f"'auto' or match the elastic value {want} "
+                            f"(got {got}); set ignore_non_elastic_batch_info "
+                            f"to override")
+            self.train_batch_size = tb
+            self.train_micro_batch_size_per_gpu = mb
+            self.gradient_accumulation_steps = tb // (mb * dp_world_size)
+            self.elasticity._resolved = True
+            logger.info(
+                f"elastic batch config: train_batch={tb}, micro={mb}, "
+                f"gas={self.gradient_accumulation_steps} over dp={dp_world_size}")
+            return
         tb = None if is_auto(self.train_batch_size) else self.train_batch_size
         mb = None if is_auto(self.train_micro_batch_size_per_gpu) else self.train_micro_batch_size_per_gpu
         gas = None if is_auto(self.gradient_accumulation_steps) else self.gradient_accumulation_steps

@@ -1,0 +1,8 @@
+from .elasticity import (
+    ElasticityError,
+    ElasticityConfigError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+    ensure_immutable_elastic_config,
+)
+from .elastic_agent import run_elastic

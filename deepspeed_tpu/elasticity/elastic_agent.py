@@ -1,0 +1,86 @@
+"""Elastic restart agent.
+
+Parity with the reference's ``DSElasticAgent`` (``elasticity/elastic_agent.py:32``,
+a torch-elastic ``LocalElasticAgent`` subclass that re-spawns workers on
+membership change). TPU SPMD has one process per host and no in-band rank
+rendezvous to re-form, so the idiomatic equivalent is a **supervisor loop**:
+run the training command; on failure (or an explicit membership-change exit
+code) re-launch it against the currently-available device/host set, with the
+elastic config pinned in the environment (``ensure_immutable_elastic_config``
+checks it runtime-side) — recovery is checkpoint-based, exactly like the
+reference (restart → ``load_checkpoint`` with the mesh-agnostic format).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..utils.logging import logger
+from .elasticity import ELASTICITY_ENV, compute_elastic_config
+
+#: a worker exits with this code to request a re-launch (membership change)
+MEMBERSHIP_CHANGE_EXIT = 99
+
+
+def run_elastic(
+    cmd: Sequence[str],
+    elastic_config: Dict,
+    max_restarts: int = 100,
+    discover_world: Optional[Callable[[], int]] = None,
+    min_restart_interval_s: float = 5.0,
+    env: Optional[Dict[str, str]] = None,
+) -> int:
+    """Supervise ``cmd`` with elastic restarts.
+
+    ``discover_world`` returns the currently-available device count (default:
+    keep the last value); each (re)launch validates it against the elastic
+    device-count set and exports the pinned elastic config plus
+    ``DSTPU_ELASTIC_WORLD_SIZE`` for the worker. Returns the final exit code
+    (0 on success)."""
+    batch, valid_dp = compute_elastic_config(
+        {"elasticity": dict(elastic_config, enabled=True)})
+    # compute_elastic_config returns DATA-PARALLEL rank counts; the agent
+    # compares device counts, so scale by the model-parallel degree
+    mp = int(elastic_config.get("model_parallel_size", 1) or 1)
+    valid_counts = [c * mp for c in valid_dp]
+    logger.info(f"elastic agent: batch={batch}, valid device counts="
+                f"{valid_counts} (dp counts {valid_dp} x mp {mp})")
+
+    restarts = 0
+    world = discover_world() if discover_world else 0
+    while True:
+        child_env = dict(os.environ)
+        child_env[ELASTICITY_ENV] = json.dumps(dict(elastic_config,
+                                                    enabled=True))
+        if world:
+            if world not in valid_counts:
+                usable = [c for c in valid_counts if c <= world]
+                if not usable:
+                    raise RuntimeError(
+                        f"no elastic device count <= available {world} "
+                        f"(valid: {valid_counts})")
+                world = max(usable)
+            child_env["DSTPU_ELASTIC_WORLD_SIZE"] = str(world)
+        child_env.update(env or {})
+
+        start = time.time()
+        proc = subprocess.run(list(cmd), env=child_env)
+        if proc.returncode == 0:
+            return 0
+        restarts += 1
+        if restarts > max_restarts:
+            logger.error(f"elastic agent: giving up after {restarts - 1} "
+                         f"restarts (last exit {proc.returncode})")
+            return proc.returncode
+        if time.time() - start < min_restart_interval_s:
+            time.sleep(min_restart_interval_s)
+        if discover_world:
+            world = discover_world()
+        logger.warning(
+            f"elastic agent: worker exited {proc.returncode} "
+            f"({'membership change' if proc.returncode == MEMBERSHIP_CHANGE_EXIT else 'failure'}), "
+            f"restart {restarts}/{max_restarts} with world={world or 'unchanged'}")

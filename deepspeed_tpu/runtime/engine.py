@@ -20,6 +20,7 @@ API parity (reference engine.py):
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
@@ -83,8 +84,16 @@ class Engine:
             inner = int(zcfg.mics_shard_size)
         elif zcfg.zero_hpz_partition_size > 1:
             inner = int(zcfg.zero_hpz_partition_size)
-        self.topology = topology or build_mesh(config.mesh,
-                                               inner_shard_size=inner)
+        if topology is None:
+            # elastic agent may have clamped the usable device count
+            # (elasticity/elastic_agent.py exports this on re-launch)
+            devices = None
+            elastic_ws = os.environ.get("DSTPU_ELASTIC_WORLD_SIZE")
+            if elastic_ws:
+                devices = jax.devices()[:int(elastic_ws)]
+            topology = build_mesh(config.mesh, devices=devices,
+                                  inner_shard_size=inner)
+        self.topology = topology
         set_topology(self.topology)
         self.loss_fn = loss_fn
         self.eval_fn = eval_fn

@@ -1,0 +1,191 @@
+"""Elasticity — reference parity: tests/unit/elasticity/test_elastic.py
+(v0.1/v0.2 batch solver invariants, immutable config, engine integration)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.elasticity import (
+    ElasticityConfigError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+    ensure_immutable_elastic_config,
+)
+from deepspeed_tpu.elasticity.elasticity import ELASTICITY_ENV
+from deepspeed_tpu.models.gpt2 import GPT2Config, make_model
+
+BASE = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 2000,
+        "micro_batch_sizes": [2, 4, 6],
+        "min_gpus": 1,
+        "max_gpus": 10000,
+        "version": 0.1,
+    }
+}
+
+
+class TestBatchSolver:
+    def test_v01_invariants(self):
+        batch, counts = compute_elastic_config(BASE)
+        assert batch <= 2000
+        assert counts == sorted(set(counts))
+        for n in counts:
+            # every valid count admits micro*gas*n == batch for some micro
+            assert any(batch % (mb * n) == 0
+                       for mb in BASE["elasticity"]["micro_batch_sizes"])
+
+    def test_v01_deterministic(self):
+        assert compute_elastic_config(BASE) == compute_elastic_config(BASE)
+
+    def test_v01_world_size_resolution(self):
+        batch, counts, mb = compute_elastic_config(
+            BASE, world_size=counts_pick(BASE), return_microbatch=True)
+        assert mb in BASE["elasticity"]["micro_batch_sizes"]
+        assert batch % (mb * counts_pick(BASE)) == 0
+
+    def test_v01_incompatible_world(self):
+        cfg = {"elasticity": dict(BASE["elasticity"], max_gpus=100)}
+        _, counts = compute_elastic_config(cfg)
+        bad = max(counts) + 1
+        while bad in counts:
+            bad += 1
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            compute_elastic_config(cfg, world_size=bad)
+
+    def test_v02_node_granularity(self):
+        cfg = {"elasticity": dict(
+            BASE["elasticity"], version=0.2, num_gpus_per_node=4,
+            model_parallel_size=2, min_gpus=4, max_gpus=64)}
+        batch, counts, mb = compute_elastic_config(
+            cfg, world_size=8, return_microbatch=True)
+        dp_per_node = 4 // 2
+        for c in counts:
+            assert c % dp_per_node == 0
+        assert batch % mb == 0
+
+    def test_v02_mp_divisibility_error(self):
+        cfg = {"elasticity": dict(BASE["elasticity"], version=0.2,
+                                  num_gpus_per_node=4,
+                                  model_parallel_size=3)}
+        with pytest.raises(Exception):
+            compute_elastic_config(cfg, world_size=4)
+
+    def test_disabled_raises(self):
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(
+                {"elasticity": dict(BASE["elasticity"], enabled=False)})
+
+    def test_micro_batch_validation(self):
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(
+                {"elasticity": dict(BASE["elasticity"],
+                                    micro_batch_sizes=[0, 2])})
+
+
+def counts_pick(cfg):
+    _, counts = compute_elastic_config(cfg)
+    return counts[len(counts) // 2]
+
+
+class TestImmutableConfig:
+    def test_mismatch_raises(self, monkeypatch):
+        monkeypatch.setenv(ELASTICITY_ENV, json.dumps(
+            dict(BASE["elasticity"], max_train_batch_size=999)))
+        with pytest.raises(ElasticityConfigError):
+            ensure_immutable_elastic_config(BASE)
+
+    def test_match_passes(self, monkeypatch):
+        monkeypatch.setenv(ELASTICITY_ENV, json.dumps(BASE["elasticity"]))
+        ensure_immutable_elastic_config(BASE)
+
+    def test_missing_env_warns_only(self, monkeypatch):
+        monkeypatch.delenv(ELASTICITY_ENV, raising=False)
+        ensure_immutable_elastic_config(BASE)
+
+
+class TestEngineIntegration:
+    def test_elastic_batch_applied(self, devices8):
+        cfg_model = GPT2Config.tiny(dtype=jnp.float32)
+        model, init_fn, loss_fn = make_model(cfg_model)
+        params = init_fn(__import__("jax").random.PRNGKey(0),
+                         batch_size=2, seq_len=16)
+        engine, _, _, _ = dstpu.initialize(
+            loss_fn=loss_fn, params=params, config={
+                "train_batch_size": "auto",
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "elasticity": dict(BASE["elasticity"]),
+            })
+        tb = engine.config.train_batch_size
+        mb = engine.config.train_micro_batch_size_per_gpu
+        gas = engine.config.gradient_accumulation_steps
+        assert tb == mb * gas * 8
+        assert mb in BASE["elasticity"]["micro_batch_sizes"]
+        tokens = np.random.RandomState(0).randint(0, 512, size=(tb, 17))
+        loss = float(engine.train_batch({"tokens": jnp.asarray(tokens, jnp.int32)}))
+        assert np.isfinite(loss)
+
+    def test_fixed_batch_conflict_raises(self, devices8):
+        cfg_model = GPT2Config.tiny(dtype=jnp.float32)
+        model, init_fn, loss_fn = make_model(cfg_model)
+        params = init_fn(__import__("jax").random.PRNGKey(0),
+                         batch_size=2, seq_len=16)
+        from deepspeed_tpu.config.config import ConfigError
+        with pytest.raises(ConfigError):
+            dstpu.initialize(
+                loss_fn=loss_fn, params=params, config={
+                    "train_batch_size": 7,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "elasticity": dict(BASE["elasticity"]),
+                })
+
+
+class TestElasticAgent:
+    def test_restart_until_success(self, tmp_path):
+        from deepspeed_tpu.elasticity import run_elastic
+        marker = tmp_path / "attempts"
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os, sys\n"
+            f"p = {str(marker)!r}\n"
+            "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+            "open(p, 'w').write(str(n + 1))\n"
+            "assert os.environ.get('DSTPU_ELASTICITY_CONFIG')\n"
+            "sys.exit(0 if n >= 2 else 99)\n")
+        rc = run_elastic([sys.executable, str(script)],
+                         BASE["elasticity"], max_restarts=5,
+                         min_restart_interval_s=0.0)
+        assert rc == 0
+        assert marker.read_text() == "3"
+
+    def test_gives_up_after_max_restarts(self, tmp_path):
+        from deepspeed_tpu.elasticity import run_elastic
+        script = tmp_path / "worker.py"
+        script.write_text("import sys; sys.exit(1)\n")
+        rc = run_elastic([sys.executable, str(script)],
+                         BASE["elasticity"], max_restarts=2,
+                         min_restart_interval_s=0.0)
+        assert rc == 1
+
+
+def test_elastic_world_size_env_clamps_mesh(devices8, monkeypatch):
+    """The agent's DSTPU_ELASTIC_WORLD_SIZE export must size the engine's
+    mesh on re-launch."""
+    import jax
+    monkeypatch.setenv("DSTPU_ELASTIC_WORLD_SIZE", "4")
+    cfg_model = GPT2Config.tiny(dtype=jnp.float32)
+    model, init_fn, loss_fn = make_model(cfg_model)
+    params = init_fn(jax.random.PRNGKey(0), batch_size=2, seq_len=16)
+    engine, _, _, _ = dstpu.initialize(
+        loss_fn=loss_fn, params=params, config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        })
+    assert engine.topology.world_size == 4
