@@ -1,0 +1,2 @@
+from .autotuner import Autotuner, Experiment
+from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner, build_tuner

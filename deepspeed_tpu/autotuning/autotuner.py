@@ -1,0 +1,214 @@
+"""Autotuner — search ZeRO stage × micro-batch (× user dimensions) for the
+fastest configuration that fits memory.
+
+Parity with the reference's ``Autotuner`` (``autotuning/autotuner.py:42``,
+``tune:404``) and its experiment scheduler (``autotuning/scheduler.py``
+``ResourceManager``): the reference forks launcher jobs per experiment and
+reads back metrics files; on TPU a single-controller process can build the
+engine in-process per candidate, so the "scheduler" is a sequential (or
+user-parallelized) experiment loop with the same record/prune/early-stop
+semantics. The reference's model-info profile run (peak activation memory at
+micro-batch 1) maps to XLA's compile-time memory analysis: candidates whose
+``compiled.memory_analysis()`` exceeds the device budget are pruned without
+running a step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+from .tuner import build_tuner
+
+FAILED = "failed"
+PRUNED = "pruned_oom"
+OK = "ok"
+
+
+@dataclasses.dataclass
+class Experiment:
+    overrides: Dict[str, Any]
+    status: str = "pending"
+    score: float = float("-inf")
+    metrics: Dict[str, float] = dataclasses.field(default_factory=dict)
+    error: str = ""
+
+
+def _apply_overrides(config: Dict[str, Any], overrides: Dict[str, Any]) -> Dict[str, Any]:
+    out = json.loads(json.dumps(config))  # deep copy, JSON-typed
+    for key, value in overrides.items():
+        node = out
+        parts = key.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return out
+
+
+def _device_memory_budget() -> Optional[int]:
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:  # noqa: BLE001 — backend without memory stats
+        pass
+    return None
+
+
+class Autotuner:
+    """Tunes engine configuration for a given model.
+
+    Args:
+      loss_fn/params: as for ``deepspeed_tpu.initialize``.
+      base_config: ds_config dict; its ``autotuning`` block steers the search.
+      batch_fn: ``(batch_size) -> batch pytree`` producing training batches.
+    """
+
+    def __init__(self, loss_fn: Callable, params: Any,
+                 base_config: Dict[str, Any], batch_fn: Callable[[int], Any]):
+        self.loss_fn = loss_fn
+        self.params = params
+        self.base_config = dict(base_config)
+        self.batch_fn = batch_fn
+        # single source of defaults: the AutotuningConfig dataclass
+        from ..config.config import AutotuningConfig
+        at = self.base_config.get("autotuning", {})
+        cfg = at if isinstance(at, AutotuningConfig) else \
+            AutotuningConfig.from_dict(dict(at))
+        self.metric = cfg.metric
+        self.tuner_type = cfg.tuner_type
+        self.early_stopping = int(cfg.tuner_early_stopping)
+        self.num_trials = int(cfg.tuner_num_trials)
+        self.fast = bool(cfg.fast)
+        self.mbs_min = int(cfg.min_train_micro_batch_size_per_gpu)
+        self.mbs_max = int(cfg.max_train_micro_batch_size_per_gpu)
+        self.num_mbs = int(cfg.num_tuning_micro_batch_sizes)
+        self.profile_steps = (int(cfg.start_profile_step),
+                              int(cfg.end_profile_step))
+        self.results_dir = cfg.results_dir
+        self.user_space = dict(cfg.tuning_space or {})
+        self.experiments: List[Experiment] = []
+
+    # ------------------------------ space ------------------------------ #
+
+    def search_space(self) -> List[Dict[str, Any]]:
+        """ZeRO stages × micro-batch powers of two × user dimensions."""
+        stages = self.user_space.get("zero_optimization.stage", [0, 1, 2, 3])
+        if self.fast:
+            stages = [s for s in stages if s in (0, 1, 2)] or stages
+        mbs = []
+        m = self.mbs_min
+        while m <= self.mbs_max and len(mbs) < self.num_mbs:
+            mbs.append(m)
+            m *= 2
+        extra_keys = [k for k in self.user_space
+                      if k != "zero_optimization.stage"]
+        cands = []
+        for stage in stages:
+            for mb in mbs:
+                base = {"zero_optimization.stage": stage,
+                        "train_micro_batch_size_per_gpu": mb}
+                stack = [base]
+                for key in extra_keys:
+                    stack = [dict(c, **{key: v}) for c in stack
+                             for v in self.user_space[key]]
+                cands.extend(stack)
+        return cands
+
+    # --------------------------- experiments --------------------------- #
+
+    def _run_experiment(self, overrides: Dict[str, Any]) -> Experiment:
+        import deepspeed_tpu as dstpu
+        exp = Experiment(overrides=overrides)
+        cfg = _apply_overrides(self.base_config, overrides)
+        cfg.pop("autotuning", None)
+        cfg.pop("train_batch_size", None)   # re-derive from micro batch
+        cfg.pop("gradient_accumulation_steps", None)
+        try:
+            engine, _, _, _ = dstpu.initialize(
+                loss_fn=self.loss_fn, params=self.params, config=cfg)
+        except Exception as e:  # noqa: BLE001 — invalid candidate
+            exp.status, exp.error = FAILED, repr(e)
+            return exp
+        try:
+            budget = _device_memory_budget()
+            batch = self.batch_fn(engine.config.train_batch_size)
+            warmup, measure = self.profile_steps
+            # memory prune before stepping (reference model-info profile run)
+            if budget is not None:
+                try:
+                    analysis = engine._train_step.lower(
+                        engine.state, batch).compile().memory_analysis()
+                    need = getattr(analysis, "temp_size_in_bytes", 0) + \
+                        getattr(analysis, "argument_size_in_bytes", 0)
+                    if need > budget:
+                        exp.status = PRUNED
+                        exp.metrics["estimated_bytes"] = float(need)
+                        return exp
+                except Exception:  # noqa: BLE001 — lowering w/o analysis
+                    pass
+            for _ in range(warmup):
+                engine.train_batch(batch)
+            jax.block_until_ready(engine.state.params)
+            t0 = time.perf_counter()
+            for _ in range(measure):
+                engine.train_batch(batch)
+            jax.block_until_ready(engine.state.params)
+            dt = (time.perf_counter() - t0) / measure
+            tput = engine.config.train_batch_size / dt
+            exp.metrics = {"samples_per_sec": tput, "step_latency_s": dt}
+            exp.score = tput if self.metric == "throughput" else -dt
+            exp.status = OK
+        except Exception as e:  # noqa: BLE001 — OOM / compile failure
+            exp.status, exp.error = FAILED, repr(e)
+        return exp
+
+    # ------------------------------ tune ------------------------------- #
+
+    def tune(self) -> Dict[str, Any]:
+        """Run the search; returns the best overrides (written to
+        ``results_dir/best_config.json`` with the full experiment log)."""
+        space = self.search_space()
+        tuner = build_tuner(self.tuner_type, space)
+        log_dist(f"autotuning: {len(space)} candidates, tuner="
+                 f"{self.tuner_type}, metric={self.metric}")
+        since_best = 0
+        best_score = float("-inf")
+        for trial in range(min(self.num_trials, len(space))):
+            cand = tuner.next()
+            if cand is None:
+                break
+            exp = self._run_experiment(cand)
+            self.experiments.append(exp)
+            tuner.update(cand, exp.score)
+            log_dist(f"autotuning trial {trial}: {cand} -> {exp.status} "
+                     f"score={exp.score:.2f}")
+            if exp.score > best_score:
+                best_score, since_best = exp.score, 0
+            else:
+                since_best += 1
+                if since_best >= self.early_stopping:
+                    log_dist(f"autotuning early stop after {trial + 1} trials")
+                    break
+        best, score = tuner.best()
+        self._write_results(best, score)
+        return best or {}
+
+    def _write_results(self, best, score) -> None:
+        os.makedirs(self.results_dir, exist_ok=True)
+        with open(os.path.join(self.results_dir, "best_config.json"), "w") as f:
+            json.dump({
+                "best_overrides": best,
+                "score": score,
+                "metric": self.metric,
+                "experiments": [dataclasses.asdict(e) for e in self.experiments],
+            }, f, indent=2, default=str)
+        log_dist(f"autotuning: best {best} (score {score:.2f}) -> "
+                 f"{self.results_dir}/best_config.json")

@@ -1,0 +1,98 @@
+"""Tuner strategies — grid / random / model-based.
+
+Parity with the reference's ``autotuning/tuner/`` (``GridSearchTuner``,
+``RandomTuner``, ``ModelBasedTuner`` — the last an xgboost cost model): a
+tuner proposes the next candidate from the search space given the scores
+observed so far. The model-based tuner here fits a least-squares cost model
+over the numeric features of the measured points (no xgboost dependency) and
+ranks untried candidates by predicted score — same explore-then-exploit
+shape, dependency-free.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class BaseTuner:
+    def __init__(self, space: Sequence[Dict[str, Any]], seed: int = 0):
+        self.space = list(space)
+        self.observed: List[Tuple[Dict[str, Any], float]] = []
+        self._rng = random.Random(seed)
+
+    def next(self) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def update(self, candidate: Dict[str, Any], score: float) -> None:
+        self.observed.append((candidate, score))
+
+    def _untried(self) -> List[Dict[str, Any]]:
+        seen = [c for c, _ in self.observed]
+        return [c for c in self.space if c not in seen]
+
+    def best(self) -> Tuple[Optional[Dict[str, Any]], float]:
+        if not self.observed:
+            return None, float("-inf")
+        return max(self.observed, key=lambda cs: cs[1])
+
+
+class GridSearchTuner(BaseTuner):
+    def next(self):
+        rest = self._untried()
+        return rest[0] if rest else None
+
+
+class RandomTuner(BaseTuner):
+    def next(self):
+        rest = self._untried()
+        return self._rng.choice(rest) if rest else None
+
+
+class ModelBasedTuner(BaseTuner):
+    """Explore ``n_warmup`` random points, then exploit a least-squares cost
+    model over numeric candidate features."""
+
+    def __init__(self, space, seed: int = 0, n_warmup: int = 3):
+        super().__init__(space, seed)
+        self.n_warmup = n_warmup
+
+    def _features(self, cand: Dict[str, Any]) -> List[float]:
+        out = []
+        for key in sorted({k for c in self.space for k in c}):
+            v = cand.get(key, 0)
+            if isinstance(v, bool):
+                out.append(float(v))
+            elif isinstance(v, (int, float)):
+                out.append(float(v))
+                out.append(float(np.log2(max(abs(v), 1))))
+            else:
+                out.append(float(abs(hash(str(v))) % 7))
+        return out + [1.0]
+
+    def next(self):
+        rest = self._untried()
+        if not rest:
+            return None
+        finite = [(c, s) for c, s in self.observed if np.isfinite(s)]
+        if len(finite) < self.n_warmup:
+            return self._rng.choice(rest)
+        X = np.asarray([self._features(c) for c, _ in finite])
+        y = np.asarray([s for _, s in finite])
+        coef, *_ = np.linalg.lstsq(X, y, rcond=None)
+        preds = [(float(np.asarray(self._features(c)) @ coef), c)
+                 for c in rest]
+        return max(preds, key=lambda pc: pc[0])[1]
+
+
+def build_tuner(name: str, space, seed: int = 0) -> BaseTuner:
+    name = (name or "gridsearch").lower()
+    if name in ("gridsearch", "grid"):
+        return GridSearchTuner(space, seed)
+    if name == "random":
+        return RandomTuner(space, seed)
+    if name in ("model_based", "modelbased", "xgboost"):
+        return ModelBasedTuner(space, seed)
+    raise ValueError(f"unknown tuner_type '{name}'")

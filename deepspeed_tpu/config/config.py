@@ -189,6 +189,28 @@ class CometConfig(ConfigModel):
 
 
 @dataclass
+class AutotuningConfig(ConfigModel):
+    """autotuning sub-tree (reference autotuning/config.py). The tuner
+    searches ZeRO stage x micro-batch (and anything in ``tuning_space``)
+    for the best throughput under the device memory budget."""
+    enabled: bool = False
+    metric: str = "throughput"          # throughput | latency
+    fast: bool = True
+    tuner_type: str = "gridsearch"      # gridsearch | random | model_based
+    tuner_early_stopping: int = 5
+    tuner_num_trials: int = 50
+    max_train_micro_batch_size_per_gpu: int = 1024
+    min_train_micro_batch_size_per_gpu: int = 1
+    num_tuning_micro_batch_sizes: int = 3
+    mp_size: int = 1
+    start_profile_step: int = 3
+    end_profile_step: int = 5
+    results_dir: str = "autotuning_results"
+    overwrite: bool = True
+    tuning_space: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
 class FlopsProfilerConfig(ConfigModel):
     enabled: bool = False
     recompute_fwd_factor: float = 0.0
@@ -333,6 +355,7 @@ class Config(ConfigModel):
     csv_monitor: CSVConfig = field(default_factory=CSVConfig)
     comet: CometConfig = field(default_factory=CometConfig)
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
+    autotuning: AutotuningConfig = field(default_factory=AutotuningConfig)
     comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
 
     mesh: MeshConfig = field(default_factory=MeshConfig)

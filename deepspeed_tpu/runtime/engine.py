@@ -150,6 +150,13 @@ class Engine:
             batch_size=config.train_batch_size,
             steps_per_output=config.steps_per_print)
         self.monitor = self._build_monitor()
+        if config.autotuning.enabled:
+            # the reference runs tuning from the launcher; here the user
+            # drives it explicitly — never silently ignore the flag
+            logger.warning(
+                "autotuning.enabled is set but initialize() does not launch "
+                "the search; run deepspeed_tpu.autotuning.Autotuner(...)"
+                ".tune() to produce a tuned config")
         self.flops_profiler = None
         if config.flops_profiler.enabled:
             from ..profiling.flops_profiler import FlopsProfiler

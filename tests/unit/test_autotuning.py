@@ -1,0 +1,108 @@
+"""Autotuning — reference parity: tests/unit/autotuning/test_autotuning.py
+(tuner strategies, search-space construction, experiment records)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import (
+    Autotuner, GridSearchTuner, ModelBasedTuner, RandomTuner, build_tuner)
+from deepspeed_tpu.models.gpt2 import GPT2Config, make_model
+
+SPACE = [{"stage": s, "mb": m} for s in (0, 1) for m in (1, 2, 4)]
+
+
+class TestTuners:
+    def test_grid_covers_space_in_order(self):
+        t = GridSearchTuner(SPACE)
+        seen = []
+        while (c := t.next()) is not None:
+            seen.append(c)
+            t.update(c, 0.0)
+        assert seen == SPACE
+
+    def test_random_covers_space(self):
+        t = RandomTuner(SPACE, seed=3)
+        seen = []
+        while (c := t.next()) is not None:
+            seen.append(c)
+            t.update(c, 0.0)
+        assert sorted(seen, key=str) == sorted(SPACE, key=str)
+
+    def test_model_based_exploits(self):
+        # score = mb (bigger micro batch better); after warmup the model
+        # should prefer large-mb candidates over small ones
+        t = ModelBasedTuner(SPACE, seed=0, n_warmup=3)
+        for _ in range(3):
+            c = t.next()
+            t.update(c, float(c["mb"]))
+        c = t.next()
+        assert c["mb"] == max(x["mb"] for x in t._untried() + [c])
+
+    def test_build_tuner_names(self):
+        assert isinstance(build_tuner("gridsearch", SPACE), GridSearchTuner)
+        assert isinstance(build_tuner("random", SPACE), RandomTuner)
+        assert isinstance(build_tuner("model_based", SPACE), ModelBasedTuner)
+        with pytest.raises(ValueError):
+            build_tuner("nope", SPACE)
+
+    def test_best(self):
+        t = GridSearchTuner(SPACE)
+        t.update(SPACE[0], 1.0)
+        t.update(SPACE[1], 5.0)
+        best, score = t.best()
+        assert best == SPACE[1] and score == 5.0
+
+
+class TestAutotuner:
+    def _tuner(self, tmp_path, **at):
+        cfg_model = GPT2Config.tiny(dtype=jnp.float32)
+        model, init_fn, loss_fn = make_model(cfg_model)
+        params = init_fn(jax.random.PRNGKey(0), batch_size=2, seq_len=16)
+
+        def batch_fn(n):
+            tokens = np.random.RandomState(0).randint(0, 512, size=(n, 17))
+            return {"tokens": jnp.asarray(tokens, jnp.int32)}
+
+        base = {
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "steps_per_print": 10000,
+            "autotuning": dict({
+                "enabled": True,
+                "results_dir": str(tmp_path / "results"),
+                "start_profile_step": 1,
+                "end_profile_step": 2,
+            }, **at),
+        }
+        return Autotuner(loss_fn, params, base, batch_fn)
+
+    def test_search_space(self, tmp_path):
+        t = self._tuner(tmp_path, num_tuning_micro_batch_sizes=2,
+                        tuning_space={"zero_optimization.stage": [0, 2]})
+        space = t.search_space()
+        assert {c["zero_optimization.stage"] for c in space} == {0, 2}
+        assert {c["train_micro_batch_size_per_gpu"] for c in space} == {1, 2}
+
+    def test_tune_end_to_end(self, devices8, tmp_path):
+        t = self._tuner(
+            tmp_path, num_tuning_micro_batch_sizes=1,
+            min_train_micro_batch_size_per_gpu=2,
+            tuning_space={"zero_optimization.stage": [0, 1]})
+        best = t.tune()
+        assert best["zero_optimization.stage"] in (0, 1)
+        ok = [e for e in t.experiments if e.status == "ok"]
+        assert len(ok) == 2
+        assert all(e.metrics["samples_per_sec"] > 0 for e in ok)
+        results = json.load(open(tmp_path / "results" / "best_config.json"))
+        assert results["best_overrides"] == best
+        assert len(results["experiments"]) == 2
+
+    def test_invalid_candidate_recorded_failed(self, devices8, tmp_path):
+        t = self._tuner(tmp_path, num_tuning_micro_batch_sizes=1,
+                        tuning_space={"optimizer.type": ["NoSuchOpt"],
+                                      "zero_optimization.stage": [0]})
+        t.tune()
+        assert all(e.status == "failed" for e in t.experiments)
