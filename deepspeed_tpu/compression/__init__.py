@@ -1,0 +1,8 @@
+from .compress import (
+    CompressionTransform,
+    apply_layer_reduction,
+    build_compression,
+    init_compression,
+    redundancy_clean,
+)
+from .scheduler import CompressionScheduler

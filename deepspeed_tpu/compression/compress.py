@@ -1,0 +1,354 @@
+"""Compression — pruning, QAT quantization, layer reduction on param pytrees.
+
+Capability parity with the reference's ``compression/`` subsystem
+(``init_compression`` ``compress.py:100`` rewriting layers to
+``LinearLayer_Compress``; sparse/row/head/channel pruning, weight/activation
+quantization, layer reduction + student init ``compress.py:192``; config
+keys from ``compression/constants.py`` — SURVEY.md §2.7 "Compression" row).
+
+The reference mutates ``nn.Module``s; the TPU-native form is a **pure
+transform over the param pytree** applied in the forward pass:
+
+    transform = build_compression(params, compression_config)
+    compressed = transform.apply(params, step)   # inside jit
+
+Each technique computes masks/fake-quant from the *current* values, gated on
+its ``schedule_offset`` with a compiled ``where`` — matching the reference's
+scheduler semantics without host control flow. QAT uses the
+straight-through estimator. ``redundancy_clean`` hard-applies masks for
+export (reference ``helper.py`` redundancy-clean path). Module matching is
+substring-based over leaf paths, like the reference's module-scope matching.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+
+_TECHNIQUES = ("sparse_pruning", "row_pruning", "head_pruning",
+               "channel_pruning", "weight_quantization",
+               "activation_quantization")
+
+
+@dataclasses.dataclass
+class TechniqueSpec:
+    kind: str                      # one of _TECHNIQUES
+    modules: List[str]             # substring patterns over leaf paths
+    offset: int = 0
+    offset_end: Optional[int] = None   # staged-bit annealing endpoint
+    dense_ratio: float = 0.5
+    method: str = "l1"             # l1 | topk
+    bits: int = 8
+    target_bits: Optional[int] = None
+    quant_type: str = "symmetric"  # symmetric | asymmetric
+    groups: int = 1
+    num_heads: int = 1
+
+
+def _leaf_path(path) -> str:
+    return ".".join(str(getattr(k, "key", getattr(k, "idx",
+                     getattr(k, "name", k)))) for k in path)
+
+
+def _matches(path: str, patterns: Sequence[str]) -> bool:
+    for p in patterns:
+        if p in path:
+            return True
+        try:
+            if re.search(p, path):
+                return True
+        except re.error:
+            pass   # pattern is a plain name with regex metachars
+    return False
+
+
+# --------------------------------------------------------------------------- #
+# technique math (pure; applied per leaf inside jit)
+# --------------------------------------------------------------------------- #
+
+
+def _ste(x: jnp.ndarray, transformed: jnp.ndarray) -> jnp.ndarray:
+    """Straight-through estimator: forward=transformed, backward=identity."""
+    return x + jax.lax.stop_gradient(transformed - x)
+
+
+def _threshold_mask(scores: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Keep the top ``dense_ratio`` fraction by score."""
+    q = jnp.quantile(scores.reshape(-1).astype(jnp.float32),
+                     1.0 - dense_ratio)
+    return (scores >= q).astype(scores.dtype)
+
+
+def sparse_prune(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    return w * _threshold_mask(jnp.abs(w), dense_ratio)
+
+
+def row_prune(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Zero output rows (last dim of a kernel) with smallest L1 norm."""
+    if w.ndim < 2:
+        return w
+    scores = jnp.sum(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
+    mask = _threshold_mask(scores, dense_ratio)
+    return w * mask                                # broadcast over last dim
+
+
+def channel_prune(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Zero input channels (dim 0) with smallest L1 norm."""
+    if w.ndim < 2:
+        return w
+    scores = jnp.sum(jnp.abs(w), axis=tuple(range(1, w.ndim)))
+    mask = _threshold_mask(scores, dense_ratio)
+    return w * mask.reshape((-1,) + (1,) * (w.ndim - 1))
+
+
+def head_prune(w: jnp.ndarray, dense_ratio: float,
+               num_heads: int) -> jnp.ndarray:
+    """Zero whole attention heads: the leading dim is split into heads."""
+    if w.ndim < 2 or w.shape[0] % num_heads:
+        return w
+    per = w.shape[0] // num_heads
+    heads = w.reshape((num_heads, per) + w.shape[1:])
+    scores = jnp.sum(jnp.abs(heads), axis=tuple(range(1, heads.ndim)))
+    mask = _threshold_mask(scores, dense_ratio)
+    heads = heads * mask.reshape((num_heads,) + (1,) * (heads.ndim - 1))
+    return heads.reshape(w.shape)
+
+
+def fake_quant(w: jnp.ndarray, bits, quant_type: str,
+               groups: int) -> jnp.ndarray:
+    """Group-wise fake quantization (QAT forward). ``bits`` may be a traced
+    scalar (staged bit annealing)."""
+    flat = w.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    g = max(1, min(groups, n))
+    pad = (-n) % g
+    # edge-pad: zero padding would corrupt the last group's min/max when the
+    # leaf has no zeros near the range boundary (asymmetric scales)
+    gr = jnp.pad(flat, (0, pad), mode="edge").reshape(g, -1)
+    qmax = 2.0 ** (jnp.asarray(bits, jnp.float32) - 1) - 1
+    if quant_type == "asymmetric":
+        lo = jnp.min(gr, axis=1, keepdims=True)
+        hi = jnp.max(gr, axis=1, keepdims=True)
+        scale = jnp.maximum(hi - lo, 1e-12) / (2 * qmax)
+        q = jnp.clip(jnp.round((gr - lo) / scale), 0, 2 * qmax)
+        deq = q * scale + lo
+    else:
+        absmax = jnp.max(jnp.abs(gr), axis=1, keepdims=True)
+        scale = jnp.maximum(absmax, 1e-12) / qmax
+        deq = jnp.clip(jnp.round(gr / scale), -qmax, qmax) * scale
+    return deq.reshape(-1)[:n].reshape(w.shape).astype(w.dtype)
+
+
+def quantize_activation(x: jnp.ndarray, bits: int = 8,
+                        quant_type: str = "symmetric") -> jnp.ndarray:
+    """Fake-quantize activations with STE (for use inside model code)."""
+    return _ste(x, fake_quant(x, bits, quant_type, groups=1))
+
+
+# --------------------------------------------------------------------------- #
+# config parsing
+# --------------------------------------------------------------------------- #
+
+
+def _parse_technique(kind: str, block: Dict) -> List[TechniqueSpec]:
+    shared = dict(block.get("shared_parameters", {}))
+    if not shared.get("enabled", False):
+        return []
+    specs = []
+    groups = block.get("different_groups", {}) or {}
+    if not groups:
+        groups = {"all": {"params": {}, "modules": [".*"]}}
+    for _, g in groups.items():
+        p = dict(shared)
+        p.update(g.get("params", {}))
+        spec = TechniqueSpec(
+            kind=kind,
+            modules=list(g.get("modules", [".*"])),
+            offset=int(p.get("schedule_offset", 0)),
+            offset_end=(int(p["schedule_offset_end"])
+                        if "schedule_offset_end" in p else None),
+            dense_ratio=float(p.get("dense_ratio", 0.5)),
+            method=p.get("method", "l1"),
+            bits=int(p.get("start_bits", p.get("bits", 8))),
+            target_bits=(int(p["target_bits"]) if "target_bits" in p else None),
+            quant_type=p.get("quantization_type", "symmetric"),
+            groups=int(p.get("quantize_groups", shared.get("quantize_groups", 1))),
+            num_heads=int(p.get("num_heads", 1)),
+        )
+        specs.append(spec)
+    return specs
+
+
+def parse_compression_config(cfg: Dict) -> List[TechniqueSpec]:
+    specs = []
+    for kind in _TECHNIQUES:
+        if kind in cfg:
+            specs.extend(_parse_technique(kind, cfg[kind]))
+    return specs
+
+
+# --------------------------------------------------------------------------- #
+# the transform
+# --------------------------------------------------------------------------- #
+
+
+class CompressionTransform:
+    """Applies all matched techniques to a param pytree, step-gated."""
+
+    def __init__(self, specs: List[TechniqueSpec], params: Any):
+        self.specs = specs
+        # leaf path -> list of specs (resolved once, host-side)
+        self._plan: Dict[str, List[TechniqueSpec]] = {}
+        flat, _ = jax.tree_util.tree_flatten_with_path(params)
+        weight_specs = [s for s in specs
+                        if s.kind != "activation_quantization"]
+        for path, leaf in flat:
+            ps = _leaf_path(path)
+            hits = [s for s in weight_specs
+                    if _matches(ps, s.modules) and np.ndim(leaf) >= 2]
+            if hits:
+                self._plan[ps] = hits
+        if any(s.kind == "activation_quantization" for s in specs):
+            logger.warning(
+                "activation_quantization configured: it applies to "
+                "activations, not weights — model code must call "
+                "deepspeed_tpu.compression.compress.quantize_activation on "
+                "the tensors to quantize")
+        log_dist(f"compression: {len(self._plan)} param leaves matched "
+                 f"across {len(weight_specs)} weight technique groups")
+
+    def _apply_leaf(self, w, specs: List[TechniqueSpec], step):
+        for s in specs:
+            if s.kind == "sparse_pruning":
+                out = sparse_prune(w, s.dense_ratio)
+            elif s.kind == "row_pruning":
+                out = row_prune(w, s.dense_ratio)
+            elif s.kind == "channel_pruning":
+                out = channel_prune(w, s.dense_ratio)
+            elif s.kind == "head_pruning":
+                out = head_prune(w, s.dense_ratio, s.num_heads)
+            elif s.kind == "weight_quantization":
+                if s.target_bits is not None and s.target_bits != s.bits:
+                    # staged annealing: start_bits -> target_bits between
+                    # schedule_offset and schedule_offset_end (reference
+                    # WEIGHT_QUANTIZE_START_BITS/TARGET_BITS schedule)
+                    end = s.offset_end if s.offset_end is not None else s.offset
+                    span = max(end - s.offset, 1)
+                    frac = jnp.clip(
+                        (jnp.asarray(step, jnp.float32) - s.offset) / span,
+                        0.0, 1.0)
+                    bits = jnp.round(s.bits - frac * (s.bits - s.target_bits))
+                else:
+                    bits = s.bits
+                out = fake_quant(w, bits, s.quant_type, s.groups)
+            else:
+                continue
+            gated = jnp.where(step >= s.offset, out, w)
+            w = _ste(w, gated)
+        return w
+
+    def apply(self, params: Any, step) -> Any:
+        """jit-safe: returns the compressed view of ``params``."""
+        if not self._plan:
+            return params
+
+        def leaf(path, w):
+            specs = self._plan.get(_leaf_path(path))
+            return self._apply_leaf(w, specs, step) if specs else w
+
+        return jax.tree_util.tree_map_with_path(leaf, params)
+
+    def hard_apply(self, params: Any) -> Any:
+        """Permanently apply all techniques (export; reference
+        redundancy_clean)."""
+        big = jnp.asarray(np.iinfo(np.int32).max, jnp.int32)
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(x),
+            self.apply(params, big))
+
+
+def build_compression(params: Any, compression_config: Dict
+                      ) -> Optional[CompressionTransform]:
+    specs = parse_compression_config(compression_config or {})
+    if not specs:
+        return None
+    return CompressionTransform(specs, params)
+
+
+def init_compression(params: Any, compression_config: Dict):
+    """Reference-named entry (``compress.py:100``): returns
+    (possibly-layer-reduced params, transform or None)."""
+    cfg = compression_config or {}
+    lr = cfg.get("layer_reduction", {})
+    if lr.get("enabled", False):
+        params = apply_layer_reduction(params, lr)
+    return params, build_compression(params, cfg)
+
+
+def redundancy_clean(params: Any, compression_config: Dict) -> Any:
+    """Hard-apply compression for deployment export."""
+    transform = build_compression(params, compression_config)
+    return transform.hard_apply(params) if transform else params
+
+
+# --------------------------------------------------------------------------- #
+# layer reduction (student init; reference compress.py student_initialization)
+# --------------------------------------------------------------------------- #
+
+
+def apply_layer_reduction(params: Any, lr_cfg: Dict) -> Any:
+    """Build a student by keeping selected teacher layers.
+
+    Config (reference keys): ``keep_number_layers``, ``teacher_layer`` (the
+    teacher indices to keep, default evenly spaced), ``module_name_prefix``
+    (layer naming pattern containing the index, default ``h_{}``).
+    """
+    keep = int(lr_cfg.get("keep_number_layers", 0))
+    prefix = lr_cfg.get("module_name_prefix", "h_{}")
+    name_re = re.compile("^" + re.escape(prefix).replace(r"\{\}", r"(\d+)") + "$")
+    found = False
+
+    def rebuild(tree):
+        nonlocal found
+        if isinstance(tree, dict):
+            idx = {}
+            rest = {}
+            for k, v in tree.items():
+                m = name_re.match(str(k))
+                if m:
+                    idx[int(m.group(1))] = v
+                else:
+                    rest[k] = v
+            if idx:
+                found = True
+                n = len(idx)
+                chosen = lr_cfg.get("teacher_layer")
+                k = keep or (len(chosen) if chosen else n)
+                if chosen is None:
+                    chosen = [round(i * (n - 1) / max(k - 1, 1))
+                              for i in range(k)]
+                new = dict(rest)
+                for student_i, teacher_i in enumerate(chosen):
+                    if teacher_i not in idx:
+                        raise ValueError(
+                            f"layer_reduction: teacher layer {teacher_i} "
+                            f"not found (have {sorted(idx)})")
+                    new[prefix.format(student_i)] = idx[teacher_i]
+                log_dist(f"layer_reduction: kept teacher layers {chosen} "
+                         f"of {n}")
+                return new
+            return {k: rebuild(v) for k, v in tree.items()}
+        return tree
+
+    out = rebuild(params)
+    if not found:
+        logger.warning(f"layer_reduction: no layer container matched "
+                       f"'{prefix}'; params unchanged")
+    return out

@@ -1,0 +1,36 @@
+"""Compression scheduler — which techniques are live at a given step.
+
+Parity with the reference's ``compression/scheduler.py``
+(``CompressionScheduler``: per-technique schedule offsets checked every
+step). The compiled transform already gates techniques with ``where`` inside
+jit; this host-side view exists for observability and for driving staged
+bit-width reduction (``start_bits`` -> ``target_bits``)."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .compress import TechniqueSpec
+
+
+class CompressionScheduler:
+    def __init__(self, specs: List[TechniqueSpec]):
+        self.specs = specs
+        self._announced = set()
+
+    def active(self, step: int) -> List[TechniqueSpec]:
+        return [s for s in self.specs if step >= s.offset]
+
+    def status(self, step: int) -> Dict[str, bool]:
+        return {f"{s.kind}[{','.join(s.modules)}]": step >= s.offset
+                for s in self.specs}
+
+    def check(self, step: int) -> None:
+        """Log newly-activated techniques (reference per-step check)."""
+        from ..utils.logging import log_dist
+        for s in self.active(step):
+            key = (s.kind, tuple(s.modules), s.offset)
+            if key not in self._announced:
+                self._announced.add(key)
+                log_dist(f"compression: {s.kind} active from step {step} "
+                         f"(offset {s.offset}) on {s.modules}")

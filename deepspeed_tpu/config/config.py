@@ -367,6 +367,9 @@ class Config(ConfigModel):
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     aio: AioConfig = field(default_factory=AioConfig)
     elasticity: ElasticityConfig = field(default_factory=ElasticityConfig)
+    # compression_training keeps the reference's raw JSON schema (parsed by
+    # deepspeed_tpu/compression/compress.py, not a typed sub-config)
+    compression_training: Dict[str, Any] = field(default_factory=dict)
 
     # misc parity keys
     seed: int = 1234

@@ -144,6 +144,25 @@ class Engine:
                     "dp>1, ZeRO stage<=1 and no seq/inner sharding; running "
                     "with full-precision gradient communication")
 
+        # compression (pruning / QAT) applied to the forward-pass params,
+        # step-gated per technique (reference compression/compress.py)
+        self._compression = None
+        self.compression_scheduler = None
+        if config.compression_training:
+            from ..compression import CompressionScheduler, build_compression
+            if config.compression_training.get(
+                    "layer_reduction", {}).get("enabled", False):
+                logger.warning(
+                    "compression_training.layer_reduction must be applied "
+                    "BEFORE initialize() — call deepspeed_tpu.compression."
+                    "init_compression(params, cfg) and pass the reduced "
+                    "params in; the engine cannot reshape your model")
+            self._compression = build_compression(
+                params, config.compression_training)
+            if self._compression is not None:
+                self.compression_scheduler = CompressionScheduler(
+                    self._compression.specs)
+
         # timers / telemetry -----------------------------------------------------
         self.timers = SynchronizedWallClockTimer() if config.wall_clock_breakdown else NoopTimer()
         self.tput_timer = ThroughputTimer(
@@ -248,7 +267,9 @@ class Engine:
     # the compiled step
     # ------------------------------------------------------------------ #
 
-    def _loss_and_aux(self, params, micro_batch, rng):
+    def _loss_and_aux(self, params, micro_batch, rng, step=None):
+        if self._compression is not None and step is not None:
+            params = self._compression.apply(params, step)
         out = self.loss_fn(params, micro_batch, rng)
         if isinstance(out, tuple):
             return out[0], out[1:]
@@ -265,11 +286,11 @@ class Engine:
         batch_sharding = self._batch_sharding()
 
 
-        def micro_grads(params, micro_batch, rng, scale_state):
+        def micro_grads(params, micro_batch, rng, scale_state, step):
             cparams = cast_floating(params, compute_dtype)
 
             def scaled_loss(cp):
-                loss, _aux = self._loss_and_aux(cp, micro_batch, rng)
+                loss, _aux = self._loss_and_aux(cp, micro_batch, rng, step)
                 return ls.scale_loss(loss, scale_state) if fp16 else loss, loss
 
             grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
@@ -300,7 +321,8 @@ class Engine:
             def scan_body(carry, xs):
                 grad_acc, loss_acc = carry
                 mb, r = xs
-                loss, grads = micro_grads(state.params, mb, r, state.scale_state)
+                loss, grads = micro_grads(state.params, mb, r,
+                                          state.scale_state, state.step)
                 grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
                 if plan.stage >= 2:
                     grad_acc = plan.constrain_grads(grad_acc, state.params)
@@ -313,7 +335,8 @@ class Engine:
                     state.scale_state, state.comm_state, state.step)
             elif gas == 1:
                 mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)
-                loss, grads = micro_grads(state.params, mb, micro_rngs[0], state.scale_state)
+                loss, grads = micro_grads(state.params, mb, micro_rngs[0],
+                                          state.scale_state, state.step)
                 loss_sum = loss
             else:
                 (grads, loss_sum), _ = jax.lax.scan(
@@ -414,7 +437,7 @@ class Engine:
             lambda s, p: strip_to_manual(s, manual_axes, np.ndim(p)),
             pspecs, self.state.params, is_leaf=lambda x: isinstance(x, P))
 
-        def local_fn(p_local, mb_local, rng, scale_state):
+        def local_fn(p_local, mb_local, rng, scale_state, step):
             # distinct dropout/noise masks per DP rank (the automatic path
             # draws masks over the global batch; fold_in restores that)
             rng = jax.random.fold_in(rng, jax.lax.axis_index(manual_axes))
@@ -423,7 +446,7 @@ class Engine:
                 pfull = prep_params(pl, pspecs, manual_axes, world,
                                     wbits, gbits)
                 cp = cast_floating(pfull, compute_dtype)
-                loss, _aux = self._loss_and_aux(cp, mb_local, rng)
+                loss, _aux = self._loss_and_aux(cp, mb_local, rng, step)
                 # each rank owns 1/world of the batch: sum over ranks of
                 # loss/world == the global-mean objective of automatic mode
                 obj = loss / world
@@ -439,7 +462,7 @@ class Engine:
 
         sm = shard_map(
             local_fn, mesh,
-            in_specs=(in_pspecs, P(manual_axes), P(), P()),
+            in_specs=(in_pspecs, P(manual_axes), P(), P(), P()),
             out_specs=(P(), in_pspecs),
             axis_names=manual_axes)
         log_dist(
@@ -473,7 +496,8 @@ class Engine:
 
             def mg(mb, r):
                 return micro_grads(params, mb,
-                                   jax.random.fold_in(r, ridx), scale_state)
+                                   jax.random.fold_in(r, ridx), scale_state,
+                                   step)
 
             if gas == 1:
                 mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)
@@ -517,13 +541,19 @@ class Engine:
 
         # takes params only (not the TrainState): eval must not touch
         # opt_state, which may be evicted to host/NVMe between train steps
-        def eval_fn(params: Any, batch: Any, rng: jax.Array):
-            return fn(cast_floating(params, compute_dtype), batch, rng)
+        comp = self._compression
+
+        def eval_fn(params: Any, batch: Any, rng: jax.Array, step):
+            cp = cast_floating(params, compute_dtype)
+            if comp is not None:
+                cp = comp.apply(cp, step)
+            return fn(cp, batch, rng)
 
         if not self.config.compile:
             return eval_fn
         return jax.jit(
-            eval_fn, in_shardings=(self._state_shardings.params, None, None))
+            eval_fn,
+            in_shardings=(self._state_shardings.params, None, None, None))
 
     # ------------------------------------------------------------------ #
     # public API
@@ -557,6 +587,10 @@ class Engine:
 
         self.global_steps += 1
         self.global_samples += expected
+        if self.compression_scheduler is not None:
+            # state.step is the gate the compiled transform sees (it does
+            # NOT advance on overflow-skipped steps; global_steps does)
+            self.compression_scheduler.check(int(jax.device_get(self.state.step)))
         self.timers(TRAIN_BATCH_TIMER).stop(barrier_value=metrics.loss)
         self.tput_timer.stop(global_step=True, report_speed=True)
         self._maybe_log(metrics)
@@ -567,7 +601,7 @@ class Engine:
     def eval_batch(self, batch: Any, rng: Optional[jax.Array] = None):
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        return self._eval_step(self.state.params, batch, rng)
+        return self._eval_step(self.state.params, batch, rng, self.state.step)
 
     # --- forward/backward/step trio (API parity) ----------------------- #
 

@@ -1,0 +1,193 @@
+"""Compression — reference parity: tests/unit/compression/test_compression.py
+(pruning masks, QAT quantization, layer reduction, scheduler offsets)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.compression import (
+    CompressionScheduler, apply_layer_reduction, build_compression,
+    init_compression, redundancy_clean)
+from deepspeed_tpu.compression.compress import (
+    channel_prune, fake_quant, head_prune, quantize_activation, row_prune,
+    sparse_prune)
+from deepspeed_tpu.models.gpt2 import GPT2Config, make_model
+
+KEY = jax.random.PRNGKey(0)
+
+
+class TestPruningMath:
+    def test_sparse_prune_ratio(self):
+        w = jax.random.normal(KEY, (64, 64))
+        out = sparse_prune(w, dense_ratio=0.25)
+        sparsity = float((out == 0).mean())
+        assert 0.70 <= sparsity <= 0.80
+        # surviving entries are the largest-magnitude ones
+        assert float(jnp.abs(out).max()) == float(jnp.abs(w).max())
+
+    def test_row_prune_zeroes_whole_rows(self):
+        w = jax.random.normal(KEY, (32, 16))
+        out = row_prune(w, dense_ratio=0.5)
+        col_zero = np.asarray((out == 0).all(axis=0))
+        assert col_zero.sum() == 8          # half the 16 output rows
+
+    def test_channel_prune_zeroes_dim0(self):
+        w = jax.random.normal(KEY, (16, 32))
+        out = channel_prune(w, dense_ratio=0.5)
+        row_zero = np.asarray((out == 0).all(axis=1))
+        assert row_zero.sum() == 8
+
+    def test_head_prune(self):
+        w = jax.random.normal(KEY, (8 * 16, 32))   # 8 heads x 16 dims
+        out = head_prune(w, dense_ratio=0.5, num_heads=8)
+        heads = np.asarray(out).reshape(8, 16, 32)
+        zero_heads = (heads == 0).all(axis=(1, 2)).sum()
+        assert zero_heads == 4
+
+    def test_fake_quant_error_bounded(self):
+        w = jax.random.normal(KEY, (64, 64))
+        for qt in ("symmetric", "asymmetric"):
+            out = fake_quant(w, bits=8, quant_type=qt, groups=16)
+            err = float(jnp.abs(out - w).max())
+            assert err < float(jnp.abs(w).max()) / 100, qt
+
+    def test_activation_quant_ste_gradient(self):
+        x = jax.random.normal(KEY, (32,))
+        g = jax.grad(lambda x: quantize_activation(x).sum())(x)
+        np.testing.assert_allclose(np.asarray(g), 1.0)   # identity backward
+
+
+SPARSE_CFG = {
+    "sparse_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 2,
+                              "method": "l1", "dense_ratio": 0.3},
+        "different_groups": {
+            "sp1": {"params": {"dense_ratio": 0.3}, "modules": ["mlp"]},
+        },
+    },
+}
+
+
+class TestTransform:
+    def _params(self):
+        return {"mlp": {"kernel": jax.random.normal(KEY, (32, 32))},
+                "attn": {"kernel": jax.random.normal(KEY, (32, 32))},
+                "bias": jnp.zeros((32,))}
+
+    def test_module_matching_and_offset(self):
+        params = self._params()
+        t = build_compression(params, SPARSE_CFG)
+        before = t.apply(params, jnp.int32(0))
+        np.testing.assert_array_equal(np.asarray(before["mlp"]["kernel"]),
+                                      np.asarray(params["mlp"]["kernel"]))
+        after = t.apply(params, jnp.int32(5))
+        assert float((after["mlp"]["kernel"] == 0).mean()) > 0.6
+        # non-matching module untouched
+        np.testing.assert_array_equal(np.asarray(after["attn"]["kernel"]),
+                                      np.asarray(params["attn"]["kernel"]))
+
+    def test_ste_gradients_flow(self):
+        params = self._params()
+        t = build_compression(params, SPARSE_CFG)
+
+        u = jax.random.normal(jax.random.PRNGKey(7), (32, 32))
+
+        def loss(p):
+            c = t.apply(p, jnp.int32(10))
+            return (c["mlp"]["kernel"] * u).sum()
+
+        g = jax.grad(loss)(params)
+        # STE has an identity backward: the upstream cotangent reaches every
+        # entry, including pruned ones
+        np.testing.assert_allclose(np.asarray(g["mlp"]["kernel"]),
+                                   np.asarray(u), rtol=1e-6)
+
+    def test_redundancy_clean(self):
+        params = self._params()
+        out = redundancy_clean(params, SPARSE_CFG)
+        assert float((out["mlp"]["kernel"] == 0).mean()) > 0.6
+
+    def test_quantization_config(self):
+        cfg = {"weight_quantization": {
+            "shared_parameters": {"enabled": True, "quantize_groups": 4},
+            "different_groups": {
+                "q1": {"params": {"start_bits": 8, "target_bits": 4},
+                       "modules": ["attn"]}}}}
+        params = self._params()
+        t = build_compression(params, cfg)
+        out = t.apply(params, jnp.int32(1))
+        err = float(jnp.abs(out["attn"]["kernel"] -
+                            params["attn"]["kernel"]).max())
+        assert 0 < err < 0.5      # int4 quantization noise, not garbage
+
+    def test_scheduler_active(self):
+        t = build_compression(self._params(), SPARSE_CFG)
+        s = CompressionScheduler(t.specs)
+        assert not s.active(0)
+        assert len(s.active(3)) == 1
+        s.check(3)
+
+
+class TestLayerReduction:
+    def test_keep_subset(self):
+        params = {"transformer": {
+            **{f"h_{i}": {"w": jnp.full((2,), float(i))} for i in range(6)},
+            "ln": {"scale": jnp.ones((2,))}}}
+        out = apply_layer_reduction(
+            params, {"enabled": True, "keep_number_layers": 3})
+        layers = sorted(k for k in out["transformer"] if k.startswith("h_"))
+        assert layers == ["h_0", "h_1", "h_2"]
+        # evenly spaced teacher layers 0, 2/3-ish, 5
+        assert float(out["transformer"]["h_0"]["w"][0]) == 0.0
+        assert float(out["transformer"]["h_2"]["w"][0]) == 5.0
+        assert "ln" in out["transformer"]
+
+    def test_explicit_teacher_layers(self):
+        params = {f"h_{i}": {"w": jnp.full((2,), float(i))} for i in range(4)}
+        out = apply_layer_reduction(
+            params, {"enabled": True, "teacher_layer": [1, 3]})
+        assert sorted(out) == ["h_0", "h_1"]
+        assert float(out["h_0"]["w"][0]) == 1.0
+        assert float(out["h_1"]["w"][0]) == 3.0
+
+    def test_init_compression_combined(self):
+        params = {f"h_{i}": {"k": jax.random.normal(KEY, (8, 8))}
+                  for i in range(4)}
+        new_params, transform = init_compression(params, {
+            "layer_reduction": {"enabled": True, "keep_number_layers": 2},
+            **SPARSE_CFG})
+        assert sorted(new_params) == ["h_0", "h_1"]
+        assert transform is None or transform.specs  # mlp pattern won't match
+
+
+class TestEngineIntegration:
+    def test_training_with_compression(self, devices8):
+        cfg_model = GPT2Config.tiny(dtype=jnp.float32)
+        model, init_fn, loss_fn = make_model(cfg_model)
+        params = init_fn(jax.random.PRNGKey(0), batch_size=2, seq_len=16)
+        engine, _, _, _ = dstpu.initialize(
+            loss_fn=loss_fn, params=params, config={
+                "train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "compression_training": {
+                    "sparse_pruning": {
+                        "shared_parameters": {"enabled": True,
+                                              "schedule_offset": 2,
+                                              "dense_ratio": 0.5},
+                        "different_groups": {
+                            "g": {"params": {}, "modules": ["mlp"]}}},
+                },
+            })
+        assert engine._compression is not None
+        losses = []
+        for i in range(5):
+            tokens = np.random.RandomState(i).randint(0, 512, size=(16, 17))
+            losses.append(float(engine.train_batch(
+                {"tokens": jnp.asarray(tokens, jnp.int32)})))
+        assert all(np.isfinite(l) for l in losses)
+        # compressed eval at the current step works
+        tokens = np.random.RandomState(9).randint(0, 512, size=(16, 17))
+        assert np.isfinite(float(engine.eval_batch(
+            {"tokens": jnp.asarray(tokens, jnp.int32)})))
