@@ -182,6 +182,10 @@ def _parse_technique(kind: str, block: Dict) -> List[TechniqueSpec]:
             groups=int(p.get("quantize_groups", shared.get("quantize_groups", 1))),
             num_heads=int(p.get("num_heads", 1)),
         )
+        if spec.method != "l1" and kind.endswith("_pruning"):
+            logger.warning(
+                f"{kind}: method '{spec.method}' is not implemented; using "
+                "magnitude (l1) scoring")
         specs.append(spec)
     return specs
 
@@ -330,6 +334,15 @@ def apply_layer_reduction(params: Any, lr_cfg: Dict) -> Any:
                 found = True
                 n = len(idx)
                 chosen = lr_cfg.get("teacher_layer")
+                if chosen is not None and len(chosen) == 0:
+                    raise ValueError(
+                        "layer_reduction: teacher_layer is empty — a student "
+                        "with zero layers is almost certainly a config error")
+                if chosen is not None and keep and keep != len(chosen):
+                    raise ValueError(
+                        f"layer_reduction: keep_number_layers ({keep}) "
+                        f"conflicts with len(teacher_layer) "
+                        f"({len(chosen)}); set one or make them agree")
                 k = keep or (len(chosen) if chosen else n)
                 if chosen is None:
                     chosen = [round(i * (n - 1) / max(k - 1, 1))

@@ -25,6 +25,11 @@ class CompressionScheduler:
         return {f"{s.kind}[{','.join(s.modules)}]": step >= s.offset
                 for s in self.specs}
 
+    def pending(self) -> bool:
+        """True while some technique has not been announced yet (the engine
+        skips its per-step device sync once everything is active)."""
+        return len(self._announced) < len(self.specs)
+
     def check(self, step: int) -> None:
         """Log newly-activated techniques (reference per-step check)."""
         from ..utils.logging import log_dist

@@ -1,0 +1,86 @@
+"""Weight-only quantization (WOQ) for inference.
+
+Parity with the reference's ``inference/quantization/`` (config-driven int4/
+int8 weight-only wrapping of matmul layers) and the v1 engine's
+``GroupQuantizer`` injection path (``module_inject/replace_module.py:44``).
+
+TPU shape: quantize matching param leaves to int8/int4 group-quantized
+storage (``ops/kernels/quantization.py``) once at load, and dequantize
+per-use — ``dequantize_tree`` returns a params view XLA fuses into the
+consuming matmuls, halving (int8) or quartering (int4) the HBM weight
+footprint, which is what decode-bound inference pays for.
+
+Config schema (reference inference/quantization keys):
+    {"quantized_weights": {"enabled": true, "num_bits": 8,
+                           "group_size": 128, "modules": ["attn", "mlp"],
+                           "excluded_modules": ["embed"]}}
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import numpy as np
+
+from ..compression.compress import _leaf_path, _matches
+from ..ops.kernels.quantization import (
+    QuantizedTensor, dequantize_blockwise, quantize_blockwise)
+from ..utils.logging import log_dist
+
+
+def quantize_model_params(params: Any, cfg: Dict) -> Any:
+    """Replace matching >=2D float leaves with QuantizedTensor storage."""
+    block = cfg.get("quantized_weights", cfg)
+    if not block.get("enabled", True):
+        return params
+    bits = int(block.get("num_bits", 8))
+    group = int(block.get("group_size", 128))
+    modules = list(block.get("modules", [".*"]))
+    excluded = list(block.get("excluded_modules", []))
+    count = [0]
+
+    def leaf(path, x):
+        ps = _leaf_path(path)
+        # read dtype from metadata — np.asarray would device_get the tensor
+        dtype = getattr(x, "dtype", None) or np.asarray(x).dtype
+        if np.ndim(x) < 2 or not np.issubdtype(dtype, np.floating):
+            return x
+        if excluded and _matches(ps, excluded):
+            return x
+        if not _matches(ps, modules):
+            return x
+        count[0] += 1
+        return quantize_blockwise(x, bits=bits, group_size=group)
+
+    out = jax.tree_util.tree_map_with_path(leaf, params)
+    log_dist(f"WOQ: quantized {count[0]} weight tensors to int{bits} "
+             f"(group {group})")
+    return out
+
+
+def dequantize_tree(params: Any, dtype=None) -> Any:
+    """Dequantized view of a WOQ params tree (jit-safe; XLA fuses)."""
+    def leaf(x):
+        if isinstance(x, QuantizedTensor):
+            out = dequantize_blockwise(x)
+            return out.astype(dtype) if dtype is not None else out
+        return x
+
+    return jax.tree_util.tree_map(
+        leaf, params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+
+
+def woq_memory_bytes(params: Any) -> int:
+    """Weight-storage bytes of a (possibly WOQ) params tree."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantizedTensor)):
+        if isinstance(leaf, QuantizedTensor):
+            total += leaf.values.size * leaf.values.dtype.itemsize
+            total += leaf.scale.size * 4
+            if leaf.zero is not None:
+                total += leaf.zero.size * 4
+        else:
+            total += np.asarray(leaf).nbytes
+    return total

@@ -1,0 +1,3 @@
+from .config import LoRAConfig, QuantizationConfig
+from .optimized_linear import OptimizedLinear, QuantizedLinear
+from .quantization import QuantizedParameter, quantize_param

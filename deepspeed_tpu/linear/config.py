@@ -1,0 +1,32 @@
+"""Configs for OptimizedLinear — parity with reference ``deepspeed/linear/
+config.py`` (LoRAConfig, QuantizationConfig)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class LoRAConfig:
+    """LoRA + base-weight-sharding settings.
+
+    ``base_weight_sharding`` shards the frozen base weight over the ``data``
+    mesh axis (the reference shards over the DP world the same way); the
+    sharding is expressed as a NamedSharding on the param, so ZeRO-style
+    memory savings come from the partitioner rather than manual chunking.
+    """
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1
+    offload: bool = False
+    offload_ratio: float = 0.0
+    delay_lora_init: bool = False
+    target_mods: tuple = ("attn", "mlp")
+
+
+@dataclasses.dataclass
+class QuantizationConfig:
+    """Minifloat quantization settings (fp6/fp8/fp12 via ops/fp_quantizer)."""
+    q_bits: int = 8
+    mantissa_bits: int = 3
+    group_size: int = 512

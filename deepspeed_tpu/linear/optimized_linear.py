@@ -1,0 +1,116 @@
+"""OptimizedLinear — LoRA over a frozen (optionally quantized, optionally
+sharded) base weight.
+
+Parity with the reference's ``deepspeed/linear/optimized_linear.py``
+(``OptimizedLinear`` dispatching to ``LoRAOptimizedLinear`` /
+``QuantizedLinear`` by config): a flax module computing
+
+    y = x @ W_base + (x @ A) @ B * (alpha / r)
+
+W_base is created frozen (no gradient: ``stop_gradient``), stored
+fp-quantized when a ``QuantizationConfig`` is given, and annotated with a
+``data``-axis sharding when ``base_weight_sharding > 1`` (the reference
+chunks the base weight across the DP world; here the SPMD partitioner owns
+the shards). Only the LoRA factors train — exactly the reference's
+memory/comm profile.
+
+Functional helpers for non-flax pytrees:
+  ``lora_init(key, in_dim, out_dim, cfg)`` / ``lora_apply(x, base, a, b, cfg)``
+  ``fuse_lora(base, a, b, cfg)`` / ``unfuse_lora(fused, a, b, cfg)``
+(the fuse/unfuse pair is what the hybrid RLHF engine uses per generate
+phase, reference ``runtime/hybrid_engine.py:132-153``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from .config import LoRAConfig, QuantizationConfig
+from ..ops.fp_quantizer import fp_quant_dequant
+
+
+def lora_init(key, in_dim: int, out_dim: int, cfg: LoRAConfig):
+    """(A, B) factors: A ~ He-uniform fan-in, B zeros (standard LoRA)."""
+    ka, _ = jax.random.split(key)
+    a = jax.random.uniform(ka, (in_dim, cfg.lora_r), jnp.float32,
+                           -1.0, 1.0) / jnp.sqrt(in_dim)
+    b = jnp.zeros((cfg.lora_r, out_dim), jnp.float32)
+    return a, b
+
+
+def lora_apply(x, base_w, a, b, cfg: LoRAConfig):
+    """y = x@W (frozen) + scaled LoRA path."""
+    y = x @ jax.lax.stop_gradient(base_w)
+    return y + (x @ a) @ b * (cfg.lora_alpha / cfg.lora_r)
+
+
+def fuse_lora(base_w, a, b, cfg: LoRAConfig):
+    return base_w + (a @ b) * (cfg.lora_alpha / cfg.lora_r)
+
+
+def unfuse_lora(fused_w, a, b, cfg: LoRAConfig):
+    return fused_w - (a @ b) * (cfg.lora_alpha / cfg.lora_r)
+
+
+class OptimizedLinear(nn.Module):
+    """Drop-in linear with LoRA and/or fp-quantized frozen base weight."""
+
+    features: int
+    lora_config: Optional[LoRAConfig] = None
+    quantization_config: Optional[QuantizationConfig] = None
+    use_bias: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        in_dim = x.shape[-1]
+        base = self.param("base_weight", nn.initializers.xavier_uniform(),
+                          (in_dim, self.features), jnp.float32)
+        # the base weight is frozen in EVERY configuration (and quantization
+        # rounding would produce garbage gradients anyway)
+        base = jax.lax.stop_gradient(base)
+        if self.lora_config is not None and \
+                self.lora_config.base_weight_sharding > 1:
+            from ..parallel.topology import has_topology, get_topology
+            if has_topology():
+                base = jax.lax.with_sharding_constraint(
+                    base, jax.sharding.NamedSharding(
+                        get_topology().mesh,
+                        jax.sharding.PartitionSpec("data", None)))
+        if self.quantization_config is not None:
+            # fake-quant view of the frozen base (storage-level quantization
+            # is QuantizedParameter; in-module we keep jit-friendliness)
+            base = fp_quant_dequant(
+                base, q_bits=self.quantization_config.q_bits,
+                group_size=self.quantization_config.group_size)
+
+        if self.lora_config is None:
+            y = x @ base.astype(self.dtype)
+        else:
+            cfg = self.lora_config
+            a = self.param("lora_a",
+                           lambda k, s: lora_init(k, in_dim, self.features,
+                                                  cfg)[0], None)
+            b = self.param("lora_b",
+                           lambda k, s: lora_init(k, in_dim, self.features,
+                                                  cfg)[1], None)
+            y = lora_apply(x.astype(self.dtype), base.astype(self.dtype),
+                           a.astype(self.dtype), b.astype(self.dtype), cfg)
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros,
+                               (self.features,), jnp.float32).astype(self.dtype)
+        return y
+
+
+class QuantizedLinear(OptimizedLinear):
+    """Quantization-only variant (reference QuantizedLinear)."""
+
+    def __post_init__(self):
+        if self.quantization_config is None:
+            object.__setattr__(self, "quantization_config",
+                               QuantizationConfig())
+        super().__post_init__()

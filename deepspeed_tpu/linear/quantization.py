@@ -1,0 +1,49 @@
+"""Quantized parameter storage (fp6/fp8/fp12).
+
+Parity with the reference's ``deepspeed/linear/quantization.py``
+``QuantizedParameter`` (a tensor subclass that stores fp-quantized bytes and
+dequantizes on access, backed by ``csrc/fp_quantizer``): here a pytree node
+holding minifloat codes + scales with an explicit ``dequantized()`` view;
+XLA fuses the dequant into the consuming matmul.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..ops.fp_quantizer import (
+    FPQuantizedTensor, fp_dequantize, fp_quantize)
+from .config import QuantizationConfig
+
+
+class QuantizedParameter:
+    """Frozen quantized parameter: quantize once, dequantize per use."""
+
+    def __init__(self, data: jnp.ndarray,
+                 quantization_config: Optional[QuantizationConfig] = None):
+        cfg = quantization_config or QuantizationConfig()
+        self.quantization_config = cfg
+        self._qt: FPQuantizedTensor = fp_quantize(
+            data, q_bits=cfg.q_bits, group_size=cfg.group_size)
+        self.shape = tuple(data.shape)
+        self.dtype = data.dtype
+
+    def dequantized(self, dtype=None) -> jnp.ndarray:
+        return fp_dequantize(self._qt, dtype or self.dtype)
+
+    @property
+    def quantized(self) -> FPQuantizedTensor:
+        return self._qt
+
+    def nbytes(self) -> int:
+        """Actual storage: bit-packed codes + f32 group scales."""
+        return int(self._qt.codes.size * self._qt.codes.dtype.itemsize +
+                   self._qt.scale.size * 4)
+
+
+def quantize_param(data: jnp.ndarray, q_bits: int = 8,
+                   group_size: int = 512) -> QuantizedParameter:
+    return QuantizedParameter(
+        data, QuantizationConfig(q_bits=q_bits, group_size=group_size))

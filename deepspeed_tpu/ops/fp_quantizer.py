@@ -1,0 +1,174 @@
+"""Floating-point (minifloat) quantization — FP6 / FP8 / FP12.
+
+Capability parity with the reference's ``csrc/fp_quantizer/`` (850 LoC of
+CUDA selective-GEMM quantization powering fp6/fp8/fp12 quantized parameters,
+``deepspeed/linear/quantization.py`` QuantizedParameter — SURVEY.md §2.6).
+The TPU version is pure VPU math XLA fuses into the consumer matmul:
+
+  - values are scaled per group so the group max hits the format's max
+    representable, then rounded to the nearest representable minifloat
+    (exponent/mantissa split emulated with frexp-style bit math);
+  - storage is int8 codes (sign + exp + mantissa packed little-endian per
+    value; fp6 packs 4 codes into 3 bytes, fp12 packs 2 into 3).
+
+Formats follow the reference: fp6 = e3m2, fp8 = e4m3, fp12 = e4m7.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: q_bits -> (exp_bits, man_bits), matching the reference's supported trio
+FORMATS = {6: (3, 2), 8: (4, 3), 12: (4, 7)}
+
+
+class FPQuantizedTensor(NamedTuple):
+    """Minifloat-quantized tensor: bit-packed uint8 codes + f32 scales.
+
+    Storage is real ``q_bits``/value: fp8 is one byte per code, fp6 packs 4
+    codes into 3 bytes, fp12 packs 2 codes into 3 bytes."""
+    codes: jnp.ndarray            # uint8, bit-packed
+    scale: jnp.ndarray            # (groups, 1) f32
+    shape: Tuple[int, ...]
+    q_bits: int
+    group_size: int
+    packed: bool
+
+
+jax.tree_util.register_pytree_node(
+    FPQuantizedTensor,
+    lambda t: ((t.codes, t.scale),
+               (t.shape, t.q_bits, t.group_size, t.packed)),
+    lambda aux, ch: FPQuantizedTensor(*ch, *aux),
+)
+
+
+def _minifloat_encode(x: jnp.ndarray, exp_bits: int, man_bits: int):
+    """Round |x| <= max_representable to nearest minifloat; return int codes.
+
+    Code layout: sign << (exp_bits + man_bits) | exp << man_bits | mantissa.
+    Denormals (exp field 0) represent mantissa * 2^(1 - bias) / 2^man_bits.
+    """
+    bias = 2 ** (exp_bits - 1) - 1
+    sign = (x < 0).astype(jnp.int32)
+    ax = jnp.abs(x.astype(jnp.float32))
+
+    # exponent of the value (floor(log2)), clamped into field range
+    e = jnp.floor(jnp.log2(jnp.maximum(ax, 1e-38))).astype(jnp.int32)
+    e = jnp.clip(e, 1 - bias, bias)
+    # normal: mantissa in [1, 2) -> man_bits fraction; denormal handled by
+    # clamping e to (1 - bias) so the scale below still applies
+    scale = jnp.exp2(e.astype(jnp.float32))
+    frac = ax / scale                           # [1, 2) for normals
+    m = jnp.round((frac - 1.0) * (1 << man_bits)).astype(jnp.int32)
+    # rounding can overflow mantissa -> bump exponent
+    bump = m >= (1 << man_bits)
+    e = jnp.where(bump & (e < bias), e + 1, e)
+    m = jnp.where(bump, 0, m)
+    m = jnp.clip(m, 0, (1 << man_bits) - 1)
+
+    # subnormal region: values below 2^(1-bias) use exp field 0
+    min_normal = 2.0 ** (1 - bias)
+    sub = ax < min_normal
+    m_sub = jnp.round(ax / min_normal * (1 << man_bits)).astype(jnp.int32)
+    m_sub = jnp.clip(m_sub, 0, (1 << man_bits) - 1)
+    efield = jnp.where(sub, 0, e + bias)
+    m = jnp.where(sub, m_sub, m)
+
+    code = (sign << (exp_bits + man_bits)) | (efield << man_bits) | m
+    return code.astype(jnp.int16)
+
+
+def _minifloat_decode(code: jnp.ndarray, exp_bits: int, man_bits: int):
+    bias = 2 ** (exp_bits - 1) - 1
+    code = code.astype(jnp.int32)
+    m = code & ((1 << man_bits) - 1)
+    efield = (code >> man_bits) & ((1 << exp_bits) - 1)
+    sign = (code >> (exp_bits + man_bits)) & 1
+    min_normal = 2.0 ** (1 - bias)
+    normal = efield > 0
+    mag = jnp.where(
+        normal,
+        jnp.exp2(efield.astype(jnp.float32) - bias) *
+        (1.0 + m.astype(jnp.float32) / (1 << man_bits)),
+        min_normal * m.astype(jnp.float32) / (1 << man_bits))
+    return jnp.where(sign == 1, -mag, mag)
+
+
+def _pack_codes(codes: jnp.ndarray, q_bits: int) -> jnp.ndarray:
+    """Bit-pack a flat int16 code array (values < 2**q_bits) into uint8."""
+    c = codes.reshape(-1).astype(jnp.uint32)
+    if q_bits == 8:
+        return c.astype(jnp.uint8)
+    if q_bits == 6:                            # 4 codes -> 3 bytes
+        pad = (-c.shape[0]) % 4
+        c = jnp.pad(c, (0, pad)).reshape(-1, 4)
+        v = c[:, 0] | (c[:, 1] << 6) | (c[:, 2] << 12) | (c[:, 3] << 18)
+    elif q_bits == 12:                         # 2 codes -> 3 bytes
+        pad = (-c.shape[0]) % 2
+        c = jnp.pad(c, (0, pad)).reshape(-1, 2)
+        v = c[:, 0] | (c[:, 1] << 12)
+    else:
+        raise ValueError(q_bits)
+    return jnp.stack([v & 0xFF, (v >> 8) & 0xFF, (v >> 16) & 0xFF],
+                     axis=1).reshape(-1).astype(jnp.uint8)
+
+
+def _unpack_codes(packed: jnp.ndarray, q_bits: int, n: int) -> jnp.ndarray:
+    """Inverse of :func:`_pack_codes`; returns ``n`` int16 codes."""
+    if q_bits == 8:
+        return packed.astype(jnp.int16)[:n]
+    b = packed.astype(jnp.uint32).reshape(-1, 3)
+    v = b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16)
+    if q_bits == 6:
+        c = jnp.stack([v & 0x3F, (v >> 6) & 0x3F, (v >> 12) & 0x3F,
+                       (v >> 18) & 0x3F], axis=1)
+    else:                                      # 12
+        c = jnp.stack([v & 0xFFF, (v >> 12) & 0xFFF], axis=1)
+    return c.reshape(-1)[:n].astype(jnp.int16)
+
+
+def _max_representable(exp_bits: int, man_bits: int) -> float:
+    bias = 2 ** (exp_bits - 1) - 1
+    return float(2.0 ** bias * (2.0 - 2.0 ** (-man_bits)))
+
+
+def fp_quantize(x: jnp.ndarray, q_bits: int = 6,
+                group_size: int = 128) -> FPQuantizedTensor:
+    """Group-scale + minifloat-round ``x`` (any shape)."""
+    if q_bits not in FORMATS:
+        raise ValueError(f"q_bits must be one of {sorted(FORMATS)}, "
+                         f"got {q_bits}")
+    exp_bits, man_bits = FORMATS[q_bits]
+    shape = tuple(x.shape)
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    pad = (-n) % group_size
+    gr = jnp.pad(flat, (0, pad)).reshape(-1, group_size)
+    absmax = jnp.max(jnp.abs(gr), axis=1, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-12) / _max_representable(exp_bits, man_bits)
+    codes = _minifloat_encode(gr / scale, exp_bits, man_bits)
+    return FPQuantizedTensor(codes=_pack_codes(codes, q_bits), scale=scale,
+                             shape=shape, q_bits=q_bits,
+                             group_size=group_size, packed=True)
+
+
+def fp_dequantize(t: FPQuantizedTensor, dtype=jnp.float32) -> jnp.ndarray:
+    exp_bits, man_bits = FORMATS[t.q_bits]
+    n = int(np.prod(t.shape)) if t.shape else 1
+    n_codes = -(-n // t.group_size) * t.group_size
+    codes = _unpack_codes(t.codes, t.q_bits, n_codes)
+    vals = _minifloat_decode(codes.reshape(-1, t.group_size),
+                             exp_bits, man_bits) * t.scale
+    return vals.reshape(-1)[:n].reshape(t.shape).astype(dtype)
+
+
+def fp_quant_dequant(x: jnp.ndarray, q_bits: int = 6,
+                     group_size: int = 128) -> jnp.ndarray:
+    """Fake-quant round trip in the target minifloat format."""
+    return fp_dequantize(fp_quantize(x, q_bits, group_size), x.dtype)

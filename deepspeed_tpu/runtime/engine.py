@@ -587,9 +587,11 @@ class Engine:
 
         self.global_steps += 1
         self.global_samples += expected
-        if self.compression_scheduler is not None:
+        if self.compression_scheduler is not None and \
+                self.compression_scheduler.pending():
             # state.step is the gate the compiled transform sees (it does
-            # NOT advance on overflow-skipped steps; global_steps does)
+            # NOT advance on overflow-skipped steps; global_steps does).
+            # The device sync stops once every technique is announced.
             self.compression_scheduler.check(int(jax.device_get(self.state.step)))
         self.timers(TRAIN_BATCH_TIMER).stop(barrier_value=metrics.loss)
         self.tput_timer.stop(global_step=True, report_speed=True)

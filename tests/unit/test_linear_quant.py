@@ -1,0 +1,155 @@
+"""OptimizedLinear/LoRA, fp_quantizer (fp6/fp8/fp12), inference WOQ —
+reference parity: tests/unit/linear/ (test_quant_param, test_linear),
+ops/fp_quantizer tests, inference/quantization."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.linear import (
+    LoRAConfig, OptimizedLinear, QuantizationConfig, QuantizedParameter,
+    quantize_param)
+from deepspeed_tpu.linear.optimized_linear import (
+    fuse_lora, lora_apply, lora_init, unfuse_lora)
+from deepspeed_tpu.ops.fp_quantizer import (
+    FORMATS, fp_dequantize, fp_quant_dequant, fp_quantize)
+
+KEY = jax.random.PRNGKey(0)
+
+
+class TestFpQuantizer:
+    @pytest.mark.parametrize("q_bits,tol", [(6, 0.15), (8, 0.07), (12, 0.005)])
+    def test_roundtrip_error(self, q_bits, tol):
+        x = jax.random.normal(KEY, (256, 64))
+        out = fp_quant_dequant(x, q_bits=q_bits, group_size=128)
+        rel = float(jnp.abs(out - x).max() / jnp.abs(x).max())
+        assert rel < tol, (q_bits, rel)
+
+    def test_exact_for_representable(self):
+        # powers of two are exactly representable in every format
+        x = jnp.asarray([0.5, 1.0, 2.0, -4.0, 0.25])
+        for q in FORMATS:
+            out = fp_quant_dequant(x, q_bits=q, group_size=8)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(x),
+                                       rtol=1e-6, err_msg=str(q))
+
+    def test_zero_and_signs(self):
+        x = jnp.asarray([0.0, -0.0, 1.5, -1.5])
+        out = fp_quant_dequant(x, q_bits=8, group_size=4)
+        assert float(out[0]) == 0.0
+        assert float(out[2]) > 0 and float(out[3]) < 0
+
+    def test_bad_bits(self):
+        with pytest.raises(ValueError):
+            fp_quantize(jnp.ones((4,)), q_bits=7)
+
+    def test_pytree_roundtrip_through_jit(self):
+        x = jax.random.normal(KEY, (64, 32))
+        qt = jax.jit(lambda x: fp_quantize(x, 8, 64))(x)
+        out = jax.jit(fp_dequantize)(qt)
+        rel = float(jnp.abs(out - x).max() / jnp.abs(x).max())
+        assert rel < 0.07
+
+
+class TestQuantizedParameter:
+    def test_storage_and_dequant(self):
+        w = jax.random.normal(KEY, (128, 64))
+        qp = quantize_param(w, q_bits=6, group_size=128)
+        deq = qp.dequantized()
+        assert deq.shape == w.shape
+        rel = float(jnp.abs(deq - w).max() / jnp.abs(w).max())
+        assert rel < 0.15
+        assert qp.nbytes() < w.size * 4 / 4   # ~6 bits vs 32
+
+
+class TestLoRA:
+    def test_b_zero_init_means_identity(self):
+        cfg = LoRAConfig(lora_r=8, lora_alpha=16)
+        a, b = lora_init(KEY, 32, 16, cfg)
+        w = jax.random.normal(KEY, (32, 16))
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 32))
+        np.testing.assert_allclose(np.asarray(lora_apply(x, w, a, b, cfg)),
+                                   np.asarray(x @ w), rtol=1e-5)
+
+    def test_fuse_unfuse_roundtrip(self):
+        cfg = LoRAConfig(lora_r=4, lora_alpha=8)
+        a = jax.random.normal(KEY, (32, 4))
+        b = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+        w = jax.random.normal(jax.random.PRNGKey(2), (32, 16))
+        fused = fuse_lora(w, a, b, cfg)
+        assert not np.allclose(np.asarray(fused), np.asarray(w))
+        np.testing.assert_allclose(np.asarray(unfuse_lora(fused, a, b, cfg)),
+                                   np.asarray(w), atol=1e-5)
+
+    def test_only_lora_grads(self):
+        """The base weight is frozen: grads flow only to LoRA factors."""
+        mod = OptimizedLinear(features=16,
+                              lora_config=LoRAConfig(lora_r=4))
+        x = jax.random.normal(KEY, (4, 32))
+        params = mod.init(KEY, x)["params"]
+
+        def loss(p):
+            return (mod.apply({"params": p}, x) ** 2).sum()
+
+        g = jax.grad(loss)(params)
+        assert float(jnp.abs(g["base_weight"]).max()) == 0.0
+        # at init b == 0, so dL/da = (x^T ct) b^T == 0; b takes the gradient
+        assert float(jnp.abs(g["lora_a"]).max()) == 0.0
+        assert float(jnp.abs(g["lora_b"]).max()) > 0.0
+        # once b moves, gradients reach a too — training is not stuck
+        params2 = dict(params, lora_b=jnp.ones_like(params["lora_b"]))
+        g2 = jax.grad(loss)(params2)
+        assert float(jnp.abs(g2["lora_a"]).max()) > 0.0
+        assert float(jnp.abs(g2["base_weight"]).max()) == 0.0
+
+    def test_quantized_base(self):
+        mod = OptimizedLinear(
+            features=16, lora_config=LoRAConfig(lora_r=4),
+            quantization_config=QuantizationConfig(q_bits=8, group_size=64))
+        x = jax.random.normal(KEY, (4, 32))
+        params = mod.init(KEY, x)
+        y = mod.apply(params, x)
+        assert y.shape == (4, 16) and np.isfinite(np.asarray(y)).all()
+
+
+class TestWOQ:
+    def _params(self):
+        return {
+            "attn": {"kernel": jax.random.normal(KEY, (64, 64))},
+            "mlp": {"kernel": jax.random.normal(KEY, (64, 256))},
+            "embed": {"table": jax.random.normal(KEY, (100, 64))},
+            "ln": {"scale": jnp.ones((64,))},
+        }
+
+    def test_quantize_and_dequantize(self):
+        from deepspeed_tpu.inference.quantization import (
+            dequantize_tree, quantize_model_params, woq_memory_bytes)
+        from deepspeed_tpu.ops.kernels.quantization import QuantizedTensor
+        params = self._params()
+        q = quantize_model_params(params, {
+            "quantized_weights": {"enabled": True, "num_bits": 8,
+                                  "modules": ["attn", "mlp"],
+                                  "excluded_modules": ["embed"]}})
+        assert isinstance(q["attn"]["kernel"], QuantizedTensor)
+        assert isinstance(q["mlp"]["kernel"], QuantizedTensor)
+        assert not isinstance(q["embed"]["table"], QuantizedTensor)
+        assert not isinstance(q["ln"]["scale"], QuantizedTensor)
+        assert woq_memory_bytes(q) < woq_memory_bytes(params) * 0.6
+
+        deq = jax.jit(dequantize_tree)(q)
+        err = float(jnp.abs(deq["attn"]["kernel"] -
+                            params["attn"]["kernel"]).max())
+        assert err < 0.05
+
+    def test_int4(self):
+        from deepspeed_tpu.inference.quantization import (
+            dequantize_tree, quantize_model_params)
+        params = self._params()
+        q = quantize_model_params(params, {
+            "quantized_weights": {"enabled": True, "num_bits": 4,
+                                  "modules": ["mlp"]}})
+        deq = dequantize_tree(q)
+        rel = float(jnp.abs(deq["mlp"]["kernel"] - params["mlp"]["kernel"]).max()
+                    / jnp.abs(params["mlp"]["kernel"]).max())
+        assert rel < 0.3
