@@ -73,7 +73,17 @@ def initialize(
         if ds_cfg:
             cfg = Config.load(ds_cfg)
 
-    engine = Engine(
+    engine_cls = Engine
+    engine_kwargs = {}
+    if cfg.hybrid_engine.enabled:
+        # RLHF actor: train + generate on one param pytree (reference
+        # dispatches to DeepSpeedHybridEngine at __init__.py:181)
+        from .runtime.hybrid_engine import HybridEngine
+        engine_cls = HybridEngine
+        engine_kwargs["apply_fn"] = model if callable(model) and \
+            model is not loss_fn else None
+
+    engine = engine_cls(
         loss_fn=loss_fn,
         params=params,
         config=cfg,
@@ -81,6 +91,7 @@ def initialize(
         tp_specs=tp_specs,
         rng=rng,
         dataloader=training_data,
+        **engine_kwargs,
     )
     return engine, engine.optimizer, engine.dataloader, engine.lr_schedule
 

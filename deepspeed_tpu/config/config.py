@@ -189,6 +189,19 @@ class CometConfig(ConfigModel):
 
 
 @dataclass
+class HybridEngineConfig(ConfigModel):
+    """hybrid_engine sub-tree (reference runtime/hybrid_engine.py RLHF
+    train+generate). TP/pinning knobs are accepted for config parity; on TPU
+    the generate jit shares the training params directly."""
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+
+
+@dataclass
 class AutotuningConfig(ConfigModel):
     """autotuning sub-tree (reference autotuning/config.py). The tuner
     searches ZeRO stage x micro-batch (and anything in ``tuning_space``)
@@ -356,6 +369,7 @@ class Config(ConfigModel):
     comet: CometConfig = field(default_factory=CometConfig)
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
     autotuning: AutotuningConfig = field(default_factory=AutotuningConfig)
+    hybrid_engine: HybridEngineConfig = field(default_factory=HybridEngineConfig)
     comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
 
     mesh: MeshConfig = field(default_factory=MeshConfig)

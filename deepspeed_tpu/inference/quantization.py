@@ -31,7 +31,11 @@ from ..utils.logging import log_dist
 
 def quantize_model_params(params: Any, cfg: Dict) -> Any:
     """Replace matching >=2D float leaves with QuantizedTensor storage."""
-    block = cfg.get("quantized_weights", cfg)
+    if "quantized_weights" not in cfg:
+        raise ValueError(
+            "WOQ config must contain a 'quantized_weights' block "
+            f"(got keys {sorted(cfg)})")
+    block = cfg["quantized_weights"]
     if not block.get("enabled", True):
         return params
     bits = int(block.get("num_bits", 8))
@@ -40,11 +44,14 @@ def quantize_model_params(params: Any, cfg: Dict) -> Any:
     excluded = list(block.get("excluded_modules", []))
     count = [0]
 
+    import jax.numpy as jnp
+
     def leaf(path, x):
         ps = _leaf_path(path)
-        # read dtype from metadata — np.asarray would device_get the tensor
+        # read dtype from metadata — np.asarray would device_get the tensor;
+        # jnp.issubdtype, unlike np's, recognizes bfloat16 as floating
         dtype = getattr(x, "dtype", None) or np.asarray(x).dtype
-        if np.ndim(x) < 2 or not np.issubdtype(dtype, np.floating):
+        if np.ndim(x) < 2 or not jnp.issubdtype(dtype, jnp.floating):
             return x
         if excluded and _matches(ps, excluded):
             return x
@@ -82,5 +89,7 @@ def woq_memory_bytes(params: Any) -> int:
             if leaf.zero is not None:
                 total += leaf.zero.size * 4
         else:
-            total += np.asarray(leaf).nbytes
+            # metadata only — no device transfer
+            total += int(np.prod(np.shape(leaf)) *
+                         np.dtype(getattr(leaf, "dtype", np.float32)).itemsize)
     return total

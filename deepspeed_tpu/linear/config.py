@@ -26,7 +26,20 @@ class LoRAConfig:
 
 @dataclasses.dataclass
 class QuantizationConfig:
-    """Minifloat quantization settings (fp6/fp8/fp12 via ops/fp_quantizer)."""
+    """Minifloat quantization settings (fp6/fp8/fp12 via ops/fp_quantizer).
+
+    ``mantissa_bits`` is accepted for reference key parity but the
+    exponent/mantissa split is fixed per q_bits (6=e3m2, 8=e4m3, 12=e4m7 —
+    the reference's fp_quantizer formats); a mismatching value raises."""
     q_bits: int = 8
     mantissa_bits: int = 3
     group_size: int = 512
+
+    def __post_init__(self):
+        from ..ops.fp_quantizer import FORMATS
+        if self.q_bits in FORMATS:
+            _, man = FORMATS[self.q_bits]
+            if self.mantissa_bits not in (man, 3):   # 3 is the ds default
+                raise ValueError(
+                    f"q_bits={self.q_bits} implies mantissa_bits={man} "
+                    f"(got {self.mantissa_bits})")

@@ -1,0 +1,125 @@
+"""Hybrid engine — one model that both trains and generates (RLHF).
+
+Parity with the reference's ``DeepSpeedHybridEngine``
+(``runtime/hybrid_engine.py:30``): the RLHF actor trains under ZeRO and
+generates rollouts with the same weights, with LoRA fused for the generate
+phase and unfused for training (``:132-153``), and ZeRO-3 params gathered
+for the forward (``_zero3_forward:357``).
+
+The TPU translation is dramatically simpler because both phases are pure
+functions of one param pytree:
+  - "swap params into inference containers" disappears — ``generate`` jits
+    over the SAME (sharded) params the train step uses; under ZeRO-3 the
+    SPMD partitioner inserts the per-layer gathers (the reference's
+    gather-forward, compiled);
+  - LoRA fuse/unfuse is a pytree transform applied around the generate jit
+    (``deepspeed_tpu.linear`` fuse_lora/unfuse_lora);
+  - the generate loop is ONE compiled ``lax.scan`` over decode positions
+    with a static context budget (no CUDA-graph capture needed: jit is the
+    graph).
+
+``apply_fn(params, tokens) -> logits [B, T, V]`` is the generation model
+(usually ``model.apply``); the prompt batch must share one prompt length.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+from .engine import Engine
+
+
+class HybridEngine(Engine):
+    def __init__(self, *args, apply_fn: Optional[Callable] = None,
+                 lora_fuse_fn: Optional[Callable] = None,
+                 lora_unfuse_fn: Optional[Callable] = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.apply_fn = apply_fn
+        self._lora_fuse = lora_fuse_fn
+        self._lora_unfuse = lora_unfuse_fn
+        self._gen_cache = {}
+        hcfg = self.config.hybrid_engine
+        self.max_out_tokens = int(hcfg.max_out_tokens)
+        self._latency = []
+
+    # ------------------------------ generate --------------------------- #
+
+    def _build_generate(self, prompt_len: int, max_new: int,
+                        temperature: float):
+        apply_fn = self.apply_fn
+        total = prompt_len + max_new
+        psh = self._state_shardings.params
+
+        def gen(params, prompt, rng):
+            batch = prompt.shape[0]
+            ctx = jnp.zeros((batch, total), prompt.dtype)
+            ctx = jax.lax.dynamic_update_slice(ctx, prompt, (0, 0))
+
+            def step(carry, _):
+                ctx, cur, rng = carry
+                logits = apply_fn(params, ctx)          # (B, total, V)
+                nxt_logits = jnp.take_along_axis(
+                    logits, (cur - 1)[None, None, None].astype(jnp.int32)
+                    * jnp.ones((batch, 1, 1), jnp.int32), axis=1)[:, 0]
+                if temperature > 0.0:
+                    rng, sub = jax.random.split(rng)
+                    nxt = jax.random.categorical(
+                        sub, nxt_logits.astype(jnp.float32) / temperature)
+                else:
+                    nxt = jnp.argmax(nxt_logits, axis=-1)
+                nxt = nxt.astype(ctx.dtype)
+                onehot = (jnp.arange(total) == cur).astype(ctx.dtype)
+                ctx = ctx * (1 - onehot)[None, :] + nxt[:, None] * onehot[None, :]
+                return (ctx, cur + 1, rng), nxt
+
+            (ctx, _, _), toks = jax.lax.scan(
+                step, (ctx, jnp.asarray(prompt_len, jnp.int32), rng),
+                None, length=max_new)
+            return ctx, toks.T                           # (B, total), (B, new)
+
+        return jax.jit(gen, in_shardings=(psh, None, None))
+
+    def generate(self, prompt_tokens, max_new_tokens: Optional[int] = None,
+                 temperature: float = 0.0,
+                 rng: Optional[jax.Array] = None
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Roll out from ``prompt_tokens`` (B, P). Returns
+        ``(full_context, new_tokens)``. LoRA is fused for the rollout and the
+        training params stay untouched."""
+        if self.apply_fn is None:
+            raise RuntimeError("HybridEngine needs apply_fn(params, tokens) "
+                               "-> logits to generate")
+        if rng is None:
+            rng = jax.random.PRNGKey(int(self.global_steps))
+        max_new = int(max_new_tokens or self.max_out_tokens)
+        prompt_len = int(prompt_tokens.shape[1])
+        key = (prompt_len, max_new, float(temperature))
+        if key not in self._gen_cache:
+            self._gen_cache[key] = self._build_generate(prompt_len, max_new,
+                                                        temperature)
+        params = self.state.params
+        if self._lora_fuse is not None:
+            params = self._lora_fuse(params)             # fused view only
+        t0 = time.perf_counter()
+        ctx, new = self._gen_cache[key](params, jnp.asarray(prompt_tokens),
+                                        rng)
+        jax.block_until_ready(new)
+        self._latency.append(time.perf_counter() - t0)
+        return ctx, new
+
+    # RLHF helpers mirroring the reference's bookkeeping ----------------- #
+
+    def generate_latency(self):
+        return list(self._latency)
+
+    def eval(self):
+        """No-op mode switches (functional model); kept for API parity."""
+        return self
+
+    def train(self, mode: bool = True):
+        return self
