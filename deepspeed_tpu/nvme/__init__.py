@@ -1,0 +1,1 @@
+from .perf_tune import run_sweep, tune

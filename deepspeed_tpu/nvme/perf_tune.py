@@ -1,0 +1,94 @@
+"""NVMe aio performance sweep — ``ds_nvme_tune`` / ``ds_io`` parity.
+
+The reference's ``deepspeed/nvme/`` sweeps aio knobs (block size, queue
+depth, thread count, submit mode) over benchmark reads/writes and reports
+the best config for the swap layer. Same here, over the native thread-pool
+library (``csrc/aio/ds_aio.cpp``): each candidate writes+reads a test file
+through an ``AioHandle`` and the winner is written as the recommended
+``aio`` config block.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..io.aio import AioHandle
+from ..utils.logging import log_dist
+
+DEFAULT_BLOCK_SIZES = [1 << 18, 1 << 20, 1 << 22]
+DEFAULT_THREADS = [2, 4, 8]
+
+
+def _bench_one(path: str, mb: int, block_size: int, threads: int
+               ) -> Tuple[float, float]:
+    """Returns (write_GBps, read_GBps) for one config."""
+    data = np.random.default_rng(0).integers(
+        0, 255, size=(mb << 20,), dtype=np.uint8)
+    h = AioHandle(block_size=block_size, num_threads=threads)
+    t0 = time.perf_counter()
+    h.sync_pwrite(data, path)
+    tw = time.perf_counter() - t0
+    back = np.empty_like(data)
+    t0 = time.perf_counter()
+    h.sync_pread(back, path)
+    tr = time.perf_counter() - t0
+    if not np.array_equal(data[:4096], back[:4096]):
+        raise RuntimeError("aio round-trip corruption during sweep")
+    gb = mb / 1024
+    return gb / tw, gb / tr
+
+
+def run_sweep(nvme_dir: str, mb_per_test: int = 64,
+              block_sizes: Optional[List[int]] = None,
+              thread_counts: Optional[List[int]] = None) -> List[Dict]:
+    """Benchmark every (block_size, threads) combination."""
+    results = []
+    path = os.path.join(nvme_dir, ".ds_tpu_io_sweep.bin")
+    try:
+        for bs in block_sizes or DEFAULT_BLOCK_SIZES:
+            for th in thread_counts or DEFAULT_THREADS:
+                w, r = _bench_one(path, mb_per_test, bs, th)
+                results.append({"block_size": bs, "num_threads": th,
+                                "write_GBps": round(w, 3),
+                                "read_GBps": round(r, 3)})
+                log_dist(f"aio sweep: block={bs} threads={th} "
+                         f"write={w:.2f}GB/s read={r:.2f}GB/s")
+    finally:
+        if os.path.exists(path):
+            os.unlink(path)
+    return results
+
+
+def tune(nvme_dir: str, mb_per_test: int = 64,
+         output: Optional[str] = None) -> Dict:
+    """Run the sweep and return (and optionally write) the best aio config."""
+    results = run_sweep(nvme_dir, mb_per_test)
+    best = max(results, key=lambda r: r["write_GBps"] + r["read_GBps"])
+    rec = {"aio": {"block_size": best["block_size"],
+                   "thread_count": best["num_threads"],
+                   "queue_depth": best["num_threads"],
+                   "single_submit": False, "overlap_events": True},
+           "sweep": results}
+    if output:
+        with open(output, "w") as f:
+            json.dump(rec, f, indent=2)
+    log_dist(f"aio tune: best {best}")
+    return rec
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(description="NVMe aio sweep (ds_nvme_tune)")
+    ap.add_argument("nvme_dir", help="directory on the device to test")
+    ap.add_argument("--mb", type=int, default=64, help="MB per test IO")
+    ap.add_argument("-o", "--output", default=None, help="write best config")
+    args = ap.parse_args(argv)
+    rec = tune(args.nvme_dir, args.mb, args.output)
+    print(json.dumps(rec["aio"], indent=2))
+    return 0

@@ -1,0 +1,277 @@
+"""Block-sparse attention — sparsity layouts + masked attention.
+
+Capability parity with the reference's ``ops/sparse_attention/`` (Triton
+block-sparse matmul + ``SparsityConfig`` family: Dense/Fixed/Variable/
+BigBird/BSLongformer, ``sparsity_config.py`` — SURVEY.md §2.6
+``csrc/sparse_attention`` row). Layout semantics match the reference:
+a (heads, nb, nb) boolean block mask over ``block``-sized tiles where entry
+[h, i, j] allows query block i to attend key block j.
+
+Execution is TPU-shaped: the layout expands to a block mask consumed by a
+single fused attention (XLA fuses mask+softmax+matmul; a dedicated
+skip-blocks Pallas kernel is the splash-attention upgrade path). The
+attention math matches ``SparseSelfAttention`` (softmax over allowed blocks
+only, optional causal combine).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG_INF = float(np.finfo(np.float32).min)
+
+
+class SparsityConfig:
+    """Base: dense unless subclass overrides (reference sparsity_config.py:10)."""
+
+    def __init__(self, num_heads: int, block: int = 16,
+                 different_layout_per_head: bool = False):
+        self.num_heads = num_heads
+        self.block = block
+        self.different_layout_per_head = different_layout_per_head
+        self.num_layout_heads = num_heads if different_layout_per_head else 1
+
+    def setup_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len % self.block:
+            raise ValueError(
+                f"seq_len ({seq_len}) must be divisible by block "
+                f"({self.block})")
+        nb = seq_len // self.block
+        return np.zeros((self.num_heads, nb, nb), dtype=bool)
+
+    def propagate_first_head(self, layout: np.ndarray) -> np.ndarray:
+        if not self.different_layout_per_head:
+            layout[1:] = layout[0]
+        return layout
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        layout[:] = True
+        return layout
+
+
+class DenseSparsityConfig(SparsityConfig):
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        layout[:] = True
+        return layout
+
+
+class FixedSparsityConfig(SparsityConfig):
+    """Local windows + periodic global blocks (reference :95; the GPT-3
+    'fixed' pattern)."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_local_blocks: int = 4, num_global_blocks: int = 1,
+                 attention: str = "bidirectional",
+                 horizontal_global_attention: bool = False,
+                 num_different_global_patterns: int = 1):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_local_blocks = num_local_blocks
+        self.num_global_blocks = num_global_blocks
+        self.attention = attention
+        self.horizontal_global_attention = horizontal_global_attention
+        self.num_different_global_patterns = num_different_global_patterns
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        nb = layout.shape[1]
+        causal = self.attention == "unidirectional"
+        for h in range(self.num_layout_heads):
+            # local: dense within each window of num_local_blocks
+            for start in range(0, nb, self.num_local_blocks):
+                end = min(start + self.num_local_blocks, nb)
+                for i in range(start, end):
+                    jend = (i + 1) if causal else end
+                    layout[h, i, start:jend] = True
+            # global: last num_global_blocks of each window attend/attended
+            pattern = h % self.num_different_global_patterns
+            for start in range(0, nb, self.num_local_blocks):
+                end = min(start + self.num_local_blocks, nb)
+                g0 = max(start, end - (pattern + 1) * self.num_global_blocks)
+                g1 = min(end, g0 + self.num_global_blocks)
+                # vertical: every later query block attends these globals
+                first = 0 if not causal else start
+                layout[h, g1:, g0:g1] = True
+                if self.horizontal_global_attention and not causal:
+                    layout[h, g0:g1, :] = True
+        if causal:
+            tri = np.tril(np.ones((nb, nb), dtype=bool))
+            layout &= tri
+        return self.propagate_first_head(layout)
+
+
+class VariableSparsityConfig(SparsityConfig):
+    """Random + custom local windows + leading global blocks (reference :239)."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_random_blocks: int = 0, local_window_blocks=None,
+                 global_block_indices=None, global_block_end_indices=None,
+                 attention: str = "bidirectional",
+                 horizontal_global_attention: bool = False, seed: int = 0):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_random_blocks = num_random_blocks
+        self.local_window_blocks = local_window_blocks or [4]
+        self.global_block_indices = global_block_indices or [0]
+        self.global_block_end_indices = global_block_end_indices
+        self.attention = attention
+        self.horizontal_global_attention = horizontal_global_attention
+        self.seed = seed
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        nb = layout.shape[1]
+        rng = random.Random(self.seed)
+        causal = self.attention == "unidirectional"
+        for h in range(self.num_layout_heads):
+            # local windows of varying sizes, repeated cyclically
+            i = 0
+            w = 0
+            while i < nb:
+                size = self.local_window_blocks[
+                    min(w, len(self.local_window_blocks) - 1)]
+                end = min(i + size, nb)
+                layout[h, i:end, i:end] = True
+                i, w = end, w + 1
+            # random blocks per row
+            for i in range(nb):
+                for j in rng.sample(range(nb), min(self.num_random_blocks, nb)):
+                    layout[h, i, j] = True
+            # globals
+            ends = self.global_block_end_indices
+            for gi, g in enumerate(self.global_block_indices):
+                g1 = (ends[gi] if ends else g + 1)
+                layout[h, :, g:g1] = True
+                if self.horizontal_global_attention:
+                    layout[h, g:g1, :] = True
+        if causal:
+            layout &= np.tril(np.ones((nb, nb), dtype=bool))
+        return self.propagate_first_head(layout)
+
+
+class BigBirdSparsityConfig(SparsityConfig):
+    """random + sliding window + global (reference :411)."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_random_blocks: int = 1, num_sliding_window_blocks: int = 3,
+                 num_global_blocks: int = 1,
+                 attention: str = "bidirectional", seed: int = 0):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_random_blocks = num_random_blocks
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.num_global_blocks = num_global_blocks
+        self.attention = attention
+        self.seed = seed
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        nb = layout.shape[1]
+        rng = random.Random(self.seed)
+        w = self.num_sliding_window_blocks // 2
+        causal = self.attention == "unidirectional"
+        for h in range(self.num_layout_heads):
+            for i in range(nb):
+                layout[h, i, max(0, i - w):min(nb, i + w + 1)] = True
+                for j in rng.sample(range(nb),
+                                    min(self.num_random_blocks, nb)):
+                    layout[h, i, j] = True
+            g = min(self.num_global_blocks, nb)
+            layout[h, :, :g] = True
+            layout[h, :g, :] = True
+        if causal:
+            layout &= np.tril(np.ones((nb, nb), dtype=bool))
+        return self.propagate_first_head(layout)
+
+
+class BSLongformerSparsityConfig(SparsityConfig):
+    """sliding window + selected global blocks (reference Longformer)."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_sliding_window_blocks: int = 3,
+                 global_block_indices=None, global_block_end_indices=None,
+                 attention: str = "bidirectional"):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.global_block_indices = global_block_indices or [0]
+        self.global_block_end_indices = global_block_end_indices
+        self.attention = attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        nb = layout.shape[1]
+        w = self.num_sliding_window_blocks // 2
+        for h in range(self.num_layout_heads):
+            for i in range(nb):
+                layout[h, i, max(0, i - w):min(nb, i + w + 1)] = True
+            ends = self.global_block_end_indices
+            for gi, g in enumerate(self.global_block_indices):
+                g1 = (ends[gi] if ends else g + 1)
+                layout[h, :, g:g1] = True
+                layout[h, g:g1, :] = True
+        if self.attention == "unidirectional":
+            layout &= np.tril(np.ones((nb, nb), dtype=bool))
+        return self.propagate_first_head(layout)
+
+
+# --------------------------------------------------------------------------- #
+# execution
+# --------------------------------------------------------------------------- #
+
+
+def sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                     sparsity_config: SparsityConfig, *,
+                     sm_scale: Optional[float] = None,
+                     layout: Optional[np.ndarray] = None,
+                     layout_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Block-sparse attention over BHTD tensors (reference
+    ``SparseSelfAttention.forward``): scores outside the layout get -inf
+    before softmax. Pass ``layout`` to reuse a precomputed pattern."""
+    b, h, t, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if layout_mask is None:
+        if layout is None:
+            layout = sparsity_config.make_layout(t)
+        block = sparsity_config.block
+        mask = np.kron(layout, np.ones((block, block), dtype=bool))
+        layout_mask = jnp.asarray(mask)                  # (H or 1, T, T)
+    if layout_mask.shape[0] == 1 and h > 1:
+        layout_mask = jnp.broadcast_to(layout_mask, (h, t, t))
+
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    s = jnp.where(layout_mask[None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # rows with no allowed block (fully masked) produce uniform garbage;
+    # zero them like the reference's zero-fill
+    any_allowed = layout_mask.any(axis=-1)               # (H, T)
+    p = jnp.where(any_allowed[None, :, :, None], p, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+class SparseSelfAttention:
+    """Thin callable wrapper matching the reference module's surface."""
+
+    def __init__(self, sparsity_config: SparsityConfig,
+                 attn_mask_mode: str = "mul"):
+        self.sparsity_config = sparsity_config
+        self._layout_cache = {}
+
+    def __call__(self, q, k, v):
+        t = q.shape[2]
+        if t not in self._layout_cache:
+            layout = self.sparsity_config.make_layout(t)
+            block = self.sparsity_config.block
+            # cache HOST arrays only: a jnp constant created while tracing
+            # would leak that trace's tracer into later jits
+            self._layout_cache[t] = np.kron(
+                layout, np.ones((block, block), dtype=bool))
+        return sparse_attention(q, k, v, self.sparsity_config,
+                                layout_mask=jnp.asarray(self._layout_cache[t]))

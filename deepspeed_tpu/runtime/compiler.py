@@ -1,0 +1,45 @@
+"""Compiler integration — parity with the reference's ``runtime/compiler.py``
+(``torch.compile`` support: ``is_compile_supported``, ``@disable`` guards).
+
+On TPU everything already runs compiled (jit is the execution model), so the
+surface inverts: ``disable`` marks a function to stay OUT of the compiled
+step (host callbacks), and ``compile`` is jax.jit with the engine's donation
+conventions."""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+
+
+def is_compile_supported() -> bool:
+    return True
+
+
+def disable(fn: Callable) -> Callable:
+    """Mark ``fn`` host-side (reference @compiler.disable). Calls inside a
+    traced region are executed via ``jax.debug.callback`` (side effects
+    only)."""
+    fn._ds_compile_disabled = True
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        import jax.core
+        try:
+            traced = any(isinstance(a, jax.core.Tracer) for a in args)
+        except Exception:  # noqa: BLE001
+            traced = False
+        if traced:
+            jax.debug.callback(lambda *a: fn(*a), *args)
+            return None
+        return fn(*args, **kwargs)
+
+    wrapper._ds_compile_disabled = True
+    return wrapper
+
+
+def compile(fn: Callable, **jit_kwargs) -> Callable:  # noqa: A001
+    """deepspeed.compile analogue: jax.jit with the given options."""
+    return jax.jit(fn, **jit_kwargs)

@@ -1,0 +1,68 @@
+"""Hessian top-eigenvalue estimation by power iteration.
+
+Parity with the reference's ``runtime/eigenvalue.py`` (power-iteration
+curvature estimates driving MoQ quantization schedules). JAX turns the
+reference's autograd double-backward into ``jvp``-of-``grad``
+Hessian-vector products; the whole iteration compiles to one ``lax.scan``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Eigenvalue:
+    def __init__(self, verbose: bool = False, max_iter: int = 100,
+                 tol: float = 1e-2, stability: float = 1e-6,
+                 gas_boundary_resolution: int = 1,
+                 layer_name: str = "", layer_num: int = 0):
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.verbose = verbose
+        # accepted for reference-config parity
+        self.gas_boundary_resolution = gas_boundary_resolution
+        self.layer_name = layer_name
+        self.layer_num = layer_num
+
+    def compute_eigenvalue(self, loss_fn: Callable, params: Any, batch: Any,
+                           rng: Optional[jax.Array] = None) -> float:
+        """Top |eigenvalue| of the loss Hessian at ``params``."""
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+
+        def scalar_loss(p):
+            out = loss_fn(p, batch, rng)
+            return out[0] if isinstance(out, tuple) else out
+
+        grad_fn = jax.grad(scalar_loss)
+
+        def hvp(v):
+            return jax.jvp(grad_fn, (params,), (v,))[1]
+
+        def norm(tree):
+            return jnp.sqrt(sum(jnp.vdot(x, x).real
+                                for x in jax.tree_util.tree_leaves(tree)))
+
+        v = jax.tree_util.tree_map(
+            lambda p: jax.random.normal(
+                jax.random.fold_in(rng, hash(p.shape) % 1000), p.shape),
+            params)
+        nv = norm(v) + self.stability
+        v = jax.tree_util.tree_map(lambda x: x / nv, v)
+
+        @jax.jit
+        def body(carry, _):
+            v, prev = carry
+            hv = hvp(v)
+            ev = norm(hv)
+            v = jax.tree_util.tree_map(lambda x: x / (ev + self.stability),
+                                       hv)
+            return (v, ev), ev
+
+        (v, ev), evs = jax.lax.scan(body, (v, jnp.zeros(())),
+                                    None, length=self.max_iter)
+        return float(ev)

@@ -36,33 +36,51 @@ from .engine import Engine
 
 class HybridEngine(Engine):
     def __init__(self, *args, apply_fn: Optional[Callable] = None,
+                 generate_fn: Optional[Callable] = None,
                  lora_fuse_fn: Optional[Callable] = None,
                  lora_unfuse_fn: Optional[Callable] = None, **kwargs):
         super().__init__(*args, **kwargs)
         self.apply_fn = apply_fn
+        # escape hatch for KV-cached decode: the built-in loop recomputes the
+        # full context per token (O(new * total^2) attention); plug a cached
+        # decoder (e.g. the v2 ragged engine's model runner) here for long
+        # rollouts
+        self.generate_fn = generate_fn
         self._lora_fuse = lora_fuse_fn
         self._lora_unfuse = lora_unfuse_fn
         self._gen_cache = {}
         hcfg = self.config.hybrid_engine
         self.max_out_tokens = int(hcfg.max_out_tokens)
         self._latency = []
+        self._gen_rng = jax.random.PRNGKey(self.config.seed ^ 0x9E3779B9)
 
     # ------------------------------ generate --------------------------- #
 
     def _build_generate(self, prompt_len: int, max_new: int,
                         temperature: float):
-        apply_fn = self.apply_fn
+        raw_apply = self.apply_fn
         total = prompt_len + max_new
         psh = self._state_shardings.params
+        comp = self._compression
+        from ..utils.dtypes import cast_floating
+        compute_dtype = self.compute_dtype
 
-        def gen(params, prompt, rng):
+        def apply_fn(params, tokens, step):
+            # rollouts must see the SAME effective model training sees:
+            # compression masks + compute-dtype cast
+            p = cast_floating(params, compute_dtype)
+            if comp is not None:
+                p = comp.apply(p, step)
+            return raw_apply(p, tokens)
+
+        def gen(params, prompt, rng, step):
             batch = prompt.shape[0]
             ctx = jnp.zeros((batch, total), prompt.dtype)
             ctx = jax.lax.dynamic_update_slice(ctx, prompt, (0, 0))
 
-            def step(carry, _):
+            def step_body(carry, _):
                 ctx, cur, rng = carry
-                logits = apply_fn(params, ctx)          # (B, total, V)
+                logits = apply_fn(params, ctx, step)    # (B, total, V)
                 nxt_logits = jnp.take_along_axis(
                     logits, (cur - 1)[None, None, None].astype(jnp.int32)
                     * jnp.ones((batch, 1, 1), jnp.int32), axis=1)[:, 0]
@@ -78,11 +96,11 @@ class HybridEngine(Engine):
                 return (ctx, cur + 1, rng), nxt
 
             (ctx, _, _), toks = jax.lax.scan(
-                step, (ctx, jnp.asarray(prompt_len, jnp.int32), rng),
+                step_body, (ctx, jnp.asarray(prompt_len, jnp.int32), rng),
                 None, length=max_new)
             return ctx, toks.T                           # (B, total), (B, new)
 
-        return jax.jit(gen, in_shardings=(psh, None, None))
+        return jax.jit(gen, in_shardings=(psh, None, None, None))
 
     def generate(self, prompt_tokens, max_new_tokens: Optional[int] = None,
                  temperature: float = 0.0,
@@ -91,23 +109,35 @@ class HybridEngine(Engine):
         """Roll out from ``prompt_tokens`` (B, P). Returns
         ``(full_context, new_tokens)``. LoRA is fused for the rollout and the
         training params stay untouched."""
+        if rng is None:
+            # fresh key per call: repeated sampled rollouts in one training
+            # step must differ
+            self._gen_rng, rng = jax.random.split(self._gen_rng)
+        max_new = int(self.max_out_tokens if max_new_tokens is None
+                      else max_new_tokens)
+        params = self.state.params
+        if self._lora_fuse is not None:
+            params = self._lora_fuse(params)             # fused view only
+        if self.generate_fn is not None:
+            t0 = time.perf_counter()
+            out = self.generate_fn(params, prompt_tokens, rng, max_new)
+            jax.block_until_ready(out)
+            self._latency.append(time.perf_counter() - t0)
+            return out
         if self.apply_fn is None:
             raise RuntimeError("HybridEngine needs apply_fn(params, tokens) "
-                               "-> logits to generate")
-        if rng is None:
-            rng = jax.random.PRNGKey(int(self.global_steps))
-        max_new = int(max_new_tokens or self.max_out_tokens)
+                               "-> logits (or generate_fn) to generate")
         prompt_len = int(prompt_tokens.shape[1])
+        if max_new == 0:
+            return jnp.asarray(prompt_tokens), jnp.zeros(
+                (prompt_tokens.shape[0], 0), jnp.int32)
         key = (prompt_len, max_new, float(temperature))
         if key not in self._gen_cache:
             self._gen_cache[key] = self._build_generate(prompt_len, max_new,
                                                         temperature)
-        params = self.state.params
-        if self._lora_fuse is not None:
-            params = self._lora_fuse(params)             # fused view only
         t0 = time.perf_counter()
         ctx, new = self._gen_cache[key](params, jnp.asarray(prompt_tokens),
-                                        rng)
+                                        rng, self.state.step)
         jax.block_until_ready(new)
         self._latency.append(time.perf_counter() - t0)
         return ctx, new

@@ -1,0 +1,122 @@
+"""Debugging access to full params / grads / optimizer state by name.
+
+Parity with the reference's ``utils/tensor_fragment.py`` APIs
+(``safe_get_full_fp32_param``, ``safe_get_full_grad``,
+``safe_get_full_optimizer_state``, ``safe_set_full_fp32_param``, … —
+SURVEY.md §2.7 "Tensor fragment mapping"). The reference needs a mapping
+from flat ZeRO partitions back to per-param fragments; here params are a
+named pytree with sharded global arrays, so "full" access is
+``device_get`` of the addressed leaf and the fragment math disappears.
+
+Addressing: a ``/``-separated path through the params tree, e.g.
+``transformer/h_0/attn/qkv/kernel`` (the same paths checkpoint meta and
+``export_fp32_params`` emit).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Optional
+
+import jax
+import numpy as np
+
+
+def _walk(tree: Any, path: str):
+    node = tree
+    for part in path.split("/"):
+        if isinstance(node, (list, tuple)):
+            node = node[int(part)]
+        elif isinstance(node, dict):
+            if part not in node:
+                return None
+            node = node[part]
+        else:
+            node = getattr(node, part, None)
+            if node is None:
+                return None
+    return node
+
+
+def _set(tree: Any, path: str, value) -> Any:
+    parts = path.split("/")
+
+    def rec(node, i):
+        if i == len(parts):
+            return value
+        key = parts[i]
+        if isinstance(node, dict):
+            if key not in node:
+                raise KeyError(f"path '{path}' not found at '{key}'")
+            out = dict(node)
+            out[key] = rec(node[key], i + 1)
+            return out
+        if isinstance(node, (list, tuple)):
+            idx = int(key)
+            out = list(node)
+            out[idx] = rec(node[idx], i + 1)
+            return type(node)(out)
+        raise KeyError(f"cannot descend into {type(node)} at '{key}'")
+
+    return rec(tree, 0)
+
+
+def list_param_names(engine) -> List[str]:
+    """All addressable param paths."""
+    out = []
+    flat, _ = jax.tree_util.tree_flatten_with_path(engine.state.params)
+    for path, _leaf in flat:
+        out.append("/".join(str(getattr(k, "key", getattr(k, "idx",
+                   getattr(k, "name", k)))) for k in path))
+    return out
+
+
+def safe_get_full_fp32_param(engine, name: str) -> Optional[np.ndarray]:
+    """Full (gathered) fp32 master weight, or None if absent."""
+    leaf = _walk(engine.state.params, name)
+    if leaf is None:
+        return None
+    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def safe_set_full_fp32_param(engine, name: str, value) -> bool:
+    """Overwrite a master weight (re-placed with its sharding)."""
+    leaf = _walk(engine.state.params, name)
+    if leaf is None:
+        return False
+    shd = _walk(engine._state_shardings.params, name)
+    arr = jax.device_put(np.asarray(value, dtype=np.asarray(
+        jax.device_get(leaf)).dtype).reshape(np.shape(leaf)), shd)
+    new_params = _set(engine.state.params, name, arr)
+    engine.state = engine.state._replace(params=new_params)
+    return True
+
+
+def safe_get_full_grad(engine, name: str) -> Optional[np.ndarray]:
+    """The last step's gradient is not retained by the compiled step (it is
+    consumed by the fused update); expose the update direction via optimizer
+    state instead. Kept for API parity: returns None with a hint."""
+    from .logging import logger
+    logger.warning(
+        "safe_get_full_grad: gradients are fused into the compiled step and "
+        "not retained; use safe_get_full_optimizer_state(name, 'mu') for "
+        "the first moment, or run jax.grad on the engine loss directly")
+    return None
+
+
+def safe_get_full_optimizer_state(engine, name: str,
+                                  state_key: str) -> Optional[np.ndarray]:
+    """Optimizer-state leaf for a param (state_key e.g. 'mu'/'nu')."""
+    found = []
+
+    def visit(path, leaf):
+        keys = [str(getattr(k, "key", getattr(k, "idx",
+                getattr(k, "name", k)))) for k in path]
+        joined = "/".join(keys)
+        if name in joined and state_key in keys:
+            found.append(leaf)
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, engine.state.opt_state)
+    if not found:
+        return None
+    return np.asarray(jax.device_get(found[0]), dtype=np.float32)

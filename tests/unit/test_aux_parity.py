@@ -1,0 +1,182 @@
+"""Aux parity: sparse attention layouts, tensor-fragment API, eigenvalue,
+compiler guards, nvme sweep — reference tests/unit/ops/sparse_attention,
+utils/tensor_fragment users, runtime/eigenvalue."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, make_model
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, SparseSelfAttention, VariableSparsityConfig,
+    sparse_attention)
+
+KEY = jax.random.PRNGKey(0)
+
+
+class TestSparsityLayouts:
+    @pytest.mark.parametrize("cfg_cls,kw", [
+        (FixedSparsityConfig, {"num_local_blocks": 2}),
+        (BigBirdSparsityConfig, {"num_sliding_window_blocks": 3}),
+        (BSLongformerSparsityConfig, {}),
+        (VariableSparsityConfig, {"num_random_blocks": 1}),
+        (DenseSparsityConfig, {}),
+    ])
+    def test_layout_shapes_and_sparsity(self, cfg_cls, kw):
+        cfg = cfg_cls(num_heads=4, block=16, **kw)
+        layout = cfg.make_layout(128)
+        assert layout.shape == (4, 8, 8)
+        assert layout.dtype == bool
+        density = layout.mean()
+        if cfg_cls is DenseSparsityConfig:
+            assert density == 1.0
+        else:
+            assert 0 < density < 1.0
+        # every query block attends something
+        assert layout.any(axis=-1).all()
+
+    def test_causal_variant(self):
+        cfg = FixedSparsityConfig(num_heads=2, block=16,
+                                  attention="unidirectional")
+        layout = cfg.make_layout(128)
+        upper = np.triu(np.ones((8, 8), dtype=bool), k=1)
+        assert not (layout[0] & upper).any()
+
+    def test_block_divisibility_error(self):
+        with pytest.raises(ValueError):
+            FixedSparsityConfig(num_heads=1, block=16).make_layout(100)
+
+    def test_same_layout_shared_across_heads(self):
+        cfg = BigBirdSparsityConfig(num_heads=4, block=16,
+                                    different_layout_per_head=False)
+        layout = cfg.make_layout(128)
+        assert (layout[0] == layout[1]).all()
+
+
+class TestSparseAttention:
+    def test_dense_layout_matches_full_attention(self):
+        q = jax.random.normal(KEY, (2, 4, 64, 16))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 4, 64, 16))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, 4, 64, 16))
+        cfg = DenseSparsityConfig(num_heads=4, block=16)
+        out = sparse_attention(q, k, v, cfg)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / 4.0
+        ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4)
+
+    def test_masked_blocks_have_no_influence(self):
+        """Perturbing keys in a masked block must not change the output."""
+        cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                         num_sliding_window_blocks=1)
+        q = jax.random.normal(KEY, (1, 1, 64, 8))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 64, 8))
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, 1, 64, 8))
+        layout = cfg.make_layout(64)          # window=1 + global block 0
+        assert not layout[0, 2, 3]            # block (2,3) masked
+        out1 = sparse_attention(q, k, v, cfg, layout=layout)
+        k2 = k.at[:, :, 48:64].add(100.0)     # inside masked block col 3
+        out2 = sparse_attention(q, k2, v, cfg, layout=layout)
+        np.testing.assert_allclose(np.asarray(out1[:, :, 32:48]),
+                                   np.asarray(out2[:, :, 32:48]), atol=1e-5)
+
+    def test_module_wrapper_caches(self):
+        attn = SparseSelfAttention(
+            BigBirdSparsityConfig(num_heads=2, block=16))
+        q = jax.random.normal(KEY, (1, 2, 32, 8))
+        out = attn(q, q, q)
+        assert out.shape == q.shape
+        assert 32 in attn._layout_cache
+
+
+class TestTensorFragment:
+    def _engine(self):
+        cfg = GPT2Config.tiny(dtype=jnp.float32)
+        model, init_fn, loss_fn = make_model(cfg)
+        params = init_fn(KEY, batch_size=2, seq_len=16)
+        engine, _, _, _ = dstpu.initialize(
+            loss_fn=loss_fn, params=params, config={
+                "train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3},
+            })
+        return engine
+
+    def test_get_set_roundtrip(self, devices8):
+        from deepspeed_tpu.utils.tensor_fragment import (
+            list_param_names, safe_get_full_fp32_param,
+            safe_set_full_fp32_param)
+        engine = self._engine()
+        names = list_param_names(engine)
+        assert names
+        name = names[0]
+        w = safe_get_full_fp32_param(engine, name)
+        assert w is not None and w.dtype == np.float32
+        ok = safe_set_full_fp32_param(engine, name, w * 2)
+        assert ok
+        w2 = safe_get_full_fp32_param(engine, name)
+        np.testing.assert_allclose(w2, w * 2, rtol=1e-6)
+        assert safe_get_full_fp32_param(engine, "no/such/param") is None
+
+    def test_optimizer_state_access(self, devices8):
+        from deepspeed_tpu.utils.tensor_fragment import (
+            list_param_names, safe_get_full_optimizer_state)
+        engine = self._engine()
+        tokens = np.random.RandomState(0).randint(0, 512, size=(16, 17))
+        engine.train_batch({"tokens": jnp.asarray(tokens, jnp.int32)})
+        name = list_param_names(engine)[0]
+        mu = safe_get_full_optimizer_state(engine, name, "mu")
+        assert mu is not None and np.abs(mu).max() > 0
+
+
+class TestEigenvalue:
+    def test_quadratic_exact(self):
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+        # loss = 0.5 x^T diag(d) x -> top eigenvalue = max(d)
+        d = jnp.asarray([1.0, 5.0, 2.0, 0.5])
+
+        def loss_fn(p, batch, rng):
+            return 0.5 * jnp.sum(d * p["x"] ** 2)
+
+        ev = Eigenvalue(max_iter=50).compute_eigenvalue(
+            loss_fn, {"x": jnp.ones((4,))}, batch=None)
+        assert abs(ev - 5.0) < 1e-2
+
+
+class TestCompiler:
+    def test_surface(self):
+        from deepspeed_tpu.runtime import compiler
+        assert compiler.is_compile_supported()
+        calls = []
+
+        @compiler.disable
+        def log_it(x):
+            calls.append(np.asarray(x).copy())
+
+        @compiler.compile
+        def f(x):
+            log_it(x)
+            return x * 2
+
+        out = f(jnp.ones((2,)))
+        jax.effects_barrier()
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+        assert len(calls) == 1
+
+
+class TestNvmeSweep:
+    def test_sweep_and_tune(self, tmp_path):
+        from deepspeed_tpu.nvme import run_sweep, tune
+        res = run_sweep(str(tmp_path), mb_per_test=2,
+                        block_sizes=[1 << 18], thread_counts=[2, 4])
+        assert len(res) == 2
+        assert all(r["write_GBps"] > 0 and r["read_GBps"] > 0 for r in res)
+        out = tmp_path / "aio.json"
+        rec = tune(str(tmp_path), mb_per_test=2, output=str(out))
+        assert out.exists()
+        assert rec["aio"]["block_size"] in (1 << 18, 1 << 20, 1 << 22)
