@@ -1,0 +1,131 @@
+"""OPT causal transformer (flax.linen).
+
+Parity target: the reference's v2 inference OPT containers
+(``inference/v2/model_implementations/opt/``) and v1 OPT injection policy
+(``module_inject/containers/opt.py``): learned positional embeddings with
+the OPT +2 offset, pre-LN decoder blocks, biased projections, ReLU MLP,
+final LayerNorm, tied LM head by default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class OPTConfig:
+    vocab_size: int = 50272
+    max_seq_len: int = 2048
+    num_layers: int = 12
+    num_heads: int = 12
+    hidden_size: int = 768
+    ffn_dim: int = 3072
+    layer_norm_eps: float = 1e-5
+    tie_embeddings: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+
+    #: OPT's learned positions start at index 2 (pad-token legacy)
+    POSITION_OFFSET = 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @staticmethod
+    def tiny(**kw):
+        kw.setdefault("vocab_size", 512)
+        kw.setdefault("max_seq_len", 128)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("ffn_dim", 128)
+        return OPTConfig(**kw)
+
+
+class OPTAttention(nn.Module):
+    cfg: OPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, T, C = x.shape
+        H, D = cfg.num_heads, cfg.head_dim
+        dense = lambda name: nn.Dense(
+            C, dtype=cfg.dtype, param_dtype=cfg.param_dtype, use_bias=True,
+            name=name)
+        q = dense("q_proj")(x).reshape(B, T, H, D)
+        k = dense("k_proj")(x).reshape(B, T, H, D)
+        v = dense("v_proj")(x).reshape(B, T, H, D)
+        y = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+        return dense("out_proj")(y.reshape(B, T, C))
+
+
+class OPTBlock(nn.Module):
+    cfg: OPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name=name)
+        x = x + OPTAttention(cfg, name="self_attn")(
+            ln("self_attn_layer_norm")(x))
+        h = ln("final_layer_norm")(x)
+        h = nn.Dense(cfg.ffn_dim, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="fc1")(h)
+        h = nn.relu(h)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="fc2")(h)
+        return x + h
+
+
+class OPT(nn.Module):
+    cfg: OPTConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        B, T = tokens.shape
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="embed_tokens")
+        pos = nn.Embed(cfg.max_seq_len + cfg.POSITION_OFFSET,
+                       cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="embed_positions")
+        x = embed(tokens) + pos(jnp.arange(T) + cfg.POSITION_OFFSET)
+        block_cls = nn.remat(OPTBlock) if cfg.remat else OPTBlock
+        for i in range(cfg.num_layers):
+            x = block_cls(cfg, name=f"layer_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         param_dtype=cfg.param_dtype,
+                         name="final_layer_norm")(x)
+        if cfg.tie_embeddings:
+            return embed.attend(x.astype(jnp.float32))
+        return nn.Dense(cfg.vocab_size, dtype=jnp.float32,
+                        param_dtype=cfg.param_dtype, use_bias=False,
+                        name="lm_head")(x.astype(jnp.float32))
+
+
+def make_model(cfg: OPTConfig):
+    model = OPT(cfg)
+
+    def init_fn(rng, batch_size: int = 2, seq_len: Optional[int] = None):
+        T = seq_len or min(cfg.max_seq_len, 64)
+        return model.init(rng, jnp.zeros((batch_size, T), jnp.int32))["params"]
+
+    def loss_fn(params, batch, rng):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = model.apply({"params": params}, inputs)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return nll.mean()
+
+    return model, init_fn, loss_fn

@@ -13,12 +13,18 @@ from typing import Any, Callable, Dict, NamedTuple
 
 from .bert import Bert, BertConfig
 from .bert import make_model as make_bert
+from .falcon import Falcon, FalconConfig
+from .falcon import make_model as make_falcon
 from .gpt2 import GPT2, GPT2Config
 from .gpt2 import make_model as make_gpt2
 from .llama import Llama, LlamaConfig
 from .llama import make_model as make_llama
 from .mixtral import Mixtral, MixtralConfig
 from .mixtral import make_model as make_mixtral
+from .opt import OPT, OPTConfig
+from .opt import make_model as make_opt
+from .phi import Phi, PhiConfig
+from .phi import make_model as make_phi
 
 
 class ArchEntry(NamedTuple):
@@ -87,6 +93,65 @@ def _entry_bert(d):
         layer_norm_eps=d.get("layer_norm_eps", 1e-12))
 
 
+def _entry_opt(d):
+    return OPTConfig(
+        vocab_size=d.get("vocab_size", 50272),
+        max_seq_len=d.get("max_position_embeddings", 2048),
+        num_layers=d.get("num_hidden_layers", 12),
+        num_heads=d.get("num_attention_heads", 12),
+        hidden_size=d.get("hidden_size", 768),
+        ffn_dim=d.get("ffn_dim", 3072),
+        tie_embeddings=d.get("tie_word_embeddings", True))
+
+
+def _entry_falcon(d):
+    new_arch = d.get("new_decoder_architecture", False)
+    return FalconConfig(
+        vocab_size=d.get("vocab_size", 65024),
+        max_seq_len=d.get("max_position_embeddings", 2048),
+        num_layers=d.get("num_hidden_layers", 32),
+        num_heads=d.get("num_attention_heads", 71),
+        num_kv_heads=(d.get("num_kv_heads", 8) if new_arch
+                      else (d.get("num_attention_heads", 71)
+                            if not d.get("multi_query", True) else 1)),
+        hidden_size=d.get("hidden_size", 4544),
+        parallel_attn=d.get("parallel_attn", True),
+        new_decoder_architecture=new_arch,
+        tie_embeddings=d.get("tie_word_embeddings", True))
+
+
+def _entry_phi(d):
+    return PhiConfig(
+        vocab_size=d.get("vocab_size", 51200),
+        max_seq_len=d.get("max_position_embeddings", 2048),
+        num_layers=d.get("num_hidden_layers", 24),
+        num_heads=d.get("num_attention_heads", 32),
+        hidden_size=d.get("hidden_size", 2048),
+        intermediate_size=d.get("intermediate_size", 8192),
+        rotary_fraction=d.get("partial_rotary_factor", 0.5),
+        rope_theta=d.get("rope_theta", 10000.0))
+
+
+def _entry_phi3(d):
+    # phi-3 is llama-architecture (fused qkv/gate_up in the HF checkpoint,
+    # unfused here — same math)
+    return LlamaConfig(**_hf_llama(d))
+
+
+def _entry_qwen2_moe(d):
+    # qwen2-moe maps onto the mixtral block (per-layer router + experts);
+    # the shared-expert path is folded into the dense residual (approx:
+    # shared_expert_intermediate_size is absorbed by the expert width)
+    return MixtralConfig(**_hf_llama(
+        d,
+        qkv_bias=True,                  # qwen2 family uses biased q/k/v
+        intermediate_size=d.get("moe_intermediate_size",
+                                d.get("intermediate_size", 11008)),
+        num_experts=d.get("num_experts", 8),
+        experts_top_k=d.get("num_experts_per_tok", 2),
+        router_aux_loss_coef=d.get("router_aux_loss_coef", 0.001)))
+
+
 ARCHITECTURES: Dict[str, ArchEntry] = {
     "gpt2": ArchEntry(GPT2Config, GPT2, make_gpt2, _entry_gpt2),
     "llama": ArchEntry(LlamaConfig, Llama, make_llama, _entry_llama),
@@ -94,6 +159,12 @@ ARCHITECTURES: Dict[str, ArchEntry] = {
     "qwen2": ArchEntry(LlamaConfig, Llama, make_llama, _entry_qwen2),
     "mixtral": ArchEntry(MixtralConfig, Mixtral, make_mixtral, _entry_mixtral),
     "bert": ArchEntry(BertConfig, Bert, make_bert, _entry_bert),
+    "opt": ArchEntry(OPTConfig, OPT, make_opt, _entry_opt),
+    "falcon": ArchEntry(FalconConfig, Falcon, make_falcon, _entry_falcon),
+    "phi": ArchEntry(PhiConfig, Phi, make_phi, _entry_phi),
+    "phi3": ArchEntry(LlamaConfig, Llama, make_llama, _entry_phi3),
+    "qwen2_moe": ArchEntry(MixtralConfig, Mixtral, make_mixtral,
+                           _entry_qwen2_moe),
 }
 
 

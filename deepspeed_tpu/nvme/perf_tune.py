@@ -25,11 +25,10 @@ DEFAULT_BLOCK_SIZES = [1 << 18, 1 << 20, 1 << 22]
 DEFAULT_THREADS = [2, 4, 8]
 
 
-def _bench_one(path: str, mb: int, block_size: int, threads: int
+def _bench_one(path: str, data: np.ndarray, block_size: int, threads: int
                ) -> Tuple[float, float]:
     """Returns (write_GBps, read_GBps) for one config."""
-    data = np.random.default_rng(0).integers(
-        0, 255, size=(mb << 20,), dtype=np.uint8)
+    mb = data.nbytes >> 20
     h = AioHandle(block_size=block_size, num_threads=threads)
     t0 = time.perf_counter()
     h.sync_pwrite(data, path)
@@ -50,10 +49,12 @@ def run_sweep(nvme_dir: str, mb_per_test: int = 64,
     """Benchmark every (block_size, threads) combination."""
     results = []
     path = os.path.join(nvme_dir, ".ds_tpu_io_sweep.bin")
+    data = np.random.default_rng(0).integers(
+        0, 255, size=(mb_per_test << 20,), dtype=np.uint8)
     try:
         for bs in block_sizes or DEFAULT_BLOCK_SIZES:
             for th in thread_counts or DEFAULT_THREADS:
-                w, r = _bench_one(path, mb_per_test, bs, th)
+                w, r = _bench_one(path, data, bs, th)
                 results.append({"block_size": bs, "num_threads": th,
                                 "write_GBps": round(w, 3),
                                 "read_GBps": round(r, 3)})

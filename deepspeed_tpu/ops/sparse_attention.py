@@ -96,9 +96,10 @@ class FixedSparsityConfig(SparsityConfig):
                 end = min(start + self.num_local_blocks, nb)
                 g0 = max(start, end - (pattern + 1) * self.num_global_blocks)
                 g1 = min(end, g0 + self.num_global_blocks)
-                # vertical: every later query block attends these globals
+                # vertical: global columns visible to all rows
+                # (bidirectional) or to rows at/after the window (causal)
                 first = 0 if not causal else start
-                layout[h, g1:, g0:g1] = True
+                layout[h, first:, g0:g1] = True
                 if self.horizontal_global_attention and not causal:
                     layout[h, g0:g1, :] = True
         if causal:

@@ -28,11 +28,18 @@ def disable(fn: Callable) -> Callable:
     def wrapper(*args, **kwargs):
         import jax.core
         try:
-            traced = any(isinstance(a, jax.core.Tracer) for a in args)
+            traced = any(isinstance(a, jax.core.Tracer)
+                         for a in list(args) + list(kwargs.values()))
         except Exception:  # noqa: BLE001
             traced = False
         if traced:
-            jax.debug.callback(lambda *a: fn(*a), *args)
+            keys = tuple(kwargs)
+
+            def host_fn(*vals):
+                n = len(vals) - len(keys)
+                fn(*vals[:n], **dict(zip(keys, vals[n:])))
+
+            jax.debug.callback(host_fn, *args, *kwargs.values())
             return None
         return fn(*args, **kwargs)
 

@@ -54,15 +54,26 @@ class Eigenvalue:
         nv = norm(v) + self.stability
         v = jax.tree_util.tree_map(lambda x: x / nv, v)
 
-        @jax.jit
-        def body(carry, _):
-            v, prev = carry
-            hv = hvp(v)
-            ev = norm(hv)
-            v = jax.tree_util.tree_map(lambda x: x / (ev + self.stability),
-                                       hv)
-            return (v, ev), ev
+        tol, stability, max_iter = self.tol, self.stability, self.max_iter
 
-        (v, ev), evs = jax.lax.scan(body, (v, jnp.zeros(())),
-                                    None, length=self.max_iter)
-        return float(ev)
+        @jax.jit
+        def run(v):
+            def cond(carry):
+                _, prev, ev, i = carry
+                rel = jnp.abs(ev - prev) / jnp.maximum(jnp.abs(ev), stability)
+                return (i < max_iter) & ((i < 2) | (rel > tol))
+
+            def body(carry):
+                v, _prev, ev, i = carry
+                hv = hvp(v)
+                new_ev = norm(hv)
+                v = jax.tree_util.tree_map(
+                    lambda x: x / (new_ev + stability), hv)
+                return (v, ev, new_ev, i + 1)
+
+            _, _, ev, _ = jax.lax.while_loop(
+                cond, body, (v, jnp.zeros(()), jnp.zeros(()),
+                             jnp.zeros((), jnp.int32)))
+            return ev
+
+        return float(run(v))

@@ -112,7 +112,9 @@ def safe_get_full_optimizer_state(engine, name: str,
         keys = [str(getattr(k, "key", getattr(k, "idx",
                 getattr(k, "name", k)))) for k in path]
         joined = "/".join(keys)
-        if name in joined and state_key in keys:
+        # boundary-aware containment: 'proj/kernel' must not match
+        # '...out_proj/kernel...'
+        if f"/{name}/" in f"/{joined}/" and state_key in keys:
             found.append(leaf)
         return leaf
 
