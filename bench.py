@@ -23,7 +23,7 @@ def main():
     from deepspeed_tpu.models.gpt2 import GPT2Config, make_model
 
     seq = 512
-    micro = 8
+    micro = 64
     cfg_model = GPT2Config(vocab_size=50304, max_seq_len=seq + 1, num_layers=12,
                            num_heads=12, hidden_size=768)  # GPT-2 124M class
     model, init_fn, loss_fn = make_model(cfg_model)
@@ -51,7 +51,7 @@ def main():
         loss = engine.train_batch(batch)
     jax.block_until_ready(loss)
 
-    steps = 20
+    steps = 30
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = engine.train_batch(batch)

@@ -9,7 +9,6 @@ Shares the fixed-shape RaggedBatch contract of ``model_runner.py``.
 
 from __future__ import annotations
 
-import functools
 from typing import Any
 
 import jax
@@ -36,9 +35,17 @@ class LlamaRaggedRunner:
         self.num_layers = model_cfg.num_layers
         self.kv_heads = model_cfg.num_kv_heads
         self.head_dim = model_cfg.head_dim
-        self._step = jax.jit(functools.partial(
-            _llama_ragged_step, model_cfg=model_cfg, cfg=cfg,
-            dtype=self.compute_dtype))
+        def _step(params, kv_data, batch):
+            # WOQ: int8/int4 leaves (inference/quantization.py) dequantize
+            # here, inside the jit — XLA fuses the dequant into each layer's
+            # matmul while HBM keeps the packed weights
+            from ..quantization import dequantize_tree
+            params = dequantize_tree(params)
+            return _llama_ragged_step(params, kv_data, batch,
+                                      model_cfg=model_cfg, cfg=cfg,
+                                      dtype=self.compute_dtype)
+
+        self._step = jax.jit(_step)
 
     def step(self, params, kv_data, batch: RaggedBatch):
         return self._step(params, kv_data, batch)

@@ -1,0 +1,47 @@
+"""Shared causal-LM plumbing for the model zoo."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def make_causal_lm(model, cfg):
+    """(model, init_fn, loss_fn) with the engine's ``(params, batch, rng)``
+    contract — batch = {"tokens": [B, T+1] int32}, next-token NLL loss."""
+
+    def init_fn(rng, batch_size: int = 2, seq_len: Optional[int] = None):
+        T = seq_len or min(cfg.max_seq_len, 64)
+        return model.init(rng, jnp.zeros((batch_size, T), jnp.int32))["params"]
+
+    def loss_fn(params, batch, rng):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = model.apply({"params": params}, inputs)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return nll.mean()
+
+    return model, init_fn, loss_fn
+
+
+def alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """ALiBi per-head slopes (geometric, Press et al.)."""
+    import math
+    p = 2 ** math.floor(math.log2(num_heads))
+    base = [2 ** (-8.0 * (i + 1) / p) for i in range(p)]
+    if p < num_heads:
+        extra = [2 ** (-4.0 * (i + 1) / p) for i in range(num_heads - p)]
+        base = base + extra
+    return jnp.asarray(base[:num_heads], jnp.float32)
+
+
+def alibi_bias(num_heads: int, q_len: int, k_len: int) -> jnp.ndarray:
+    """[1, H, Tq, Tk] additive attention bias: -slope * distance."""
+    slopes = alibi_slopes(num_heads)                       # [H]
+    pos_q = jnp.arange(q_len)[:, None]
+    pos_k = jnp.arange(k_len)[None, :]
+    dist = (pos_q - pos_k).astype(jnp.float32)             # >=0 on causal side
+    return (-slopes[None, :, None, None] * dist[None, None]).astype(jnp.float32)

@@ -28,6 +28,7 @@ class FalconConfig:
     num_kv_heads: int = 1              # MQA (falcon-7b); 8 on 40b
     hidden_size: int = 4544
     rope_theta: float = 10000.0
+    alibi: bool = False                # falcon-rw family: ALiBi, no rotary
     layer_norm_eps: float = 1e-5
     parallel_attn: bool = True
     new_decoder_architecture: bool = False   # 40b: separate attn/mlp norms
@@ -65,13 +66,18 @@ class FalconAttention(nn.Module):
         q = dense(H * D, "q_proj")(x).reshape(B, T, H, D)
         k = dense(KV * D, "k_proj")(x).reshape(B, T, KV, D)
         v = dense(KV * D, "v_proj")(x).reshape(B, T, KV, D)
-        pos = jnp.arange(T)[None, :]
-        q = apply_rope(q, pos, cfg.rope_theta)
-        k = apply_rope(k, pos, cfg.rope_theta)
+        bias = None
+        if cfg.alibi:
+            from ._lm_utils import alibi_bias
+            bias = alibi_bias(H, T, T).astype(x.dtype)
+        else:
+            pos = jnp.arange(T)[None, :]
+            q = apply_rope(q, pos, cfg.rope_theta)
+            k = apply_rope(k, pos, cfg.rope_theta)
         if KV != H:
             k = jnp.repeat(k, H // KV, axis=2)
             v = jnp.repeat(v, H // KV, axis=2)
-        y = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+        y = jax.nn.dot_product_attention(q, k, v, bias=bias, is_causal=True)
         return dense(C, "dense")(y.reshape(B, T, H * D))
 
 
@@ -135,18 +141,5 @@ class Falcon(nn.Module):
 
 
 def make_model(cfg: FalconConfig):
-    model = Falcon(cfg)
-
-    def init_fn(rng, batch_size: int = 2, seq_len: Optional[int] = None):
-        T = seq_len or min(cfg.max_seq_len, 64)
-        return model.init(rng, jnp.zeros((batch_size, T), jnp.int32))["params"]
-
-    def loss_fn(params, batch, rng):
-        tokens = batch["tokens"]
-        inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits = model.apply({"params": params}, inputs)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return nll.mean()
-
-    return model, init_fn, loss_fn
+    from ._lm_utils import make_causal_lm
+    return make_causal_lm(Falcon(cfg), cfg)

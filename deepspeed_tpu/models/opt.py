@@ -26,6 +26,8 @@ class OPTConfig:
     hidden_size: int = 768
     ffn_dim: int = 3072
     layer_norm_eps: float = 1e-5
+    do_layer_norm_before: bool = True      # False on opt-350m (post-LN)
+    word_embed_proj_dim: Optional[int] = None   # opt-350m: 512 != hidden
     tie_embeddings: bool = True
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -76,15 +78,20 @@ class OPTBlock(nn.Module):
         ln = lambda name: nn.LayerNorm(
             epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name=name)
-        x = x + OPTAttention(cfg, name="self_attn")(
-            ln("self_attn_layer_norm")(x))
-        h = ln("final_layer_norm")(x)
+        attn_ln = ln("self_attn_layer_norm")
+        if cfg.do_layer_norm_before:                  # pre-LN (most OPTs)
+            x = x + OPTAttention(cfg, name="self_attn")(attn_ln(x))
+        else:                                          # post-LN (opt-350m)
+            x = attn_ln(x + OPTAttention(cfg, name="self_attn")(x))
+        mlp_ln = ln("final_layer_norm")
+        h = mlp_ln(x) if cfg.do_layer_norm_before else x
         h = nn.Dense(cfg.ffn_dim, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="fc1")(h)
         h = nn.relu(h)
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="fc2")(h)
-        return x + h
+        x = x + h
+        return x if cfg.do_layer_norm_before else mlp_ln(x)
 
 
 class OPT(nn.Module):
@@ -94,18 +101,27 @@ class OPT(nn.Module):
     def __call__(self, tokens):
         cfg = self.cfg
         B, T = tokens.shape
-        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+        embed_dim = cfg.word_embed_proj_dim or cfg.hidden_size
+        embed = nn.Embed(cfg.vocab_size, embed_dim, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="embed_tokens")
         pos = nn.Embed(cfg.max_seq_len + cfg.POSITION_OFFSET,
                        cfg.hidden_size, dtype=cfg.dtype,
                        param_dtype=cfg.param_dtype, name="embed_positions")
-        x = embed(tokens) + pos(jnp.arange(T) + cfg.POSITION_OFFSET)
+        x = embed(tokens)
+        if embed_dim != cfg.hidden_size:               # opt-350m project_in
+            x = nn.Dense(cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="project_in")(x)
+        x = x + pos(jnp.arange(T) + cfg.POSITION_OFFSET)
         block_cls = nn.remat(OPTBlock) if cfg.remat else OPTBlock
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"layer_{i}")(x)
-        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
-                         param_dtype=cfg.param_dtype,
-                         name="final_layer_norm")(x)
+        if cfg.do_layer_norm_before:                   # post-LN has no final
+            x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                             param_dtype=cfg.param_dtype,
+                             name="final_layer_norm")(x)
+        if embed_dim != cfg.hidden_size:               # opt-350m project_out
+            x = nn.Dense(embed_dim, use_bias=False, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="project_out")(x)
         if cfg.tie_embeddings:
             return embed.attend(x.astype(jnp.float32))
         return nn.Dense(cfg.vocab_size, dtype=jnp.float32,
@@ -114,18 +130,5 @@ class OPT(nn.Module):
 
 
 def make_model(cfg: OPTConfig):
-    model = OPT(cfg)
-
-    def init_fn(rng, batch_size: int = 2, seq_len: Optional[int] = None):
-        T = seq_len or min(cfg.max_seq_len, 64)
-        return model.init(rng, jnp.zeros((batch_size, T), jnp.int32))["params"]
-
-    def loss_fn(params, batch, rng):
-        tokens = batch["tokens"]
-        inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits = model.apply({"params": params}, inputs)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return nll.mean()
-
-    return model, init_fn, loss_fn
+    from ._lm_utils import make_causal_lm
+    return make_causal_lm(OPT(cfg), cfg)

@@ -119,18 +119,5 @@ class Phi(nn.Module):
 
 
 def make_model(cfg: PhiConfig):
-    model = Phi(cfg)
-
-    def init_fn(rng, batch_size: int = 2, seq_len: Optional[int] = None):
-        T = seq_len or min(cfg.max_seq_len, 64)
-        return model.init(rng, jnp.zeros((batch_size, T), jnp.int32))["params"]
-
-    def loss_fn(params, batch, rng):
-        tokens = batch["tokens"]
-        inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits = model.apply({"params": params}, inputs)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return nll.mean()
-
-    return model, init_fn, loss_fn
+    from ._lm_utils import make_causal_lm
+    return make_causal_lm(Phi(cfg), cfg)

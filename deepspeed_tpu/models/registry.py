@@ -94,6 +94,7 @@ def _entry_bert(d):
 
 
 def _entry_opt(d):
+    proj = d.get("word_embed_proj_dim")
     return OPTConfig(
         vocab_size=d.get("vocab_size", 50272),
         max_seq_len=d.get("max_position_embeddings", 2048),
@@ -101,6 +102,9 @@ def _entry_opt(d):
         num_heads=d.get("num_attention_heads", 12),
         hidden_size=d.get("hidden_size", 768),
         ffn_dim=d.get("ffn_dim", 3072),
+        do_layer_norm_before=d.get("do_layer_norm_before", True),
+        word_embed_proj_dim=(proj if proj and
+                             proj != d.get("hidden_size", 768) else None),
         tie_embeddings=d.get("tie_word_embeddings", True))
 
 
@@ -115,6 +119,7 @@ def _entry_falcon(d):
                       else (d.get("num_attention_heads", 71)
                             if not d.get("multi_query", True) else 1)),
         hidden_size=d.get("hidden_size", 4544),
+        alibi=d.get("alibi", False),
         parallel_attn=d.get("parallel_attn", True),
         new_decoder_architecture=new_arch,
         tie_embeddings=d.get("tie_word_embeddings", True))
@@ -139,9 +144,15 @@ def _entry_phi3(d):
 
 
 def _entry_qwen2_moe(d):
-    # qwen2-moe maps onto the mixtral block (per-layer router + experts);
-    # the shared-expert path is folded into the dense residual (approx:
-    # shared_expert_intermediate_size is absorbed by the expert width)
+    # qwen2-moe maps onto the mixtral block (per-layer router + experts).
+    # LIMITATION: the always-on shared-expert branch is NOT modeled; logits
+    # will differ from HF qwen2-moe checkpoints until it is added.
+    if d.get("shared_expert_intermediate_size"):
+        from ..utils.logging import logger
+        logger.warning(
+            "qwen2_moe: shared-expert branch (shared_expert_intermediate_"
+            "size=%s) is not modeled; outputs will differ from the HF "
+            "checkpoint", d["shared_expert_intermediate_size"])
     return MixtralConfig(**_hf_llama(
         d,
         qkv_bias=True,                  # qwen2 family uses biased q/k/v

@@ -168,3 +168,64 @@ class TestRaggedEngineParity:
         eng = InferenceEngineV2(mcfg, params, cfg)
         with pytest.raises((RuntimeError, ValueError)):
             eng.put([0, 1], [[1] * 8, [2] * 8])   # needs 4 blocks, pool has 2
+
+
+class TestWOQRunner:
+    """WOQ int8 weights through the ragged llama runner — dequant fuses
+    inside the jitted step (reference v1 WOQ + v2 quantized_linear class)."""
+
+    def test_woq_llama_generate_close_to_fp(self):
+        from deepspeed_tpu.inference.quantization import quantize_model_params
+        from deepspeed_tpu.models.llama import Llama, LlamaConfig
+        mcfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="xla")
+        model = Llama(mcfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        cfg = RaggedInferenceConfig(max_seqs=2, chunk_size=8, block_size=4,
+                                    num_blocks=64, max_blocks_per_seq=16,
+                                    dtype="float32")
+        prompt = list(np.random.default_rng(3).integers(1, 500, 9))
+
+        eng_fp = InferenceEngineV2(mcfg, params, cfg)
+        ref = eng_fp.generate([prompt], max_new_tokens=5)[0]
+
+        qparams = quantize_model_params(params, {"quantized_weights": {
+            "enabled": True, "num_bits": 8, "group_size": 64,
+            "modules": ["proj"]}})
+        eng_q = InferenceEngineV2(mcfg, qparams, cfg)
+        got = eng_q.generate([prompt], max_new_tokens=5)[0]
+        # int8 WOQ on a random tiny model: trajectories may diverge after a
+        # few greedy steps, but the first next-token prediction must agree
+        assert got[0] == ref[0]
+
+
+class TestEvoformer:
+    def test_bias_shapes_and_grad(self):
+        from deepspeed_tpu.ops.evoformer_attn import DS4Sci_EvoformerAttention
+        B, N, S, H, D = 1, 3, 8, 2, 4
+        key = jax.random.PRNGKey(0)
+        q = jax.random.normal(key, (B, N, S, H, D))
+        k = jax.random.normal(jax.random.PRNGKey(1), (B, N, S, H, D))
+        v = jax.random.normal(jax.random.PRNGKey(2), (B, N, S, H, D))
+        mask_bias = jnp.zeros((B, N, 1, 1, S)).at[..., -2:].set(-1e9)
+        pair_bias = jax.random.normal(jax.random.PRNGKey(3), (B, 1, H, S, S))
+        out = DS4Sci_EvoformerAttention(q, k, v, [mask_bias, pair_bias])
+        assert out.shape == (B, N, S, H, D)
+        # masked keys contribute nothing
+        v2 = v.at[:, :, -2:].add(100.0)
+        out2 = DS4Sci_EvoformerAttention(q, k, v2, [mask_bias, pair_bias])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
+                                   atol=1e-4)
+        # differentiable through biases
+        g = jax.grad(lambda pb: DS4Sci_EvoformerAttention(
+            q, k, v, [mask_bias, pb]).sum())(pair_bias)
+        assert np.isfinite(np.asarray(g)).all() and np.abs(g).max() > 0
+
+    def test_softmax_normalization(self):
+        from deepspeed_tpu.ops.evoformer_attn import DS4Sci_EvoformerAttention
+        # constant V: attention output must equal V regardless of biases
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 6, 2, 4))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 6, 2, 4))
+        v = jnp.ones((1, 2, 6, 2, 4)) * 2.5
+        out = DS4Sci_EvoformerAttention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), 2.5, rtol=1e-5)

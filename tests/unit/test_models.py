@@ -292,3 +292,31 @@ class TestNewArchFamilies:
             losses.append(float(engine.train_batch(
                 {"tokens": jnp.asarray(seq, jnp.int32)})))
         assert losses[-1] < losses[0]
+
+    def test_opt_350m_and_falcon_alibi_variants(self):
+        from deepspeed_tpu.models.opt import OPT, OPTConfig
+        from deepspeed_tpu.models.falcon import Falcon, FalconConfig
+        cfg = OPTConfig.tiny(dtype=jnp.float32, do_layer_norm_before=False,
+                             word_embed_proj_dim=32)
+        model = OPT(cfg)
+        p = model.init(jax.random.PRNGKey(0),
+                       jnp.zeros((1, 8), jnp.int32))["params"]
+        assert "project_in" in p and "project_out" in p
+        out = model.apply({"params": p}, jnp.zeros((1, 8), jnp.int32))
+        assert out.shape == (1, 8, cfg.vocab_size)
+
+        fcfg = FalconConfig.tiny(dtype=jnp.float32, alibi=True)
+        fmodel = Falcon(fcfg)
+        fp = fmodel.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+        out = fmodel.apply({"params": fp}, jnp.zeros((1, 8), jnp.int32))
+        assert np.isfinite(np.asarray(out)).all()
+
+        from deepspeed_tpu.models.registry import config_from_hf
+        _, c = config_from_hf({"model_type": "opt", "hidden_size": 64,
+                               "word_embed_proj_dim": 32,
+                               "do_layer_norm_before": False})
+        assert c.word_embed_proj_dim == 32 and not c.do_layer_norm_before
+        _, c = config_from_hf({"model_type": "falcon", "alibi": True,
+                               "num_attention_heads": 4, "hidden_size": 64})
+        assert c.alibi
