@@ -1,0 +1,243 @@
+"""HuggingFace checkpoint loading — torch/safetensors → param pytrees.
+
+Parity with the reference's checkpoint-ingestion surface: the v2 engine
+factory streams HF shards (``inference/v2/checkpoint/huggingface_engine.py``,
+``build_hf_engine``), v1 loads sharded ``.bin``/``.safetensors`` files
+(``module_inject/load_checkpoint.py``, ``state_dict_factory.py``), and
+SURVEY.md §7 hard-part 6 calls out torch-format interop explicitly.
+
+Pieces:
+  - a dependency-free **safetensors reader** (the format is a JSON header +
+    raw little-endian tensor bytes — no torch needed);
+  - a ``.bin`` path via ``torch.load`` (torch-cpu is available; weights are
+    converted to numpy immediately);
+  - per-architecture **name maps** from HF module paths to this framework's
+    flax param paths, with the torch→flax transpose on linear kernels.
+
+Entry points:
+    state = load_hf_state_dict(model_dir)            # {hf_name: np.ndarray}
+    params = convert_hf_state(arch, state)           # framework pytree
+    arch, cfg, params = load_hf_model(model_dir)     # all of the above
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+
+_SAFETENSORS_DTYPES = {
+    "F64": np.float64, "F32": np.float32, "F16": np.float16,
+    "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
+    "U8": np.uint8, "BOOL": np.bool_,
+    # BF16 has no numpy dtype pre-ml_dtypes; widened to f32 on read
+    "BF16": None,
+}
+
+
+def read_safetensors(path: str) -> Dict[str, np.ndarray]:
+    """Minimal pure-python safetensors reader."""
+    out = {}
+    with open(path, "rb") as f:
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen))
+        base = 8 + hlen
+        for name, meta in header.items():
+            if name == "__metadata__":
+                continue
+            dt = meta["dtype"]
+            if dt not in _SAFETENSORS_DTYPES:
+                raise ValueError(f"unsupported safetensors dtype {dt}")
+            start, end = meta["data_offsets"]
+            f.seek(base + start)
+            raw = f.read(end - start)
+            if dt == "BF16":
+                u16 = np.frombuffer(raw, dtype=np.uint16)
+                arr = (u16.astype(np.uint32) << 16).view(np.float32)
+            else:
+                arr = np.frombuffer(raw, dtype=_SAFETENSORS_DTYPES[dt])
+            out[name] = arr.reshape(meta["shape"]).copy()
+    return out
+
+
+def _read_torch_bin(path: str) -> Dict[str, np.ndarray]:
+    import torch
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    return {k: v.to(torch.float32).numpy() if v.dtype == torch.bfloat16
+            else v.numpy() for k, v in sd.items()}
+
+
+def load_hf_state_dict(model_dir: str) -> Dict[str, np.ndarray]:
+    """Read all weight shards of an HF checkpoint directory."""
+    files = sorted(os.listdir(model_dir))
+    shards = [f for f in files if f.endswith(".safetensors")]
+    if shards:
+        out = {}
+        for s in shards:
+            out.update(read_safetensors(os.path.join(model_dir, s)))
+        return out
+    bins = [f for f in files
+            if f.endswith(".bin") and f.startswith("pytorch_model")]
+    if bins:
+        out = {}
+        for b in bins:
+            out.update(_read_torch_bin(os.path.join(model_dir, b)))
+        return out
+    raise FileNotFoundError(
+        f"no .safetensors or pytorch_model*.bin shards in {model_dir}")
+
+
+# --------------------------------------------------------------------------- #
+# name mapping
+# --------------------------------------------------------------------------- #
+
+# HF-path regex -> (framework path template, kind)
+# kind: "linear" (transpose [out,in]->[in,out]), "embed", "vector"
+_LLAMA_MAP = [
+    (r"model\.embed_tokens\.weight", "embed/embedding", "embed"),
+    (r"model\.norm\.weight", "final_norm/scale", "vector"),
+    (r"lm_head\.weight", "lm_head/kernel", "linear"),
+    (r"model\.layers\.(\d+)\.input_layernorm\.weight",
+     "layer_{0}/input_norm/scale", "vector"),
+    (r"model\.layers\.(\d+)\.post_attention_layernorm\.weight",
+     "layer_{0}/post_attn_norm/scale", "vector"),
+    (r"model\.layers\.(\d+)\.self_attn\.(q|k|v|o)_proj\.weight",
+     "layer_{0}/attn/{1}_proj/kernel", "linear"),
+    (r"model\.layers\.(\d+)\.self_attn\.(q|k|v)_proj\.bias",
+     "layer_{0}/attn/{1}_proj/bias", "vector"),
+    (r"model\.layers\.(\d+)\.mlp\.(gate|up|down)_proj\.weight",
+     "layer_{0}/mlp/{1}_proj/kernel", "linear"),
+]
+
+_OPT_MAP = [
+    (r"(?:model\.)?decoder\.embed_tokens\.weight", "embed_tokens/embedding",
+     "embed"),
+    (r"(?:model\.)?decoder\.embed_positions\.weight",
+     "embed_positions/embedding", "embed"),
+    (r"(?:model\.)?decoder\.final_layer_norm\.(weight|bias)",
+     "final_layer_norm/{w:scale,b:bias}", "vector"),
+    (r"(?:model\.)?decoder\.project_in\.weight", "project_in/kernel",
+     "linear"),
+    (r"(?:model\.)?decoder\.project_out\.weight", "project_out/kernel",
+     "linear"),
+    (r"(?:model\.)?decoder\.layers\.(\d+)\.self_attn\.(q|k|v|out)_proj\.weight",
+     "layer_{0}/self_attn/{1}_proj/kernel", "linear"),
+    (r"(?:model\.)?decoder\.layers\.(\d+)\.self_attn\.(q|k|v|out)_proj\.bias",
+     "layer_{0}/self_attn/{1}_proj/bias", "vector"),
+    (r"(?:model\.)?decoder\.layers\.(\d+)\.self_attn_layer_norm\.(weight|bias)",
+     "layer_{0}/self_attn_layer_norm/{w:scale,b:bias}", "vector"),
+    (r"(?:model\.)?decoder\.layers\.(\d+)\.final_layer_norm\.(weight|bias)",
+     "layer_{0}/final_layer_norm/{w:scale,b:bias}", "vector"),
+    (r"(?:model\.)?decoder\.layers\.(\d+)\.fc(1|2)\.weight",
+     "layer_{0}/fc{1}/kernel", "linear"),
+    (r"(?:model\.)?decoder\.layers\.(\d+)\.fc(1|2)\.bias",
+     "layer_{0}/fc{1}/bias", "vector"),
+]
+
+_GPT2_MAP = [
+    (r"(?:transformer\.)?wte\.weight", "wte/embedding", "embed"),
+    (r"(?:transformer\.)?wpe\.weight", "wpe/embedding", "embed"),
+    (r"(?:transformer\.)?ln_f\.(weight|bias)",
+     "ln_f/{w:scale,b:bias}", "vector"),
+    # HF GPT-2 Conv1D weights are ALREADY [in, out] — no transpose
+    (r"(?:transformer\.)?h\.(\d+)\.ln_(1|2)\.(weight|bias)",
+     "h_{0}/ln_{1}/{w:scale,b:bias}", "vector"),
+    (r"(?:transformer\.)?h\.(\d+)\.attn\.c_attn\.(weight|bias)",
+     "h_{0}/attn/c_attn/{w:kernel,b:bias}", "conv1d"),
+    (r"(?:transformer\.)?h\.(\d+)\.attn\.c_proj\.(weight|bias)",
+     "h_{0}/attn/c_proj/{w:kernel,b:bias}", "conv1d"),
+    (r"(?:transformer\.)?h\.(\d+)\.mlp\.c_fc\.(weight|bias)",
+     "h_{0}/mlp/c_fc/{w:kernel,b:bias}", "conv1d"),
+    (r"(?:transformer\.)?h\.(\d+)\.mlp\.c_proj\.(weight|bias)",
+     "h_{0}/mlp/c_proj/{w:kernel,b:bias}", "conv1d"),
+]
+
+ARCH_MAPS = {
+    "llama": _LLAMA_MAP,
+    "mistral": _LLAMA_MAP,
+    "qwen2": _LLAMA_MAP,
+    "phi3": _LLAMA_MAP,
+    "opt": _OPT_MAP,
+    "gpt2": _GPT2_MAP,
+}
+
+
+def _fw_path(template: str, groups: Tuple[str, ...]) -> str:
+    """Expand a map template: {N} positional groups and the
+    {w:scale,b:bias} weight/bias selector."""
+    out = template
+    for i, g in enumerate(groups):
+        out = out.replace("{" + str(i) + "}", g)
+    m = re.search(r"\{w:([^,]+),b:([^}]+)\}", out)
+    if m:
+        which = groups[-1]
+        out = out[:m.start()] + (m.group(1) if which.startswith("w")
+                                 else m.group(2)) + out[m.end():]
+    return out
+
+
+#: non-parameter tensors present in real Hub checkpoints — skipped silently
+_IGNORED_TENSORS = re.compile(
+    r".*\.(attn\.bias|attn\.masked_bias|rotary_emb\.inv_freq)$")
+
+
+def convert_hf_state(arch: str, state: Dict[str, np.ndarray],
+                     strict: bool = True) -> Dict[str, Any]:
+    """Map an HF state dict onto this framework's nested param dict."""
+    if arch not in ARCH_MAPS:
+        raise ValueError(f"no HF name map for architecture '{arch}' "
+                         f"(have {sorted(ARCH_MAPS)})")
+    rules = [(re.compile(pat + r"$"), tmpl, kind)
+             for pat, tmpl, kind in ARCH_MAPS[arch]]
+    params: Dict[str, Any] = {}
+    unmapped = []
+    for name, arr in state.items():
+        if _IGNORED_TENSORS.match(name):
+            continue
+        if arch == "gpt2" and name.endswith("lm_head.weight"):
+            continue                      # tied duplicate of wte
+        hit = None
+        for rx, tmpl, kind in rules:
+            m = rx.match(name)
+            if m:
+                hit = (_fw_path(tmpl, m.groups() + (name.split(".")[-1],)),
+                       kind)
+                break
+        if hit is None:
+            unmapped.append(name)
+            continue
+        path, kind = hit
+        if kind == "linear" and arr.ndim == 2:
+            arr = arr.T                      # torch [out,in] -> flax [in,out]
+        node = params
+        parts = path.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = np.ascontiguousarray(arr)
+    if unmapped:
+        msg = (f"{len(unmapped)} HF tensors had no mapping for '{arch}': "
+               f"{unmapped[:5]}{'...' if len(unmapped) > 5 else ''}")
+        if strict:
+            raise ValueError(msg)
+        logger.warning(msg)
+    return params
+
+
+def load_hf_model(model_dir: str, strict: bool = True):
+    """(arch, model_config, params) from an HF checkpoint directory."""
+    from ..models.registry import config_from_hf
+    with open(os.path.join(model_dir, "config.json")) as f:
+        hf_cfg = json.load(f)
+    arch, cfg = config_from_hf(hf_cfg)
+    state = load_hf_state_dict(model_dir)
+    params = convert_hf_state(arch, state, strict=strict)
+    n = sum(int(np.prod(a.shape)) for a in state.values())
+    log_dist(f"loaded HF checkpoint {model_dir}: arch={arch}, "
+             f"{n / 1e6:.1f}M params")
+    return arch, cfg, params

@@ -34,7 +34,7 @@ class RaggedBatch(NamedTuple):
     block_tables: jnp.ndarray  # [S, MAXB] int32 (padded with 0)
 
 
-def _layer_norm(x, p, eps=1e-6):   # flax nn.LayerNorm default epsilon
+def _layer_norm(x, p, eps=1e-5):   # GPT2Config.layer_norm_eps default
     mu = jnp.mean(x, -1, keepdims=True)
     var = jnp.var(x, -1, keepdims=True)
     return (x - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
@@ -94,7 +94,7 @@ def _gpt2_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: GPT2Config,
 
     for li in range(model_cfg.num_layers):
         p = params[f"h_{li}"]
-        h = _layer_norm(x.astype(jnp.float32), p["ln_1"]).astype(dtype)
+        h = _layer_norm(x.astype(jnp.float32), p["ln_1"], model_cfg.layer_norm_eps).astype(dtype)
         qkv = h @ p["attn"]["c_attn"]["kernel"].astype(dtype)
         if "bias" in p["attn"]["c_attn"]:
             qkv = qkv + p["attn"]["c_attn"]["bias"].astype(dtype)
@@ -124,7 +124,7 @@ def _gpt2_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: GPT2Config,
             y = y + p["attn"]["c_proj"]["bias"].astype(dtype)
         x = x + y
 
-        h = _layer_norm(x.astype(jnp.float32), p["ln_2"]).astype(dtype)
+        h = _layer_norm(x.astype(jnp.float32), p["ln_2"], model_cfg.layer_norm_eps).astype(dtype)
         m = h @ p["mlp"]["c_fc"]["kernel"].astype(dtype)
         if "bias" in p["mlp"]["c_fc"]:
             m = m + p["mlp"]["c_fc"]["bias"].astype(dtype)
@@ -134,7 +134,7 @@ def _gpt2_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: GPT2Config,
             m = m + p["mlp"]["c_proj"]["bias"].astype(dtype)
         x = x + m
 
-    x = _layer_norm(x.astype(jnp.float32), params["ln_f"])
+    x = _layer_norm(x.astype(jnp.float32), params["ln_f"], model_cfg.layer_norm_eps)
 
     # logits_gather: only each slot's last valid token
     last = jnp.maximum(batch.n_tokens - 1, 0)               # [S]

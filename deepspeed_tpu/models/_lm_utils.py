@@ -28,12 +28,15 @@ def make_causal_lm(model, cfg):
 
 
 def alibi_slopes(num_heads: int) -> jnp.ndarray:
-    """ALiBi per-head slopes (geometric, Press et al.)."""
+    """ALiBi per-head slopes (Press et al.): geometric schedule over the
+    nearest power of two, with ODD multiples from the 2p schedule filling
+    the remainder (so extra slopes interleave, never duplicate)."""
     import math
     p = 2 ** math.floor(math.log2(num_heads))
     base = [2 ** (-8.0 * (i + 1) / p) for i in range(p)]
     if p < num_heads:
-        extra = [2 ** (-4.0 * (i + 1) / p) for i in range(num_heads - p)]
+        extra = [2 ** (-4.0 * (2 * i + 1) / p)
+                 for i in range(num_heads - p)]
         base = base + extra
     return jnp.asarray(base[:num_heads], jnp.float32)
 

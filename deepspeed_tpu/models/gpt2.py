@@ -34,6 +34,7 @@ class GPT2Config:
     param_dtype: Any = jnp.float32
     remat: bool = False
     use_bias: bool = True
+    layer_norm_eps: float = 1e-5   # HF GPT-2 epsilon
     # "auto": Pallas flash attention on TPU, XLA fused attention elsewhere;
     # "flash" / "xla" force one path.
     attention_impl: str = "auto"
@@ -122,9 +123,9 @@ class Block(nn.Module):
     def __call__(self, x, deterministic: bool = True):
         cfg = self.cfg
         x = x + CausalSelfAttention(cfg, name="attn")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x), deterministic)
+            nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="ln_1")(x), deterministic)
         x = x + MLP(cfg, name="mlp")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x), deterministic)
+            nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="ln_2")(x), deterministic)
         return x
 
 
@@ -145,7 +146,7 @@ class GPT2(nn.Module):
             block_cls = nn.remat(Block, static_argnums=(2,))
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
-        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="ln_f")(x)
         # tied embedding unembed (GPT-2 ties wte)
         logits = wte.attend(x.astype(jnp.float32))
         return logits

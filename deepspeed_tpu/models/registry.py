@@ -78,7 +78,8 @@ def _entry_gpt2(d):
         max_seq_len=d.get("n_positions", 1024),
         num_layers=d.get("n_layer", 12),
         num_heads=d.get("n_head", 12),
-        hidden_size=d.get("n_embd", 768))
+        hidden_size=d.get("n_embd", 768),
+        layer_norm_eps=d.get("layer_norm_epsilon", 1e-5))
 
 
 def _entry_bert(d):
