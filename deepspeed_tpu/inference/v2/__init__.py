@@ -12,6 +12,7 @@ lengths), never in array shapes.
 
 from .blocked_allocator import BlockedAllocator
 from .config import RaggedInferenceConfig
+from .engine_factory import build_hf_engine
 from .engine_v2 import InferenceEngineV2
 from .kv_cache import BlockedKVCache
 from .sequence import SequenceDescriptor, SequenceStatus
