@@ -168,6 +168,39 @@ ARCH_MAPS = {
 }
 
 
+def _split_phi3_fused(state: Dict[str, np.ndarray],
+                      hf_cfg: Dict) -> Dict[str, np.ndarray]:
+    """Phi-3 stores fused qkv_proj / gate_up_proj; split them to the
+    llama-style unfused names so _LLAMA_MAP applies (same math)."""
+    heads = int(hf_cfg["num_attention_heads"])
+    kv = int(hf_cfg.get("num_key_value_heads", heads))
+    hidden = int(hf_cfg["hidden_size"])
+    d = hidden // heads
+    out = {}
+    for name, arr in state.items():
+        m = re.match(r"(model\.layers\.\d+\.self_attn)\.qkv_proj\.weight$",
+                     name)
+        if m:
+            q, k, v = np.split(arr, [heads * d, heads * d + kv * d], axis=0)
+            out[f"{m.group(1)}.q_proj.weight"] = q
+            out[f"{m.group(1)}.k_proj.weight"] = k
+            out[f"{m.group(1)}.v_proj.weight"] = v
+            continue
+        m = re.match(r"(model\.layers\.\d+\.mlp)\.gate_up_proj\.weight$",
+                     name)
+        if m:
+            gate, up = np.split(arr, 2, axis=0)
+            out[f"{m.group(1)}.gate_proj.weight"] = gate
+            out[f"{m.group(1)}.up_proj.weight"] = up
+            continue
+        out[name] = arr
+    return out
+
+
+#: pre-conversion transforms keyed by arch (fused-tensor splitting etc.)
+SPECIAL_HANDLERS = {"phi3": _split_phi3_fused}
+
+
 def _fw_path(template: str, groups: Tuple[str, ...]) -> str:
     """Expand a map template: {N} positional groups and the
     {w:scale,b:bias} weight/bias selector."""
@@ -235,7 +268,13 @@ def load_hf_model(model_dir: str, strict: bool = True):
     with open(os.path.join(model_dir, "config.json")) as f:
         hf_cfg = json.load(f)
     arch, cfg = config_from_hf(hf_cfg)
+    if arch not in ARCH_MAPS:
+        # fail BEFORE reading multi-GB shards
+        raise ValueError(f"no HF name map for architecture '{arch}' "
+                         f"(have {sorted(ARCH_MAPS)})")
     state = load_hf_state_dict(model_dir)
+    if arch in SPECIAL_HANDLERS:
+        state = SPECIAL_HANDLERS[arch](state, hf_cfg)
     params = convert_hf_state(arch, state, strict=strict)
     n = sum(int(np.prod(a.shape)) for a in state.values())
     log_dist(f"loaded HF checkpoint {model_dir}: arch={arch}, "

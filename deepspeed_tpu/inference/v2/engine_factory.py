@@ -19,9 +19,11 @@ from ...utils.logging import log_dist
 from .config import RaggedInferenceConfig
 from .engine_v2 import InferenceEngineV2
 
-#: arches with a ragged paged-KV runner (others raise with a clear message)
-_RAGGED_ARCHES = {"llama", "mistral", "qwen2", "phi3", "mixtral",
-                  "qwen2_moe", "gpt2"}
+#: arches whose HF weights map exactly AND that have a ragged runner.
+#: (mixtral/qwen2_moe RUN on the ragged path with in-framework params, but
+#: their HF expert layout — per-expert SwiGLU triples — does not map onto
+#: this framework's stacked 2-matrix experts, so HF loading is excluded.)
+_RAGGED_ARCHES = {"llama", "mistral", "qwen2", "phi3", "gpt2"}
 
 
 def build_hf_engine(model_dir: str,
@@ -34,12 +36,17 @@ def build_hf_engine(model_dir: str,
     ``quantization_mode``: None | "wf8" (int8 WOQ) | "wf4" (int4 WOQ) —
     mirrors the reference's quantization-mode string.
     """
-    arch, model_cfg, params = load_hf_model(model_dir, strict=strict)
-    if arch not in _RAGGED_ARCHES:
+    import json
+    import os
+    with open(os.path.join(model_dir, "config.json")) as f:
+        arch_name = json.load(f).get("model_type", "").lower()
+    if arch_name not in _RAGGED_ARCHES:
+        # fail BEFORE reading the (possibly multi-GB) weight shards
         raise ValueError(
-            f"architecture '{arch}' has no ragged runner yet (have "
-            f"{sorted(_RAGGED_ARCHES)}); use the v1 engine or the hybrid "
-            "engine's generate for this model")
+            f"architecture '{arch_name}' is not servable via build_hf_engine "
+            f"(have {sorted(_RAGGED_ARCHES)}); load params yourself and use "
+            "InferenceEngineV2 / the v1 engine / hybrid generate")
+    arch, model_cfg, params = load_hf_model(model_dir, strict=strict)
     if dtype is not None:
         model_cfg = dataclasses.replace(model_cfg,
                                         dtype=resolve_dtype(dtype))

@@ -141,7 +141,18 @@ def _llama_ragged_step(params, kv, batch: RaggedBatch, *,
         h = _rms(x, p["post_attn_norm"]["scale"],
                  model_cfg.rms_eps).astype(dtype)
         if is_moe:
-            x = x + _moe_mlp(p["moe"], h, model_cfg, dtype)
+            y = _moe_mlp(p["moe"], h, model_cfg, dtype)
+            if getattr(model_cfg, "shared_expert_size", 0):
+                # qwen2-moe always-on shared expert (sigmoid scalar gate)
+                gate = h @ p["shared_gate_proj"]["kernel"].astype(dtype)
+                up = h @ p["shared_up_proj"]["kernel"].astype(dtype)
+                shared = (jax.nn.silu(gate) * up) @ \
+                    p["shared_down_proj"]["kernel"].astype(dtype)
+                sg = jax.nn.sigmoid(
+                    (h @ p["shared_expert_gate"]["kernel"].astype(dtype)
+                     ).astype(jnp.float32))
+                y = y + shared * sg.astype(dtype)
+            x = x + y
         else:
             pm = p["mlp"]
             gate = h @ pm["gate_proj"]["kernel"].astype(dtype)

@@ -28,6 +28,7 @@ class MixtralConfig(LlamaConfig):
     capacity_factor: float = 2.0
     drop_tokens: bool = False          # mixtral routes all tokens
     router_aux_loss_coef: float = 0.02
+    shared_expert_size: int = 0        # qwen2-moe always-on expert width
 
     @staticmethod
     def tiny(**kw):
@@ -69,6 +70,19 @@ class MixtralBlock(nn.Module):
             drop_tokens=cfg.drop_tokens, ep_mesh=self.ep_mesh,
             dtype=cfg.dtype, activation=nn.silu, name="moe")(x=h, train=train)
         self.sow("losses", "moe_aux", l_aux)
+        if cfg.shared_expert_size:
+            # qwen2-moe: an always-on SwiGLU expert gated by a sigmoid
+            # (HF Qwen2MoeSparseMoeBlock shared_expert + shared_expert_gate)
+            dense = lambda feats, name: nn.Dense(  # noqa: E731
+                feats, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                use_bias=False, name=name)
+            gate = dense(cfg.shared_expert_size, "shared_gate_proj")(h)
+            up = dense(cfg.shared_expert_size, "shared_up_proj")(h)
+            shared = dense(cfg.hidden_size, "shared_down_proj")(
+                nn.silu(gate) * up)
+            sgate = jax.nn.sigmoid(
+                dense(1, "shared_expert_gate")(h).astype(jnp.float32))
+            y = y + shared * sgate.astype(cfg.dtype)
         return x + y
 
 

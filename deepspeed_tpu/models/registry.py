@@ -145,15 +145,7 @@ def _entry_phi3(d):
 
 
 def _entry_qwen2_moe(d):
-    # qwen2-moe maps onto the mixtral block (per-layer router + experts).
-    # LIMITATION: the always-on shared-expert branch is NOT modeled; logits
-    # will differ from HF qwen2-moe checkpoints until it is added.
-    if d.get("shared_expert_intermediate_size"):
-        from ..utils.logging import logger
-        logger.warning(
-            "qwen2_moe: shared-expert branch (shared_expert_intermediate_"
-            "size=%s) is not modeled; outputs will differ from the HF "
-            "checkpoint", d["shared_expert_intermediate_size"])
+    # qwen2-moe = mixtral block + an always-on sigmoid-gated shared expert
     return MixtralConfig(**_hf_llama(
         d,
         qkv_bias=True,                  # qwen2 family uses biased q/k/v
@@ -161,6 +153,7 @@ def _entry_qwen2_moe(d):
                                 d.get("intermediate_size", 11008)),
         num_experts=d.get("num_experts", 8),
         experts_top_k=d.get("num_experts_per_tok", 2),
+        shared_expert_size=d.get("shared_expert_intermediate_size", 0),
         router_aux_loss_coef=d.get("router_aux_loss_coef", 0.001)))
 
 

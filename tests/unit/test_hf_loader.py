@@ -203,3 +203,57 @@ class TestBuildHfEngine:
         transformers.BertModel(hf_cfg).save_pretrained(tmp_path)
         with pytest.raises(ValueError):
             build_hf_engine(str(tmp_path))
+
+
+class TestPhi3Parity:
+    def test_fused_tensors_split_and_logits_match(self, tmp_path):
+        hf_cfg = transformers.Phi3Config(
+            vocab_size=96, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            pad_token_id=0, tie_word_embeddings=False)
+        hf_model = transformers.Phi3ForCausalLM(hf_cfg).eval()
+        hf_model.save_pretrained(tmp_path)
+        arch, cfg, params = load_hf_model(str(tmp_path))
+        assert arch == "phi3"
+        cfg = dataclasses.replace(cfg, dtype=jnp.float32,
+                                  param_dtype=jnp.float32,
+                                  attention_impl="xla", qkv_bias=False)
+        from deepspeed_tpu.models.llama import Llama
+        model = Llama(cfg)
+        tokens = np.random.RandomState(3).randint(0, 96, size=(1, 10))
+        ours = model.apply({"params": params},
+                           jnp.asarray(tokens, jnp.int32))
+        with torch.no_grad():
+            theirs = hf_model(torch.tensor(tokens)).logits
+        _logit_match(ours, theirs)
+
+
+class TestQwen2MoeRaggedRunner:
+    def test_shared_expert_in_ragged_decode(self):
+        """In-framework qwen2-moe params: ragged decode matches the full
+        forward (shared expert included)."""
+        from deepspeed_tpu.inference.v2 import (
+            InferenceEngineV2, RaggedInferenceConfig)
+        from deepspeed_tpu.models.mixtral import Mixtral, MixtralConfig
+        cfg = dataclasses.replace(
+            MixtralConfig.tiny(num_experts=2, shared_expert_size=24),
+            dtype=jnp.float32, param_dtype=jnp.float32,
+            attention_impl="xla", drop_tokens=False)
+        model = Mixtral(cfg)
+        params = model.init(
+            {"params": jax.random.PRNGKey(0),
+             "gating": jax.random.PRNGKey(0)},
+            jnp.zeros((1, 8), jnp.int32))["params"]
+        eng = InferenceEngineV2(cfg, params, RaggedInferenceConfig(
+            max_seqs=2, chunk_size=8, block_size=4, num_blocks=64,
+            max_blocks_per_seq=16, dtype="float32"))
+        prompt = list(np.random.RandomState(0).randint(1, 500, 9))
+        gen = eng.generate([prompt], max_new_tokens=4)[0]
+        toks = list(prompt)
+        for _ in range(4):
+            logits = model.apply({"params": params},
+                                 jnp.asarray([toks], jnp.int32),
+                                 train=False, rngs={"gating": jax.random.PRNGKey(0)})
+            toks.append(int(jnp.argmax(logits[0, -1])))
+        assert gen == toks[len(prompt):]

@@ -144,3 +144,37 @@ def test_moe_invalid_expert_split():
     x = jnp.ones((4, 4, 16))
     with pytest.raises(ValueError):
         layer.init(jax.random.PRNGKey(0), x)
+
+
+def test_qwen2_moe_shared_expert():
+    """qwen2-moe: the always-on shared expert contributes and trains
+    (reference v2 qwen_v2_moe containers)."""
+    import numpy as np
+    from deepspeed_tpu.models.mixtral import Mixtral, MixtralConfig, make_model
+    from deepspeed_tpu.models.registry import config_from_hf
+    arch, cfg = config_from_hf({
+        "model_type": "qwen2_moe", "vocab_size": 64, "hidden_size": 32,
+        "num_hidden_layers": 1, "num_attention_heads": 2,
+        "num_key_value_heads": 2, "moe_intermediate_size": 16,
+        "num_experts": 4, "num_experts_per_tok": 2,
+        "shared_expert_intermediate_size": 24,
+        "max_position_embeddings": 64})
+    assert cfg.shared_expert_size == 24
+    import dataclasses
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32, param_dtype=jnp.float32,
+                              attention_impl="xla")
+    model, init_fn, loss_fn = make_model(cfg)
+    params = init_fn(jax.random.PRNGKey(0), batch_size=2, seq_len=8)
+    layer = params["layer_0"]
+    assert "shared_gate_proj" in layer and "shared_expert_gate" in layer
+    loss = float(loss_fn(params, {"tokens": jnp.ones((2, 9), jnp.int32)},
+                         jax.random.PRNGKey(0)))
+    assert np.isfinite(loss)
+    # the shared expert changes outputs (zeroing it perturbs the loss)
+    zeroed = jax.tree_util.tree_map(lambda x: x, params)
+    zeroed["layer_0"] = dict(zeroed["layer_0"])
+    zeroed["layer_0"]["shared_down_proj"] = {
+        "kernel": jnp.zeros_like(layer["shared_down_proj"]["kernel"])}
+    loss2 = float(loss_fn(zeroed, {"tokens": jnp.ones((2, 9), jnp.int32)},
+                          jax.random.PRNGKey(0)))
+    assert loss != loss2
