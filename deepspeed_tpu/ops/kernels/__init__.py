@@ -16,7 +16,10 @@ def default_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-from .flash_attention import flash_attention  # noqa: E402,F401
+from .flash_attention import (  # noqa: E402,F401
+    flash_attention,
+    flash_attention_sparse,
+)
 from .normalization import fused_layer_norm, fused_rms_norm  # noqa: E402,F401
 from .quantization import (  # noqa: E402,F401
     dequantize_blockwise,

@@ -58,11 +58,6 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        s = s * sm_scale
         col = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = col < kv_len
@@ -70,21 +65,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             row = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             mask = jnp.logical_and(mask, row + causal_offset >= col)
-        s = jnp.where(mask, s, _NEG_INF)
-
-        m_prev = m_scr[:]                                   # (bq, LANES)
-        l_prev = l_scr[:]
-        m_cur = jnp.max(s, axis=1, keepdims=True)           # (bq, 1)
-        m_next = jnp.maximum(m_prev, m_cur)                 # (bq, LANES)
-        alpha = jnp.exp(m_prev - m_next)
-        p = jnp.exp(s - m_next[:, :1])                      # (bq, bk)
-        l_scr[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        m_scr[:] = m_next
-
-        v = v_ref[0, 0]
-        pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
+        _online_softmax_block(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+                              mask, sm_scale)
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -96,6 +78,146 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         # block shape Mosaic-tileable ((block_q, 1) is legal; (1, block_q)
         # as the last two dims of a 3-D block is not).
         lse_ref[0, 0] = (m_scr[:, :1] + jnp.log(l_safe))
+
+
+# --------------------------------------------------------------------------- #
+# block-sparse variant: a (h, nq, nk) int32 layout in SMEM (scalar prefetch)
+# gates each grid step — masked blocks skip the MXU work entirely (the
+# "splash"-style sparsity path used by ops/sparse_attention.py)
+# --------------------------------------------------------------------------- #
+
+
+def _online_softmax_block(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+                          s_mask, sm_scale):
+    """One flash block update (shared by the dense and sparse kernels):
+    scores for the current (q, k) tile, ``s_mask`` applied, online-softmax
+    accumulators advanced."""
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+    s = jnp.where(s_mask, s, _NEG_INF)
+    m_prev = m_scr[:]
+    l_prev = l_scr[:]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_next = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_next)
+    p = jnp.exp(s - m_next[:, :1])
+    l_scr[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    m_scr[:] = m_next
+    v = v_ref[0, 0]
+    pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
+
+
+def _fwd_sparse_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref,
+                       m_scr, l_scr, acc_scr, *, sm_scale, block_q, block_k,
+                       kv_len, nq, nk):
+    hi = pl.program_id(1)
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, _NEG_INF, m_scr.dtype)
+        l_scr[:] = jnp.zeros(l_scr.shape, l_scr.dtype)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, acc_scr.dtype)
+
+    run = mask_ref[hi * nq * nk + qi * nk + ki] > 0
+
+    @pl.when(run)
+    def _compute():
+        col = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        _online_softmax_block(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+                              col < kv_len, sm_scale)
+
+    @pl.when(ki == pl.num_programs(3) - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def _fwd_sparse(q, k, v, block_mask, sm_scale, block_q, block_k, kv_len,
+                interpret):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    nq, nk = tq // block_q, tk // block_k
+    kernel = functools.partial(
+        _fwd_sparse_kernel, sm_scale=sm_scale, block_q=block_q,
+        block_k=block_k, kv_len=kv_len, nq=nq, nk=nk)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b, h, i, j, *_: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, i, j, *_: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, i, j, *_: (b, h, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b, h, i, j, *_: (b, h, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(block_mask.reshape(-1).astype(jnp.int32), q, k, v)
+
+
+def flash_attention_sparse(q, k, v, block_mask, *, sm_scale=None,
+                           block_q: int = 128, block_k: int = 128,
+                           layout: str = "BTHD",
+                           interpret: Optional[bool] = None):
+    """Block-sparse flash attention (forward): ``block_mask`` is a
+    (heads, ceil(T/block_q), ceil(T/block_k)) boolean/int layout — masked
+    blocks are skipped on the MXU. Used by ops/sparse_attention.py when the
+    layout sparsity pays for the kernel switch. Inference-oriented (no VJP);
+    training paths use the masked XLA attention."""
+    if interpret is None:
+        from . import default_interpret
+        interpret = default_interpret()
+    if layout == "BTHD":
+        q, k, v = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    elif layout != "BHTD":
+        raise ValueError(f"unknown layout {layout!r}")
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    block_q = min(block_q, _round_up(tq, _LANES))
+    block_k = min(block_k, _round_up(tk, _LANES))
+    tq_p, tk_p = _round_up(tq, block_q), _round_up(tk, block_k)
+    if tq_p - tq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, tq_p - tq), (0, 0)))
+    if tk_p - tk:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, tk_p - tk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, tk_p - tk), (0, 0)))
+    nq, nk = tq_p // block_q, tk_p // block_k
+    bm = jnp.asarray(block_mask)
+    if bm.shape != (h, nq, nk):
+        raise ValueError(
+            f"block_mask shape {bm.shape} != (heads={h}, nq={nq}, nk={nk}) "
+            f"for block_q={block_q}, block_k={block_k}")
+    o = _fwd_sparse(q, k, v, bm, float(sm_scale), block_q, block_k, tk,
+                    interpret)
+    o = o[:, :, :tq, :]
+    if layout == "BTHD":
+        o = jnp.swapaxes(o, 1, 2)
+    return o
 
 
 def _fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len, causal_offset,

@@ -225,14 +225,78 @@ class BSLongformerSparsityConfig(SparsityConfig):
 # --------------------------------------------------------------------------- #
 
 
+def coarsen_layout(layout: np.ndarray, from_block: int,
+                   to_block: int = 128) -> np.ndarray:
+    """Re-tile a block layout to the kernel granularity.
+
+    ``from_block > to_block`` expands by repetition (always exact);
+    ``from_block < to_block`` OR-reduces — callers that need exactness must
+    check with :func:`coarsening_is_exact` (adding attention silently would
+    break causal layouts)."""
+    if from_block >= to_block:
+        if from_block % to_block:
+            raise ValueError(f"{from_block} not a multiple of {to_block}")
+        r = from_block // to_block
+        return np.repeat(np.repeat(layout, r, axis=1), r, axis=2)
+    if to_block % from_block:
+        raise ValueError(f"{to_block} not a multiple of {from_block}")
+    r = to_block // from_block
+    h, nq, nk = layout.shape
+    pad_q, pad_k = (-nq) % r, (-nk) % r
+    if pad_q or pad_k:
+        layout = np.pad(layout, ((0, 0), (0, pad_q), (0, pad_k)))
+        nq, nk = layout.shape[1:]
+    return layout.reshape(h, nq // r, r, nk // r, r).any(axis=(2, 4))
+
+
+def coarsening_is_exact(layout: np.ndarray, from_block: int,
+                        to_block: int = 128) -> bool:
+    """True when re-tiling to ``to_block`` adds no attention (every coarse
+    block is either fully allowed or fully masked in the fine layout)."""
+    if from_block >= to_block:
+        return True
+    coarse = coarsen_layout(layout, from_block, to_block)
+    back = coarsen_layout(coarse, to_block, from_block)
+    h, nq, nk = layout.shape
+    return bool((back[:, :nq, :nk] == layout.astype(bool)).all())
+
+
 def sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      sparsity_config: SparsityConfig, *,
                      sm_scale: Optional[float] = None,
                      layout: Optional[np.ndarray] = None,
-                     layout_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                     layout_mask: Optional[jnp.ndarray] = None,
+                     impl: str = "xla") -> jnp.ndarray:
     """Block-sparse attention over BHTD tensors (reference
     ``SparseSelfAttention.forward``): scores outside the layout get -inf
-    before softmax. Pass ``layout`` to reuse a precomputed pattern."""
+    before softmax. Pass ``layout`` to reuse a precomputed pattern.
+
+    ``impl="flash"`` dispatches to the Pallas block-skipping kernel
+    (forward-only — inference/serving path; masked blocks never touch the
+    MXU). The kernel tiles at 128 and applies no intra-block masking, so
+    the layout must re-tile to 128 blocks EXACTLY — a layout whose
+    coarsening would add attention (e.g. a fine-grained causal pattern)
+    raises rather than silently attending extra (or future) tokens. The
+    default XLA path applies the exact layout and is differentiable."""
+    if impl == "flash":
+        if layout_mask is not None:
+            raise ValueError(
+                "impl='flash' takes a block-level 'layout', not a token-"
+                "level 'layout_mask' (the kernel skips whole 128-blocks)")
+        if layout is None:
+            layout = sparsity_config.make_layout(q.shape[2])
+        fine = np.asarray(layout, bool)
+        if not coarsening_is_exact(fine, sparsity_config.block):
+            raise ValueError(
+                "impl='flash': this layout does not re-tile exactly to the "
+                "kernel's 128-block granularity (coarsening would ADD "
+                "attention — for unidirectional layouts that breaks "
+                "causality). Use a block size that divides into 128-aligned "
+                "patterns, or impl='xla'")
+        from .kernels.flash_attention import flash_attention_sparse
+        bm = coarsen_layout(fine, sparsity_config.block)
+        return flash_attention_sparse(q, k, v, bm, sm_scale=sm_scale,
+                                      layout="BHTD")
     b, h, t, d = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)

@@ -236,3 +236,91 @@ class TestFusedAdamW:
                 p_fused, g, m, v, t, lr=1e-3, weight_decay=0.01,
                 interpret=True)
         np.testing.assert_allclose(p_fused, p_opx, atol=1e-5, rtol=1e-5)
+
+
+class TestFlashAttentionSparse:
+    """Block-sparse flash path (splash-style grid skipping)."""
+
+    def _ref(self, q, k, v, bm, block=128):
+        mask = np.kron(np.asarray(bm, bool),
+                       np.ones((block, block), dtype=bool))[:, :q.shape[2],
+                                                            :k.shape[2]]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) / np.sqrt(q.shape[-1])
+        s = jnp.where(jnp.asarray(mask)[None], s,
+                      float(np.finfo(np.float32).min))
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(jnp.asarray(mask)[None].any(-1, keepdims=True), p, 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+    def test_matches_masked_reference(self):
+        from deepspeed_tpu.ops.kernels import flash_attention_sparse
+        rng = jax.random.PRNGKey(0)
+        b, h, t, d = 2, 2, 384, 64            # 3x3 blocks of 128
+        q = jax.random.normal(rng, (b, h, t, d), jnp.float32)
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, h, t, d))
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, h, t, d))
+        bm = np.array([[[1, 0, 1], [0, 1, 0], [1, 1, 1]],
+                       [[1, 1, 0], [1, 0, 1], [0, 0, 1]]], np.int32)
+        out = flash_attention_sparse(q, k, v, bm, layout="BHTD",
+                                     interpret=True)
+        ref = self._ref(q, k, v, bm)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_fully_masked_row_is_zero(self):
+        from deepspeed_tpu.ops.kernels import flash_attention_sparse
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 256, 64))
+        bm = np.array([[[1, 1], [0, 0]]], np.int32)   # row block 1: nothing
+        out = flash_attention_sparse(q, q, q, bm, layout="BHTD",
+                                     interpret=True)
+        np.testing.assert_allclose(np.asarray(out[:, :, 128:]), 0.0)
+        assert float(jnp.abs(out[:, :, :128]).max()) > 0
+
+    def test_sparse_attention_flash_impl(self):
+        from deepspeed_tpu.ops.sparse_attention import (
+            BigBirdSparsityConfig, sparse_attention)
+        # 128-block layout re-tiles exactly — the flash path applies it
+        cfg = BigBirdSparsityConfig(num_heads=2, block=128,
+                                    num_sliding_window_blocks=1,
+                                    num_global_blocks=1)
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 384, 32))
+        layout = cfg.make_layout(384)
+        out = sparse_attention(q, q, q, cfg, layout=layout, impl="flash")
+        ref = sparse_attention(q, q, q, cfg, layout=layout)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_flash_impl_rejects_inexact_and_token_masks(self):
+        from deepspeed_tpu.ops.sparse_attention import (
+            FixedSparsityConfig, sparse_attention)
+        import jax.numpy as jnp
+        import pytest
+        # fine causal layout: coarsening would add (future) attention
+        cfg = FixedSparsityConfig(num_heads=1, block=16,
+                                  attention="unidirectional")
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 256, 32))
+        with pytest.raises(ValueError, match="128-block"):
+            sparse_attention(q, q, q, cfg, impl="flash")
+        with pytest.raises(ValueError, match="layout_mask"):
+            sparse_attention(q, q, q, cfg, impl="flash",
+                             layout_mask=jnp.ones((1, 256, 256), bool))
+
+    def test_coarsen_layout(self):
+        from deepspeed_tpu.ops.sparse_attention import (
+            coarsen_layout, coarsening_is_exact)
+        fine = np.zeros((1, 16, 16), bool)
+        fine[0, 3, 9] = True                  # one 16-block hit
+        coarse = coarsen_layout(fine, 16, 128)
+        assert coarse.shape == (1, 2, 2)
+        assert coarse[0, 0, 1] and coarse.sum() == 1
+        assert not coarsening_is_exact(fine, 16)   # partial block -> inexact
+        # fully-dense coarse blocks are exact
+        fine2 = np.zeros((1, 16, 16), bool)
+        fine2[0, :8, 8:] = True
+        assert coarsening_is_exact(fine2, 16)
+        # expansion (block > 128) is exact by repetition
+        big = np.asarray([[[1, 0], [0, 1]]], bool)
+        exp = coarsen_layout(big, 256, 128)
+        assert exp.shape == (1, 4, 4)
+        assert exp[0, 0, 0] and exp[0, 1, 1] and not exp[0, 0, 2]
