@@ -116,6 +116,9 @@ _LLAMA_MAP = [
 ]
 
 _OPT_MAP = [
+    # .bin checkpoints carry lm_head.weight even when tied; load_hf_model
+    # drops the mapped head for tie_embeddings configs
+    (r"lm_head\.weight", "lm_head/kernel", "linear"),
     (r"(?:model\.)?decoder\.embed_tokens\.weight", "embed_tokens/embedding",
      "embed"),
     (r"(?:model\.)?decoder\.embed_positions\.weight",
@@ -276,6 +279,9 @@ def load_hf_model(model_dir: str, strict: bool = True):
     if arch in SPECIAL_HANDLERS:
         state = SPECIAL_HANDLERS[arch](state, hf_cfg)
     params = convert_hf_state(arch, state, strict=strict)
+    if getattr(cfg, "tie_embeddings", False) and isinstance(params, dict):
+        # tied models unembed through the embedding; drop the duplicate head
+        params.pop("lm_head", None)
     n = sum(int(np.prod(a.shape)) for a in state.values())
     log_dist(f"loaded HF checkpoint {model_dir}: arch={arch}, "
              f"{n / 1e6:.1f}M params")

@@ -30,9 +30,13 @@ from .state_manager import StateManager
 def _runner_for(model_cfg: Any, cfg: RaggedInferenceConfig):
     """Arch dispatch (the reference's policy map, ``engine_factory.py:92``)."""
     from ...models.llama import LlamaConfig
+    from ...models.opt import OPTConfig
     if isinstance(model_cfg, LlamaConfig):   # includes MixtralConfig
         from .llama_runner import LlamaRaggedRunner
         return LlamaRaggedRunner(model_cfg, cfg)
+    if isinstance(model_cfg, OPTConfig):
+        from .opt_runner import OPTRaggedRunner
+        return OPTRaggedRunner(model_cfg, cfg)
     return GPT2RaggedRunner(model_cfg, cfg)
 
 

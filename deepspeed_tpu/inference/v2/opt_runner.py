@@ -1,0 +1,131 @@
+"""Ragged paged-KV runner for OPT.
+
+Analogue of the reference's v2 OPT containers
+(``inference/v2/model_implementations/opt/``): learned positional embedding
+with the OPT +2 offset, pre-LN (or opt-350m post-LN) decoder blocks, biased
+separate q/k/v/out projections, ReLU MLP, optional embed projections, tied
+unembed. Shares the fixed-shape RaggedBatch contract of ``model_runner.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ...models.opt import OPTConfig
+from .config import RaggedInferenceConfig
+from .model_runner import RaggedBatch, _layer_norm
+
+
+class OPTRaggedRunner:
+    def __init__(self, model_cfg: OPTConfig, cfg: RaggedInferenceConfig,
+                 compute_dtype: Any = None):
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.compute_dtype = compute_dtype or model_cfg.dtype
+        self.num_layers = model_cfg.num_layers
+        self.kv_heads = model_cfg.num_heads
+        self.head_dim = model_cfg.head_dim
+
+        def _step(params, kv_data, batch):
+            from ..quantization import dequantize_tree
+            params = dequantize_tree(params)
+            return _opt_ragged_step(params, kv_data, batch,
+                                    model_cfg=model_cfg, cfg=cfg,
+                                    dtype=self.compute_dtype)
+
+        self._step = jax.jit(_step)
+
+    def step(self, params, kv_data, batch: RaggedBatch):
+        return self._step(params, kv_data, batch)
+
+
+def _linear(x, p, dtype):
+    y = x @ p["kernel"].astype(dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(dtype)
+    return y
+
+
+def _opt_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: OPTConfig,
+                     cfg: RaggedInferenceConfig, dtype):
+    mc = model_cfg
+    S, C = batch.tokens.shape
+    H, D = mc.num_heads, mc.head_dim
+    bs = cfg.block_size
+    ctx_max = cfg.max_context
+    trash = kv.shape[2] - 1
+    scale = 1.0 / (D ** 0.5)
+    pre_ln = mc.do_layer_norm_before
+
+    pos = batch.start_pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+    valid_q = jnp.arange(C, dtype=jnp.int32)[None, :] < batch.n_tokens[:, None]
+    pos_c = jnp.minimum(pos, mc.max_seq_len - 1) + mc.POSITION_OFFSET
+
+    blk = jnp.take_along_axis(
+        batch.block_tables,
+        jnp.minimum(pos // bs, cfg.max_blocks_per_seq - 1), axis=1)
+    write_idx = jnp.where(valid_q, blk * bs + pos % bs, trash)
+    j = jnp.arange(ctx_max, dtype=jnp.int32)
+    ctx_idx = batch.block_tables[:, j // bs] * bs + j % bs
+
+    wte = params["embed_tokens"]["embedding"]
+    wpe = params["embed_positions"]["embedding"]
+    x = wte[batch.tokens].astype(dtype)
+    if "project_in" in params:
+        x = x @ params["project_in"]["kernel"].astype(dtype)
+    x = x + wpe[pos_c].astype(dtype)
+
+    for li in range(mc.num_layers):
+        p = params[f"layer_{li}"]
+        attn_in = (_layer_norm(x.astype(jnp.float32),
+                               p["self_attn_layer_norm"],
+                               mc.layer_norm_eps).astype(dtype)
+                   if pre_ln else x)
+        pa = p["self_attn"]
+        q = _linear(attn_in, pa["q_proj"], dtype).reshape(S, C, H, D)
+        k = _linear(attn_in, pa["k_proj"], dtype).reshape(S, C, H, D)
+        v = _linear(attn_in, pa["v_proj"], dtype).reshape(S, C, H, D)
+
+        kv = kv.at[li, 0, write_idx.reshape(-1)].set(
+            k.reshape(S * C, H, D).astype(kv.dtype))
+        kv = kv.at[li, 1, write_idx.reshape(-1)].set(
+            v.reshape(S * C, H, D).astype(kv.dtype))
+        k_ctx = kv[li, 0][ctx_idx].astype(dtype)
+        v_ctx = kv[li, 1][ctx_idx].astype(dtype)
+
+        s_att = jnp.einsum("schd,skhd->shck", q, k_ctx) * scale
+        mask = j[None, None, None, :] <= pos[:, None, :, None]
+        s_att = jnp.where(mask, s_att.astype(jnp.float32), -jnp.inf)
+        p_att = jax.nn.softmax(s_att, axis=-1).astype(dtype)
+        y = jnp.einsum("shck,skhd->schd", p_att, v_ctx).reshape(S, C, H * D)
+        y = _linear(y, pa["out_proj"], dtype)
+        x = x + y
+        if not pre_ln:
+            x = _layer_norm(x.astype(jnp.float32), p["self_attn_layer_norm"],
+                            mc.layer_norm_eps).astype(dtype)
+
+        mlp_in = (_layer_norm(x.astype(jnp.float32), p["final_layer_norm"],
+                              mc.layer_norm_eps).astype(dtype)
+                  if pre_ln else x)
+        m = jax.nn.relu(_linear(mlp_in, p["fc1"], dtype))
+        m = _linear(m, p["fc2"], dtype)
+        x = x + m
+        if not pre_ln:
+            x = _layer_norm(x.astype(jnp.float32), p["final_layer_norm"],
+                            mc.layer_norm_eps).astype(dtype)
+
+    if pre_ln:
+        x = _layer_norm(x.astype(jnp.float32), params["final_layer_norm"],
+                        mc.layer_norm_eps)
+    x = x.astype(jnp.float32)
+    if "project_out" in params:
+        x = x @ params["project_out"]["kernel"].astype(jnp.float32)
+
+    last = jnp.maximum(batch.n_tokens - 1, 0)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    if "lm_head" in params:
+        return x_last @ params["lm_head"]["kernel"].astype(jnp.float32), kv
+    return x_last @ wte.T.astype(jnp.float32), kv

@@ -23,6 +23,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -111,12 +112,13 @@ def _online_softmax_block(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
     acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
 
 
-def _fwd_sparse_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref,
+def _fwd_sparse_kernel(mask_ref, fetch_ref, q_ref, k_ref, v_ref, o_ref,
                        m_scr, l_scr, acc_scr, *, sm_scale, block_q, block_k,
                        kv_len, nq, nk):
     hi = pl.program_id(1)
     qi = pl.program_id(2)
     ki = pl.program_id(3)
+    del fetch_ref  # consumed by the k/v index maps
 
     @pl.when(ki == 0)
     def _init():
@@ -140,6 +142,23 @@ def _fwd_sparse_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
 
 
+def _sparse_fetch_schedule(block_mask: np.ndarray) -> np.ndarray:
+    """Per grid step, the KV block index to have resident: allowed steps
+    fetch their own block; masked steps repeat the previous allowed index so
+    the block revisit costs no DMA (the splash-attention fetch trick)."""
+    bm = np.asarray(block_mask) > 0
+    h, nq, nk = bm.shape
+    fetch = np.zeros((h, nq, nk), np.int32)
+    for hi in range(h):
+        for qi in range(nq):
+            cur = int(np.argmax(bm[hi, qi])) if bm[hi, qi].any() else 0
+            for j in range(nk):
+                if bm[hi, qi, j]:
+                    cur = j
+                fetch[hi, qi, j] = cur
+    return fetch
+
+
 def _fwd_sparse(q, k, v, block_mask, sm_scale, block_q, block_k, kv_len,
                 interpret):
     b, h, tq, d = q.shape
@@ -148,16 +167,19 @@ def _fwd_sparse(q, k, v, block_mask, sm_scale, block_q, block_k, kv_len,
     kernel = functools.partial(
         _fwd_sparse_kernel, sm_scale=sm_scale, block_q=block_q,
         block_k=block_k, kv_len=kv_len, nq=nq, nk=nk)
+
+    def kv_index(bb, hh, i, j, mask_ref, fetch_ref):
+        del mask_ref
+        return (bb, hh, fetch_ref[hh * nq * nk + i * nk + j], 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda b, h, i, j, *_: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda b, h, i, j, *_: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda b, h, i, j, *_: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda b, h, i, j, *_: (b, h, i, 0)),
@@ -167,6 +189,7 @@ def _fwd_sparse(q, k, v, block_mask, sm_scale, block_q, block_k, kv_len,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
     )
+    fetch = _sparse_fetch_schedule(block_mask)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -175,7 +198,7 @@ def _fwd_sparse(q, k, v, block_mask, sm_scale, block_q, block_k, kv_len,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(block_mask.reshape(-1).astype(jnp.int32), q, k, v)
+    )(block_mask.reshape(-1).astype(np.int32), fetch.reshape(-1), q, k, v)
 
 
 def flash_attention_sparse(q, k, v, block_mask, *, sm_scale=None,
@@ -195,6 +218,12 @@ def flash_attention_sparse(q, k, v, block_mask, *, sm_scale=None,
     elif layout != "BHTD":
         raise ValueError(f"unknown layout {layout!r}")
     b, h, tq, d = q.shape
+    hk = k.shape[1]
+    if hk != h:
+        if h % hk:
+            raise ValueError(f"GQA requires q_heads % kv_heads == 0 ({h}/{hk})")
+        k = jnp.repeat(k, h // hk, axis=1)
+        v = jnp.repeat(v, h // hk, axis=1)
     tk = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
@@ -207,7 +236,15 @@ def flash_attention_sparse(q, k, v, block_mask, *, sm_scale=None,
         k = jnp.pad(k, ((0, 0), (0, 0), (0, tk_p - tk), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, tk_p - tk), (0, 0)))
     nq, nk = tq_p // block_q, tk_p // block_k
-    bm = jnp.asarray(block_mask)
+    try:
+        # the layout is STATIC: it parameterizes the compiled grid (fetch
+        # schedule is host-side) — a traced mask cannot work here
+        bm = np.asarray(block_mask)
+    except jax.errors.TracerArrayConversionError as e:
+        raise ValueError(
+            "flash_attention_sparse needs a static (host/numpy) block_mask; "
+            "it determines the compiled fetch schedule and cannot be a "
+            "traced value") from e
     if bm.shape != (h, nq, nk):
         raise ValueError(
             f"block_mask shape {bm.shape} != (heads={h}, nq={nq}, nk={nk}) "

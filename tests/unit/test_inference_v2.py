@@ -229,3 +229,51 @@ class TestEvoformer:
         v = jnp.ones((1, 2, 6, 2, 4)) * 2.5
         out = DS4Sci_EvoformerAttention(q, k, v)
         np.testing.assert_allclose(np.asarray(out), 2.5, rtol=1e-5)
+
+
+class TestOPTRaggedRunner:
+    @pytest.mark.parametrize("variant", ["pre_ln", "opt350m"])
+    def test_decode_matches_full_forward(self, variant):
+        from deepspeed_tpu.models.opt import OPT, OPTConfig
+        kw = {} if variant == "pre_ln" else {
+            "do_layer_norm_before": False, "word_embed_proj_dim": 24}
+        mcfg = OPTConfig.tiny(dtype=jnp.float32, **kw)
+        model = OPT(mcfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        cfg = RaggedInferenceConfig(max_seqs=2, chunk_size=8, block_size=4,
+                                    num_blocks=64, max_blocks_per_seq=16,
+                                    dtype="float32")
+        eng = InferenceEngineV2(mcfg, params, cfg)
+        prompt = list(np.random.default_rng(4).integers(1, 500, 11))
+        gen = eng.generate([prompt], max_new_tokens=5)[0]
+        toks = list(prompt)
+        for _ in range(5):
+            logits = model.apply({"params": params},
+                                 jnp.asarray([toks], jnp.int32))
+            toks.append(int(jnp.argmax(logits[0, -1])))
+        assert gen == toks[len(prompt):]
+
+    def test_build_hf_engine_opt(self, tmp_path):
+        transformers = pytest.importorskip("transformers")
+        from deepspeed_tpu.inference.v2.engine_factory import build_hf_engine
+        import torch as _t
+        hf_cfg = transformers.OPTConfig(
+            vocab_size=96, hidden_size=48, ffn_dim=96,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, word_embed_proj_dim=48)
+        hf_model = transformers.OPTForCausalLM(hf_cfg).eval()
+        hf_model.save_pretrained(tmp_path)
+        eng = build_hf_engine(str(tmp_path), dtype="float32",
+                              engine_config=RaggedInferenceConfig(
+                                  max_seqs=2, chunk_size=8, block_size=4,
+                                  num_blocks=64, max_blocks_per_seq=16,
+                                  dtype="float32"))
+        prompt = list(np.random.default_rng(5).integers(1, 90, 7))
+        gen = eng.generate([prompt], max_new_tokens=4)[0]
+        toks = list(prompt)
+        for _ in range(4):
+            with _t.no_grad():
+                logits = hf_model(_t.tensor([toks])).logits
+            toks.append(int(logits[0, -1].argmax()))
+        assert gen == toks[len(prompt):]

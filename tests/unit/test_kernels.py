@@ -294,8 +294,6 @@ class TestFlashAttentionSparse:
     def test_flash_impl_rejects_inexact_and_token_masks(self):
         from deepspeed_tpu.ops.sparse_attention import (
             FixedSparsityConfig, sparse_attention)
-        import jax.numpy as jnp
-        import pytest
         # fine causal layout: coarsening would add (future) attention
         cfg = FixedSparsityConfig(num_heads=1, block=16,
                                   attention="unidirectional")
