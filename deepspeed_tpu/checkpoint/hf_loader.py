@@ -161,11 +161,34 @@ _GPT2_MAP = [
      "h_{0}/mlp/c_proj/{w:kernel,b:bias}", "conv1d"),
 ]
 
+_PHI_MAP = [
+    (r"model\.embed_tokens\.weight", "embed_tokens/embedding", "embed"),
+    (r"model\.final_layernorm\.(weight|bias)",
+     "final_layernorm/{w:scale,b:bias}", "vector"),
+    (r"lm_head\.weight", "lm_head/kernel", "linear"),
+    (r"lm_head\.bias", "lm_head/bias", "vector"),
+    (r"model\.layers\.(\d+)\.input_layernorm\.(weight|bias)",
+     "layer_{0}/input_layernorm/{w:scale,b:bias}", "vector"),
+    (r"model\.layers\.(\d+)\.self_attn\.(q|k|v)_proj\.weight",
+     "layer_{0}/self_attn/{1}_proj/kernel", "linear"),
+    (r"model\.layers\.(\d+)\.self_attn\.(q|k|v)_proj\.bias",
+     "layer_{0}/self_attn/{1}_proj/bias", "vector"),
+    (r"model\.layers\.(\d+)\.self_attn\.dense\.weight",
+     "layer_{0}/self_attn/dense/kernel", "linear"),
+    (r"model\.layers\.(\d+)\.self_attn\.dense\.bias",
+     "layer_{0}/self_attn/dense/bias", "vector"),
+    (r"model\.layers\.(\d+)\.mlp\.fc(1|2)\.weight",
+     "layer_{0}/fc{1}/kernel", "linear"),
+    (r"model\.layers\.(\d+)\.mlp\.fc(1|2)\.bias",
+     "layer_{0}/fc{1}/bias", "vector"),
+]
+
 ARCH_MAPS = {
     "llama": _LLAMA_MAP,
     "mistral": _LLAMA_MAP,
     "qwen2": _LLAMA_MAP,
     "phi3": _LLAMA_MAP,
+    "phi": _PHI_MAP,
     "opt": _OPT_MAP,
     "gpt2": _GPT2_MAP,
 }

@@ -23,7 +23,7 @@ from .engine_v2 import InferenceEngineV2
 #: (mixtral/qwen2_moe RUN on the ragged path with in-framework params, but
 #: their HF expert layout — per-expert SwiGLU triples — does not map onto
 #: this framework's stacked 2-matrix experts, so HF loading is excluded.)
-_RAGGED_ARCHES = {"llama", "mistral", "qwen2", "phi3", "gpt2", "opt"}
+_RAGGED_ARCHES = {"llama", "mistral", "qwen2", "phi3", "phi", "gpt2", "opt"}
 
 
 def build_hf_engine(model_dir: str,

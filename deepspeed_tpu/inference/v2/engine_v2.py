@@ -37,6 +37,14 @@ def _runner_for(model_cfg: Any, cfg: RaggedInferenceConfig):
     if isinstance(model_cfg, OPTConfig):
         from .opt_runner import OPTRaggedRunner
         return OPTRaggedRunner(model_cfg, cfg)
+    from ...models.falcon import FalconConfig
+    from ...models.phi import PhiConfig
+    if isinstance(model_cfg, FalconConfig):
+        from .falcon_phi_runner import FalconRaggedRunner
+        return FalconRaggedRunner(model_cfg, cfg)
+    if isinstance(model_cfg, PhiConfig):
+        from .falcon_phi_runner import PhiRaggedRunner
+        return PhiRaggedRunner(model_cfg, cfg)
     return GPT2RaggedRunner(model_cfg, cfg)
 
 

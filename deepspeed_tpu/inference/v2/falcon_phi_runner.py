@@ -1,0 +1,217 @@
+"""Ragged paged-KV runners for the parallel-residual families: Falcon & Phi.
+
+Analogue of the reference's v2 falcon / phi containers
+(``inference/v2/model_implementations/{falcon,phi}/``). Both share the
+parallel attention+MLP residual; they differ in norm layout (Falcon:
+LayerNorm per block or dual ln_attn/ln_mlp; Phi: one shared LN), position
+encoding (Falcon: full rotary or ALiBi; Phi: partial rotary), MQA/GQA
+(Falcon) and biases (Phi). Shares the RaggedBatch contract of
+``model_runner.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ...models.falcon import FalconConfig
+from ...models.llama import apply_rope
+from ...models.phi import PhiConfig, apply_partial_rope
+from .config import RaggedInferenceConfig
+from .model_runner import RaggedBatch, _layer_norm
+
+
+def _paged_context(kv, li, batch, cfg, valid_q, pos):
+    """Shared KV paging plumbing: returns (write_idx, ctx_idx, j)."""
+    bs = cfg.block_size
+    trash = kv.shape[2] - 1
+    blk = jnp.take_along_axis(
+        batch.block_tables,
+        jnp.minimum(pos // bs, cfg.max_blocks_per_seq - 1), axis=1)
+    write_idx = jnp.where(valid_q, blk * bs + pos % bs, trash)
+    j = jnp.arange(cfg.max_context, dtype=jnp.int32)
+    ctx_idx = batch.block_tables[:, j // bs] * bs + j % bs
+    return write_idx, ctx_idx, j
+
+
+def _paged_attention(kv, li, q, k, v, write_idx, ctx_idx, j, pos, scale,
+                     dtype, alibi_slopes=None):
+    """Append this step's KV, gather context, masked softmax attention.
+    q: [S, C, H, D]; k/v: [S, C, KV, D] (broadcast to H)."""
+    S, C, H, D = q.shape
+    KV = k.shape[2]
+    kv = kv.at[li, 0, write_idx.reshape(-1)].set(
+        k.reshape(S * C, KV, D).astype(kv.dtype))
+    kv = kv.at[li, 1, write_idx.reshape(-1)].set(
+        v.reshape(S * C, KV, D).astype(kv.dtype))
+    k_ctx = kv[li, 0][ctx_idx].astype(dtype)
+    v_ctx = kv[li, 1][ctx_idx].astype(dtype)
+    if KV != H:
+        k_ctx = jnp.repeat(k_ctx, H // KV, axis=2)
+        v_ctx = jnp.repeat(v_ctx, H // KV, axis=2)
+    s_att = jnp.einsum("schd,skhd->shck", q, k_ctx) * scale
+    s_att = s_att.astype(jnp.float32)
+    if alibi_slopes is not None:
+        dist = (pos[:, None, :, None] - j[None, None, None, :]).astype(
+            jnp.float32)
+        s_att = s_att - alibi_slopes[None, :, None, None] * dist
+    mask = j[None, None, None, :] <= pos[:, None, :, None]
+    s_att = jnp.where(mask, s_att, -jnp.inf)
+    p_att = jax.nn.softmax(s_att, axis=-1).astype(dtype)
+    y = jnp.einsum("shck,skhd->schd", p_att, v_ctx).reshape(S, C, H * D)
+    return kv, y
+
+
+def _linear(x, p, dtype):
+    y = x @ p["kernel"].astype(dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(dtype)
+    return y
+
+
+class FalconRaggedRunner:
+    def __init__(self, model_cfg: FalconConfig, cfg: RaggedInferenceConfig,
+                 compute_dtype: Any = None):
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.compute_dtype = compute_dtype or model_cfg.dtype
+        self.num_layers = model_cfg.num_layers
+        self.kv_heads = model_cfg.num_kv_heads
+        self.head_dim = model_cfg.head_dim
+
+        def _step(params, kv_data, batch):
+            from ..quantization import dequantize_tree
+            return _falcon_ragged_step(dequantize_tree(params), kv_data,
+                                       batch, model_cfg=model_cfg, cfg=cfg,
+                                       dtype=self.compute_dtype)
+
+        self._step = jax.jit(_step)
+
+    def step(self, params, kv_data, batch: RaggedBatch):
+        return self._step(params, kv_data, batch)
+
+
+def _falcon_ragged_step(params, kv, batch, *, model_cfg: FalconConfig,
+                        cfg: RaggedInferenceConfig, dtype):
+    mc = model_cfg
+    S, C = batch.tokens.shape
+    H, KV, D = mc.num_heads, mc.num_kv_heads, mc.head_dim
+    scale = 1.0 / (D ** 0.5)
+    pos = batch.start_pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+    valid_q = jnp.arange(C, dtype=jnp.int32)[None, :] < batch.n_tokens[:, None]
+
+    slopes = None
+    if mc.alibi:
+        from ...models._lm_utils import alibi_slopes
+        slopes = alibi_slopes(H)
+
+    x = params["word_embeddings"]["embedding"][batch.tokens].astype(dtype)
+    for li in range(mc.num_layers):
+        p = params[f"layer_{li}"]
+        eps = mc.layer_norm_eps
+        if mc.new_decoder_architecture:
+            attn_in = _layer_norm(x.astype(jnp.float32), p["ln_attn"],
+                                  eps).astype(dtype)
+            mlp_in = _layer_norm(x.astype(jnp.float32), p["ln_mlp"],
+                                 eps).astype(dtype)
+        else:
+            attn_in = _layer_norm(x.astype(jnp.float32),
+                                  p["input_layernorm"], eps).astype(dtype)
+            mlp_in = attn_in if mc.parallel_attn else None
+
+        pa = p["self_attention"]
+        q = _linear(attn_in, pa["q_proj"], dtype).reshape(S, C, H, D)
+        k = _linear(attn_in, pa["k_proj"], dtype).reshape(S, C, KV, D)
+        v = _linear(attn_in, pa["v_proj"], dtype).reshape(S, C, KV, D)
+        if not mc.alibi:
+            q = apply_rope(q, pos, mc.rope_theta)
+            k = apply_rope(k, pos, mc.rope_theta)
+        write_idx, ctx_idx, j = _paged_context(kv, li, batch, cfg, valid_q,
+                                               pos)
+        kv, y = _paged_attention(kv, li, q, k, v, write_idx, ctx_idx, j,
+                                 pos, scale, dtype, alibi_slopes=slopes)
+        attn_out = _linear(y, pa["dense"], dtype)
+
+        def mlp(h):
+            m = jax.nn.gelu(_linear(h, p["mlp"]["dense_h_to_4h"], dtype))
+            return _linear(m, p["mlp"]["dense_4h_to_h"], dtype)
+
+        if mc.parallel_attn or mc.new_decoder_architecture:
+            x = x + attn_out + mlp(mlp_in)
+        else:
+            x = x + attn_out
+            h = _layer_norm(x.astype(jnp.float32),
+                            p["post_attention_layernorm"], eps).astype(dtype)
+            x = x + mlp(h)
+
+    x = _layer_norm(x.astype(jnp.float32), params["ln_f"],
+                    mc.layer_norm_eps)
+    last = jnp.maximum(batch.n_tokens - 1, 0)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    if "lm_head" in params:
+        return x_last @ params["lm_head"]["kernel"].astype(jnp.float32), kv
+    w = params["word_embeddings"]["embedding"]
+    return x_last @ w.T.astype(jnp.float32), kv
+
+
+class PhiRaggedRunner:
+    def __init__(self, model_cfg: PhiConfig, cfg: RaggedInferenceConfig,
+                 compute_dtype: Any = None):
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.compute_dtype = compute_dtype or model_cfg.dtype
+        self.num_layers = model_cfg.num_layers
+        self.kv_heads = model_cfg.num_heads
+        self.head_dim = model_cfg.head_dim
+
+        def _step(params, kv_data, batch):
+            from ..quantization import dequantize_tree
+            return _phi_ragged_step(dequantize_tree(params), kv_data, batch,
+                                    model_cfg=model_cfg, cfg=cfg,
+                                    dtype=self.compute_dtype)
+
+        self._step = jax.jit(_step)
+
+    def step(self, params, kv_data, batch: RaggedBatch):
+        return self._step(params, kv_data, batch)
+
+
+def _phi_ragged_step(params, kv, batch, *, model_cfg: PhiConfig,
+                     cfg: RaggedInferenceConfig, dtype):
+    mc = model_cfg
+    S, C = batch.tokens.shape
+    H, D = mc.num_heads, mc.head_dim
+    scale = 1.0 / (D ** 0.5)
+    pos = batch.start_pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+    valid_q = jnp.arange(C, dtype=jnp.int32)[None, :] < batch.n_tokens[:, None]
+
+    x = params["embed_tokens"]["embedding"][batch.tokens].astype(dtype)
+    for li in range(mc.num_layers):
+        p = params[f"layer_{li}"]
+        h = _layer_norm(x.astype(jnp.float32), p["input_layernorm"],
+                        mc.layer_norm_eps).astype(dtype)
+        pa = p["self_attn"]
+        q = _linear(h, pa["q_proj"], dtype).reshape(S, C, H, D)
+        k = _linear(h, pa["k_proj"], dtype).reshape(S, C, H, D)
+        v = _linear(h, pa["v_proj"], dtype).reshape(S, C, H, D)
+        q = apply_partial_rope(q, pos, mc.rope_theta, mc.rotary_dim)
+        k = apply_partial_rope(k, pos, mc.rope_theta, mc.rotary_dim)
+        write_idx, ctx_idx, j = _paged_context(kv, li, batch, cfg, valid_q,
+                                               pos)
+        kv, y = _paged_attention(kv, li, q, k, v, write_idx, ctx_idx, j,
+                                 pos, scale, dtype)
+        attn_out = _linear(y, pa["dense"], dtype)
+        m = jax.nn.gelu(_linear(h, p["fc1"], dtype))
+        m = _linear(m, p["fc2"], dtype)
+        x = x + attn_out + m                      # parallel residual
+
+    x = _layer_norm(x.astype(jnp.float32), params["final_layernorm"],
+                    mc.layer_norm_eps)
+    last = jnp.maximum(batch.n_tokens - 1, 0)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    logits = x_last @ params["lm_head"]["kernel"].astype(jnp.float32)
+    if "bias" in params["lm_head"]:
+        logits = logits + params["lm_head"]["bias"].astype(jnp.float32)
+    return logits, kv

@@ -257,3 +257,42 @@ class TestQwen2MoeRaggedRunner:
                                  train=False, rngs={"gating": jax.random.PRNGKey(0)})
             toks.append(int(jnp.argmax(logits[0, -1])))
         assert gen == toks[len(prompt):]
+
+
+class TestPhiParity:
+    def test_logits_and_serving(self, tmp_path):
+        hf_cfg = transformers.PhiConfig(
+            vocab_size=96, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, partial_rotary_factor=0.5)
+        hf_model = transformers.PhiForCausalLM(hf_cfg).eval()
+        hf_model.save_pretrained(tmp_path)
+        arch, cfg, params = load_hf_model(str(tmp_path))
+        assert arch == "phi"
+        cfg = dataclasses.replace(cfg, dtype=jnp.float32,
+                                  param_dtype=jnp.float32)
+        from deepspeed_tpu.models.phi import Phi
+        model = Phi(cfg)
+        tokens = np.random.RandomState(4).randint(0, 96, size=(1, 10))
+        ours = model.apply({"params": params},
+                           jnp.asarray(tokens, jnp.int32))
+        with torch.no_grad():
+            theirs = hf_model(torch.tensor(tokens)).logits
+        _logit_match(ours, theirs)
+
+        # one-call serving from the checkpoint dir
+        from deepspeed_tpu.inference.v2.engine_factory import build_hf_engine
+        from deepspeed_tpu.inference.v2.config import RaggedInferenceConfig
+        eng = build_hf_engine(str(tmp_path), dtype="float32",
+                              engine_config=RaggedInferenceConfig(
+                                  max_seqs=2, chunk_size=8, block_size=4,
+                                  num_blocks=64, max_blocks_per_seq=16,
+                                  dtype="float32"))
+        prompt = list(np.random.RandomState(5).randint(1, 90, 7))
+        gen = eng.generate([prompt], max_new_tokens=3)[0]
+        toks = list(prompt)
+        for _ in range(3):
+            with torch.no_grad():
+                logits = hf_model(torch.tensor([toks])).logits
+            toks.append(int(logits[0, -1].argmax()))
+        assert gen == toks[len(prompt):]

@@ -277,3 +277,50 @@ class TestOPTRaggedRunner:
                 logits = hf_model(_t.tensor([toks])).logits
             toks.append(int(logits[0, -1].argmax()))
         assert gen == toks[len(prompt):]
+
+
+class TestFalconPhiRaggedRunners:
+    @pytest.mark.parametrize("variant", ["mqa_rotary", "alibi",
+                                         "new_arch", "serial"])
+    def test_falcon_decode_matches_full_forward(self, variant):
+        from deepspeed_tpu.models.falcon import Falcon, FalconConfig
+        kw = {"mqa_rotary": {},
+              "alibi": {"alibi": True},
+              "new_arch": {"new_decoder_architecture": True,
+                           "num_kv_heads": 2},
+              "serial": {"parallel_attn": False}}[variant]
+        mcfg = FalconConfig.tiny(dtype=jnp.float32, **kw)
+        model = Falcon(mcfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        cfg = RaggedInferenceConfig(max_seqs=2, chunk_size=8, block_size=4,
+                                    num_blocks=64, max_blocks_per_seq=16,
+                                    dtype="float32")
+        eng = InferenceEngineV2(mcfg, params, cfg)
+        prompt = list(np.random.default_rng(6).integers(1, 500, 10))
+        gen = eng.generate([prompt], max_new_tokens=4)[0]
+        toks = list(prompt)
+        for _ in range(4):
+            logits = model.apply({"params": params},
+                                 jnp.asarray([toks], jnp.int32))
+            toks.append(int(jnp.argmax(logits[0, -1])))
+        assert gen == toks[len(prompt):], variant
+
+    def test_phi_decode_matches_full_forward(self):
+        from deepspeed_tpu.models.phi import Phi, PhiConfig
+        mcfg = PhiConfig.tiny(dtype=jnp.float32)
+        model = Phi(mcfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        cfg = RaggedInferenceConfig(max_seqs=2, chunk_size=8, block_size=4,
+                                    num_blocks=64, max_blocks_per_seq=16,
+                                    dtype="float32")
+        eng = InferenceEngineV2(mcfg, params, cfg)
+        prompt = list(np.random.default_rng(7).integers(1, 500, 9))
+        gen = eng.generate([prompt], max_new_tokens=5)[0]
+        toks = list(prompt)
+        for _ in range(5):
+            logits = model.apply({"params": params},
+                                 jnp.asarray([toks], jnp.int32))
+            toks.append(int(jnp.argmax(logits[0, -1])))
+        assert gen == toks[len(prompt):]
