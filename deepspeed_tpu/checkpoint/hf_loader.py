@@ -223,8 +223,80 @@ def _split_phi3_fused(state: Dict[str, np.ndarray],
     return out
 
 
-#: pre-conversion transforms keyed by arch (fused-tensor splitting etc.)
-SPECIAL_HANDLERS = {"phi3": _split_phi3_fused}
+def _stack_moe_experts(state: Dict[str, np.ndarray], hf_cfg: Dict,
+                       expert_re: str, gate_name: str, up_name: str,
+                       down_name: str, prefix_out: str
+                       ) -> Dict[str, np.ndarray]:
+    """Assemble per-expert SwiGLU triples into the framework's stacked
+    [E, M, H] / [E, H, M] tensors (pre-transposed: mapped with kind
+    'stacked', no further transpose)."""
+    out = {}
+    experts: Dict[Tuple[int, str], Dict[int, np.ndarray]] = {}
+    rx = re.compile(expert_re)
+    for name, arr in state.items():
+        m = rx.match(name)
+        if not m:
+            out[name] = arr
+            continue
+        layer, eidx, which = int(m.group(1)), int(m.group(2)), m.group(3)
+        experts.setdefault((layer, which), {})[eidx] = arr
+    for (layer, which), tensors in experts.items():
+        stacked = np.stack([tensors[i] for i in range(len(tensors))])
+        # HF per-expert weights are [out, in]; stacked layout wants
+        # wi*: [E, M, H] (in, out) and wo: [E, H, M] (in, out)
+        stacked = stacked.transpose(0, 2, 1)
+        kind = {gate_name: "wi_gate", up_name: "wi_up",
+                down_name: "wo"}[which]
+        out[f"{prefix_out}.{layer}.moe_stacked.{kind}"] = stacked
+    return out
+
+
+def _mixtral_experts(state, hf_cfg):
+    return _stack_moe_experts(
+        state, hf_cfg,
+        r"model\.layers\.(\d+)\.block_sparse_moe\.experts\.(\d+)\.(w1|w2|w3)\.weight$",
+        gate_name="w1", up_name="w3", down_name="w2",
+        prefix_out="model.layers")
+
+
+def _qwen2_moe_experts(state, hf_cfg):
+    return _stack_moe_experts(
+        state, hf_cfg,
+        r"model\.layers\.(\d+)\.mlp\.experts\.(\d+)\."
+        r"(gate_proj|up_proj|down_proj)\.weight$",
+        gate_name="gate_proj", up_name="up_proj", down_name="down_proj",
+        prefix_out="model.layers")
+
+
+#: pre-conversion transforms keyed by arch (fused-tensor splitting,
+#: per-expert stacking)
+SPECIAL_HANDLERS = {
+    "phi3": _split_phi3_fused,
+    "mixtral": _mixtral_experts,
+    "qwen2_moe": _qwen2_moe_experts,
+}
+
+_MOE_STACKED_RULES = [
+    (r"model\.layers\.(\d+)\.moe_stacked\.(wi_gate|wi_up|wo)",
+     "layer_{0}/moe/{1}", "stacked"),
+]
+
+_MIXTRAL_MAP = _LLAMA_MAP + _MOE_STACKED_RULES + [
+    (r"model\.layers\.(\d+)\.block_sparse_moe\.gate\.weight",
+     "layer_{0}/moe/gate", "linear"),
+]
+
+_QWEN2_MOE_MAP = _LLAMA_MAP + _MOE_STACKED_RULES + [
+    (r"model\.layers\.(\d+)\.mlp\.gate\.weight",
+     "layer_{0}/moe/gate", "linear"),
+    (r"model\.layers\.(\d+)\.mlp\.shared_expert\.(gate|up|down)_proj\.weight",
+     "layer_{0}/shared_{1}_proj/kernel", "linear"),
+    (r"model\.layers\.(\d+)\.mlp\.shared_expert_gate\.weight",
+     "layer_{0}/shared_expert_gate/kernel", "linear"),
+]
+
+ARCH_MAPS["mixtral"] = _MIXTRAL_MAP
+ARCH_MAPS["qwen2_moe"] = _QWEN2_MOE_MAP
 
 
 def _fw_path(template: str, groups: Tuple[str, ...]) -> str:

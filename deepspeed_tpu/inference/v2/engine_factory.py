@@ -19,11 +19,9 @@ from ...utils.logging import log_dist
 from .config import RaggedInferenceConfig
 from .engine_v2 import InferenceEngineV2
 
-#: arches whose HF weights map exactly AND that have a ragged runner.
-#: (mixtral/qwen2_moe RUN on the ragged path with in-framework params, but
-#: their HF expert layout — per-expert SwiGLU triples — does not map onto
-#: this framework's stacked 2-matrix experts, so HF loading is excluded.)
-_RAGGED_ARCHES = {"llama", "mistral", "qwen2", "phi3", "phi", "gpt2", "opt"}
+#: arches whose HF weights map exactly AND that have a ragged runner
+_RAGGED_ARCHES = {"llama", "mistral", "qwen2", "phi3", "phi", "gpt2", "opt",
+                  "mixtral", "qwen2_moe"}
 
 
 def build_hf_engine(model_dir: str,

@@ -63,10 +63,14 @@ def _moe_mlp(p_moe, h, cfg: MixtralConfig, dtype):
     keep = logits >= thresh                                   # [SC, E]
     w = jax.nn.softmax(jnp.where(keep, logits, -jnp.inf), axis=-1)
     x = h.reshape(S * C, M)
-    wi = p_moe["wi"].astype(dtype)                            # [E, M, I]
     wo = p_moe["wo"].astype(dtype)                            # [E, I, M]
-    up = jnp.einsum("sm,emi->esi", x, wi)
-    act = jax.nn.silu(up)
+    if "wi_gate" in p_moe:                                    # SwiGLU experts
+        g = jnp.einsum("sm,emi->esi", x, p_moe["wi_gate"].astype(dtype))
+        u = jnp.einsum("sm,emi->esi", x, p_moe["wi_up"].astype(dtype))
+        act = jax.nn.silu(g) * u
+    else:
+        up = jnp.einsum("sm,emi->esi", x, p_moe["wi"].astype(dtype))
+        act = jax.nn.silu(up)
     outs = jnp.einsum("esi,eim->esm", act, wo)                # [E, SC, M]
     y = jnp.einsum("se,esm->sm", w.astype(dtype), outs)
     return y.reshape(S, C, M)

@@ -29,6 +29,7 @@ class MixtralConfig(LlamaConfig):
     drop_tokens: bool = False          # mixtral routes all tokens
     router_aux_loss_coef: float = 0.02
     shared_expert_size: int = 0        # qwen2-moe always-on expert width
+    gated_experts: bool = True         # SwiGLU experts (HF mixtral layout)
 
     @staticmethod
     def tiny(**kw):
@@ -68,7 +69,8 @@ class MixtralBlock(nn.Module):
             capacity_factor=cfg.capacity_factor,
             eval_capacity_factor=cfg.capacity_factor,
             drop_tokens=cfg.drop_tokens, ep_mesh=self.ep_mesh,
-            dtype=cfg.dtype, activation=nn.silu, name="moe")(x=h, train=train)
+            dtype=cfg.dtype, activation=nn.silu,
+            gated=cfg.gated_experts, name="moe")(x=h, train=train)
         self.sow("losses", "moe_aux", l_aux)
         if cfg.shared_expert_size:
             # qwen2-moe: an always-on SwiGLU expert gated by a sigmoid

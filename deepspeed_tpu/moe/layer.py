@@ -30,9 +30,19 @@ EXPERT_AXIS = "expert"
 DATA_AXIS = "data"
 
 
-def _ffn(dispatched, wi, wo, activation, dtype):
-    h = jnp.einsum("etm,emh->eth", dispatched, wi.astype(dtype))
-    h = activation(h)
+def _ffn(dispatched, weights, activation, dtype):
+    """Per-expert FFN over [E, T, M]. ``weights`` is (wi, wo) for the plain
+    2-matrix expert or (wi_gate, wi_up, wo) for gated SwiGLU experts
+    (mixtral/qwen2-moe)."""
+    if len(weights) == 3:
+        wi_gate, wi_up, wo = weights
+        g = jnp.einsum("etm,emh->eth", dispatched, wi_gate.astype(dtype))
+        u = jnp.einsum("etm,emh->eth", dispatched, wi_up.astype(dtype))
+        h = activation(g) * u
+    else:
+        wi, wo = weights
+        h = activation(jnp.einsum("etm,emh->eth", dispatched,
+                                  wi.astype(dtype)))
     return jnp.einsum("eth,ehm->etm", h, wo.astype(dtype))
 
 
@@ -45,13 +55,28 @@ class Experts(nn.Module):
     dtype: jnp.dtype = jnp.float32
     activation: Callable = nn.gelu
 
+    gated: bool = False
+
     @nn.compact
     def __call__(self, x):
-        wi = self.param("wi", nn.initializers.lecun_normal(),
-                        (self.num_experts, self.d_model, self.hidden), jnp.float32)
-        wo = self.param("wo", nn.initializers.lecun_normal(),
-                        (self.num_experts, self.hidden, self.d_model), jnp.float32)
-        return _ffn(x, wi, wo, self.activation, self.dtype)
+        E, M, H = self.num_experts, self.d_model, self.hidden
+        if self.gated:
+            weights = (
+                self.param("wi_gate", nn.initializers.lecun_normal(),
+                           (E, M, H), jnp.float32),
+                self.param("wi_up", nn.initializers.lecun_normal(),
+                           (E, M, H), jnp.float32),
+                self.param("wo", nn.initializers.lecun_normal(),
+                           (E, H, M), jnp.float32),
+            )
+        else:
+            weights = (
+                self.param("wi", nn.initializers.lecun_normal(),
+                           (E, M, H), jnp.float32),
+                self.param("wo", nn.initializers.lecun_normal(),
+                           (E, H, M), jnp.float32),
+            )
+        return _ffn(x, weights, self.activation, self.dtype)
 
 
 class MoE(nn.Module):
@@ -77,6 +102,7 @@ class MoE(nn.Module):
     ep_mesh: Optional[Mesh] = None
     dtype: jnp.dtype = jnp.float32
     activation: Callable = nn.gelu
+    gated: bool = False                   # SwiGLU experts (mixtral/qwen2-moe)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -88,10 +114,22 @@ class MoE(nn.Module):
             raise ValueError(f"num_experts ({E}) must divide by expert axis ({ep})")
 
         wg = self.param("gate", nn.initializers.lecun_normal(), (M, E), jnp.float32)
-        wi = self.param("wi", nn.initializers.lecun_normal(),
-                        (E, M, hidden), jnp.float32)
-        wo = self.param("wo", nn.initializers.lecun_normal(),
-                        (E, hidden, M), jnp.float32)
+        if self.gated:
+            weights = (
+                self.param("wi_gate", nn.initializers.lecun_normal(),
+                           (E, M, hidden), jnp.float32),
+                self.param("wi_up", nn.initializers.lecun_normal(),
+                           (E, M, hidden), jnp.float32),
+                self.param("wo", nn.initializers.lecun_normal(),
+                           (E, hidden, M), jnp.float32),
+            )
+        else:
+            weights = (
+                self.param("wi", nn.initializers.lecun_normal(),
+                           (E, M, hidden), jnp.float32),
+                self.param("wo", nn.initializers.lecun_normal(),
+                           (E, hidden, M), jnp.float32),
+            )
         cf = self.capacity_factor if train else self.eval_capacity_factor
         needs_rng = train and (
             self.noisy_gate_policy
@@ -118,17 +156,17 @@ class MoE(nn.Module):
         tokens = x.reshape(B * T, M)
         if ep <= 1:
             out, l_aux = route_and_run(
-                tokens, lambda d: _ffn(d, wi, wo, act, dtype), rng)
+                tokens, lambda d: _ffn(d, weights, act, dtype), rng)
         else:
-            def body(tokens_local, wi_local, wo_local):
+            def body(tokens_local, weights_local):
                 """One (data, expert) device: tokens_local [S_loc, M];
-                wi/wo are this device's expert shards [E/ep, ...]."""
+                weights_local are this device's expert shards [E/ep, ...]."""
                 def expert_apply(dispatched):
                     # [E, C, M] → a2a → [E/ep, ep*C, M]: tokens meet their experts
                     d = comm.all_to_all_single(dispatched, axis_name=EXPERT_AXIS,
                                                split_axis=0, concat_axis=1,
                                                log_name="moe_dispatch")
-                    eo = _ffn(d, wi_local, wo_local, act, dtype)
+                    eo = _ffn(d, weights_local, act, dtype)
                     # inverse a2a → [E, C, M]: results return to their tokens
                     return comm.all_to_all_single(eo, axis_name=EXPERT_AXIS,
                                                   split_axis=1, concat_axis=0,
@@ -147,10 +185,9 @@ class MoE(nn.Module):
 
             out, l_aux = shard_map(
                 body, mesh=self.ep_mesh,
-                in_specs=(P((DATA_AXIS, EXPERT_AXIS)), P(EXPERT_AXIS),
-                          P(EXPERT_AXIS)),
+                in_specs=(P((DATA_AXIS, EXPERT_AXIS)), P(EXPERT_AXIS)),
                 out_specs=(P((DATA_AXIS, EXPERT_AXIS)), P()),
-                check_vma=False)(tokens, wi, wo)
+                check_vma=False)(tokens, weights)
         out = out.reshape(B, T, M)
 
         if self.use_residual:

@@ -296,3 +296,46 @@ class TestPhiParity:
                 logits = hf_model(torch.tensor([toks])).logits
             toks.append(int(logits[0, -1].argmax()))
         assert gen == toks[len(prompt):]
+
+
+class TestMoEParity:
+    def _serve(self, tmp_path, hf_model):
+        from deepspeed_tpu.inference.v2.engine_factory import build_hf_engine
+        from deepspeed_tpu.inference.v2.config import RaggedInferenceConfig
+        hf_model.save_pretrained(tmp_path)
+        eng = build_hf_engine(str(tmp_path), dtype="float32",
+                              engine_config=RaggedInferenceConfig(
+                                  max_seqs=2, chunk_size=8, block_size=4,
+                                  num_blocks=64, max_blocks_per_seq=16,
+                                  dtype="float32"))
+        prompt = list(np.random.RandomState(8).randint(1, 90, 8))
+        gen = eng.generate([prompt], max_new_tokens=4)[0]
+        toks = list(prompt)
+        for _ in range(4):
+            with torch.no_grad():
+                logits = hf_model(torch.tensor([toks])).logits
+            toks.append(int(logits[0, -1].argmax()))
+        return gen, toks[len(prompt):]
+
+    def test_mixtral_serving_matches_transformers(self, tmp_path):
+        hf_cfg = transformers.MixtralConfig(
+            vocab_size=96, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=2, num_attention_heads=2,
+            num_key_value_heads=2, num_local_experts=4,
+            num_experts_per_tok=2, max_position_embeddings=64,
+            tie_word_embeddings=False)
+        hf_model = transformers.MixtralForCausalLM(hf_cfg).eval()
+        gen, ref = self._serve(tmp_path, hf_model)
+        assert gen == ref
+
+    def test_qwen2_moe_serving_matches_transformers(self, tmp_path):
+        hf_cfg = transformers.Qwen2MoeConfig(
+            vocab_size=96, hidden_size=32, intermediate_size=48,
+            moe_intermediate_size=24, shared_expert_intermediate_size=40,
+            num_hidden_layers=2, num_attention_heads=2,
+            num_key_value_heads=2, num_experts=4, num_experts_per_tok=2,
+            max_position_embeddings=64, tie_word_embeddings=False,
+            decoder_sparse_step=1)
+        hf_model = transformers.Qwen2MoeForCausalLM(hf_cfg).eval()
+        gen, ref = self._serve(tmp_path, hf_model)
+        assert gen == ref

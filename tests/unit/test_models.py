@@ -89,7 +89,7 @@ class TestMixtral:
         model, init_fn, loss_fn = mixtral.make_model(cfg)
         params = init_fn(jax.random.PRNGKey(0))
         assert "moe" in params["layer_0"]
-        assert params["layer_0"]["moe"]["wi"].shape[0] == cfg.num_experts
+        assert params["layer_0"]["moe"]["wi_gate"].shape[0] == cfg.num_experts
         loss = loss_fn(params,
                        {"tokens": jnp.ones((2, 17), jnp.int32)},
                        jax.random.PRNGKey(1))
