@@ -20,7 +20,7 @@ from ...models.falcon import FalconConfig
 from ...models.llama import apply_rope
 from ...models.phi import PhiConfig, apply_partial_rope
 from .config import RaggedInferenceConfig
-from .model_runner import RaggedBatch, _layer_norm
+from .model_runner import RaggedBatch, _layer_norm, _linear
 
 
 def _paged_context(kv, li, batch, cfg, valid_q, pos):
@@ -62,13 +62,6 @@ def _paged_attention(kv, li, q, k, v, write_idx, ctx_idx, j, pos, scale,
     p_att = jax.nn.softmax(s_att, axis=-1).astype(dtype)
     y = jnp.einsum("shck,skhd->schd", p_att, v_ctx).reshape(S, C, H * D)
     return kv, y
-
-
-def _linear(x, p, dtype):
-    y = x @ p["kernel"].astype(dtype)
-    if "bias" in p:
-        y = y + p["bias"].astype(dtype)
-    return y
 
 
 class FalconRaggedRunner:

@@ -61,7 +61,12 @@ def _moe_mlp(p_moe, h, cfg: MixtralConfig, dtype):
     top_vals, _ = jax.lax.top_k(logits, k)
     thresh = top_vals[:, -1:]
     keep = logits >= thresh                                   # [SC, E]
-    w = jax.nn.softmax(jnp.where(keep, logits, -jnp.inf), axis=-1)
+    if getattr(cfg, "norm_topk_prob", True):
+        # mixtral: softmax over the selected experts (renormalized)
+        w = jax.nn.softmax(jnp.where(keep, logits, -jnp.inf), axis=-1)
+    else:
+        # qwen2-moe default: softmax over ALL experts, top-k un-renormalized
+        w = jax.nn.softmax(logits, axis=-1) * keep
     x = h.reshape(S * C, M)
     wo = p_moe["wo"].astype(dtype)                            # [E, I, M]
     if "wi_gate" in p_moe:                                    # SwiGLU experts

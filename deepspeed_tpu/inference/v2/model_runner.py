@@ -34,6 +34,15 @@ class RaggedBatch(NamedTuple):
     block_tables: jnp.ndarray  # [S, MAXB] int32 (padded with 0)
 
 
+def _linear(x, p, dtype):
+    """Dense apply over a flax {kernel[, bias]} param dict (shared by the
+    OPT/Falcon/Phi runners)."""
+    y = x @ p["kernel"].astype(dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(dtype)
+    return y
+
+
 def _layer_norm(x, p, eps=1e-5):   # GPT2Config.layer_norm_eps default
     mu = jnp.mean(x, -1, keepdims=True)
     var = jnp.var(x, -1, keepdims=True)

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 
 from ...models.opt import OPTConfig
 from .config import RaggedInferenceConfig
-from .model_runner import RaggedBatch, _layer_norm
+from .model_runner import RaggedBatch, _layer_norm, _linear
 
 
 class OPTRaggedRunner:
@@ -40,13 +40,6 @@ class OPTRaggedRunner:
 
     def step(self, params, kv_data, batch: RaggedBatch):
         return self._step(params, kv_data, batch)
-
-
-def _linear(x, p, dtype):
-    y = x @ p["kernel"].astype(dtype)
-    if "bias" in p:
-        y = y + p["bias"].astype(dtype)
-    return y
 
 
 def _opt_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: OPTConfig,

@@ -30,6 +30,10 @@ class MixtralConfig(LlamaConfig):
     router_aux_loss_coef: float = 0.02
     shared_expert_size: int = 0        # qwen2-moe always-on expert width
     gated_experts: bool = True         # SwiGLU experts (HF mixtral layout)
+    # True (mixtral): softmax over the selected top-k (renormalized).
+    # False (qwen2-moe default): softmax over ALL experts, top-k taken
+    # without renormalization.
+    norm_topk_prob: bool = True
 
     @staticmethod
     def tiny(**kw):

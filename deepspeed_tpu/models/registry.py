@@ -146,6 +146,11 @@ def _entry_phi3(d):
 
 def _entry_qwen2_moe(d):
     # qwen2-moe = mixtral block + an always-on sigmoid-gated shared expert
+    if int(d.get("decoder_sparse_step", 1)) != 1 or d.get("mlp_only_layers"):
+        raise ValueError(
+            "qwen2_moe configs with dense layers interleaved "
+            "(decoder_sparse_step != 1 or mlp_only_layers) are not "
+            "supported — every layer is treated as sparse MoE here")
     return MixtralConfig(**_hf_llama(
         d,
         qkv_bias=True,                  # qwen2 family uses biased q/k/v
@@ -154,6 +159,7 @@ def _entry_qwen2_moe(d):
         num_experts=d.get("num_experts", 8),
         experts_top_k=d.get("num_experts_per_tok", 2),
         shared_expert_size=d.get("shared_expert_intermediate_size", 0),
+        norm_topk_prob=d.get("norm_topk_prob", False),
         router_aux_loss_coef=d.get("router_aux_loss_coef", 0.001)))
 
 

@@ -46,6 +46,19 @@ def _ffn(dispatched, weights, activation, dtype):
     return jnp.einsum("eth,ehm->etm", h, wo.astype(dtype))
 
 
+def _expert_weight_params(mod: nn.Module, E: int, M: int, H: int,
+                          gated: bool):
+    """Declare the stacked expert weights on ``mod``: (wi, wo) or gated
+    (wi_gate, wi_up, wo)."""
+    init = nn.initializers.lecun_normal()
+    if gated:
+        return (mod.param("wi_gate", init, (E, M, H), jnp.float32),
+                mod.param("wi_up", init, (E, M, H), jnp.float32),
+                mod.param("wo", init, (E, H, M), jnp.float32))
+    return (mod.param("wi", init, (E, M, H), jnp.float32),
+            mod.param("wo", init, (E, H, M), jnp.float32))
+
+
 class Experts(nn.Module):
     """Standalone stacked-FFN experts [E, T, M] → [E, T, M] — the reference's
     ``Experts`` (moe/experts.py:13) as one vmapped dense block (MXU-friendly)."""
@@ -59,23 +72,8 @@ class Experts(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        E, M, H = self.num_experts, self.d_model, self.hidden
-        if self.gated:
-            weights = (
-                self.param("wi_gate", nn.initializers.lecun_normal(),
-                           (E, M, H), jnp.float32),
-                self.param("wi_up", nn.initializers.lecun_normal(),
-                           (E, M, H), jnp.float32),
-                self.param("wo", nn.initializers.lecun_normal(),
-                           (E, H, M), jnp.float32),
-            )
-        else:
-            weights = (
-                self.param("wi", nn.initializers.lecun_normal(),
-                           (E, M, H), jnp.float32),
-                self.param("wo", nn.initializers.lecun_normal(),
-                           (E, H, M), jnp.float32),
-            )
+        weights = _expert_weight_params(self, self.num_experts, self.d_model,
+                                        self.hidden, self.gated)
         return _ffn(x, weights, self.activation, self.dtype)
 
 
@@ -114,22 +112,7 @@ class MoE(nn.Module):
             raise ValueError(f"num_experts ({E}) must divide by expert axis ({ep})")
 
         wg = self.param("gate", nn.initializers.lecun_normal(), (M, E), jnp.float32)
-        if self.gated:
-            weights = (
-                self.param("wi_gate", nn.initializers.lecun_normal(),
-                           (E, M, hidden), jnp.float32),
-                self.param("wi_up", nn.initializers.lecun_normal(),
-                           (E, M, hidden), jnp.float32),
-                self.param("wo", nn.initializers.lecun_normal(),
-                           (E, hidden, M), jnp.float32),
-            )
-        else:
-            weights = (
-                self.param("wi", nn.initializers.lecun_normal(),
-                           (E, M, hidden), jnp.float32),
-                self.param("wo", nn.initializers.lecun_normal(),
-                           (E, hidden, M), jnp.float32),
-            )
+        weights = _expert_weight_params(self, E, M, hidden, self.gated)
         cf = self.capacity_factor if train else self.eval_capacity_factor
         needs_rng = train and (
             self.noisy_gate_policy

@@ -339,3 +339,22 @@ class TestMoEParity:
         hf_model = transformers.Qwen2MoeForCausalLM(hf_cfg).eval()
         gen, ref = self._serve(tmp_path, hf_model)
         assert gen == ref
+
+    def test_qwen2_moe_norm_topk_variants(self, tmp_path):
+        """Both router normalization modes must match transformers (the HF
+        default is norm_topk_prob=False — softmax over all experts, no
+        renormalization)."""
+        for norm in (False, True):
+            d = tmp_path / f"norm_{norm}"
+            hf_cfg = transformers.Qwen2MoeConfig(
+                vocab_size=96, hidden_size=32, intermediate_size=48,
+                moe_intermediate_size=24,
+                shared_expert_intermediate_size=40,
+                num_hidden_layers=2, num_attention_heads=2,
+                num_key_value_heads=2, num_experts=4,
+                num_experts_per_tok=2, max_position_embeddings=64,
+                tie_word_embeddings=False, decoder_sparse_step=1,
+                norm_topk_prob=norm)
+            hf_model = transformers.Qwen2MoeForCausalLM(hf_cfg).eval()
+            gen, ref = self._serve(d, hf_model)
+            assert gen == ref, f"norm_topk_prob={norm}"
