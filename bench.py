@@ -46,16 +46,18 @@ def main():
     rng = np.random.RandomState(0)
     batch = {"tokens": jnp.asarray(rng.randint(0, 50304, size=(B, seq + 1)), jnp.int32)}
 
-    # warmup (compile)
+    # warmup (compile). NOTE: block_until_ready is a no-op over the axon
+    # tunnel; float() forces a device round-trip, which is the only reliable
+    # barrier here.
     for _ in range(3):
         loss = engine.train_batch(batch)
-    jax.block_until_ready(loss)
+    float(loss)
 
     steps = 30
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = engine.train_batch(batch)
-    jax.block_until_ready(loss)
+    last_loss = float(loss)
     dt = time.perf_counter() - t0
 
     samples_per_sec = steps * B / dt
@@ -76,7 +78,7 @@ def main():
             "n_devices": n_dev,
             "seq_len": seq,
             "micro_batch": micro,
-            "last_loss": float(loss),
+            "last_loss": last_loss,
         },
     }))
 
