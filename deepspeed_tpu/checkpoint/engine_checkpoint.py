@@ -77,18 +77,29 @@ def load_state_tree(ckpt_dir: str, target: Any) -> Tuple[Any, Dict]:
     with open(os.path.join(ckpt_dir, META_FILE)) as f:
         meta = json.load(f)
     version = int(meta.get("format_version", 0))
-    if version != FORMAT_VERSION:
+    if version > FORMAT_VERSION:
         raise ValueError(
             f"checkpoint {ckpt_dir} has format_version {version}; this "
-            f"build reads version {FORMAT_VERSION} — re-save the checkpoint "
-            f"with the current framework")
+            f"build reads versions <= {FORMAT_VERSION} — upgrade the "
+            f"framework to load it")
+    if version < 2 and "paths" not in meta:
+        # v1 state.npz files are structurally compatible (but only the
+        # offline zero_to_fp32 tool needs the v2 'paths' meta, so that export
+        # won't work on them). Exception: v1 saves from onebit-optimizer runs
+        # also serialized comm_state leaves — those fail the leaf count below.
+        log_dist(f"checkpoint {ckpt_dir} is format_version {version} "
+                 f"(no 'paths' meta): zero_to_fp32 export will not work on it")
     data = np.load(os.path.join(ckpt_dir, STATE_FILE))
     leaves_t, treedef = jax.tree_util.tree_flatten(target)
     n = meta["n_leaves"]
     if n != len(leaves_t):
+        hint = (" (format_version 1 checkpoints from onebit-optimizer runs "
+                "included comm_state leaves and cannot be loaded by this "
+                "build — re-save with the current framework)"
+                if version < 2 else "")
         raise ValueError(
             f"checkpoint has {n} leaves but target state has {len(leaves_t)} — "
-            f"model/optimizer structure changed since save")
+            f"model/optimizer structure changed since save{hint}")
     new_leaves = []
     for i, tgt in enumerate(leaves_t):
         arr = data[f"leaf_{i:05d}"]

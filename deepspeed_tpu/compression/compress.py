@@ -86,39 +86,60 @@ def _threshold_mask(scores: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
     return (scores >= q).astype(scores.dtype)
 
 
-def sparse_prune(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
-    return w * _threshold_mask(jnp.abs(w), dense_ratio)
+def sparse_prune_mask(w: jnp.ndarray, dense_ratio: float):
+    return _threshold_mask(jnp.abs(w), dense_ratio)
 
 
-def row_prune(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
-    """Zero output rows (last dim of a kernel) with smallest L1 norm."""
+def row_prune_mask(w: jnp.ndarray, dense_ratio: float):
+    """Mask zeroing output rows (last dim of a kernel) with smallest L1 norm."""
     if w.ndim < 2:
-        return w
+        return None
     scores = jnp.sum(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
     mask = _threshold_mask(scores, dense_ratio)
-    return w * mask                                # broadcast over last dim
+    return jnp.broadcast_to(mask, w.shape)         # broadcast over last dim
 
 
-def channel_prune(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
-    """Zero input channels (dim 0) with smallest L1 norm."""
+def channel_prune_mask(w: jnp.ndarray, dense_ratio: float):
+    """Mask zeroing input channels (dim 0) with smallest L1 norm."""
     if w.ndim < 2:
-        return w
+        return None
     scores = jnp.sum(jnp.abs(w), axis=tuple(range(1, w.ndim)))
     mask = _threshold_mask(scores, dense_ratio)
-    return w * mask.reshape((-1,) + (1,) * (w.ndim - 1))
+    return jnp.broadcast_to(
+        mask.reshape((-1,) + (1,) * (w.ndim - 1)), w.shape)
 
 
-def head_prune(w: jnp.ndarray, dense_ratio: float,
-               num_heads: int) -> jnp.ndarray:
-    """Zero whole attention heads: the leading dim is split into heads."""
+def head_prune_mask(w: jnp.ndarray, dense_ratio: float, num_heads: int):
+    """Mask zeroing whole attention heads (leading dim split into heads)."""
     if w.ndim < 2 or w.shape[0] % num_heads:
-        return w
+        return None
     per = w.shape[0] // num_heads
     heads = w.reshape((num_heads, per) + w.shape[1:])
     scores = jnp.sum(jnp.abs(heads), axis=tuple(range(1, heads.ndim)))
     mask = _threshold_mask(scores, dense_ratio)
-    heads = heads * mask.reshape((num_heads,) + (1,) * (heads.ndim - 1))
-    return heads.reshape(w.shape)
+    return jnp.broadcast_to(
+        mask.reshape((num_heads,) + (1,) * (heads.ndim - 1)),
+        heads.shape).reshape(w.shape)
+
+
+def sparse_prune(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    return w * sparse_prune_mask(w, dense_ratio)
+
+
+def row_prune(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    mask = row_prune_mask(w, dense_ratio)
+    return w if mask is None else w * mask
+
+
+def channel_prune(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    mask = channel_prune_mask(w, dense_ratio)
+    return w if mask is None else w * mask
+
+
+def head_prune(w: jnp.ndarray, dense_ratio: float,
+               num_heads: int) -> jnp.ndarray:
+    mask = head_prune_mask(w, dense_ratio, num_heads)
+    return w if mask is None else w * mask
 
 
 def fake_quant(w: jnp.ndarray, bits, quant_type: str,
@@ -230,15 +251,26 @@ class CompressionTransform:
 
     def _apply_leaf(self, w, specs: List[TechniqueSpec], step):
         for s in specs:
-            if s.kind == "sparse_pruning":
-                out = sparse_prune(w, s.dense_ratio)
-            elif s.kind == "row_pruning":
-                out = row_prune(w, s.dense_ratio)
-            elif s.kind == "channel_pruning":
-                out = channel_prune(w, s.dense_ratio)
-            elif s.kind == "head_pruning":
-                out = head_prune(w, s.dense_ratio, s.num_heads)
-            elif s.kind == "weight_quantization":
+            if s.kind in ("sparse_pruning", "row_pruning", "channel_pruning",
+                          "head_pruning"):
+                # Mask-multiply (not STE): pruned entries must receive ZERO
+                # gradient, matching the reference's mask-multiply forward —
+                # under STE masked weights keep training and can climb back
+                # above threshold each step.
+                if s.kind == "sparse_pruning":
+                    mask = sparse_prune_mask(w, s.dense_ratio)
+                elif s.kind == "row_pruning":
+                    mask = row_prune_mask(w, s.dense_ratio)
+                elif s.kind == "channel_pruning":
+                    mask = channel_prune_mask(w, s.dense_ratio)
+                else:
+                    mask = head_prune_mask(w, s.dense_ratio, s.num_heads)
+                if mask is None:
+                    continue
+                mask = jnp.where(step >= s.offset, mask, jnp.ones_like(mask))
+                w = w * jax.lax.stop_gradient(mask)
+                continue
+            if s.kind == "weight_quantization":
                 if s.target_bits is not None and s.target_bits != s.bits:
                     # staged annealing: start_bits -> target_bits between
                     # schedule_offset and schedule_offset_end (reference

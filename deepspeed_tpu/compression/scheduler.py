@@ -17,6 +17,8 @@ class CompressionScheduler:
     def __init__(self, specs: List[TechniqueSpec]):
         self.specs = specs
         self._announced = set()
+        self._max_offset = max((s.offset for s in specs), default=-1)
+        self._done = not specs
 
     def active(self, step: int) -> List[TechniqueSpec]:
         return [s for s in self.specs if step >= s.offset]
@@ -26,9 +28,13 @@ class CompressionScheduler:
                 for s in self.specs}
 
     def pending(self) -> bool:
-        """True while some technique has not been announced yet (the engine
-        skips its per-step device sync once everything is active)."""
-        return len(self._announced) < len(self.specs)
+        """True while the per-step check may still announce something; turns
+        False once the step passes the LARGEST configured offset (after which
+        every reachable technique has been announced). The check itself is
+        host-only (engine passes global_steps, not a device read), so a spec
+        whose offset is never reached costs a host comparison per step, not
+        a device sync."""
+        return not self._done
 
     def check(self, step: int) -> None:
         """Log newly-activated techniques (reference per-step check)."""
@@ -39,3 +45,5 @@ class CompressionScheduler:
                 self._announced.add(key)
                 log_dist(f"compression: {s.kind} active from step {step} "
                          f"(offset {s.offset}) on {s.modules}")
+        if step >= self._max_offset:
+            self._done = True

@@ -74,7 +74,8 @@ class MixtralBlock(nn.Module):
             eval_capacity_factor=cfg.capacity_factor,
             drop_tokens=cfg.drop_tokens, ep_mesh=self.ep_mesh,
             dtype=cfg.dtype, activation=nn.silu,
-            gated=cfg.gated_experts, name="moe")(x=h, train=train)
+            gated=cfg.gated_experts,
+            normalize_weights=cfg.norm_topk_prob, name="moe")(x=h, train=train)
         self.sow("losses", "moe_aux", l_aux)
         if cfg.shared_expert_size:
             # qwen2-moe: an always-on SwiGLU expert gated by a sigmoid

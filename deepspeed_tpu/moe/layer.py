@@ -95,6 +95,10 @@ class MoE(nn.Module):
     min_capacity: int = 4
     noisy_gate_policy: Optional[str] = None
     top2_2nd_expert_sampling: bool = True   # reference top2gating default ON
+    # renormalize top-k weights to sum to 1 (HF norm_topk_prob). False =
+    # full-softmax weights, the qwen2-moe default; must match the serving
+    # path (inference/v2/llama_runner._moe_mlp) for checkpoint parity.
+    normalize_weights: bool = True
     drop_tokens: bool = True
     use_residual: bool = False            # PR-MoE
     ep_mesh: Optional[Mesh] = None
@@ -128,7 +132,8 @@ class MoE(nn.Module):
                 min_capacity=self.min_capacity, rng=rng,
                 noisy_gate_policy=self.noisy_gate_policy,
                 top2_2nd_expert_sampling=self.top2_2nd_expert_sampling,
-                drop_tokens=self.drop_tokens)
+                drop_tokens=self.drop_tokens,
+                normalize_weights=self.normalize_weights)
             dispatched = jnp.einsum("sec,sm->ecm",
                                     dispatch.astype(tokens.dtype), tokens)
             expert_out = expert_apply(dispatched)            # [E, C, M]

@@ -86,6 +86,7 @@ def top2gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
                min_capacity: int = 4, rng: Optional[jax.Array] = None,
                top2_2nd_expert_sampling: bool = True,
                drop_tokens: bool = True,
+               normalize_weights: bool = True,
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """GShard top-2 gating (reference top2gating:290). logits [S, E]."""
     S, E = logits.shape
@@ -117,8 +118,9 @@ def top2gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
 
     g1 = (gates * mask1).sum(axis=-1)
     g2 = (gates * mask2).sum(axis=-1)
-    denom = jnp.maximum(g1 + g2, 1e-9)
-    g1, g2 = g1 / denom, g2 / denom
+    if normalize_weights:   # norm_topk_prob=False keeps full-softmax weights
+        denom = jnp.maximum(g1 + g2, 1e-9)
+        g1, g2 = g1 / denom, g2 / denom
 
     combine = (g1[:, None, None] * mask1[:, :, None] * _one_hot(pos1, C)[:, None, :]
                + g2[:, None, None] * mask2[:, :, None] * _one_hot(pos2, C)[:, None, :])
@@ -171,6 +173,7 @@ def gate(logits: jnp.ndarray, k: int = 1, **kwargs):
     """Dispatch to the right gating fn by k (TopKGate.forward analogue)."""
     if k == 1:
         kwargs.pop("top2_2nd_expert_sampling", None)
+        kwargs.pop("normalize_weights", None)   # top-1 weight IS the softmax prob
         return top1gating(logits, **kwargs)
     if k == 2:
         kwargs.pop("noisy_gate_policy", None)

@@ -589,10 +589,14 @@ class Engine:
         self.global_samples += expected
         if self.compression_scheduler is not None and \
                 self.compression_scheduler.pending():
-            # state.step is the gate the compiled transform sees (it does
-            # NOT advance on overflow-skipped steps; global_steps does).
-            # The device sync stops once every technique is announced.
-            self.compression_scheduler.check(int(jax.device_get(self.state.step)))
+            # state.step is the gate the compiled transform sees, but reading
+            # it would block on the device every step (and a technique whose
+            # offset is never reached would keep that sync alive for the whole
+            # run). global_steps is its host-side upper bound — they differ
+            # only by overflow-skipped steps (rare, fp16 warmup), so the
+            # announcement log may fire a few steps early; the compiled
+            # gating itself is unaffected.
+            self.compression_scheduler.check(self.global_steps)
         self.timers(TRAIN_BATCH_TIMER).stop(barrier_value=metrics.loss)
         self.tput_timer.stop(global_step=True, report_speed=True)
         self._maybe_log(metrics)

@@ -56,6 +56,45 @@ def test_save_load_roundtrip(tmp_path):
     assert abs(l1 - l2) < 1e-5
 
 
+def test_load_old_format_version(tmp_path):
+    """format_version 1 checkpoints (pre-'paths' meta) must stay loadable —
+    only zero_to_fp32 needs the v2 meta; newer-than-current versions error."""
+    import glob
+    import json
+    import os
+
+    e = _engine()
+    e.train_batch(_batch(e, 0))
+    path = e.save_checkpoint(str(tmp_path))
+    meta_files = glob.glob(os.path.join(path, "**", "meta.json"),
+                           recursive=True)
+    assert meta_files
+    for mf in meta_files:
+        with open(mf) as f:
+            meta = json.load(f)
+        meta["format_version"] = 1
+        meta.pop("paths", None)
+        with open(mf, "w") as f:
+            json.dump(meta, f)
+
+    e2 = _engine()
+    loaded_path, _ = e2.load_checkpoint(str(tmp_path))
+    assert loaded_path == path
+    for a, b in zip(jax.tree_util.tree_leaves(e.state.params),
+                    jax.tree_util.tree_leaves(e2.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    for mf in meta_files:
+        with open(mf) as f:
+            meta = json.load(f)
+        meta["format_version"] = 99
+        with open(mf, "w") as f:
+            json.dump(meta, f)
+    e3 = _engine()
+    with pytest.raises(ValueError, match="format_version 99"):
+        e3.load_checkpoint(str(tmp_path))
+
+
 def test_elastic_resume_different_mesh(tmp_path, devices8):
     """Save on an 8-way data mesh, load on a 4(data)x2(model) mesh — the
     universal-checkpoint capability, with no conversion step."""

@@ -88,7 +88,7 @@ class TestTransform:
         np.testing.assert_array_equal(np.asarray(after["attn"]["kernel"]),
                                       np.asarray(params["attn"]["kernel"]))
 
-    def test_ste_gradients_flow(self):
+    def test_prune_gradients_masked(self):
         params = self._params()
         t = build_compression(params, SPARSE_CFG)
 
@@ -99,10 +99,14 @@ class TestTransform:
             return (c["mlp"]["kernel"] * u).sum()
 
         g = jax.grad(loss)(params)
-        # STE has an identity backward: the upstream cotangent reaches every
-        # entry, including pruned ones
+        # Mask-multiply forward (reference parity): pruned entries receive
+        # ZERO gradient — masked weights must not keep training and climb
+        # back above the threshold. Kept entries see the full cotangent.
+        compressed = t.apply(params, jnp.int32(10))["mlp"]["kernel"]
+        mask = np.asarray(compressed != 0, np.float32)
+        assert 0.0 < mask.mean() < 1.0   # pruning actually happened
         np.testing.assert_allclose(np.asarray(g["mlp"]["kernel"]),
-                                   np.asarray(u), rtol=1e-6)
+                                   np.asarray(u) * mask, rtol=1e-6)
 
     def test_redundancy_clean(self):
         params = self._params()

@@ -55,6 +55,23 @@ def test_top2_two_experts_per_token():
                                np.ones(16), rtol=1e-5)
 
 
+def test_top2_norm_topk_prob_off():
+    """normalize_weights=False keeps full-softmax weights (HF qwen2-moe
+    norm_topk_prob=False): combine weights are the raw softmax probs of the
+    two picks, so they sum to < 1 per token."""
+    logits = _logits()
+    gates = np.asarray(jax.nn.softmax(logits, axis=-1))
+    _, combine, dispatch = top2gating(logits, capacity_factor=2.0,
+                                      normalize_weights=False)
+    combine = np.asarray(combine)
+    picked = np.asarray(dispatch).astype(np.float32)
+    # each kept (expert, slot) weight equals the raw softmax prob
+    per_expert_w = combine.sum(axis=2)          # [S, E]
+    per_expert_m = picked.sum(axis=2)           # [S, E]
+    np.testing.assert_allclose(per_expert_w, gates * per_expert_m, rtol=1e-5)
+    assert np.all(combine.sum(axis=(1, 2)) < 1.0)
+
+
 def test_topk_matches_k():
     _, _, dispatch = topkgating(_logits(S=32, E=8), k=3, capacity_factor=3.0)
     per_token = np.asarray(dispatch).sum(axis=(1, 2))
