@@ -23,6 +23,10 @@ class RaggedInferenceConfig(ConfigModel):
     num_blocks: int = 256             # pool size (blocks of block_size tokens)
     max_blocks_per_seq: int = 32      # static width of the block table
     dtype: str = "bfloat16"
+    # "auto": Pallas paged-flash kernel on TPU (per-step HBM traffic = live
+    # blocks only), dense gather elsewhere (interpret-mode Pallas would be a
+    # Python-loop per layer per step off-TPU). "paged_flash"/"dense" force.
+    attention_impl: str = "auto"
 
     # sampling defaults for the built-in generate loop
     greedy: bool = True

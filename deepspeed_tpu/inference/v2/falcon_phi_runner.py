@@ -20,48 +20,8 @@ from ...models.falcon import FalconConfig
 from ...models.llama import apply_rope
 from ...models.phi import PhiConfig, apply_partial_rope
 from .config import RaggedInferenceConfig
-from .model_runner import RaggedBatch, _layer_norm, _linear
-
-
-def _paged_context(kv, li, batch, cfg, valid_q, pos):
-    """Shared KV paging plumbing: returns (write_idx, ctx_idx, j)."""
-    bs = cfg.block_size
-    trash = kv.shape[2] - 1
-    blk = jnp.take_along_axis(
-        batch.block_tables,
-        jnp.minimum(pos // bs, cfg.max_blocks_per_seq - 1), axis=1)
-    write_idx = jnp.where(valid_q, blk * bs + pos % bs, trash)
-    j = jnp.arange(cfg.max_context, dtype=jnp.int32)
-    ctx_idx = batch.block_tables[:, j // bs] * bs + j % bs
-    return write_idx, ctx_idx, j
-
-
-def _paged_attention(kv, li, q, k, v, write_idx, ctx_idx, j, pos, scale,
-                     dtype, alibi_slopes=None):
-    """Append this step's KV, gather context, masked softmax attention.
-    q: [S, C, H, D]; k/v: [S, C, KV, D] (broadcast to H)."""
-    S, C, H, D = q.shape
-    KV = k.shape[2]
-    kv = kv.at[li, 0, write_idx.reshape(-1)].set(
-        k.reshape(S * C, KV, D).astype(kv.dtype))
-    kv = kv.at[li, 1, write_idx.reshape(-1)].set(
-        v.reshape(S * C, KV, D).astype(kv.dtype))
-    k_ctx = kv[li, 0][ctx_idx].astype(dtype)
-    v_ctx = kv[li, 1][ctx_idx].astype(dtype)
-    if KV != H:
-        k_ctx = jnp.repeat(k_ctx, H // KV, axis=2)
-        v_ctx = jnp.repeat(v_ctx, H // KV, axis=2)
-    s_att = jnp.einsum("schd,skhd->shck", q, k_ctx) * scale
-    s_att = s_att.astype(jnp.float32)
-    if alibi_slopes is not None:
-        dist = (pos[:, None, :, None] - j[None, None, None, :]).astype(
-            jnp.float32)
-        s_att = s_att - alibi_slopes[None, :, None, None] * dist
-    mask = j[None, None, None, :] <= pos[:, None, :, None]
-    s_att = jnp.where(mask, s_att, -jnp.inf)
-    p_att = jax.nn.softmax(s_att, axis=-1).astype(dtype)
-    y = jnp.einsum("shck,skhd->schd", p_att, v_ctx).reshape(S, C, H * D)
-    return kv, y
+from .model_runner import (RaggedBatch, _layer_norm, _linear,
+                           paged_attention)
 
 
 class FalconRaggedRunner:
@@ -121,10 +81,8 @@ def _falcon_ragged_step(params, kv, batch, *, model_cfg: FalconConfig,
         if not mc.alibi:
             q = apply_rope(q, pos, mc.rope_theta)
             k = apply_rope(k, pos, mc.rope_theta)
-        write_idx, ctx_idx, j = _paged_context(kv, li, batch, cfg, valid_q,
-                                               pos)
-        kv, y = _paged_attention(kv, li, q, k, v, write_idx, ctx_idx, j,
-                                 pos, scale, dtype, alibi_slopes=slopes)
+        kv, y = paged_attention(kv, li, q, k, v, batch, cfg, pos, valid_q,
+                                scale, dtype, alibi_slopes=slopes)
         attn_out = _linear(y, pa["dense"], dtype)
 
         def mlp(h):
@@ -191,10 +149,8 @@ def _phi_ragged_step(params, kv, batch, *, model_cfg: PhiConfig,
         v = _linear(h, pa["v_proj"], dtype).reshape(S, C, H, D)
         q = apply_partial_rope(q, pos, mc.rope_theta, mc.rotary_dim)
         k = apply_partial_rope(k, pos, mc.rope_theta, mc.rotary_dim)
-        write_idx, ctx_idx, j = _paged_context(kv, li, batch, cfg, valid_q,
-                                               pos)
-        kv, y = _paged_attention(kv, li, q, k, v, write_idx, ctx_idx, j,
-                                 pos, scale, dtype)
+        kv, y = paged_attention(kv, li, q, k, v, batch, cfg, pos, valid_q,
+                                scale, dtype)
         attn_out = _linear(y, pa["dense"], dtype)
         m = jax.nn.gelu(_linear(h, p["fc1"], dtype))
         m = _linear(m, p["fc2"], dtype)

@@ -27,9 +27,11 @@ class BlockedKVCache:
         self.head_dim = head_dim
         self.dtype = dtype or jnp.bfloat16
         self.allocator = BlockedAllocator(cfg.num_blocks)
-        # +1 trash slot: padded query positions scatter there, so they can
-        # never corrupt a live sequence's KV (see model_runner).
-        slots = cfg.num_blocks * cfg.block_size + 1
+        # +1 trash BLOCK at the end: padded query positions scatter into its
+        # last slot, so they can never corrupt a live sequence's KV (see
+        # model_runner) — and the pool stays an exact multiple of block_size,
+        # so the paged flash kernel's [nb, bs, KV, D] view is a free reshape.
+        slots = (cfg.num_blocks + 1) * cfg.block_size
         self.data = jnp.zeros(
             (num_layers, 2, slots, kv_heads, head_dim), self.dtype)
 

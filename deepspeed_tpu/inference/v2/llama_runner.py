@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from ...models.llama import LlamaConfig, apply_rope
 from ...models.mixtral import MixtralConfig
 from .config import RaggedInferenceConfig
-from .model_runner import RaggedBatch
+from .model_runner import RaggedBatch, paged_attention
 
 
 def _rms(x, scale, eps):
@@ -88,20 +88,11 @@ def _llama_ragged_step(params, kv, batch: RaggedBatch, *,
     H = model_cfg.num_heads
     KV = model_cfg.num_kv_heads
     D = model_cfg.head_dim
-    bs = cfg.block_size
-    ctx_max = cfg.max_context
-    trash = kv.shape[2] - 1
     scale = 1.0 / (D ** 0.5)
     is_moe = isinstance(model_cfg, MixtralConfig)
 
     pos = batch.start_pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
     valid_q = jnp.arange(C, dtype=jnp.int32)[None, :] < batch.n_tokens[:, None]
-    blk = jnp.take_along_axis(
-        batch.block_tables,
-        jnp.minimum(pos // bs, cfg.max_blocks_per_seq - 1), axis=1)
-    write_idx = jnp.where(valid_q, blk * bs + pos % bs, trash)
-    j = jnp.arange(ctx_max, dtype=jnp.int32)
-    ctx_idx = batch.block_tables[:, j // bs] * bs + j % bs
 
     x = params["embed"]["embedding"][batch.tokens].astype(dtype)
 
@@ -123,27 +114,9 @@ def _llama_ragged_step(params, kv, batch: RaggedBatch, *,
         q = apply_rope(q, pos, model_cfg.rope_theta)
         k = apply_rope(k, pos, model_cfg.rope_theta)
 
-        kv = kv.at[li, 0, write_idx.reshape(-1)].set(
-            k.reshape(S * C, KV, D).astype(kv.dtype))
-        kv = kv.at[li, 1, write_idx.reshape(-1)].set(
-            v.reshape(S * C, KV, D).astype(kv.dtype))
-
-        k_ctx = kv[li, 0][ctx_idx].astype(dtype)              # [S, ctx, KV, D]
-        v_ctx = kv[li, 1][ctx_idx].astype(dtype)
-        if KV != H:
-            k_ctx = jnp.repeat(k_ctx, H // KV, axis=2)
-            v_ctx = jnp.repeat(v_ctx, H // KV, axis=2)
-
-        s_att = jnp.einsum("schd,skhd->shck", q, k_ctx) * scale
-        mask = j[None, None, None, :] <= pos[:, None, :, None]
-        if model_cfg.sliding_window is not None:
-            mask = jnp.logical_and(
-                mask,
-                j[None, None, None, :] > pos[:, None, :, None]
-                - model_cfg.sliding_window)
-        s_att = jnp.where(mask, s_att.astype(jnp.float32), -jnp.inf)
-        p_att = jax.nn.softmax(s_att, axis=-1).astype(dtype)
-        y = jnp.einsum("shck,skhd->schd", p_att, v_ctx).reshape(S, C, H * D)
+        kv, y = paged_attention(kv, li, q, k, v, batch, cfg, pos, valid_q,
+                                scale, dtype,
+                                sliding_window=model_cfg.sliding_window)
         y = y @ pa["o_proj"]["kernel"].astype(dtype)
         x = x + y
 

@@ -49,6 +49,85 @@ def _layer_norm(x, p, eps=1e-5):   # GPT2Config.layer_norm_eps default
     return (x - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
 
 
+def paged_attention(kv, li, q, k, v, batch: "RaggedBatch",
+                    cfg: RaggedInferenceConfig, pos, valid_q, scale, dtype,
+                    alibi_slopes=None, sliding_window=None):
+    """Append this step's K/V through the block tables, then attend.
+
+    Shared by every ragged runner. q: [S, C, H, D]; k/v: [S, C, KV, D]
+    (KV may divide H — GQA). Dispatches on ``cfg.attention_impl``:
+
+      "auto" — "paged_flash" on TPU, "dense" elsewhere (interpret-mode
+        Pallas off-TPU would run a Python-loop interpreter per layer/step).
+      "paged_flash" — Pallas flash kernel reading K/V straight through the
+        block tables (ops/kernels/paged_attention.py): per-step HBM traffic
+        is the LIVE blocks only, no ``max_context`` wall. (Reference:
+        inference/v2/kernels/ragged_ops/blocked_flash/.)
+      "dense" — gather [S, max_context] context and mask (fallback/debug;
+        the round-1 path the kernel replaces).
+
+    Returns (kv, y[S, C, H*D] in ``dtype``).
+    """
+    S, C, H, D = q.shape
+    KV = k.shape[2]
+    bs = cfg.block_size
+    trash = kv.shape[2] - 1
+    impl = cfg.attention_impl
+    if impl == "auto":
+        impl = "paged_flash" if jax.default_backend() == "tpu" else "dense"
+
+    blk = jnp.take_along_axis(
+        batch.block_tables,
+        jnp.minimum(pos // bs, cfg.max_blocks_per_seq - 1), axis=1)
+    write_idx = jnp.where(valid_q, blk * bs + pos % bs, trash)
+    kv = kv.at[li, 0, write_idx.reshape(-1)].set(
+        k.reshape(S * C, KV, D).astype(kv.dtype))
+    kv = kv.at[li, 1, write_idx.reshape(-1)].set(
+        v.reshape(S * C, KV, D).astype(kv.dtype))
+
+    if impl == "paged_flash":
+        from ...ops.kernels import flash_paged_attention
+        seq_lens = jnp.where(batch.n_tokens > 0,
+                             batch.start_pos + batch.n_tokens, 0)
+        # q joins the pool's storage dtype so the kernel's matmuls stay
+        # single-dtype (f32 accumulation inside); the pool itself is NEVER
+        # cast or copied — that would re-introduce the full-pool traffic
+        # this kernel exists to avoid
+        y = flash_paged_attention(
+            q.astype(kv.dtype), kv[li, 0], kv[li, 1],
+            batch.block_tables, batch.start_pos, seq_lens,
+            block_size=bs, sm_scale=scale, alibi_slopes=alibi_slopes,
+            sliding_window=sliding_window)
+        return kv, y.reshape(S, C, H * D).astype(dtype)
+    if impl != "dense":
+        raise ValueError(
+            f"attention_impl must be 'auto', 'paged_flash' or 'dense', "
+            f"got {cfg.attention_impl!r}")
+
+    j = jnp.arange(cfg.max_context, dtype=jnp.int32)
+    ctx_idx = batch.block_tables[:, j // bs] * bs + j % bs
+    k_ctx = kv[li, 0][ctx_idx].astype(dtype)
+    v_ctx = kv[li, 1][ctx_idx].astype(dtype)
+    if KV != H:
+        k_ctx = jnp.repeat(k_ctx, H // KV, axis=2)
+        v_ctx = jnp.repeat(v_ctx, H // KV, axis=2)
+    s_att = jnp.einsum("schd,skhd->shck", q, k_ctx) * scale
+    s_att = s_att.astype(jnp.float32)
+    if alibi_slopes is not None:
+        dist = (pos[:, None, :, None] - j[None, None, None, :]).astype(
+            jnp.float32)
+        s_att = s_att - alibi_slopes[None, :, None, None] * dist
+    mask = j[None, None, None, :] <= pos[:, None, :, None]
+    if sliding_window is not None:
+        mask = jnp.logical_and(
+            mask, j[None, None, None, :] > pos[:, None, :, None]
+            - sliding_window)
+    s_att = jnp.where(mask, s_att, -jnp.inf)
+    p_att = jax.nn.softmax(s_att, axis=-1).astype(dtype)
+    y = jnp.einsum("shck,skhd->schd", p_att, v_ctx).reshape(S, C, H * D)
+    return kv, y
+
+
 class GPT2RaggedRunner:
     """Paged-KV decode/prefill over the flax ``GPT2`` param tree
     (``deepspeed_tpu/models/gpt2.py`` naming: wte/wpe/h_i/ln_f)."""
@@ -76,26 +155,12 @@ def _gpt2_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: GPT2Config,
     S, C = batch.tokens.shape
     H = model_cfg.num_heads
     D = model_cfg.hidden_size // H
-    bs = cfg.block_size
-    ctx_max = cfg.max_context
-    n_slots = kv.shape[2]              # num_blocks*block_size + 1 (trash)
-    trash = n_slots - 1
     scale = 1.0 / (D ** 0.5)
 
     # absolute positions of this step's queries
     pos = batch.start_pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
     valid_q = jnp.arange(C, dtype=jnp.int32)[None, :] < batch.n_tokens[:, None]
     pos_c = jnp.minimum(pos, model_cfg.max_seq_len - 1)
-
-    # KV slot for each query token through the block table; trash if padded
-    blk = jnp.take_along_axis(batch.block_tables,
-                              jnp.minimum(pos // bs, cfg.max_blocks_per_seq - 1),
-                              axis=1)                       # [S, C]
-    write_idx = jnp.where(valid_q, blk * bs + pos % bs, trash)
-
-    # context gather indices: absolute position j -> cache slot
-    j = jnp.arange(ctx_max, dtype=jnp.int32)
-    ctx_idx = batch.block_tables[:, j // bs] * bs + j % bs  # [S, ctx_max]
 
     wte = params["wte"]["embedding"]
     wpe = params["wpe"]["embedding"]
@@ -112,21 +177,8 @@ def _gpt2_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: GPT2Config,
         k = k.reshape(S, C, H, D)
         v = v.reshape(S, C, H, D)
 
-        # append this step's KV (padded tokens land in the trash slot)
-        kv = kv.at[li, 0, write_idx.reshape(-1)].set(
-            k.reshape(S * C, H, D).astype(kv.dtype))
-        kv = kv.at[li, 1, write_idx.reshape(-1)].set(
-            v.reshape(S * C, H, D).astype(kv.dtype))
-
-        # gather each slot's context through its block table
-        k_ctx = kv[li, 0][ctx_idx].astype(dtype)            # [S, ctx, H, D]
-        v_ctx = kv[li, 1][ctx_idx].astype(dtype)
-
-        s_att = jnp.einsum("schd,skhd->shck", q, k_ctx) * scale
-        mask = j[None, None, None, :] <= pos[:, None, :, None]  # causal
-        s_att = jnp.where(mask, s_att.astype(jnp.float32), -jnp.inf)
-        p_att = jax.nn.softmax(s_att, axis=-1).astype(dtype)
-        y = jnp.einsum("shck,skhd->schd", p_att, v_ctx).reshape(S, C, H * D)
+        kv, y = paged_attention(kv, li, q, k, v, batch, cfg, pos, valid_q,
+                                scale, dtype)
 
         y = y @ p["attn"]["c_proj"]["kernel"].astype(dtype)
         if "bias" in p["attn"]["c_proj"]:

@@ -16,7 +16,8 @@ import jax.numpy as jnp
 
 from ...models.opt import OPTConfig
 from .config import RaggedInferenceConfig
-from .model_runner import RaggedBatch, _layer_norm, _linear
+from .model_runner import (RaggedBatch, _layer_norm, _linear,
+                           paged_attention)
 
 
 class OPTRaggedRunner:
@@ -47,22 +48,12 @@ def _opt_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: OPTConfig,
     mc = model_cfg
     S, C = batch.tokens.shape
     H, D = mc.num_heads, mc.head_dim
-    bs = cfg.block_size
-    ctx_max = cfg.max_context
-    trash = kv.shape[2] - 1
     scale = 1.0 / (D ** 0.5)
     pre_ln = mc.do_layer_norm_before
 
     pos = batch.start_pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
     valid_q = jnp.arange(C, dtype=jnp.int32)[None, :] < batch.n_tokens[:, None]
     pos_c = jnp.minimum(pos, mc.max_seq_len - 1) + mc.POSITION_OFFSET
-
-    blk = jnp.take_along_axis(
-        batch.block_tables,
-        jnp.minimum(pos // bs, cfg.max_blocks_per_seq - 1), axis=1)
-    write_idx = jnp.where(valid_q, blk * bs + pos % bs, trash)
-    j = jnp.arange(ctx_max, dtype=jnp.int32)
-    ctx_idx = batch.block_tables[:, j // bs] * bs + j % bs
 
     wte = params["embed_tokens"]["embedding"]
     wpe = params["embed_positions"]["embedding"]
@@ -82,18 +73,8 @@ def _opt_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: OPTConfig,
         k = _linear(attn_in, pa["k_proj"], dtype).reshape(S, C, H, D)
         v = _linear(attn_in, pa["v_proj"], dtype).reshape(S, C, H, D)
 
-        kv = kv.at[li, 0, write_idx.reshape(-1)].set(
-            k.reshape(S * C, H, D).astype(kv.dtype))
-        kv = kv.at[li, 1, write_idx.reshape(-1)].set(
-            v.reshape(S * C, H, D).astype(kv.dtype))
-        k_ctx = kv[li, 0][ctx_idx].astype(dtype)
-        v_ctx = kv[li, 1][ctx_idx].astype(dtype)
-
-        s_att = jnp.einsum("schd,skhd->shck", q, k_ctx) * scale
-        mask = j[None, None, None, :] <= pos[:, None, :, None]
-        s_att = jnp.where(mask, s_att.astype(jnp.float32), -jnp.inf)
-        p_att = jax.nn.softmax(s_att, axis=-1).astype(dtype)
-        y = jnp.einsum("shck,skhd->schd", p_att, v_ctx).reshape(S, C, H * D)
+        kv, y = paged_attention(kv, li, q, k, v, batch, cfg, pos, valid_q,
+                                scale, dtype)
         y = _linear(y, pa["out_proj"], dtype)
         x = x + y
         if not pre_ln:

@@ -27,6 +27,46 @@ def make_causal_lm(model, cfg):
     return model, init_fn, loss_fn
 
 
+def chunked_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
+                    targets: jnp.ndarray, num_chunks: int = 8) -> jnp.ndarray:
+    """Mean next-token NLL without ever materializing the full logits.
+
+    ``hidden`` [B, T, C] (compute dtype, e.g. bf16), ``embedding`` [V, C]
+    (the tied LM head), ``targets`` [B, T] int32. The logits for each
+    sequence chunk are computed on the MXU in the compute dtype with fp32
+    accumulation, reduced to (logsumexp - target logit), and DISCARDED —
+    ``jax.checkpoint`` recomputes them in the backward pass. Peak memory is
+    O(B * T/num_chunks * V) instead of O(B * T * V); the reference pays the
+    full-logits cost (its fused CUDA xent kernels live in
+    csrc/transformer/inference; training goes through torch xent).
+    """
+    B, T, C = hidden.shape
+    nc = num_chunks
+    while T % nc:           # degrade gracefully for odd T
+        nc -= 1
+    emb = embedding.astype(hidden.dtype)
+
+    @jax.checkpoint
+    def chunk_nll(h, t):
+        # [B, Tc, C] @ [V, C]^T -> [B, Tc, V] fp32 (bf16 MXU, f32 accum)
+        logits = jax.lax.dot_general(
+            h, emb, (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
+        return (lse - tgt).sum()
+
+    hs = hidden.reshape(B, nc, T // nc, C).swapaxes(0, 1)    # [nc, B, Tc, C]
+    ts = targets.reshape(B, nc, T // nc).swapaxes(0, 1)      # [nc, B, Tc]
+
+    def body(acc, xs):
+        h, t = xs
+        return acc + chunk_nll(h, t), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ts))
+    return total / (B * T)
+
+
 def alibi_slopes(num_heads: int) -> jnp.ndarray:
     """ALiBi per-head slopes (Press et al.): geometric schedule over the
     nearest power of two, with ODD multiples from the 2p schedule filling

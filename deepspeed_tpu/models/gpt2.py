@@ -33,6 +33,10 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16          # compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = False
+    # remat granularity: "full" recomputes the whole block; "dots" saves
+    # matmul outputs and recomputes only elementwise ops (usually the best
+    # memory/FLOPs trade on TPU — the MXU work is never repeated)
+    remat_policy: str = "full"
     use_bias: bool = True
     layer_norm_eps: float = 1e-5   # HF GPT-2 epsilon
     # "auto": Pallas flash attention on TPU, XLA fused attention elsewhere;
@@ -70,26 +74,33 @@ class CausalSelfAttention(nn.Module):
         v = v.reshape(B, T, H, D)
         impl = cfg.attention_impl
         if impl == "auto":
-            # Pallas custom calls carry no GSPMD partitioning rules: under a
-            # multi-device jit, XLA would replicate q/k/v around the kernel.
-            # Auto therefore picks flash only for single-device TPU; sharded
-            # meshes keep the XLA fused attention (which GSPMD partitions).
-            # (The SP paths in parallel/{ulysses,ring_attention}.py currently
-            # use XLA attention too; moving their local attention onto this
-            # kernel inside shard_map is a planned perf step.)
-            single_dev = jax.device_count() == 1
-            impl = "flash" if (jax.default_backend() == "tpu"
-                               and single_dev) else "xla"
+            # Pallas custom calls carry no GSPMD partitioning rules, so a
+            # multi-device jit would replicate q/k/v around the kernel. Auto
+            # picks: single-device TPU -> plain flash; multi-device TPU with
+            # a registered topology -> flash inside shard_map (batch over
+            # data, heads over model); anything else -> XLA fused attention.
+            from deepspeed_tpu.parallel import topology as _topo
+            if jax.default_backend() != "tpu":
+                impl = "xla"
+            elif jax.device_count() == 1:
+                impl = "flash"
+            else:
+                impl = "flash_sharded" if _topo.has_topology() else "xla"
         if impl == "flash":
             from deepspeed_tpu.ops.kernels import flash_attention
             y = flash_attention(q, k, v, causal=True, layout="BTHD")
+        elif impl == "flash_sharded":
+            from deepspeed_tpu.ops.kernels import sharded_flash_attention
+            from deepspeed_tpu.parallel.topology import get_topology
+            y = sharded_flash_attention(q, k, v, get_topology().mesh,
+                                        causal=True, layout="BTHD")
         elif impl == "xla":
             # jax.nn.dot_product_attention lowers to a fused attention on TPU
             y = jax.nn.dot_product_attention(q, k, v, is_causal=True)
         else:
             raise ValueError(
-                f"attention_impl must be 'auto', 'flash' or 'xla', "
-                f"got {cfg.attention_impl!r}")
+                f"attention_impl must be 'auto', 'flash', 'flash_sharded' "
+                f"or 'xla', got {cfg.attention_impl!r}")
         y = y.reshape(B, T, C)
         y = nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                      use_bias=cfg.use_bias, name="c_proj")(y)
@@ -133,7 +144,8 @@ class GPT2(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, tokens, deterministic: bool = True):
+    def __call__(self, tokens, deterministic: bool = True,
+                 return_hidden: bool = False):
         cfg = self.cfg
         B, T = tokens.shape
         wte = nn.Embed(cfg.vocab_size, cfg.hidden_size,
@@ -143,10 +155,18 @@ class GPT2(nn.Module):
         x = wte(tokens) + wpe(jnp.arange(T)[None, :])
         block_cls = Block
         if cfg.remat:
-            block_cls = nn.remat(Block, static_argnums=(2,))
+            policy = None
+            if cfg.remat_policy == "dots":
+                policy = jax.checkpoint_policies.checkpoint_dots
+            block_cls = nn.remat(Block, static_argnums=(2,), policy=policy)
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="ln_f")(x)
+        if return_hidden:
+            # post-ln_f activations in the compute dtype: the training loss
+            # consumes these via the chunked fused cross-entropy
+            # (models/_lm_utils.chunked_lm_xent) instead of full logits
+            return x
         # tied embedding unembed (GPT-2 ties wte)
         logits = wte.attend(x.astype(jnp.float32))
         return logits
@@ -164,13 +184,13 @@ def make_model(cfg: GPT2Config):
         return model.init(rng, tokens)["params"]
 
     def loss_fn(params, batch, rng):
+        from ._lm_utils import chunked_lm_xent
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits = model.apply({"params": params}, inputs,
+        hidden = model.apply({"params": params}, inputs,
                              deterministic=cfg.dropout == 0,
+                             return_hidden=True,
                              rngs={"dropout": rng} if cfg.dropout > 0 else None)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return nll.mean()
+        return chunked_lm_xent(hidden, params["wte"]["embedding"], targets)
 
     return model, init_fn, loss_fn

@@ -19,7 +19,9 @@ def default_interpret() -> bool:
 from .flash_attention import (  # noqa: E402,F401
     flash_attention,
     flash_attention_sparse,
+    sharded_flash_attention,
 )
+from .paged_attention import flash_paged_attention  # noqa: E402,F401
 from .normalization import fused_layer_norm, fused_rms_norm  # noqa: E402,F401
 from .quantization import (  # noqa: E402,F401
     dequantize_blockwise,

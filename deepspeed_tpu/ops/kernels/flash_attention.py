@@ -92,9 +92,10 @@ def _online_softmax_block(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
                           s_mask, sm_scale):
     """One flash block update (shared by the dense and sparse kernels):
     scores for the current (q, k) tile, ``s_mask`` applied, online-softmax
-    accumulators advanced."""
-    q = q_ref[0, 0].astype(jnp.float32)
-    k = k_ref[0, 0].astype(jnp.float32)
+    accumulators advanced. Matmul operands stay in their storage dtype
+    (bf16 runs the MXU at full rate) with fp32 accumulation."""
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
     s = jnp.where(s_mask, s, _NEG_INF)
@@ -319,10 +320,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # matmul operands stay in storage dtype (bf16 MXU) w/ f32 accumulation
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]                                   # (bq, 1)
         delta = delta_ref[0, 0]                               # (bq, 1)
 
@@ -340,7 +342,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
         dq_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
@@ -368,10 +370,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # matmul operands stay in storage dtype (bf16 MXU) w/ f32 accumulation
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]                                   # (bq, 1)
         delta = delta_ref[0, 0]                               # (bq, 1)
 
@@ -386,11 +389,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             mask = jnp.logical_and(mask, row + causal_offset >= col)
         mask = jnp.logical_and(mask, jnp.isfinite(lse))
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)            # (bq, bk)
-        dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        dv_scr[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                         (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale                      # (bq, bk)
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)    # (bq, bk)
         dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
@@ -401,7 +405,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset, interpret,
-         res, g):
+         res, g, dlse=None):
     q, k, v, o, lse = res
     do = g[0]
     b, h, tq, d = q.shape
@@ -410,6 +414,12 @@ def _bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset, interpret,
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)                    # (b, h, tq, 1)
+    if dlse is not None:
+        # lse is a differentiable output here (ring attention combines
+        # per-round partials by lse). Its cotangent folds into the FA-2
+        # backward exactly: ds = p*(dp - delta) gains + p*dlse, i.e. the
+        # same kernels run with delta' = delta - dlse.
+        delta = delta - dlse.astype(jnp.float32)
 
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, j, 0))
     kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, i, 0))
@@ -485,6 +495,31 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_lse(q, k, v, causal, sm_scale, block_q, block_k, kv_len,
+               causal_offset, interpret):
+    """(o, lse) with lse a differentiable output (used by ring attention)."""
+    return _fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len,
+                causal_offset, interpret)
+
+
+def _flash_lse_fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len,
+                   causal_offset, interpret):
+    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len,
+                  causal_offset, interpret)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset,
+                   interpret, res, cts):
+    do, dlse = cts
+    return _bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset,
+                interpret, res, (do,), dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
 # ---------------------------------------------------------------------------
 # public wrapper
 # ---------------------------------------------------------------------------
@@ -492,9 +527,10 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     causal: bool = True, sm_scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 512,
                     layout: str = "BTHD",
-                    interpret: Optional[bool] = None) -> jnp.ndarray:
+                    interpret: Optional[bool] = None,
+                    return_lse: bool = False):
     """Tiled online-softmax attention; differentiable (custom VJP).
 
     Args:
@@ -502,7 +538,13 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
       k, v: same layout; KV head count may divide H (GQA — heads broadcast).
       causal: lower-triangular mask.
       sm_scale: softmax scale, default 1/sqrt(D).
+      block_q/block_k: tile sizes (clamped to the padded sequence). 512/512
+        measured ~1.25x faster than XLA fused attention at T=512 and ~1.9x
+        at T=2048 on v5e (fwd+bwd); 128/128 is ~2x SLOWER — small tiles
+        leave the MXU idle between grid steps.
       interpret: run the Pallas interpreter (defaults to True off-TPU).
+      return_lse: also return the per-row logsumexp (B, H, Tq) fp32 — itself
+        differentiable, so callers (ring attention) can combine partials.
     """
     if interpret is None:
         from . import default_interpret
@@ -539,13 +581,75 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     # bottom-right-aligned causal diagonal (matches jnp.tril(..., k=tk-tq)
     # and jax.nn.dot_product_attention): decode-style tq < tk attends the
     # whole prefix.
-    o = _flash(q, k, v, causal, float(sm_scale), block_q, block_k, tk,
-               tk - tq, interpret)
+    args = (q, k, v, causal, float(sm_scale), block_q, block_k, tk,
+            tk - tq, interpret)
+    if return_lse:
+        o, lse = _flash_lse(*args)
+        lse = lse[..., 0]                                  # (b, h, tq_p)
+        if pad_q:
+            lse = lse[:, :, :tq]
+    else:
+        o = _flash(*args)
     if pad_q:
         o = o[:, :, :tq, :]
     if layout == "BTHD":
         o = jnp.swapaxes(o, 1, 2)
-    return o
+    return (o, lse) if return_lse else o
+
+
+def sharded_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                            mesh, *, causal: bool = True,
+                            sm_scale: Optional[float] = None,
+                            layout: str = "BTHD",
+                            batch_axes=("data", "data_inner"),
+                            head_axis: str = "model",
+                            interpret: Optional[bool] = None) -> jnp.ndarray:
+    """``flash_attention`` under ``shard_map``: batch over the data axes,
+    heads over the model axis, full sequence local. This is the DP/ZeRO/TP
+    wrapping (batch and heads are embarrassingly parallel for attention) —
+    Pallas custom calls carry no GSPMD rules, so without this a multi-device
+    jit would replicate q/k/v around the kernel. SP meshes go through
+    ``parallel/ulysses.py`` / ``parallel/ring_attention.py`` instead, which
+    use the kernel as their local attention.
+
+    Falls back to fewer sharded dims when sizes don't divide. q/k/v are
+    (B, T, H, D) for layout="BTHD" (flax convention) or (B, H, T, D).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if layout == "BTHD":
+        b_dim, h_dim = 0, 2
+    elif layout == "BHTD":
+        b_dim, h_dim = 0, 1
+    else:
+        raise ValueError(f"unknown layout {layout!r}")
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    bat = tuple(a for a in batch_axes
+                if sizes.get(a, 1) > 1 and q.shape[b_dim] % sizes[a] == 0)
+    bsz = int(np.prod([sizes[a] for a in bat])) if bat else 1
+    if bat and q.shape[b_dim] % bsz:
+        bat = bat[:1]
+        bsz = sizes[bat[0]]
+    hd = (head_axis if head_axis and sizes.get(head_axis, 1) > 1
+          and q.shape[h_dim] % sizes[head_axis] == 0
+          and k.shape[h_dim] % sizes[head_axis] == 0 else None)
+
+    spec = [None, None, None, None]
+    spec[b_dim] = bat if bat else None
+    spec[h_dim] = hd
+    pspec = P(*spec)
+    if pspec == P(None, None, None, None):
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                               layout=layout, interpret=interpret)
+
+    def local(q_, k_, v_):
+        return flash_attention(q_, k_, v_, causal=causal, sm_scale=sm_scale,
+                               layout=layout, interpret=interpret)
+
+    return shard_map(local, mesh=mesh, in_specs=(pspec, pspec, pspec),
+                     out_specs=pspec, check_vma=False)(q, k, v)
 
 
 def attention_reference(q, k, v, *, causal=True, sm_scale=None,

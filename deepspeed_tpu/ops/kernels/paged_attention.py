@@ -1,0 +1,208 @@
+"""Paged-KV flash attention (Pallas TPU) — the FastGen decode hot loop.
+
+TPU-native analogue of the reference's blocked flash decode
+(``inference/v2/kernels/ragged_ops/blocked_flash/``, wired at
+``inference/v2/model_implementations/inference_transformer_base.py``): flash
+attention reads K/V DIRECTLY through per-sequence block tables, so each step
+touches only the blocks a sequence actually occupies. The block tables ride
+scalar prefetch (their values drive the K/V BlockSpec index maps), and dead
+grid steps (past a sequence's live block count) repeat the previous block
+index — a revisited block costs no DMA (same trick as the splash-style
+sparse kernel in flash_attention.py). Replaces the dense
+``[max_seqs, max_context]`` gather-then-mask attention, whose per-step HBM
+traffic scaled with ``max_context`` regardless of actual lengths.
+
+Layout contract (matches BlockedKVCache): the flat KV pool
+``[slots, KV_heads, D]`` has ``slots = (num_blocks + 1) * block_size`` — the
+final block is the trash block (padded query positions scatter there), so
+``pool.reshape(num_blocks + 1, block_size, KV, D)`` is a free reshape, never
+a copy. Block tables only ever reference blocks < num_blocks.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float("-inf")
+_LANES = 128
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _paged_kernel(starts_ref, fetch_ref, nlive_ref, lo_ref, slopes_ref,
+                  q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                  *, bs, C, H, KV, D, sm_scale, use_alibi, window):
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+    HC = H * C
+    g = H // KV
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, _NEG_INF, m_scr.dtype)
+        l_scr[:] = jnp.zeros(l_scr.shape, l_scr.dtype)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, acc_scr.dtype)
+
+    @pl.when(jnp.logical_and(j >= lo_ref[s], j < nlive_ref[s]))
+    def _compute():
+        q = q_ref[0]                                   # [C, H, D]
+        kb = k_ref[0]                                  # [bs, KV, D]
+        vb = v_ref[0]
+        # per-chunk-position query positions and this block's column range
+        pos_q = starts_ref[s] + jax.lax.broadcasted_iota(
+            jnp.int32, (C, bs), 0)                     # [C, bs]
+        col = j * bs + jax.lax.broadcasted_iota(jnp.int32, (C, bs), 1)
+        causal = col <= pos_q
+        if window is not None:                         # mistral sliding window
+            causal = jnp.logical_and(causal, col > pos_q - window)
+        dist = (pos_q - col).astype(jnp.float32)
+
+        # rows are head-major: scores row h*C + c <-> (head h, chunk pos c)
+        parts = []
+        for h in range(H):
+            qh = q[:, h, :]                            # [C, D]
+            kh = kb[:, h // g, :]                      # [bs, D]
+            sc = jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            if use_alibi:
+                sc = sc - slopes_ref[h] * dist         # static-index SMEM read
+            parts.append(jnp.where(causal, sc, _NEG_INF))
+        scores = jnp.concatenate(parts, axis=0)        # [HC, bs] f32
+
+        m_prev, l_prev = m_scr[:], l_scr[:]
+        m_cur = jnp.max(scores, axis=1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_cur)
+        # a row can be fully masked in its first processed block (sliding
+        # window): m_next stays -inf there, and exp(-inf - -inf) would be
+        # nan — clamp through a finite stand-in (p comes out 0 either way)
+        m_safe = jnp.where(jnp.isfinite(m_next), m_next, 0.0)
+        alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+        p = jnp.exp(jnp.where(jnp.isfinite(scores),
+                              scores - m_safe[:, :1], _NEG_INF))
+        l_scr[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[:] = m_next
+        pv_parts = []
+        for h in range(H):
+            ph = p[h * C:(h + 1) * C, :].astype(vb.dtype)    # [C, bs]
+            pv_parts.append(jax.lax.dot_general(
+                ph, vb[:, h // g, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        acc_scr[:] = acc_scr[:] * alpha[:, :1] + jnp.concatenate(pv_parts, 0)
+
+    @pl.when(j == nb - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)           # idle slots emit zeros
+        o = acc_scr[:] / l_safe                        # [HC, D]
+        o_ref[0] = o.reshape(H, C, D).swapaxes(0, 1).astype(o_ref.dtype)
+
+
+def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                          v_pool: jnp.ndarray, block_tables: jnp.ndarray,
+                          start_pos: jnp.ndarray, seq_lens: jnp.ndarray,
+                          *, block_size: int,
+                          sm_scale: Optional[float] = None,
+                          alibi_slopes: Optional[jnp.ndarray] = None,
+                          sliding_window: Optional[int] = None,
+                          interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Flash attention over paged KV.
+
+    Args:
+      q: [S, C, H, D] — C query tokens per slot (1 for pure decode;
+        SplitFuse prefill chunks are larger). The step's K/V must ALREADY be
+        scattered into the pool (causal masking handles the chunk interior).
+      k_pool/v_pool: [slots, KV, D] with slots = (num_blocks+1)*block_size
+        (trailing trash block).
+      block_tables: [S, MAXB] int32 — pool block id per sequence block.
+      start_pos: [S] int32 — absolute position of q[s, 0].
+      seq_lens: [S] int32 — total live context length (incl. this chunk);
+        0 marks an idle slot (emits zeros).
+      alibi_slopes: optional [H] f32 — in-kernel ALiBi bias (falcon/bloom).
+
+    Returns [S, C, H, D] attention outputs in q.dtype. HBM traffic per step
+    is O(sum of live blocks), not O(S * max_context).
+    """
+    if interpret is None:
+        from . import default_interpret
+        interpret = default_interpret()
+    S, C, H, D = q.shape
+    slots, KV, Dk = k_pool.shape
+    bs = block_size
+    if Dk != D:
+        raise ValueError(f"head_dim mismatch q={D} pool={Dk}")
+    if H % KV:
+        raise ValueError(f"GQA requires H % KV == 0 ({H}/{KV})")
+    if slots % bs:
+        raise ValueError(
+            f"pool slots ({slots}) must be a multiple of block_size ({bs}); "
+            f"allocate (num_blocks+1)*block_size with a trailing trash block")
+    nb_pool = slots // bs
+    maxb = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+
+    kp = k_pool.reshape(nb_pool, bs, KV, D)
+    vp = v_pool.reshape(nb_pool, bs, KV, D)
+
+    nlive = jnp.minimum((seq_lens + bs - 1) // bs, maxb).astype(jnp.int32)
+    # sliding window: blocks entirely below every query's window are dead too
+    if sliding_window is not None:
+        lo = jnp.maximum(start_pos - sliding_window + 1, 0) // bs
+        lo = jnp.minimum(lo.astype(jnp.int32), jnp.maximum(nlive - 1, 0))
+    else:
+        lo = jnp.zeros_like(nlive)
+    # dead steps re-fetch a live block: no new DMA
+    jj = jnp.arange(maxb, dtype=jnp.int32)[None, :]
+    fetch = jnp.take_along_axis(
+        block_tables.astype(jnp.int32),
+        jnp.clip(jj, lo[:, None], jnp.maximum(nlive[:, None] - 1, 0)), axis=1)
+
+    use_alibi = alibi_slopes is not None
+    slopes = (jnp.asarray(alibi_slopes, jnp.float32) if use_alibi
+              else jnp.zeros((H,), jnp.float32))
+
+    HC = H * C
+    kernel = functools.partial(
+        _paged_kernel, bs=bs, C=C, H=H, KV=KV, D=D, sm_scale=float(sm_scale),
+        use_alibi=use_alibi,
+        window=int(sliding_window) if sliding_window is not None else None)
+
+    def kv_index(s, j, starts_ref, fetch_ref, nlive_ref, lo_ref, slopes_ref):
+        del starts_ref, nlive_ref, lo_ref, slopes_ref
+        return (fetch_ref[s * maxb + j], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(S, maxb),
+        in_specs=[
+            pl.BlockSpec((1, C, H, D), lambda s, j, *_: (s, 0, 0, 0)),
+            pl.BlockSpec((1, bs, KV, D), kv_index),
+            pl.BlockSpec((1, bs, KV, D), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, C, H, D), lambda s, j, *_: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((HC, _LANES), jnp.float32),
+            pltpu.VMEM((HC, _LANES), jnp.float32),
+            pltpu.VMEM((HC, D), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, C, H, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(start_pos.astype(jnp.int32), fetch.reshape(-1),
+      nlive, lo, slopes, q, kp, vp)

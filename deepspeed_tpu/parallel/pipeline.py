@@ -23,6 +23,7 @@ description and ``parameters``/``uniform``/``type:regex`` partition methods
 from __future__ import annotations
 
 import dataclasses
+import functools
 import re
 from typing import Any, Callable, List, Optional, Sequence
 
@@ -61,8 +62,17 @@ class LayerSpec:
 @dataclasses.dataclass
 class TiedLayerSpec(LayerSpec):
     """Layer sharing params with another by key (reference pipe/module.py:77).
-    In JAX, tying = reusing the same param subtree; the spec records intent."""
+
+    In JAX, tying is a real mechanism, not intent: all specs with the same
+    ``key`` read ONE param subtree (stored once under ``params["tied"][key]``
+    by ``PipelineModule``), and because that subtree enters the pipeline's
+    ``shard_map`` replicated, ``jax.grad`` psums its per-stage gradient
+    contributions across the pipe axis — the automatic form of the
+    reference's ``_exec_reduce_tied_grads`` allreduce (pipe/engine.py:275).
+    ``forward_fn(params, x)`` overrides the module's apply for the non-owning
+    use (e.g. embedding-transpose unembed)."""
     key: str = ""
+    forward_fn: Optional[Callable] = None
 
 
 def partition_layers(layers: Sequence[LayerSpec], num_stages: int,
@@ -192,3 +202,238 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jnp.ndarray,
                   in_specs=(param_spec, batch_spec),
                   out_specs=batch_spec, check_vma=False)(stage_params, micro)
     return y.reshape(B, *y.shape[2:])
+
+
+# --------------------------------------------------------------------- #
+# engine-integrated pipeline module
+# --------------------------------------------------------------------- #
+
+class PipelineModule:
+    """Trainable pipeline model the Engine can drive — the analogue of the
+    reference's ``PipelineModule`` + ``PipelineEngine.train_batch``
+    (runtime/pipe/module.py:86, engine.py:338), re-designed for one compiled
+    SPMD program instead of an instruction interpreter.
+
+    ``layers`` is a flat LayerSpec list (embed ... blocks ... head), each
+    spec building an object with ``.init(rng, x) -> params`` and
+    ``.apply(params, x) -> y`` (flax modules qualify). Layers are partitioned
+    into ``num_stages = mesh.shape["pipe"]`` groups by ``partition_method``
+    (reference ``_partition_layers`` semantics). Stage s runs its sublist as
+    one ``lax.switch`` branch inside a fill/drain ring over the pipe axis:
+
+        step t: stage 0 feeds microbatch t; stage s computes its branch on
+        the ppermute'd boundary activation; the LAST stage also computes the
+        per-microbatch loss (so only boundary-shaped tensors ever ride the
+        ring — tokens in, loss out, no logits traffic).
+
+    Schedule/bubble math: with m microbatches and P stages the compiled
+    fill/drain loop runs m + P - 1 steps, so the bubble fraction is
+    (P-1)/(m+P-1) — GPipe's. The reference's 1F1B has the SAME bubble; what
+    1F1B buys on GPUs is peak activation memory (P microbatches in flight
+    instead of m). Here that role is played by ``remat=True`` (default):
+    each stage keeps only its boundary activations [m, mb, T, C] and
+    recomputes the interior in backward, which is the memory profile 1F1B
+    targets, without hand-scheduling the reverse stream (autodiff of the
+    scan IS the reverse schedule). Use m >> P to amortize the bubble.
+
+    The engine consumes this via ``loss_fn`` / ``init`` — train_batch, GAS,
+    loss scaling, ZeRO (over data axes), checkpointing all compose unchanged.
+    """
+
+    def __init__(self, layers: Sequence[LayerSpec], mesh: Mesh,
+                 num_microbatches: int,
+                 loss_fn: Optional[Callable] = None,
+                 input_fn: Optional[Callable] = None,
+                 partition_method: str = "uniform",
+                 pipe_axis: str = PIPE_AXIS,
+                 remat: bool = True):
+        self.specs = list(layers)
+        self.mesh = mesh
+        self.pipe_axis = pipe_axis
+        self.num_stages = mesh.shape.get(pipe_axis, 1)
+        self.num_microbatches = num_microbatches
+        self.remat = remat
+        # batch -> stage-0 input; default: next-token LM on batch["tokens"]
+        self.input_fn = input_fn or (lambda b: b["tokens"][:, :-1])
+        # (last_layer_out, batch_slice) -> scalar mean loss; default: NLL
+        self.loss_head = loss_fn or _default_lm_loss
+        self.bounds = partition_layers(self.specs, self.num_stages,
+                                       partition_method)
+        self._built = [s.build() for s in self.specs]
+
+    # ------------------------------ init ------------------------------ #
+
+    def init(self, rng, sample_batch) -> Any:
+        """Build the params pytree {"stages": (tree...,), "tied": {...}} by
+        running the layers once on a host-side sample; validates that every
+        stage boundary carries the same activation signature."""
+        x = self.input_fn(sample_batch)
+        stage_params: List[Any] = []
+        tied: dict = {}
+        boundary_sig = None
+        for s in range(self.num_stages):
+            group: List[Any] = []
+            for i in range(self.bounds[s], self.bounds[s + 1]):
+                spec, mod = self.specs[i], self._built[i]
+                rng, sub = jax.random.split(rng)
+                if isinstance(spec, TiedLayerSpec) and spec.key in tied:
+                    p = tied[spec.key]       # share the existing subtree
+                    group.append(None)       # marker: read from tied
+                else:
+                    p = mod.init(sub, x)
+                    if isinstance(spec, TiedLayerSpec):
+                        tied[spec.key] = p
+                        group.append(None)
+                    else:
+                        group.append(p)
+                x = self._apply_layer(i, p, x)
+            if s < self.num_stages - 1:
+                sig = (jnp.shape(x), jnp.result_type(x))
+                if boundary_sig is None:
+                    boundary_sig = sig
+                elif sig != boundary_sig:
+                    raise ValueError(
+                        f"stage {s} boundary signature {sig} != stage 0's "
+                        f"{boundary_sig}; pipeline stages must exchange "
+                        f"identically-shaped activations (choose partition "
+                        f"bounds so embed/head sit inside the first/last "
+                        f"stage)")
+            stage_params.append(tuple(group))
+        self._boundary_sig = boundary_sig
+        return {"stages": tuple(stage_params), "tied": tied}
+
+    def _apply_layer(self, i: int, p: Any, x):
+        spec, mod = self.specs[i], self._built[i]
+        if isinstance(spec, TiedLayerSpec) and spec.forward_fn is not None:
+            return spec.forward_fn(p, x)
+        return mod.apply(p, x)
+
+    # ----------------------------- loss ------------------------------- #
+
+    def loss_fn(self, params, batch, rng):
+        del rng
+        m = self.num_microbatches
+        P_ = self.num_stages
+        if P_ == 1:
+            x = self.input_fn(batch)
+            x = self._run_stage(0, params, x)
+            return self.loss_head(x, batch)
+
+        if not hasattr(self, "_boundary_sig"):
+            # params came from disk without an in-process init(): derive the
+            # boundary signature abstractly from stage 0
+            mb = jax.tree_util.tree_leaves(batch)[0].shape[0] // m
+            sample = jax.tree_util.tree_map(lambda a: a[:mb], batch)
+            sd = jax.eval_shape(
+                lambda p, b: self._stage_fn(0, p)(self.input_fn(b)),
+                params, sample)
+            self._boundary_sig = (sd.shape, sd.dtype)
+
+        micro = jax.tree_util.tree_map(
+            lambda a: a.reshape((m, a.shape[0] // m) + a.shape[1:]), batch)
+
+        dp_axes = tuple(a for a in ("data", "data_inner")
+                        if self.mesh.shape.get(a, 1) > 1)
+        bspec = P(None, dp_axes) if dp_axes else P(None)
+        # Params enter replicated across the pipe axis: with heterogeneous
+        # per-stage subtrees there is no stackable leading dim to shard over
+        # ``pipe`` (each device COMPUTES only its switch branch, but holds
+        # the full tree). Param-memory scaling comes from ZeRO over the data
+        # axes instead (gathered at this boundary per step, like any stage-3
+        # step); for homogeneous block stacks, ``pipeline_apply`` +
+        # ``stack_stage_params`` DOES shard params over ``pipe``.
+        pspec = jax.tree_util.tree_map(lambda _: P(), params)
+
+        return shard_map(self._ring_schedule, mesh=self.mesh,
+                         in_specs=(pspec, jax.tree_util.tree_map(
+                             lambda _: bspec, micro)),
+                         out_specs=P(), check_vma=False)(params, micro)
+
+    def _run_stage(self, s: int, params, x):
+        fn = self._stage_fn(s, params)
+        return fn(x)
+
+    def _stage_fn(self, s: int, params):
+        def run(x):
+            for i in range(self.bounds[s], self.bounds[s + 1]):
+                spec = self.specs[i]
+                if isinstance(spec, TiedLayerSpec):
+                    p = params["tied"][spec.key]
+                else:
+                    p = params["stages"][s][i - self.bounds[s]]
+                x = self._apply_layer(i, p, x)
+            return x
+        return jax.checkpoint(run) if self.remat else run
+
+    def _ring_schedule(self, params, micro):
+        """Inside shard_map over the pipe axis (and data axes for batch)."""
+        m, n_stages = self.num_microbatches, self.num_stages
+        idx = jax.lax.axis_index(self.pipe_axis)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        shape, dtype = self._boundary_sig
+        mb = jax.tree_util.tree_leaves(micro)[0].shape[1]
+        bshape = (mb,) + tuple(shape[1:])
+
+        def branch(s):
+            def run(tok_batch, buf):
+                fn = self._stage_fn(s, params)
+                if s == 0:
+                    out = fn(self.input_fn(tok_batch))
+                    loss = jnp.zeros((), jnp.float32)
+                elif s == n_stages - 1:
+                    y = fn(buf)
+                    loss = self.loss_head(y, tok_batch).astype(jnp.float32)
+                    out = jnp.zeros(bshape, dtype)
+                else:
+                    out = fn(buf)
+                    loss = jnp.zeros((), jnp.float32)
+                if out.shape != bshape or out.dtype != dtype:
+                    raise ValueError(
+                        f"stage {s} emitted {out.shape}/{out.dtype}, "
+                        f"boundary is {bshape}/{dtype}")
+                return out, loss
+            return run
+
+        branches = [branch(s) for s in range(n_stages)]
+        total_steps = m + n_stages - 1
+
+        def step(carry, t):
+            buf_in, loss_acc = carry
+            # stage 0 consumes microbatch t; the last stage consumes t-(P-1)
+            my_t = jnp.where(idx == n_stages - 1, t - (n_stages - 1), t)
+            my_t_c = jnp.clip(my_t, 0, m - 1)
+            mb_slice = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, my_t_c,
+                                                       keepdims=False), micro)
+            out, loss = jax.lax.switch(idx, branches, mb_slice, buf_in)
+            valid = jnp.logical_and(my_t >= 0, my_t <= m - 1)
+            loss_acc = loss_acc + jnp.where(
+                jnp.logical_and(idx == n_stages - 1, valid), loss, 0.0)
+            buf_next = comm.ppermute(out, perm, axis_name=self.pipe_axis,
+                                     log_name="pipe_send_activations")
+            return (buf_next, loss_acc), None
+
+        buf0 = jnp.zeros(bshape, dtype)
+        (_, loss_sum), _ = jax.lax.scan(
+            step, (buf0, jnp.zeros((), jnp.float32)), jnp.arange(total_steps))
+        # only the last stage accumulated loss; psum broadcasts it, and the
+        # same psum over the data axes averages the data-parallel shards
+        loss = jax.lax.psum(
+            jnp.where(idx == n_stages - 1, loss_sum, 0.0), self.pipe_axis) / m
+        for a in ("data", "data_inner"):
+            if self.mesh.shape.get(a, 1) > 1:
+                loss = jax.lax.pmean(loss, a)
+        return loss
+
+
+def _default_lm_loss(out, batch):
+    """Mean next-token NLL: ``out`` [mb, T, V] logits, batch["tokens"]
+    [mb, T+1]. Computed as logsumexp - target logit (no [mb, T, V] log_softmax
+    materialization). For a real vocab, prefer a last stage that emits HIDDEN
+    states and a ``loss_fn`` built on ``models/_lm_utils.chunked_lm_xent``
+    (hidden @ embedding fused per chunk) — then full logits never exist."""
+    targets = batch["tokens"][:, 1:]
+    logits = out.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return (lse - tgt).mean()

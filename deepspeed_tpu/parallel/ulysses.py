@@ -111,8 +111,23 @@ def sp_cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray, mesh: Mesh,
 
 def ulysses_attention(query, key, value, mesh: Mesh,
                       local_attention: Optional[Callable] = None,
-                      seq_axis: str = SEQ_AXIS, causal: bool = True):
-    """Functional one-shot form of DistributedAttention."""
-    attn = local_attention or functools.partial(
-        jax.nn.dot_product_attention, is_causal=causal)
+                      seq_axis: str = SEQ_AXIS, causal: bool = True,
+                      use_kernel: Optional[bool] = None,
+                      interpret: Optional[bool] = None):
+    """Functional one-shot form of DistributedAttention.
+
+    The post-a2a local attention (heads sharded, full sequence) is exactly
+    the Pallas flash kernel's shape, so ``use_kernel`` (default on TPU) runs
+    it per device; False keeps the XLA fused attention."""
+    attn = local_attention
+    if attn is None:
+        if use_kernel is None:
+            use_kernel = jax.default_backend() == "tpu"
+        if use_kernel:
+            from ..ops.kernels import flash_attention
+            attn = functools.partial(flash_attention, causal=causal,
+                                     layout="BTHD", interpret=interpret)
+        else:
+            attn = functools.partial(
+                jax.nn.dot_product_attention, is_causal=causal)
     return DistributedAttention(attn, mesh, seq_axis)(query, key, value)
