@@ -186,6 +186,7 @@ _PHI_MAP = [
 ARCH_MAPS = {
     "llama": _LLAMA_MAP,
     "mistral": _LLAMA_MAP,
+    "qwen": _LLAMA_MAP,    # v1: fused names pre-split by _split_qwen_fused
     "qwen2": _LLAMA_MAP,
     "phi3": _LLAMA_MAP,
     "phi": _PHI_MAP,
@@ -270,8 +271,49 @@ def _qwen2_moe_experts(state, hf_cfg):
 
 #: pre-conversion transforms keyed by arch (fused-tensor splitting,
 #: per-expert stacking)
+def _split_qwen_fused(state: Dict[str, np.ndarray],
+                      hf_cfg: Dict) -> Dict[str, np.ndarray]:
+    """Qwen v1 (model_type "qwen", the original Qwen-7B layout — reference
+    inference/v2/model_implementations/qwen/): fused ``c_attn`` qkv and
+    ``w1``/``w2``/``c_proj`` SwiGLU rename to llama-style unfused names so
+    _LLAMA_MAP applies. Qwen's MLP is ``c_proj(w1(x) * silu(w2(x)))`` —
+    w2 is the gate (silu branch), w1 the up projection."""
+    out: Dict[str, np.ndarray] = {}
+    H = int(hf_cfg["hidden_size"])
+    for name, arr in state.items():
+        n = name.replace("transformer.h.", "model.layers.")
+        if n.endswith(".attn.c_attn.weight") or \
+                n.endswith(".attn.c_attn.bias"):
+            base = n[:n.index(".attn.c_attn.")]
+            leaf = name.split(".")[-1]
+            q, k, v = arr[:H], arr[H:2 * H], arr[2 * H:]
+            out[f"{base}.self_attn.q_proj.{leaf}"] = q
+            out[f"{base}.self_attn.k_proj.{leaf}"] = k
+            out[f"{base}.self_attn.v_proj.{leaf}"] = v
+        elif n.endswith(".attn.c_proj.weight"):
+            out[n.replace(".attn.c_proj.", ".self_attn.o_proj.")] = arr
+        elif ".mlp.w2." in n:                       # silu branch = gate
+            out[n.replace(".mlp.w2.", ".mlp.gate_proj.")] = arr
+        elif ".mlp.w1." in n:                       # multiplicative branch
+            out[n.replace(".mlp.w1.", ".mlp.up_proj.")] = arr
+        elif ".mlp.c_proj." in n:
+            out[n.replace(".mlp.c_proj.", ".mlp.down_proj.")] = arr
+        elif ".ln_1." in n:
+            out[n.replace(".ln_1.", ".input_layernorm.")] = arr
+        elif ".ln_2." in n:
+            out[n.replace(".ln_2.", ".post_attention_layernorm.")] = arr
+        elif name.endswith("transformer.wte.weight"):
+            out["model.embed_tokens.weight"] = arr
+        elif name.endswith("transformer.ln_f.weight"):
+            out["model.norm.weight"] = arr
+        else:
+            out[n] = arr                            # lm_head etc.
+    return out
+
+
 SPECIAL_HANDLERS = {
     "phi3": _split_phi3_fused,
+    "qwen": _split_qwen_fused,
     "mixtral": _mixtral_experts,
     "qwen2_moe": _qwen2_moe_experts,
 }

@@ -263,6 +263,14 @@ class MeshConfig(ConfigModel):
     expert: int = 1       # expert parallel (MoE)
     # axis ordering innermost-last; ICI-heavy axes should be innermost
     axis_order: List[str] = field(default_factory=lambda: ["pipe", "data", "expert", "seq", "model"])
+    # Reference EP group orderings (utils/groups.py:117,188 — the two
+    # expert/data factorizations are behavioral spec): "inside_data" makes
+    # expert groups CONTIGUOUS ranks (EP-before-DP,
+    # _create_expert_and_data_parallel); "outside_data" moves expert outside
+    # data so expert groups STRIDE across data groups (DP-before-EP).
+    # None (default) leaves axis_order exactly as given; setting a value
+    # overrides the data/expert relative position in axis_order.
+    expert_placement: Optional[str] = None
 
 
 @dataclass

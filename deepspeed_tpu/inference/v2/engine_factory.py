@@ -20,8 +20,8 @@ from .config import RaggedInferenceConfig
 from .engine_v2 import InferenceEngineV2
 
 #: arches whose HF weights map exactly AND that have a ragged runner
-_RAGGED_ARCHES = {"llama", "mistral", "qwen2", "phi3", "phi", "gpt2", "opt",
-                  "mixtral", "qwen2_moe"}
+_RAGGED_ARCHES = {"llama", "mistral", "qwen", "qwen2", "phi3", "phi", "gpt2",
+                  "opt", "mixtral", "qwen2_moe"}
 
 
 def build_hf_engine(model_dir: str,

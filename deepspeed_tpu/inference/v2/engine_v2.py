@@ -104,6 +104,43 @@ class InferenceEngineV2:
     def flush(self, uid: int) -> None:
         self.state.flush(uid)
 
+    def pause(self, uid: int) -> None:
+        """Evict a sequence's KV blocks to host memory and free them — the
+        pool can then be oversubscribed by other sequences. Reference:
+        ``BlockedKVCache.offload`` (inference/v2/ragged/kv_cache.py:166).
+        The sequence must have no in-flight tokens."""
+        seq = self.state.get(uid)
+        if seq is None:
+            raise KeyError(f"unknown sequence {uid}")
+        if seq.status is SequenceStatus.PAUSED:
+            return
+        if seq.in_flight:
+            raise ValueError(
+                f"sequence {uid} has {seq.in_flight} pending tokens; run "
+                f"them (put) before pausing")
+        seq.host_kv = self.kv_cache.offload(self._kv_data, seq.kv_blocks)
+        self.kv_cache.free(seq.kv_blocks)
+        seq.kv_blocks = []
+        seq.status = SequenceStatus.PAUSED
+
+    def resume(self, uid: int) -> None:
+        """Re-allocate blocks for a paused sequence and restore its KV from
+        host memory, exactly as it was (reference ``restore``,
+        kv_cache.py:176). Block ids may differ — tables are per-sequence."""
+        seq = self.state.get(uid)
+        if seq is None:
+            raise KeyError(f"unknown sequence {uid}")
+        if seq.status is not SequenceStatus.PAUSED:
+            return
+        bs = self.config.block_size
+        need = -(-seq.seen_tokens // bs)
+        blocks = self.kv_cache.reserve(need)
+        self._kv_data = self.kv_cache.restore(self._kv_data, seq.host_kv,
+                                              blocks)
+        seq.kv_blocks = list(blocks)
+        seq.host_kv = None
+        seq.status = SequenceStatus.WAITING
+
     @property
     def free_blocks(self) -> int:
         return self.kv_cache.free_blocks

@@ -47,3 +47,34 @@ class BlockedKVCache:
 
     def memory_bytes(self) -> int:
         return self.data.size * self.data.dtype.itemsize
+
+    # ------------------- host offload / restore ----------------------- #
+    # Reference parity: BlockedKVCache.offload/restore
+    # (/root/reference/deepspeed/inference/v2/ragged/kv_cache.py:166,176) —
+    # a paused sequence's blocks move to host memory so the pool can be
+    # oversubscribed; restore scatters them into freshly allocated blocks
+    # (the block ids need not match: block tables are per-sequence).
+
+    def _slot_indices(self, blocks):
+        import numpy as np
+        bs = self.cfg.block_size
+        blocks = np.asarray(list(blocks), np.int32)
+        return (blocks[:, None] * bs + np.arange(bs)[None, :]).reshape(-1)
+
+    def offload(self, kv_data: jnp.ndarray, blocks) -> "Any":
+        """Gather ``blocks`` of a (functional) kv buffer to host memory.
+        Returns a numpy array [layers, 2, len(blocks)*bs, KV, D]."""
+        import jax
+        idx = self._slot_indices(blocks)
+        return jax.device_get(kv_data[:, :, idx])
+
+    def restore(self, kv_data: jnp.ndarray, host_buf, blocks) -> jnp.ndarray:
+        """Scatter a host buffer from :meth:`offload` into ``blocks``;
+        returns the updated kv buffer."""
+        idx = self._slot_indices(blocks)
+        if host_buf.shape[2] != idx.size:
+            raise ValueError(
+                f"restore: buffer holds {host_buf.shape[2]} slots, "
+                f"{idx.size} requested")
+        return kv_data.at[:, :, idx].set(
+            jnp.asarray(host_buf, kv_data.dtype))

@@ -16,6 +16,7 @@ from typing import List
 class SequenceStatus(enum.Enum):
     WAITING = "waiting"        # has pending tokens, not yet scheduled
     RUNNING = "running"        # scheduled in the current/last batch
+    PAUSED = "paused"          # KV evicted to host (engine.pause)
     FINISHED = "finished"      # flushed / EOS'd by the caller
 
 
@@ -27,6 +28,7 @@ class SequenceDescriptor:
     kv_blocks: List[int] = field(default_factory=list)
     status: SequenceStatus = SequenceStatus.WAITING
     generated: List[int] = field(default_factory=list)
+    host_kv: object = None                # offloaded KV (engine.pause)
 
     @property
     def in_flight(self) -> int:

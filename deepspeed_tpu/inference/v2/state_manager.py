@@ -37,6 +37,10 @@ class StateManager:
 
     def put_tokens(self, uid: int, tokens: Iterable[int]) -> SequenceDescriptor:
         seq = self.get_or_create(uid)
+        if seq.status is SequenceStatus.PAUSED:
+            raise ValueError(
+                f"sequence {uid} is paused (KV offloaded to host); call "
+                f"engine.resume({uid}) before feeding more tokens")
         seq.pending_tokens.extend(int(t) for t in tokens)
         if seq.status is not SequenceStatus.RUNNING:
             seq.status = SequenceStatus.WAITING

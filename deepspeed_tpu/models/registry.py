@@ -64,6 +64,26 @@ def _entry_qwen2(d):
     return LlamaConfig(**_hf_llama(d, qkv_bias=True))
 
 
+def _entry_qwen(d):
+    """Qwen v1 (original Qwen-7B; reference
+    inference/v2/model_implementations/qwen/): llama-shaped with biased
+    fused qkv, RMSNorm, SwiGLU whose config ``intermediate_size`` counts
+    BOTH branches (per-branch width is half), and its own config key names
+    (seq_length / rotary_emb_base / layer_norm_epsilon)."""
+    return LlamaConfig(
+        vocab_size=d.get("vocab_size", 151936),
+        max_seq_len=d.get("seq_length", 8192),
+        num_layers=d.get("num_hidden_layers", 32),
+        num_heads=d.get("num_attention_heads", 32),
+        num_kv_heads=d.get("num_attention_heads", 32),
+        hidden_size=d.get("hidden_size", 4096),
+        intermediate_size=d.get("intermediate_size", 22016) // 2,
+        rope_theta=d.get("rotary_emb_base", 10000.0),
+        rms_eps=d.get("layer_norm_epsilon", 1e-6),
+        tie_embeddings=d.get("tie_word_embeddings", False),
+        qkv_bias=True)
+
+
 def _entry_mixtral(d):
     return MixtralConfig(**_hf_llama(
         d,
@@ -167,6 +187,7 @@ ARCHITECTURES: Dict[str, ArchEntry] = {
     "gpt2": ArchEntry(GPT2Config, GPT2, make_gpt2, _entry_gpt2),
     "llama": ArchEntry(LlamaConfig, Llama, make_llama, _entry_llama),
     "mistral": ArchEntry(LlamaConfig, Llama, make_llama, _entry_mistral),
+    "qwen": ArchEntry(LlamaConfig, Llama, make_llama, _entry_qwen),
     "qwen2": ArchEntry(LlamaConfig, Llama, make_llama, _entry_qwen2),
     "mixtral": ArchEntry(MixtralConfig, Mixtral, make_mixtral, _entry_mixtral),
     "bert": ArchEntry(BertConfig, Bert, make_bert, _entry_bert),

@@ -105,6 +105,10 @@ class MoE(nn.Module):
     dtype: jnp.dtype = jnp.float32
     activation: Callable = nn.gelu
     gated: bool = False                   # SwiGLU experts (mixtral/qwen2-moe)
+    # experts-TP (reference moe/mappings.py + tutorial TP-for-experts):
+    # expert weights additionally shard their HIDDEN dim over the "model"
+    # axis (column-parallel wi, row-parallel wo) with one psum after wo.
+    expert_tensor_parallel: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -142,26 +146,35 @@ class MoE(nn.Module):
             return out, l_aux
 
         tokens = x.reshape(B * T, M)
-        if ep <= 1:
+        tp = (self.expert_tensor_parallel and self.ep_mesh is not None
+              and self.ep_mesh.shape.get("model", 1) > 1)
+        if ep <= 1 and not tp:
             out, l_aux = route_and_run(
                 tokens, lambda d: _ffn(d, weights, act, dtype), rng)
         else:
             def body(tokens_local, weights_local):
-                """One (data, expert) device: tokens_local [S_loc, M];
-                weights_local are this device's expert shards [E/ep, ...]."""
+                """One (data, expert[, model]) device: tokens_local
+                [S_loc, M]; weights_local are this device's expert shards
+                [E/ep, ...] (hidden dim further sharded under experts-TP)."""
                 def expert_apply(dispatched):
                     # [E, C, M] → a2a → [E/ep, ep*C, M]: tokens meet their experts
                     d = comm.all_to_all_single(dispatched, axis_name=EXPERT_AXIS,
                                                split_axis=0, concat_axis=1,
                                                log_name="moe_dispatch")
                     eo = _ffn(d, weights_local, act, dtype)
+                    if tp:
+                        # row-parallel wo: every model rank holds a partial
+                        # sum over its hidden shard (reference
+                        # moe/mappings.py reduce on the TP region)
+                        eo = jax.lax.psum(eo, "model")
                     # inverse a2a → [E, C, M]: results return to their tokens
                     return comm.all_to_all_single(eo, axis_name=EXPERT_AXIS,
                                                   split_axis=1, concat_axis=0,
                                                   log_name="moe_combine")
 
                 # decorrelate gating noise across shards: each (data, expert)
-                # device draws from an independent fold of the layer rng
+                # device draws from an independent fold of the layer rng —
+                # model ranks share it (routing must agree across TP)
                 local_rng = rng
                 if rng is not None:
                     shard_id = (jax.lax.axis_index(DATA_AXIS) * ep
@@ -171,9 +184,16 @@ class MoE(nn.Module):
                 return out, jax.lax.pmean(
                     jax.lax.pmean(l_aux, EXPERT_AXIS), DATA_AXIS)
 
+            if tp:
+                col = P(EXPERT_AXIS, None, "model")     # wi: [E, M, H]
+                row = P(EXPERT_AXIS, "model", None)     # wo: [E, H, M]
+                wspecs = (col, col, row) if self.gated else (col, row)
+            else:
+                wspecs = jax.tree_util.tree_map(lambda _: P(EXPERT_AXIS),
+                                                weights)
             out, l_aux = shard_map(
                 body, mesh=self.ep_mesh,
-                in_specs=(P((DATA_AXIS, EXPERT_AXIS)), P(EXPERT_AXIS)),
+                in_specs=(P((DATA_AXIS, EXPERT_AXIS)), wspecs),
                 out_specs=(P((DATA_AXIS, EXPERT_AXIS)), P()),
                 check_vma=False)(tokens, weights)
         out = out.reshape(B, T, M)

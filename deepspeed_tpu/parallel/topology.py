@@ -155,6 +155,19 @@ def build_mesh(
     order = list(cfg.axis_order)
     if sorted(order) != sorted(AXIS_NAMES):
         raise ConfigError(f"mesh.axis_order must be a permutation of {AXIS_NAMES}, got {order}")
+    placement = getattr(cfg, "expert_placement", None)
+    if placement is not None:                 # None = respect axis_order
+        if placement not in ("inside_data", "outside_data"):
+            raise ConfigError(
+                f"expert_placement must be 'inside_data' or 'outside_data', "
+                f"got {placement!r}")
+        di, ei = order.index("data"), order.index("expert")
+        if placement == "inside_data" and ei < di:
+            order.remove("expert")
+            order.insert(order.index("data") + 1, "expert")
+        elif placement == "outside_data" and ei > di:
+            order.remove("expert")
+            order.insert(order.index("data"), "expert")
 
     inner = int(inner_shard_size)
     if inner > 1:

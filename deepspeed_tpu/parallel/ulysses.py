@@ -10,9 +10,10 @@ rides the ICI ``seq`` mesh axis inside ``shard_map``:
     a2a  →  [B, T, H/sp, D]  (heads sharded, full sequence)   — attention here
     a2a  →  [B, T/sp, H, D]  back
 
-GQA/uneven heads: heads must divide sp (the reference's uneven-head path
-``uneven_heads_all2all:43`` is a padding fallback; here we require divisibility
-and document it — pad heads to a multiple of sp upstream).
+GQA/uneven heads (reference ``uneven_heads_all2all:43``): kv heads broadcast
+to the q head count, then heads pad to a multiple of sp with zero heads that
+are sliced off after the inverse a2a — so kv_heads < sp (llama-70B kv=8 on
+sp=16) and non-divisible layouts both work.
 """
 
 from __future__ import annotations
@@ -63,10 +64,32 @@ class DistributedAttention:
         if sp == 1:
             return self.local_attn(query, key, value)
         H = query.shape[2]
-        if H % sp != 0:
+        Hk = key.shape[2]
+        if Hk != H and H % Hk:
             raise ValueError(
-                f"num heads ({H}) must be divisible by seq-parallel degree "
-                f"({sp}); pad heads upstream for GQA/uneven layouts")
+                f"GQA requires q_heads % kv_heads == 0 ({H}/{Hk})")
+        # GQA / uneven heads (reference uneven_heads_all2all,
+        # sequence/layer.py:43). When both head counts divide sp, kv rides
+        # the a2a at its NATIVE width — rank r's q heads [rH/sp,(r+1)H/sp)
+        # map exactly into its kv range [rHk/sp,(r+1)Hk/sp), and the local
+        # attention (flash kernel / jax.nn.dot_product_attention) handles
+        # grouping, so kv comm volume stays 1/group of the broadcast cost.
+        # Otherwise: broadcast kv to H and pad all three up to a multiple of
+        # sp with zero heads, sliced off after the inverse a2a (zero q-heads
+        # emit garbage rows nobody reads; zero kv-heads are never attended).
+        pad_h = 0
+        if H % sp == 0 and Hk % sp == 0:
+            pass                                    # native GQA through a2a
+        else:
+            if Hk != H:
+                key = jnp.repeat(key, H // Hk, axis=2)
+                value = jnp.repeat(value, H // Hk, axis=2)
+            pad_h = (-H) % sp
+            if pad_h:
+                pad = ((0, 0), (0, 0), (0, pad_h), (0, 0))
+                query = jnp.pad(query, pad)
+                key = jnp.pad(key, pad)
+                value = jnp.pad(value, pad)
 
         axis = self.seq_axis
         attn = self.local_attn
@@ -81,8 +104,9 @@ class DistributedAttention:
         dp = self.mesh.shape.get(DATA_AXIS, 1)
         batch_axis = DATA_AXIS if dp > 1 and query.shape[0] % dp == 0 else None
         spec = P(batch_axis, axis, None, None)
-        return shard_map(inner, mesh=self.mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(query, key, value)
+        out = shard_map(inner, mesh=self.mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec, check_vma=False)(query, key, value)
+        return out[:, :, :H] if pad_h else out
 
 
 def sp_cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray, mesh: Mesh,

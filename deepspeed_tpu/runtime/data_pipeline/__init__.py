@@ -1,3 +1,5 @@
 from .curriculum_scheduler import CurriculumScheduler
 from .data_sampler import DeepSpeedDataSampler
 from . import random_ltd
+from .data_analyzer import DataAnalyzer, load_difficulties, load_metric_to_sample
+from .indexed_dataset import IndexedDatasetBuilder, MMapIndexedDataset

@@ -1,0 +1,134 @@
+"""Offline map-reduce data analyzer.
+
+Analogue of the reference's ``DataAnalyzer``
+(``data_sampling/data_analyzer.py``): compute per-sample metrics over a
+dataset in sharded map tasks (one per worker, resumable/parallel across
+processes), persist each shard as a memory-mapped indexed dataset, then
+reduce the shards into the two index files the curriculum sampler consumes:
+
+  ``<metric>_sample_to_metric``  — metric value per sample id (the
+    difficulty array, file-backed)
+  ``<metric>_metric_to_sample``  — sample ids grouped by metric value
+    (one row per distinct value)
+
+The reduced ``sample_to_metric`` feeds ``DeepSpeedDataSampler`` directly via
+``load_difficulties`` — file-backed instead of the in-memory array
+``analyze_difficulty`` builds (reference "curriculum_learning.data_cluster_
+path" flow).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .indexed_dataset import (IndexedDatasetBuilder, MMapIndexedDataset,
+                              exists)
+
+
+def _shard_bounds(n: int, num_workers: int, worker_id: int):
+    per = -(-n // num_workers)
+    lo = min(worker_id * per, n)
+    return lo, min(lo + per, n)
+
+
+class DataAnalyzer:
+    def __init__(self, dataset,
+                 metric_names: Sequence[str],
+                 metric_functions: Sequence[Callable],
+                 save_path: str,
+                 num_workers: int = 1,
+                 worker_id: int = 0,
+                 metric_dtypes: Optional[Sequence] = None):
+        if len(metric_names) != len(metric_functions):
+            raise ValueError("one metric_function per metric_name")
+        self.dataset = dataset
+        self.metric_names = list(metric_names)
+        self.metric_functions = list(metric_functions)
+        self.save_path = save_path
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        self.metric_dtypes = list(metric_dtypes or
+                                  [np.int64] * len(metric_names))
+        os.makedirs(save_path, exist_ok=True)
+
+    # ------------------------------ map ------------------------------- #
+
+    def _shard_path(self, metric: str, worker_id: int) -> str:
+        return os.path.join(self.save_path,
+                            f"{metric}_worker{worker_id}")
+
+    def run_map(self) -> None:
+        """Compute this worker's shard of every metric (reference
+        ``run_map``: each worker handles dataset[lo:hi] and writes its own
+        indexed file; workers can run in separate processes)."""
+        lo, hi = _shard_bounds(len(self.dataset), self.num_workers,
+                               self.worker_id)
+        for name, fn, dt in zip(self.metric_names, self.metric_functions,
+                                self.metric_dtypes):
+            builder = IndexedDatasetBuilder(
+                self._shard_path(name, self.worker_id), dtype=dt)
+            for i in range(lo, hi):
+                builder.add_item([fn(self.dataset[i])])
+            builder.finalize()
+
+    # ----------------------------- reduce ----------------------------- #
+
+    def run_reduce(self) -> None:
+        """Merge all workers' shards into ``sample_to_metric`` +
+        ``metric_to_sample`` index files (reference ``run_reduce``)."""
+        for name, dt in zip(self.metric_names, self.metric_dtypes):
+            s2m = IndexedDatasetBuilder(
+                os.path.join(self.save_path, f"{name}_sample_to_metric"),
+                dtype=dt)
+            for w in range(self.num_workers):
+                shard = self._shard_path(name, w)
+                if not exists(shard):
+                    raise FileNotFoundError(
+                        f"worker {w} shard missing for metric {name}: "
+                        f"{shard} (did its run_map finish?)")
+                s2m.merge_file(shard)
+            s2m.finalize()
+
+            values = np.asarray(
+                MMapIndexedDataset(os.path.join(
+                    self.save_path, f"{name}_sample_to_metric"))._data)
+            m2s = IndexedDatasetBuilder(
+                os.path.join(self.save_path, f"{name}_metric_to_sample"),
+                dtype=np.int64)
+            # one argsort + boundary split — O(n log n) regardless of metric
+            # cardinality (a per-value nonzero scan would be O(n * unique))
+            order = np.argsort(values, kind="stable")
+            svals = values[order]
+            bounds = np.nonzero(np.diff(svals))[0] + 1
+            if len(svals):
+                vals = svals[np.concatenate([[0], bounds])]
+                for ids in np.split(order, bounds):
+                    m2s.add_item(ids)
+            else:
+                vals = np.empty((0,), values.dtype)
+            m2s.finalize()
+            np.save(os.path.join(self.save_path, f"{name}_values.npy"), vals)
+
+    def run_map_reduce(self) -> None:
+        self.run_map()
+        if self.worker_id == 0:
+            self.run_reduce()
+
+
+def load_difficulties(save_path: str, metric_name: str) -> np.ndarray:
+    """The file-backed difficulty array for ``DeepSpeedDataSampler`` —
+    memory-mapped, so a billion-sample index never loads into RAM."""
+    ds = MMapIndexedDataset(
+        os.path.join(save_path, f"{metric_name}_sample_to_metric"))
+    return ds._data
+
+
+def load_metric_to_sample(save_path: str, metric_name: str) -> Dict[int, np.ndarray]:
+    """{metric value: sample ids} view over the reduced index."""
+    ds = MMapIndexedDataset(
+        os.path.join(save_path, f"{metric_name}_metric_to_sample"))
+    vals = np.load(os.path.join(save_path, f"{metric_name}_values.npy"))
+    return {int(v): ds[i] for i, v in enumerate(vals)}

@@ -1,0 +1,105 @@
+"""Memory-mapped indexed dataset (Megatron binary format capability).
+
+Analogue of the reference's ``data_sampling/indexed_dataset.py``
+(``MMapIndexedDataset`` + builder): variable-length int sequences stored as
+one flat binary blob plus an index of (offset, length) pairs, read back
+zero-copy through ``np.memmap``. The byte layout is deliberately simple and
+self-describing (a JSON header instead of Megatron's packed magic/version
+struct) — the capability row is "file-backed datasets that never load into
+RAM", not byte-for-byte Megatron compat; ``zero_to_fp32``-style offline
+tools and the curriculum ``DataAnalyzer`` build on it.
+
+Files: ``<path>.bin`` (raw sample data, concatenated) and ``<path>.idx.npz``
+(dtype tag + int64 offsets/lengths arrays).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Sequence
+
+import numpy as np
+
+_DATA_SUFFIX = ".bin"
+_INDEX_SUFFIX = ".idx.npz"
+
+
+class IndexedDatasetBuilder:
+    """Append samples, then ``finalize()`` — the reference's
+    ``make_builder``/``add_item``/``finalize`` surface."""
+
+    def __init__(self, path: str, dtype=np.int32):
+        self.path = path
+        self.dtype = np.dtype(dtype)
+        self._data_f = open(path + _DATA_SUFFIX, "wb")
+        self._lengths = []
+
+    def add_item(self, sample: Sequence) -> None:
+        arr = np.asarray(sample, dtype=self.dtype)
+        self._data_f.write(arr.tobytes(order="C"))
+        self._lengths.append(arr.size)
+
+    def add_items(self, samples: Iterable[Sequence]) -> None:
+        for s in samples:
+            self.add_item(s)
+
+    def merge_file(self, other_path: str) -> None:
+        """Append another indexed dataset (the reduce step of a sharded
+        build — reference ``merge_file_``)."""
+        other = MMapIndexedDataset(other_path)
+        if other._dtype != self.dtype:
+            raise ValueError(
+                f"dtype mismatch: {other._dtype} vs {self.dtype}")
+        with open(other_path + _DATA_SUFFIX, "rb") as f:
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    break
+                self._data_f.write(chunk)
+        self._lengths.extend(other.lengths.tolist())
+
+    def finalize(self) -> None:
+        self._data_f.close()
+        lengths = np.asarray(self._lengths, np.int64)
+        offsets = np.concatenate([[0], np.cumsum(lengths)[:-1]])
+        np.savez(self.path + _INDEX_SUFFIX,
+                 meta=json.dumps({"dtype": self.dtype.name,
+                                  "n": len(lengths)}),
+                 offsets=offsets, lengths=lengths)
+
+
+class MMapIndexedDataset:
+    """Zero-copy reader: ``ds[i]`` returns a view into the memory-mapped
+    blob (reference ``MMapIndexedDataset`` semantics)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with np.load(path + _INDEX_SUFFIX, allow_pickle=False) as idx:
+            meta = json.loads(str(idx["meta"]))
+            self._dtype = np.dtype(meta["dtype"])
+            self.offsets = idx["offsets"]
+            self.lengths = idx["lengths"]
+        # np.memmap raises on zero-byte files — an empty shard (a worker
+        # whose ceil-sized range was past the dataset end) is still valid
+        if os.path.getsize(path + _DATA_SUFFIX) == 0:
+            self._data = np.empty((0,), self._dtype)
+        else:
+            self._data = np.memmap(path + _DATA_SUFFIX, dtype=self._dtype,
+                                   mode="r")
+
+    def __len__(self) -> int:
+        return len(self.lengths)
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        o, n = int(self.offsets[i]), int(self.lengths[i])
+        return self._data[o:o + n]
+
+    @property
+    def sizes(self) -> np.ndarray:      # reference attribute name
+        return self.lengths
+
+
+def exists(path: str) -> bool:
+    return (os.path.exists(path + _DATA_SUFFIX)
+            and os.path.exists(path + _INDEX_SUFFIX))

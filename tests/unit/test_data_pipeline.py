@@ -238,3 +238,99 @@ class TestPLD:
             lambda x: x * 2, h, k, theta=0.5, layer_idx=1, num_layers=2))(keys)
         # E[out] = h + f(h) = 3 regardless of p (inverted scaling)
         assert float(outs.mean()) == pytest.approx(3.0, abs=0.25)
+
+
+# --------------- offline analyzer + indexed dataset -------------------- #
+
+class TestIndexedDataset:
+    def test_build_and_mmap_roundtrip(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline import (
+            IndexedDatasetBuilder, MMapIndexedDataset)
+        path = str(tmp_path / "data")
+        b = IndexedDatasetBuilder(path, dtype=np.int32)
+        samples = [[1, 2, 3], [9], [4, 5, 6, 7], []]
+        b.add_items(samples)
+        b.finalize()
+        ds = MMapIndexedDataset(path)
+        assert len(ds) == 4
+        for i, s in enumerate(samples):
+            np.testing.assert_array_equal(ds[i], np.asarray(s, np.int32))
+        assert list(ds.sizes) == [3, 1, 4, 0]
+
+    def test_merge(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline import (
+            IndexedDatasetBuilder, MMapIndexedDataset)
+        a, bp, m = (str(tmp_path / n) for n in ("a", "b", "m"))
+        for p, items in ((a, [[1, 2]]), (bp, [[3], [4, 5]])):
+            bd = IndexedDatasetBuilder(p)
+            bd.add_items(items)
+            bd.finalize()
+        bd = IndexedDatasetBuilder(m)
+        bd.merge_file(a)
+        bd.merge_file(bp)
+        bd.finalize()
+        ds = MMapIndexedDataset(m)
+        assert [list(ds[i]) for i in range(3)] == [[1, 2], [3], [4, 5]]
+
+
+class TestDataAnalyzer:
+    """Reference data_analyzer.py map-reduce: sharded metric computation,
+    file-backed difficulty index, feeding the curriculum sampler."""
+
+    def _dataset(self, n=37, seed=0):
+        rng = np.random.default_rng(seed)
+        return [rng.integers(0, 64, rng.integers(1, 17)).tolist()
+                for _ in range(n)]
+
+    def test_map_reduce_matches_direct(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline import (
+            DataAnalyzer, load_difficulties, load_metric_to_sample)
+        data = self._dataset()
+        # 3 workers map independent shards (run in one process here; each
+        # run_map touches only its own shard files)
+        for w in range(3):
+            DataAnalyzer(data, ["seqlen"], [len], str(tmp_path),
+                         num_workers=3, worker_id=w).run_map()
+        DataAnalyzer(data, ["seqlen"], [len], str(tmp_path),
+                     num_workers=3, worker_id=0).run_reduce()
+
+        diff = load_difficulties(str(tmp_path), "seqlen")
+        np.testing.assert_array_equal(np.asarray(diff),
+                                      [len(s) for s in data])
+        m2s = load_metric_to_sample(str(tmp_path), "seqlen")
+        for val, ids in m2s.items():
+            assert all(len(data[i]) == val for i in ids)
+        # every sample appears exactly once across the value groups
+        assert sorted(np.concatenate(list(m2s.values()))) == list(range(len(data)))
+
+    def test_feeds_curriculum_sampler(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline import (
+            CurriculumScheduler, DataAnalyzer, DeepSpeedDataSampler,
+            load_difficulties)
+        data = self._dataset(64)
+        DataAnalyzer(data, ["seqlen"], [len], str(tmp_path)).run_map_reduce()
+        diff = load_difficulties(str(tmp_path), "seqlen")
+        sched = CurriculumScheduler({
+            "curriculum_type": "seqlen", "schedule_type": "fixed_linear",
+            "min_difficulty": 4, "max_difficulty": 16,
+            "schedule_config": {"total_curriculum_step": 10,
+                                "difficulty_step": 4}})
+        sampler = DeepSpeedDataSampler(diff, batch_size=8, scheduler=sched)
+        batch = next(iter(sampler))
+        assert all(len(data[i]) <= 8 for i in batch)   # early = easy only
+
+
+def test_analyzer_empty_worker_shard(tmp_path):
+    """num_workers not dividing the dataset can strand a trailing worker
+    with zero samples — reduce must still succeed."""
+    from deepspeed_tpu.runtime.data_pipeline import (DataAnalyzer,
+                                                     load_difficulties)
+    data = [[1] * (i + 1) for i in range(8)]
+    for w in range(5):     # ceil(8/5)=2 per worker; worker 4 gets nothing
+        DataAnalyzer(data, ["seqlen"], [len], str(tmp_path),
+                     num_workers=5, worker_id=w).run_map()
+    DataAnalyzer(data, ["seqlen"], [len], str(tmp_path),
+                 num_workers=5, worker_id=0).run_reduce()
+    np.testing.assert_array_equal(
+        np.asarray(load_difficulties(str(tmp_path), "seqlen")),
+        [len(s) for s in data])

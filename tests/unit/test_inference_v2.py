@@ -409,3 +409,50 @@ class TestPagedFlashKernel:
             ref = jnp.einsum("hck,khd->chd", p, jnp.asarray(vc))
             np.testing.assert_allclose(np.asarray(out)[s], np.asarray(ref),
                                        atol=2e-5, rtol=1e-4)
+
+
+class TestKVOffloadRestore:
+    """engine.pause/resume — reference BlockedKVCache.offload/restore
+    (inference/v2/ragged/kv_cache.py:166,176): a sequence's KV moves to host
+    memory, its blocks are reused by another sequence, and generation resumes
+    token-exact after restore."""
+
+    def test_pause_evict_resume_token_exact(self):
+        cfg, mcfg, model, params = _tiny_setup(chunk=8, block_size=4,
+                                               num_blocks=8,
+                                               max_blocks_per_seq=8)
+        rng = np.random.default_rng(4)
+        prompt = rng.integers(1, 96, 9).tolist()
+
+        # uninterrupted reference generation
+        eng_ref = InferenceEngineV2(mcfg, params, cfg)
+        ref = eng_ref.generate([prompt], max_new_tokens=6)[0]
+
+        # interrupted: 3 tokens, pause, fill the pool with another sequence
+        # (forcing reuse of the evicted blocks), flush it, resume, finish
+        eng = InferenceEngineV2(mcfg, params, cfg)
+        logits = eng.put([0], [prompt])
+        out = []
+        for _ in range(3):
+            nxt = int(np.argmax(logits[0]))
+            out.append(nxt)
+            logits = eng.put([0], [[nxt]])
+
+        free_before = eng.free_blocks
+        eng.pause(0)
+        assert eng.free_blocks > free_before          # blocks really freed
+        with pytest.raises(ValueError, match="paused"):
+            eng.put([0], [[1]])
+
+        # occupy (and dirty) the whole pool, then release it
+        filler = rng.integers(1, 96, cfg.num_blocks * cfg.block_size
+                              - 2).tolist()
+        eng.put([99], [filler])
+        eng.flush(99)
+
+        eng.resume(0)
+        for _ in range(3):
+            nxt = int(np.argmax(logits[0]))
+            out.append(nxt)
+            logits = eng.put([0], [[nxt]])
+        assert out == ref

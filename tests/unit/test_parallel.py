@@ -119,13 +119,45 @@ def test_ulysses_noncausal(devices8):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
-def test_ulysses_uneven_heads_raises(devices8):
+def test_ulysses_uneven_heads(devices8):
+    """heads % sp != 0: pad-and-mask fallback (reference
+    uneven_heads_all2all, sequence/layer.py:43)."""
     topo = build_mesh(MeshConfig(seq=4, data=2))
     q, k, v = _qkv(T=16, H=6)   # 6 heads not divisible by sp=4
-    attn = DistributedAttention(
-        lambda a, b, c: jax.nn.dot_product_attention(a, b, c), topo.mesh)
-    with pytest.raises(ValueError):
-        attn(q, k, v)
+    ref = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+    out = ulysses_attention(q, k, v, topo.mesh, causal=True)
+    assert out.shape == q.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ulysses_gqa_kv_heads_below_sp(devices8):
+    """GQA with kv_heads (2) < sp (4): kv heads broadcast before the a2a
+    (the llama-70B kv=8 on larger sp meshes case the VERDICT flagged)."""
+    topo = build_mesh(MeshConfig(seq=4, data=2))
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (2, 32, 8, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 32, 2, 16), jnp.float32)
+    v = jax.random.normal(ks[2], (2, 32, 2, 16), jnp.float32)
+    kr = jnp.repeat(k, 4, axis=2)
+    vr = jnp.repeat(v, 4, axis=2)
+    ref = jax.nn.dot_product_attention(q, kr, vr, is_causal=True)
+    out = ulysses_attention(q, k, v, topo.mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ulysses_gqa_uneven_with_kernel(devices8):
+    """Uneven heads + GQA through the Pallas local attention."""
+    topo = build_mesh(MeshConfig(seq=4, data=2))
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    q = jax.random.normal(ks[0], (2, 32, 6, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 32, 2, 16), jnp.float32)
+    v = jax.random.normal(ks[2], (2, 32, 2, 16), jnp.float32)
+    kr = jnp.repeat(k, 3, axis=2)
+    vr = jnp.repeat(v, 3, axis=2)
+    ref = jax.nn.dot_product_attention(q, kr, vr, is_causal=True)
+    out = ulysses_attention(q, k, v, topo.mesh, causal=True,
+                            use_kernel=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
 def test_sp_cross_entropy_matches(devices8):
@@ -208,3 +240,20 @@ def test_ring_attention_kernel_grad(devices8):
     for a, b in zip(gk, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-4, rtol=1e-3)
+
+
+def test_ulysses_gqa_native_width(devices8):
+    """When both H and Hk divide sp, kv rides the a2a at native GQA width
+    (no broadcast): parity with the broadcast reference."""
+    topo = build_mesh(MeshConfig(seq=4, data=2))
+    ks = jax.random.split(jax.random.PRNGKey(6), 3)
+    q = jax.random.normal(ks[0], (2, 32, 8, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 32, 4, 16), jnp.float32)
+    v = jax.random.normal(ks[2], (2, 32, 4, 16), jnp.float32)
+    ref = jax.nn.dot_product_attention(q, jnp.repeat(k, 2, 2),
+                                       jnp.repeat(v, 2, 2), is_causal=True)
+    out = ulysses_attention(q, k, v, topo.mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    out_k = ulysses_attention(q, k, v, topo.mesh, causal=True,
+                              use_kernel=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(ref), atol=1e-5)
