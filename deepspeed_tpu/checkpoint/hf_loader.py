@@ -183,11 +183,74 @@ _PHI_MAP = [
      "layer_{0}/fc{1}/bias", "vector"),
 ]
 
+_BLOOM_MAP = [
+    (r"lm_head\.weight", "lm_head/kernel", "linear"),   # untied variants
+    (r"(?:transformer\.)?word_embeddings\.weight",
+     "word_embeddings/embedding", "embed"),
+    (r"(?:transformer\.)?word_embeddings_layernorm\.(weight|bias)",
+     "word_embeddings_layernorm/{w:scale,b:bias}", "vector"),
+    (r"(?:transformer\.)?ln_f\.(weight|bias)", "ln_f/{w:scale,b:bias}",
+     "vector"),
+    (r"(?:transformer\.)?h\.(\d+)\.(input|post_attention)_layernorm\.(weight|bias)",
+     "layer_{0}/{1}_layernorm/{w:scale,b:bias}", "vector"),
+    (r"(?:transformer\.)?h\.(\d+)\.self_attention\.(q|k|v)_proj\.weight",
+     "layer_{0}/self_attention/{1}_proj/kernel", "linear"),
+    (r"(?:transformer\.)?h\.(\d+)\.self_attention\.(q|k|v)_proj\.bias",
+     "layer_{0}/self_attention/{1}_proj/bias", "vector"),
+    (r"(?:transformer\.)?h\.(\d+)\.self_attention\.dense\.weight",
+     "layer_{0}/self_attention/dense/kernel", "linear"),
+    (r"(?:transformer\.)?h\.(\d+)\.self_attention\.dense\.bias",
+     "layer_{0}/self_attention/dense/bias", "vector"),
+    (r"(?:transformer\.)?h\.(\d+)\.mlp\.dense_(h_to_4h|4h_to_h)\.weight",
+     "layer_{0}/dense_{1}/kernel", "linear"),
+    (r"(?:transformer\.)?h\.(\d+)\.mlp\.dense_(h_to_4h|4h_to_h)\.bias",
+     "layer_{0}/dense_{1}/bias", "vector"),
+]
+
+_NEOX_MAP = [
+    (r"gpt_neox\.embed_in\.weight", "embed_in/embedding", "embed"),
+    (r"gpt_neox\.final_layer_norm\.(weight|bias)",
+     "final_layer_norm/{w:scale,b:bias}", "vector"),
+    (r"embed_out\.weight", "embed_out/kernel", "linear"),
+    (r"gpt_neox\.layers\.(\d+)\.(input|post_attention)_layernorm\.(weight|bias)",
+     "layer_{0}/{1}_layernorm/{w:scale,b:bias}", "vector"),
+    (r"gpt_neox\.layers\.(\d+)\.attention\.(q|k|v)_proj\.weight",
+     "layer_{0}/{1}_proj/kernel", "linear"),
+    (r"gpt_neox\.layers\.(\d+)\.attention\.(q|k|v)_proj\.bias",
+     "layer_{0}/{1}_proj/bias", "vector"),
+    (r"gpt_neox\.layers\.(\d+)\.attention\.dense\.weight",
+     "layer_{0}/dense/kernel", "linear"),
+    (r"gpt_neox\.layers\.(\d+)\.attention\.dense\.bias",
+     "layer_{0}/dense/bias", "vector"),
+    (r"gpt_neox\.layers\.(\d+)\.mlp\.dense_(h_to_4h|4h_to_h)\.weight",
+     "layer_{0}/dense_{1}/kernel", "linear"),
+    (r"gpt_neox\.layers\.(\d+)\.mlp\.dense_(h_to_4h|4h_to_h)\.bias",
+     "layer_{0}/dense_{1}/bias", "vector"),
+]
+
+_GPTJ_MAP = [
+    (r"transformer\.wte\.weight", "wte/embedding", "embed"),
+    (r"transformer\.ln_f\.(weight|bias)", "ln_f/{w:scale,b:bias}", "vector"),
+    (r"lm_head\.weight", "lm_head/kernel", "linear"),
+    (r"lm_head\.bias", "lm_head/bias", "vector"),
+    (r"transformer\.h\.(\d+)\.ln_1\.(weight|bias)",
+     "layer_{0}/ln_1/{w:scale,b:bias}", "vector"),
+    (r"transformer\.h\.(\d+)\.attn\.(q|k|v|out)_proj\.weight",
+     "layer_{0}/{1}_proj/kernel", "linear"),
+    (r"transformer\.h\.(\d+)\.mlp\.fc_(in|out)\.weight",
+     "layer_{0}/fc_{1}/kernel", "linear"),
+    (r"transformer\.h\.(\d+)\.mlp\.fc_(in|out)\.bias",
+     "layer_{0}/fc_{1}/bias", "vector"),
+]
+
 ARCH_MAPS = {
     "llama": _LLAMA_MAP,
     "mistral": _LLAMA_MAP,
     "qwen": _LLAMA_MAP,    # v1: fused names pre-split by _split_qwen_fused
     "qwen2": _LLAMA_MAP,
+    "bloom": _BLOOM_MAP,   # fused qkv pre-split by _split_headwise_qkv
+    "gpt_neox": _NEOX_MAP,
+    "gptj": _GPTJ_MAP,
     "phi3": _LLAMA_MAP,
     "phi": _PHI_MAP,
     "opt": _OPT_MAP,
@@ -311,9 +374,42 @@ def _split_qwen_fused(state: Dict[str, np.ndarray],
     return out
 
 
+def _split_headwise_qkv(state: Dict[str, np.ndarray], hf_cfg: Dict,
+                        fused_suffix: str) -> Dict[str, np.ndarray]:
+    """BLOOM / GPT-NeoX fused ``query_key_value`` is PER-HEAD interleaved:
+    rows ordered (head, [q k v], head_dim). Split into q/k/v projections
+    (reference containers do the same de-interleave when injecting —
+    module_inject/containers/bloom.py, gptneox.py)."""
+    heads = int(hf_cfg.get("n_head", hf_cfg.get("num_attention_heads")))
+    out: Dict[str, np.ndarray] = {}
+    for name, arr in state.items():
+        if f"{fused_suffix}.weight" in name or f"{fused_suffix}.bias" in name:
+            base = name[:name.index(fused_suffix)]
+            leaf = name.split(".")[-1]
+            hd3 = arr.shape[0]
+            D = hd3 // (3 * heads)
+            a = arr.reshape((heads, 3, D) + arr.shape[1:])
+            for j, which in enumerate("qkv"):
+                out[f"{base}{which}_proj.{leaf}"] = np.ascontiguousarray(
+                    a[:, j].reshape((heads * D,) + arr.shape[1:]))
+        else:
+            out[name] = arr
+    return out
+
+
+def _split_bloom_fused(state, hf_cfg):
+    return _split_headwise_qkv(state, hf_cfg, "query_key_value")
+
+
+def _split_neox_fused(state, hf_cfg):
+    return _split_headwise_qkv(state, hf_cfg, "query_key_value")
+
+
 SPECIAL_HANDLERS = {
     "phi3": _split_phi3_fused,
     "qwen": _split_qwen_fused,
+    "bloom": _split_bloom_fused,
+    "gpt_neox": _split_neox_fused,
     "mixtral": _mixtral_experts,
     "qwen2_moe": _qwen2_moe_experts,
 }
@@ -357,7 +453,7 @@ def _fw_path(template: str, groups: Tuple[str, ...]) -> str:
 
 #: non-parameter tensors present in real Hub checkpoints — skipped silently
 _IGNORED_TENSORS = re.compile(
-    r".*\.(attn\.bias|attn\.masked_bias|rotary_emb\.inv_freq)$")
+    r".*\.((attn|attention)\.(bias|masked_bias)|rotary_emb\.inv_freq)$")
 
 
 def convert_hf_state(arch: str, state: Dict[str, np.ndarray],

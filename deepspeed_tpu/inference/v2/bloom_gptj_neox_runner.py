@@ -1,0 +1,191 @@
+"""Ragged paged-KV runners for BLOOM, GPT-NeoX and GPT-J.
+
+Analogues of the reference's v1-injection containers for these families
+(``module_inject/containers/{bloom,gptneox,gptj}.py``) on the v2 ragged
+surface: the same fixed-shape RaggedBatch contract and shared
+``paged_attention`` (Pallas paged flash / dense fallback) as every other
+runner. BLOOM attends with in-kernel ALiBi; NeoX applies partial rotate-half
+rope; GPT-J partial INTERLEAVED rope with a single shared layernorm and
+parallel residual.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ...models._lm_utils import alibi_slopes
+from ...models.bloom import BloomConfig
+from ...models.gpt_neox import (GPTJConfig, GPTNeoXConfig,
+                                apply_partial_rope_interleaved)
+from ...models.phi import apply_partial_rope
+from .config import RaggedInferenceConfig
+from .model_runner import (RaggedBatch, _layer_norm, _linear,
+                           paged_attention)
+
+
+class _RunnerBase:
+    step_fn = None
+
+    def __init__(self, model_cfg, cfg: RaggedInferenceConfig,
+                 compute_dtype: Any = None):
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.compute_dtype = compute_dtype or model_cfg.dtype
+        self.num_layers = model_cfg.num_layers
+        self.kv_heads = model_cfg.num_heads
+        self.head_dim = model_cfg.head_dim
+
+        def _step(params, kv_data, batch):
+            from ..quantization import dequantize_tree
+            return type(self).step_fn(dequantize_tree(params), kv_data,
+                                      batch, model_cfg=model_cfg, cfg=cfg,
+                                      dtype=self.compute_dtype)
+
+        self._step = jax.jit(_step)
+
+    def step(self, params, kv_data, batch: RaggedBatch):
+        return self._step(params, kv_data, batch)
+
+
+def _bloom_ragged_step(params, kv, batch: RaggedBatch, *,
+                       model_cfg: BloomConfig, cfg: RaggedInferenceConfig,
+                       dtype):
+    mc = model_cfg
+    S, C = batch.tokens.shape
+    H, D = mc.num_heads, mc.head_dim
+    scale = 1.0 / (D ** 0.5)
+    slopes = alibi_slopes(H)
+
+    pos = batch.start_pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+    valid_q = jnp.arange(C, dtype=jnp.int32)[None, :] < batch.n_tokens[:, None]
+
+    x = params["word_embeddings"]["embedding"][batch.tokens].astype(dtype)
+    x = _layer_norm(x.astype(jnp.float32),
+                    params["word_embeddings_layernorm"],
+                    mc.layer_norm_eps).astype(dtype)
+
+    for li in range(mc.num_layers):
+        p = params[f"layer_{li}"]
+        h = _layer_norm(x.astype(jnp.float32), p["input_layernorm"],
+                        mc.layer_norm_eps).astype(dtype)
+        pa = p["self_attention"]
+        q = _linear(h, pa["q_proj"], dtype).reshape(S, C, H, D)
+        k = _linear(h, pa["k_proj"], dtype).reshape(S, C, H, D)
+        v = _linear(h, pa["v_proj"], dtype).reshape(S, C, H, D)
+        kv, y = paged_attention(kv, li, q, k, v, batch, cfg, pos, valid_q,
+                                scale, dtype, alibi_slopes=slopes)
+        x = x + _linear(y, pa["dense"], dtype)
+
+        h = _layer_norm(x.astype(jnp.float32), p["post_attention_layernorm"],
+                        mc.layer_norm_eps).astype(dtype)
+        m = jax.nn.gelu(_linear(h, p["dense_h_to_4h"], dtype))
+        x = x + _linear(m, p["dense_4h_to_h"], dtype)
+
+    x = _layer_norm(x.astype(jnp.float32), params["ln_f"], mc.layer_norm_eps)
+    last = jnp.maximum(batch.n_tokens - 1, 0)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    if "lm_head" in params:                    # untied variant
+        return x_last @ params["lm_head"]["kernel"].astype(jnp.float32), kv
+    wte = params["word_embeddings"]["embedding"]
+    return x_last.astype(jnp.float32) @ wte.T.astype(jnp.float32), kv
+
+
+def _neox_ragged_step(params, kv, batch: RaggedBatch, *,
+                      model_cfg: GPTNeoXConfig, cfg: RaggedInferenceConfig,
+                      dtype):
+    mc = model_cfg
+    S, C = batch.tokens.shape
+    H, D = mc.num_heads, mc.head_dim
+    scale = 1.0 / (D ** 0.5)
+
+    pos = batch.start_pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+    valid_q = jnp.arange(C, dtype=jnp.int32)[None, :] < batch.n_tokens[:, None]
+
+    x = params["embed_in"]["embedding"][batch.tokens].astype(dtype)
+
+    for li in range(mc.num_layers):
+        p = params[f"layer_{li}"]
+        attn_in = _layer_norm(x.astype(jnp.float32), p["input_layernorm"],
+                              mc.layer_norm_eps).astype(dtype)
+        q = _linear(attn_in, p["q_proj"], dtype).reshape(S, C, H, D)
+        k = _linear(attn_in, p["k_proj"], dtype).reshape(S, C, H, D)
+        v = _linear(attn_in, p["v_proj"], dtype).reshape(S, C, H, D)
+        q = apply_partial_rope(q, pos, mc.rope_theta, mc.rotary_dim)
+        k = apply_partial_rope(k, pos, mc.rope_theta, mc.rotary_dim)
+        kv, y = paged_attention(kv, li, q, k, v, batch, cfg, pos, valid_q,
+                                scale, dtype)
+        attn_out = _linear(y, p["dense"], dtype)
+
+        if not mc.use_parallel_residual:
+            x = x + attn_out        # sequential: norm AFTER attn residual
+        mlp_in = _layer_norm(x.astype(jnp.float32),
+                             p["post_attention_layernorm"],
+                             mc.layer_norm_eps).astype(dtype)
+        m = jax.nn.gelu(_linear(mlp_in, p["dense_h_to_4h"], dtype))
+        m = _linear(m, p["dense_4h_to_h"], dtype)
+        x = (x + attn_out + m) if mc.use_parallel_residual else (x + m)
+
+    x = _layer_norm(x.astype(jnp.float32), params["final_layer_norm"],
+                    mc.layer_norm_eps)
+    last = jnp.maximum(batch.n_tokens - 1, 0)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    if "embed_out" in params:
+        return x_last @ params["embed_out"]["kernel"].astype(jnp.float32), kv
+    return x_last @ params["embed_in"]["embedding"].T.astype(jnp.float32), kv
+
+
+def _gptj_ragged_step(params, kv, batch: RaggedBatch, *,
+                      model_cfg: GPTJConfig, cfg: RaggedInferenceConfig,
+                      dtype):
+    mc = model_cfg
+    S, C = batch.tokens.shape
+    H, D = mc.num_heads, mc.head_dim
+    scale = 1.0 / (D ** 0.5)
+
+    pos = batch.start_pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+    valid_q = jnp.arange(C, dtype=jnp.int32)[None, :] < batch.n_tokens[:, None]
+
+    x = params["wte"]["embedding"][batch.tokens].astype(dtype)
+
+    for li in range(mc.num_layers):
+        p = params[f"layer_{li}"]
+        h = _layer_norm(x.astype(jnp.float32), p["ln_1"],
+                        mc.layer_norm_eps).astype(dtype)
+        q = _linear(h, p["q_proj"], dtype).reshape(S, C, H, D)
+        k = _linear(h, p["k_proj"], dtype).reshape(S, C, H, D)
+        v = _linear(h, p["v_proj"], dtype).reshape(S, C, H, D)
+        q = apply_partial_rope_interleaved(q, pos, mc.rope_theta,
+                                           mc.rotary_dim)
+        k = apply_partial_rope_interleaved(k, pos, mc.rope_theta,
+                                           mc.rotary_dim)
+        kv, y = paged_attention(kv, li, q, k, v, batch, cfg, pos, valid_q,
+                                scale, dtype)
+        attn_out = _linear(y, p["out_proj"], dtype)
+        m = _linear(jax.nn.gelu(_linear(h, p["fc_in"], dtype)),
+                    p["fc_out"], dtype)
+        x = x + attn_out + m                    # parallel residual
+
+    x = _layer_norm(x.astype(jnp.float32), params["ln_f"], mc.layer_norm_eps)
+    last = jnp.maximum(batch.n_tokens - 1, 0)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    if "lm_head" in params:
+        logits = x_last @ params["lm_head"]["kernel"].astype(jnp.float32)
+        if "bias" in params["lm_head"]:
+            logits = logits + params["lm_head"]["bias"]
+        return logits, kv
+    return x_last @ params["wte"]["embedding"].T.astype(jnp.float32), kv
+
+
+class BloomRaggedRunner(_RunnerBase):
+    step_fn = staticmethod(_bloom_ragged_step)
+
+
+class GPTNeoXRaggedRunner(_RunnerBase):
+    step_fn = staticmethod(_neox_ragged_step)
+
+
+class GPTJRaggedRunner(_RunnerBase):
+    step_fn = staticmethod(_gptj_ragged_step)

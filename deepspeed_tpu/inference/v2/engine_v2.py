@@ -45,6 +45,17 @@ def _runner_for(model_cfg: Any, cfg: RaggedInferenceConfig):
     if isinstance(model_cfg, PhiConfig):
         from .falcon_phi_runner import PhiRaggedRunner
         return PhiRaggedRunner(model_cfg, cfg)
+    from ...models.bloom import BloomConfig
+    from ...models.gpt_neox import GPTJConfig, GPTNeoXConfig
+    if isinstance(model_cfg, BloomConfig):
+        from .bloom_gptj_neox_runner import BloomRaggedRunner
+        return BloomRaggedRunner(model_cfg, cfg)
+    if isinstance(model_cfg, GPTNeoXConfig):
+        from .bloom_gptj_neox_runner import GPTNeoXRaggedRunner
+        return GPTNeoXRaggedRunner(model_cfg, cfg)
+    if isinstance(model_cfg, GPTJConfig):
+        from .bloom_gptj_neox_runner import GPTJRaggedRunner
+        return GPTJRaggedRunner(model_cfg, cfg)
     return GPT2RaggedRunner(model_cfg, cfg)
 
 
@@ -91,8 +102,11 @@ class InferenceEngineV2:
         return done
 
     def query(self, uid: int) -> Tuple[int, int]:
-        """(tokens seen, max additional tokens before block exhaustion)."""
+        """(tokens seen, max additional tokens before block exhaustion).
+        A paused sequence reports 0 headroom — resume() it first."""
         seq = self.state.get_or_create(uid)
+        if seq.status is SequenceStatus.PAUSED:
+            return seq.seen_tokens, 0
         free_local = self.config.max_blocks_per_seq - len(seq.kv_blocks)
         free = min(free_local, self.kv_cache.free_blocks)
         slack = len(seq.kv_blocks) * self.config.block_size - seq.seen_tokens

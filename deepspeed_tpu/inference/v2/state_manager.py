@@ -55,8 +55,11 @@ class StateManager:
 
     def can_schedule(self, uid: int, n_tokens: int) -> bool:
         """Scheduling hint (reference ``engine_v2.py:158-184``): would
-        `n_tokens` more tokens fit in blocks we can still allocate?"""
+        `n_tokens` more tokens fit in blocks we can still allocate?
+        Paused sequences (KV on host) are never schedulable — resume first."""
         seq = self.get_or_create(uid)
+        if seq.status is SequenceStatus.PAUSED:
+            return False
         need = seq.blocks_needed(n_tokens, self.cfg.block_size)
         return (need <= self.kv_cache.free_blocks
                 and len(seq.kv_blocks) + need <= self.cfg.max_blocks_per_seq)

@@ -13,6 +13,10 @@ from typing import Any, Callable, Dict, NamedTuple
 
 from .bert import Bert, BertConfig
 from .bert import make_model as make_bert
+from .bloom import Bloom, BloomConfig
+from .bloom import make_model as make_bloom
+from .gpt_neox import (GPTJ, GPTJConfig, GPTNeoX, GPTNeoXConfig,
+                       make_model_gptj, make_model_neox)
 from .falcon import Falcon, FalconConfig
 from .falcon import make_model as make_falcon
 from .gpt2 import GPT2, GPT2Config
@@ -129,6 +133,44 @@ def _entry_opt(d):
         tie_embeddings=d.get("tie_word_embeddings", True))
 
 
+def _entry_bloom(d):
+    return BloomConfig(
+        vocab_size=d.get("vocab_size", 250880),
+        num_layers=d.get("n_layer", d.get("num_hidden_layers", 30)),
+        num_heads=d.get("n_head", d.get("num_attention_heads", 32)),
+        hidden_size=d.get("hidden_size", d.get("n_embed", 4096)),
+        layer_norm_eps=d.get("layer_norm_epsilon", 1e-5),
+        tie_embeddings=d.get("tie_word_embeddings", True))
+
+
+def _entry_gpt_neox(d):
+    return GPTNeoXConfig(
+        vocab_size=d.get("vocab_size", 50432),
+        max_seq_len=d.get("max_position_embeddings", 2048),
+        num_layers=d.get("num_hidden_layers", 44),
+        num_heads=d.get("num_attention_heads", 64),
+        hidden_size=d.get("hidden_size", 6144),
+        intermediate_size=d.get("intermediate_size", 24576),
+        rotary_pct=d.get("rotary_pct", 0.25),
+        rope_theta=d.get("rotary_emb_base", 10000.0),
+        layer_norm_eps=d.get("layer_norm_eps", 1e-5),
+        use_parallel_residual=d.get("use_parallel_residual", True),
+        tie_embeddings=d.get("tie_word_embeddings", False))
+
+
+def _entry_gptj(d):
+    return GPTJConfig(
+        vocab_size=d.get("vocab_size", 50400),
+        max_seq_len=d.get("n_positions", 2048),
+        num_layers=d.get("n_layer", 28),
+        num_heads=d.get("n_head", 16),
+        hidden_size=d.get("n_embd", 4096),
+        intermediate_size=d.get("n_inner") or 4 * d.get("n_embd", 4096),
+        rotary_dim=d.get("rotary_dim", 64),
+        layer_norm_eps=d.get("layer_norm_epsilon", 1e-5),
+        tie_embeddings=d.get("tie_word_embeddings", False))
+
+
 def _entry_falcon(d):
     new_arch = d.get("new_decoder_architecture", False)
     return FalconConfig(
@@ -193,6 +235,10 @@ ARCHITECTURES: Dict[str, ArchEntry] = {
     "bert": ArchEntry(BertConfig, Bert, make_bert, _entry_bert),
     "opt": ArchEntry(OPTConfig, OPT, make_opt, _entry_opt),
     "falcon": ArchEntry(FalconConfig, Falcon, make_falcon, _entry_falcon),
+    "bloom": ArchEntry(BloomConfig, Bloom, make_bloom, _entry_bloom),
+    "gpt_neox": ArchEntry(GPTNeoXConfig, GPTNeoX, make_model_neox,
+                          _entry_gpt_neox),
+    "gptj": ArchEntry(GPTJConfig, GPTJ, make_model_gptj, _entry_gptj),
     "phi": ArchEntry(PhiConfig, Phi, make_phi, _entry_phi),
     "phi3": ArchEntry(LlamaConfig, Llama, make_llama, _entry_phi3),
     "qwen2_moe": ArchEntry(MixtralConfig, Mixtral, make_mixtral,

@@ -113,6 +113,12 @@ class DataAnalyzer:
             np.save(os.path.join(self.save_path, f"{name}_values.npy"), vals)
 
     def run_map_reduce(self) -> None:
+        """Single-process convenience ONLY (num_workers shards still apply —
+        run this once per worker_id in ONE process, or just leave
+        num_workers=1). Multi-PROCESS builds must run every worker's
+        ``run_map`` to completion first and then call ``run_reduce`` once —
+        there is no cross-process barrier here (the reference uses a dist
+        barrier; this framework's launcher runs one process per host)."""
         self.run_map()
         if self.worker_id == 0:
             self.run_reduce()
@@ -131,4 +137,6 @@ def load_metric_to_sample(save_path: str, metric_name: str) -> Dict[int, np.ndar
     ds = MMapIndexedDataset(
         os.path.join(save_path, f"{metric_name}_metric_to_sample"))
     vals = np.load(os.path.join(save_path, f"{metric_name}_values.npy"))
-    return {int(v): ds[i] for i, v in enumerate(vals)}
+    # .item() keeps the metric's native scalar type — int(v) would collapse
+    # distinct float metric values onto one key
+    return {v.item(): ds[i] for i, v in enumerate(vals)}

@@ -320,3 +320,33 @@ class TestNewArchFamilies:
         _, c = config_from_hf({"model_type": "falcon", "alibi": True,
                                "num_attention_heads": 4, "hidden_size": 64})
         assert c.alibi
+
+
+def test_bloom_neox_gptj_train():
+    """The three new v1-injection-breadth families train (loss drops)."""
+    from deepspeed_tpu.models.bloom import BloomConfig
+    from deepspeed_tpu.models.bloom import make_model as make_bloom
+    from deepspeed_tpu.models.gpt_neox import (GPTJConfig, GPTNeoXConfig,
+                                               make_model_gptj,
+                                               make_model_neox)
+    import deepspeed_tpu as dstpu
+
+    for make, cfg in [
+            (make_bloom, BloomConfig.tiny(dtype=jnp.float32)),
+            (make_model_neox, GPTNeoXConfig.tiny(dtype=jnp.float32)),
+            (make_model_gptj, GPTJConfig.tiny(dtype=jnp.float32))]:
+        model, init_fn, loss_fn = make(cfg)
+        params = init_fn(jax.random.PRNGKey(0), batch_size=4, seq_len=16)
+        engine, _, _, _ = dstpu.initialize(
+            loss_fn=loss_fn, params=params,
+            config={"train_micro_batch_size_per_gpu": 4,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+                    "steps_per_print": 10_000})
+        rng = np.random.default_rng(0)
+        losses = []
+        for _ in range(8):
+            st = rng.integers(0, 64, size=(engine.config.train_batch_size,))
+            seq = (st[:, None] + np.arange(17)[None, :]) % 64
+            losses.append(float(engine.train_batch(
+                {"tokens": jnp.asarray(seq, jnp.int32)})))
+        assert losses[-1] < losses[0], f"{type(cfg).__name__}: {losses}"
