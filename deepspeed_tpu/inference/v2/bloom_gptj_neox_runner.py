@@ -22,32 +22,8 @@ from ...models.gpt_neox import (GPTJConfig, GPTNeoXConfig,
                                 apply_partial_rope_interleaved)
 from ...models.phi import apply_partial_rope
 from .config import RaggedInferenceConfig
-from .model_runner import (RaggedBatch, _layer_norm, _linear,
-                           paged_attention)
-
-
-class _RunnerBase:
-    step_fn = None
-
-    def __init__(self, model_cfg, cfg: RaggedInferenceConfig,
-                 compute_dtype: Any = None):
-        self.model_cfg = model_cfg
-        self.cfg = cfg
-        self.compute_dtype = compute_dtype or model_cfg.dtype
-        self.num_layers = model_cfg.num_layers
-        self.kv_heads = model_cfg.num_heads
-        self.head_dim = model_cfg.head_dim
-
-        def _step(params, kv_data, batch):
-            from ..quantization import dequantize_tree
-            return type(self).step_fn(dequantize_tree(params), kv_data,
-                                      batch, model_cfg=model_cfg, cfg=cfg,
-                                      dtype=self.compute_dtype)
-
-        self._step = jax.jit(_step)
-
-    def step(self, params, kv_data, batch: RaggedBatch):
-        return self._step(params, kv_data, batch)
+from .model_runner import (RaggedBatch, RaggedRunnerBase, _layer_norm,
+                           _linear, paged_attention)
 
 
 def _bloom_ragged_step(params, kv, batch: RaggedBatch, *,
@@ -179,13 +155,13 @@ def _gptj_ragged_step(params, kv, batch: RaggedBatch, *,
     return x_last @ params["wte"]["embedding"].T.astype(jnp.float32), kv
 
 
-class BloomRaggedRunner(_RunnerBase):
+class BloomRaggedRunner(RaggedRunnerBase):
     step_fn = staticmethod(_bloom_ragged_step)
 
 
-class GPTNeoXRaggedRunner(_RunnerBase):
+class GPTNeoXRaggedRunner(RaggedRunnerBase):
     step_fn = staticmethod(_neox_ragged_step)
 
 
-class GPTJRaggedRunner(_RunnerBase):
+class GPTJRaggedRunner(RaggedRunnerBase):
     step_fn = staticmethod(_gptj_ragged_step)

@@ -20,30 +20,12 @@ from ...models.falcon import FalconConfig
 from ...models.llama import apply_rope
 from ...models.phi import PhiConfig, apply_partial_rope
 from .config import RaggedInferenceConfig
-from .model_runner import (RaggedBatch, _layer_norm, _linear,
-                           paged_attention)
+from .model_runner import (RaggedBatch, RaggedRunnerBase, _layer_norm,
+                           _linear, paged_attention)
 
 
-class FalconRaggedRunner:
-    def __init__(self, model_cfg: FalconConfig, cfg: RaggedInferenceConfig,
-                 compute_dtype: Any = None):
-        self.model_cfg = model_cfg
-        self.cfg = cfg
-        self.compute_dtype = compute_dtype or model_cfg.dtype
-        self.num_layers = model_cfg.num_layers
-        self.kv_heads = model_cfg.num_kv_heads
-        self.head_dim = model_cfg.head_dim
-
-        def _step(params, kv_data, batch):
-            from ..quantization import dequantize_tree
-            return _falcon_ragged_step(dequantize_tree(params), kv_data,
-                                       batch, model_cfg=model_cfg, cfg=cfg,
-                                       dtype=self.compute_dtype)
-
-        self._step = jax.jit(_step)
-
-    def step(self, params, kv_data, batch: RaggedBatch):
-        return self._step(params, kv_data, batch)
+class FalconRaggedRunner(RaggedRunnerBase):
+    pass
 
 
 def _falcon_ragged_step(params, kv, batch, *, model_cfg: FalconConfig,
@@ -107,26 +89,8 @@ def _falcon_ragged_step(params, kv, batch, *, model_cfg: FalconConfig,
     return x_last @ w.T.astype(jnp.float32), kv
 
 
-class PhiRaggedRunner:
-    def __init__(self, model_cfg: PhiConfig, cfg: RaggedInferenceConfig,
-                 compute_dtype: Any = None):
-        self.model_cfg = model_cfg
-        self.cfg = cfg
-        self.compute_dtype = compute_dtype or model_cfg.dtype
-        self.num_layers = model_cfg.num_layers
-        self.kv_heads = model_cfg.num_heads
-        self.head_dim = model_cfg.head_dim
-
-        def _step(params, kv_data, batch):
-            from ..quantization import dequantize_tree
-            return _phi_ragged_step(dequantize_tree(params), kv_data, batch,
-                                    model_cfg=model_cfg, cfg=cfg,
-                                    dtype=self.compute_dtype)
-
-        self._step = jax.jit(_step)
-
-    def step(self, params, kv_data, batch: RaggedBatch):
-        return self._step(params, kv_data, batch)
+class PhiRaggedRunner(RaggedRunnerBase):
+    pass
 
 
 def _phi_ragged_step(params, kv, batch, *, model_cfg: PhiConfig,
@@ -164,3 +128,7 @@ def _phi_ragged_step(params, kv, batch, *, model_cfg: PhiConfig,
     if "bias" in params["lm_head"]:
         logits = logits + params["lm_head"]["bias"].astype(jnp.float32)
     return logits, kv
+
+
+FalconRaggedRunner.step_fn = staticmethod(_falcon_ragged_step)
+PhiRaggedRunner.step_fn = staticmethod(_phi_ragged_step)

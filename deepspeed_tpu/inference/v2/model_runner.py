@@ -16,7 +16,6 @@ sequences' KV.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, NamedTuple
 
 import jax
@@ -128,26 +127,42 @@ def paged_attention(kv, li, q, k, v, batch: "RaggedBatch",
     return kv, y
 
 
-class GPT2RaggedRunner:
-    """Paged-KV decode/prefill over the flax ``GPT2`` param tree
-    (``deepspeed_tpu/models/gpt2.py`` naming: wte/wpe/h_i/ln_f)."""
+class RaggedRunnerBase:
+    """Shared runner plumbing: jitted step closing over the configs, with
+    WOQ int8/int4 leaves dequantized INSIDE the jit (XLA fuses the dequant
+    into each layer's matmul while HBM keeps the packed weights). Subclasses
+    set ``step_fn``; kv-cache geometry derives from the model config."""
 
-    def __init__(self, model_cfg: GPT2Config, cfg: RaggedInferenceConfig,
+    step_fn = None   # staticmethod(params, kv, batch, *, model_cfg, cfg, dtype)
+
+    def __init__(self, model_cfg: Any, cfg: RaggedInferenceConfig,
                  compute_dtype: Any = None):
         self.model_cfg = model_cfg
         self.cfg = cfg
         self.compute_dtype = compute_dtype or model_cfg.dtype
         self.num_layers = model_cfg.num_layers
-        self.kv_heads = model_cfg.num_heads
-        self.head_dim = model_cfg.hidden_size // model_cfg.num_heads
-        self._step = jax.jit(functools.partial(_gpt2_ragged_step,
-                                               model_cfg=model_cfg,
-                                               cfg=cfg,
-                                               dtype=self.compute_dtype))
+        self.kv_heads = getattr(model_cfg, "num_kv_heads",
+                                model_cfg.num_heads)
+        self.head_dim = getattr(
+            model_cfg, "head_dim",
+            model_cfg.hidden_size // model_cfg.num_heads)
 
-    def step(self, params, kv_data, batch: RaggedBatch):
+        def _step(params, kv_data, batch):
+            from ..quantization import dequantize_tree
+            return type(self).step_fn(dequantize_tree(params), kv_data,
+                                      batch, model_cfg=model_cfg, cfg=cfg,
+                                      dtype=self.compute_dtype)
+
+        self._step = jax.jit(_step)
+
+    def step(self, params, kv_data, batch: "RaggedBatch"):
         """Returns (last_token_logits [S, V] f32, new kv_data)."""
         return self._step(params, kv_data, batch)
+
+
+class GPT2RaggedRunner(RaggedRunnerBase):
+    """Paged-KV decode/prefill over the flax ``GPT2`` param tree
+    (``deepspeed_tpu/models/gpt2.py`` naming: wte/wpe/h_i/ln_f)."""
 
 
 def _gpt2_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: GPT2Config,
@@ -202,3 +217,6 @@ def _gpt2_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: GPT2Config,
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
     logits = x_last.astype(jnp.float32) @ wte.T.astype(jnp.float32)
     return logits, kv
+
+
+GPT2RaggedRunner.step_fn = staticmethod(_gpt2_ragged_step)

@@ -1,0 +1,101 @@
+"""CLIP text encoder (flax.linen).
+
+Parity target: the reference's CLIP v1-injection container
+(``module_inject/containers/clip.py``, serving the text encoder of stable
+diffusion pipelines): causal-masked pre-LN transformer with quick-GELU MLP,
+token + learned-position embeddings, final LayerNorm. The UNet/VAE half of
+the diffusers surface is convolutional and out of scope (documented in
+PARITY.md — XLA handles conv fusion natively; there is no injection win to
+port).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    max_seq_len: int = 77
+    num_layers: int = 12
+    num_heads: int = 8
+    hidden_size: int = 512
+    intermediate_size: int = 2048
+    layer_norm_eps: float = 1e-5
+    hidden_act: str = "quick_gelu"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @staticmethod
+    def tiny(**kw):
+        kw.setdefault("vocab_size", 128)
+        kw.setdefault("max_seq_len", 32)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 2)
+        kw.setdefault("hidden_size", 32)
+        kw.setdefault("intermediate_size", 64)
+        return CLIPTextConfig(**kw)
+
+
+def _act(name: str):
+    if name == "quick_gelu":
+        return lambda x: x * jax.nn.sigmoid(1.702 * x)
+    if name in ("gelu", "gelu_new"):
+        return nn.gelu
+    raise ValueError(f"unknown activation {name!r}")
+
+
+class CLIPTextLayer(nn.Module):
+    cfg: CLIPTextConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, T, C = x.shape
+        H, D = cfg.num_heads, cfg.head_dim
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name=name)
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name)
+        h = ln("layer_norm1")(x)
+        q = dense(C, "q_proj")(h).reshape(B, T, H, D)
+        k = dense(C, "k_proj")(h).reshape(B, T, H, D)
+        v = dense(C, "v_proj")(h).reshape(B, T, H, D)
+        y = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+        x = x + dense(C, "out_proj")(y.reshape(B, T, C))
+        h = ln("layer_norm2")(x)
+        h = dense(cfg.intermediate_size, "fc1")(h)
+        h = _act(cfg.hidden_act)(h)
+        return x + dense(C, "fc2")(h)
+
+
+class CLIPTextEncoder(nn.Module):
+    """Returns the final-LN hidden states [B, T, C] (the tensor stable
+    diffusion consumes as conditioning)."""
+    cfg: CLIPTextConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        B, T = tokens.shape
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="token_embedding")(tokens)
+        wpe = nn.Embed(cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="position_embedding")
+        x = x + wpe(jnp.arange(T)[None, :])
+        for i in range(cfg.num_layers):
+            x = CLIPTextLayer(cfg, name=f"layer_{i}")(x)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                            param_dtype=cfg.param_dtype,
+                            name="final_layer_norm")(x)

@@ -152,7 +152,7 @@ def _entry_gpt_neox(d):
         hidden_size=d.get("hidden_size", 6144),
         intermediate_size=d.get("intermediate_size", 24576),
         rotary_pct=d.get("rotary_pct", 0.25),
-        rope_theta=d.get("rotary_emb_base", 10000.0),
+        rope_theta=d.get("rope_theta", d.get("rotary_emb_base", 10000.0)),
         layer_norm_eps=d.get("layer_norm_eps", 1e-5),
         use_parallel_residual=d.get("use_parallel_residual", True),
         tie_embeddings=d.get("tie_word_embeddings", False))

@@ -11,9 +11,14 @@ Shapes follow the reference API:
               [B, N, 1, 1, S] (per-row mask bias) and
               [B, 1, H, S, S] (pair / triangle bias)
 
-The TPU form leans on XLA: one einsum-softmax-einsum chain the compiler
-fuses; fp32 softmax accumulation regardless of input dtype (the reference
-kernel does the same). Differentiable end-to-end (no custom VJP needed).
+The TPU form leans on XLA for small shapes (one einsum-softmax-einsum chain
+the compiler fuses), and CHUNKS the query dimension for AlphaFold-scale
+shapes — the reference's CUTLASS kernel exists precisely because the full
+[B, N, H, S, S] bias-added score tensor blows memory at real MSA sizes; the
+chunked scan bounds peak memory at O(B*N*H*chunk*S) with ``jax.checkpoint``
+recomputing each chunk's scores in backward. fp32 softmax accumulation
+regardless of input dtype (the reference kernel does the same).
+Differentiable end-to-end.
 """
 
 from __future__ import annotations
@@ -23,30 +28,72 @@ from typing import List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+#: auto-chunk once the fp32 score tensor would exceed this many bytes
+_FUSED_SCORE_BUDGET = 1 << 30
+
+
+def _attend(q, k, v, biases, scale):
+    """[B, N, Cq, H, D] x [B, N, Sk, H, D] -> [B, N, Cq, H, D]; biases
+    already sliced to the chunk."""
+    logits = jnp.einsum("bnqhd,bnkhd->bnhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    for b in biases:
+        logits = logits + b
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bnhqk,bnkhd->bnqhd", probs, v.astype(jnp.float32))
+
 
 def DS4Sci_EvoformerAttention(q: jnp.ndarray, k: jnp.ndarray,
                               v: jnp.ndarray,
                               biases: Optional[Sequence[Optional[jnp.ndarray]]]
-                              = None) -> jnp.ndarray:
-    """Fused evoformer attention (reference-API name kept verbatim)."""
+                              = None,
+                              chunk_size: Optional[int] = None) -> jnp.ndarray:
+    """Fused evoformer attention (reference-API name kept verbatim).
+
+    ``chunk_size``: query-dim tile for the memory-bounded path. None = auto
+    (fused below ~1 GiB of fp32 scores, 128-wide chunks above); pass
+    ``q.shape[2]`` to force fusion.
+    """
     if q.ndim != 5:
         raise ValueError(f"expected [B, N, S, H, D] tensors, got {q.shape}")
-    d = q.shape[-1]
+    B, N, Sq, H, d = q.shape
+    Sk = k.shape[2]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
-    # [B, N, H, Sq, Sk]
-    logits = jnp.einsum("bnqhd,bnkhd->bnhqk", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
+    bs = []
     for bias in biases or ():
         if bias is None:
             continue
         b = bias.astype(jnp.float32)
         if b.ndim != 5:
             raise ValueError(
-                f"bias must be 5-D broadcastable to {logits.shape}, "
-                f"got {b.shape}")
+                f"bias must be 5-D broadcastable to "
+                f"[B, N, H, Sq, Sk], got {b.shape}")
         # reference bias layouts are [B, N, 1, 1, Sk] / [B, 1, H, Sq, Sk] —
         # already aligned with [B, N, H, Sq, Sk]
-        logits = logits + b
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bnhqk,bnkhd->bnqhd", probs, v.astype(jnp.float32))
+        bs.append(b)
+
+    if chunk_size is None:
+        score_bytes = 4 * B * N * H * Sq * Sk
+        chunk_size = Sq if score_bytes <= _FUSED_SCORE_BUDGET else 128
+    if chunk_size >= Sq:
+        return _attend(q, k, v, bs, scale).astype(q.dtype)
+
+    nc = -(-Sq // chunk_size)
+
+    @jax.checkpoint
+    def chunk(i):
+        # the last chunk clamps back instead of padding (its overlap with
+        # the previous chunk recomputes identical rows)
+        start = jnp.minimum(i * chunk_size, Sq - chunk_size)
+        qc = jax.lax.dynamic_slice_in_dim(q, start, chunk_size, 2)
+        bc = [b if b.shape[3] == 1 else
+              jax.lax.dynamic_slice_in_dim(b, start, chunk_size, 3)
+              for b in bs]
+        return _attend(qc, k, v, bc, scale)
+
+    outs = jax.lax.map(chunk, jnp.arange(nc))    # [nc, B, N, c, H, D]
+    out = jnp.zeros((B, N, Sq, H, d), jnp.float32)
+    for i in range(nc):
+        start = min(i * chunk_size, Sq - chunk_size)
+        out = jax.lax.dynamic_update_slice_in_dim(out, outs[i], start, 2)
     return out.astype(q.dtype)

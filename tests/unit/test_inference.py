@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import deepspeed_tpu as dstpu
 from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
@@ -130,3 +131,114 @@ def test_bert_classification_head_through_v1(devices8):
     np.testing.assert_allclose(np.asarray(out),
                                np.asarray(classify_fn(full, tokens)),
                                atol=2e-4, rtol=1e-4)
+
+
+# --------------------- arch x dtype v1 engine zoo ---------------------- #
+# the analogue of the reference's parameterized HF-model zoo in
+# tests/unit/inference/test_inference.py (arch x dtype x graph x inject)
+
+_V1_ZOO = ["gpt2", "llama", "mistral", "mixtral", "opt", "falcon", "phi",
+           "bloom", "gpt_neox", "gptj"]
+
+
+def _zoo_model(arch):
+    import dataclasses
+
+    from deepspeed_tpu.models.registry import get_arch
+    entry = get_arch(arch)
+    kw = {}
+    if arch == "mistral":
+        from deepspeed_tpu.models.llama import LlamaConfig
+        cfg = LlamaConfig.tiny(dtype=jnp.float32, sliding_window=16)
+    else:
+        cfg = entry.config_cls.tiny(dtype=jnp.float32)
+    if hasattr(cfg, "attention_impl"):
+        cfg = dataclasses.replace(cfg, attention_impl="xla", **kw)
+    out = entry.make_model(cfg)
+    model = out[0] if isinstance(out, tuple) else out
+    return cfg, model
+
+
+@pytest.mark.parametrize("arch", _V1_ZOO)
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_v1_engine_zoo(arch, dtype):
+    """Every decoder family forwards + greedily generates through the v1
+    InferenceEngine at both serving dtypes; f32 matches the raw model."""
+    cfg, model = _zoo_model(arch)
+    rngs = {"params": jax.random.PRNGKey(0), "gating": jax.random.PRNGKey(1)}
+    params = model.init(rngs, jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def apply_fn(p, tokens):
+        if arch in ("mixtral",):            # MoE: eval routing, no rng
+            return model.apply({"params": p}, tokens, train=False)
+        return model.apply({"params": p}, tokens)
+
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(1, cfg.vocab_size - 1, (2, 9)),
+        jnp.int32)
+    eng = dstpu.init_inference((apply_fn, params), dtype=dtype)
+    logits = eng.forward(tokens)
+    assert logits.shape[:2] == (2, 9)
+    assert np.isfinite(np.asarray(logits, np.float32)).all()
+    if dtype == "float32":
+        ref = apply_fn(params, tokens)
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                                   atol=3e-4, rtol=3e-4)
+        gen = eng.generate(tokens[:1, :5], max_new_tokens=3)
+        assert gen.shape == (1, 8)
+
+
+def test_clip_text_encoder_matches_transformers(tmp_path):
+    """CLIP text encoder through the v1 engine, logits vs transformers
+    CLIPTextModel (the diffusers-injection text half —
+    module_inject/containers/clip.py)."""
+    import torch
+    import transformers
+
+    from deepspeed_tpu.models.clip import CLIPTextConfig, CLIPTextEncoder
+
+    hf_cfg = transformers.CLIPTextConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2,
+        max_position_embeddings=32)
+    hf = transformers.CLIPTextModel(hf_cfg).eval()
+
+    cfg = CLIPTextConfig.tiny()
+    model = CLIPTextEncoder(cfg)
+
+    # map HF weights onto the flax tree
+    sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+    pre = "text_model."
+    params = {
+        "token_embedding": {
+            "embedding": sd[f"{pre}embeddings.token_embedding.weight"]},
+        "position_embedding": {
+            "embedding": sd[f"{pre}embeddings.position_embedding.weight"]},
+        "final_layer_norm": {
+            "scale": sd[f"{pre}final_layer_norm.weight"],
+            "bias": sd[f"{pre}final_layer_norm.bias"]},
+    }
+    for i in range(cfg.num_layers):
+        lp = f"{pre}encoder.layers.{i}."
+        layer = {}
+        for ln in ("layer_norm1", "layer_norm2"):
+            layer[ln] = {"scale": sd[f"{lp}{ln}.weight"],
+                         "bias": sd[f"{lp}{ln}.bias"]}
+        for proj in ("q_proj", "k_proj", "v_proj", "out_proj"):
+            layer[proj] = {"kernel": sd[f"{lp}self_attn.{proj}.weight"].T,
+                           "bias": sd[f"{lp}self_attn.{proj}.bias"]}
+        for fc in ("fc1", "fc2"):
+            layer[fc] = {"kernel": sd[f"{lp}mlp.{fc}.weight"].T,
+                         "bias": sd[f"{lp}mlp.{fc}.bias"]}
+        params[f"layer_{i}"] = layer
+
+    toks = np.random.RandomState(0).randint(1, 127, (2, 16))
+    with torch.no_grad():
+        ref = hf(torch.tensor(toks)).last_hidden_state.numpy()
+
+    def apply_fn(p, tokens):
+        return model.apply({"params": p}, tokens)
+
+    eng = dstpu.init_inference((apply_fn, params), dtype="float32")
+    out = eng.forward(jnp.asarray(toks, jnp.int32))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=3e-4, rtol=3e-4)
