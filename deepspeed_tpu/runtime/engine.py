@@ -181,26 +181,33 @@ class Engine:
             from ..profiling.flops_profiler import FlopsProfiler
             self.flops_profiler = FlopsProfiler(self, config.flops_profiler)
 
+        # ZeRO-Offload mode: the optimizer STEP runs on the host CPU — fp32
+        # master params + moments never enter HBM (reference stage_1_and_2
+        # CPU-offload + csrc/adam/cpu_adam; see zero/cpu_optimizer.py). The
+        # 1-bit manual-collective seam is mutually exclusive with it.
+        offload_dev = config.zero_optimization.offload_optimizer.device
+        self._cpu_opt_mode = offload_dev == "cpu"
+        self._device_params = None
+        if self._cpu_opt_mode and self._onebit is not None:
+            logger.warning("cpu optimizer offload is incompatible with 1-bit "
+                           "compressed allreduce; disabling the offload")
+            self._cpu_opt_mode = False
+
         # state ------------------------------------------------------------------
         rng = rng if rng is not None else jax.random.PRNGKey(config.seed)
         self.state = self._init_state(params, rng)
         self._state_shardings = self._compute_state_shardings(self.state)
         self.state = self._place_state(self.state)
+        if self._cpu_opt_mode:
+            self._refresh_device_params()
 
-        # Offloaded optimizer state lives off-HBM between steps — pinned host
-        # memory (cpu) or NVMe files through the native aio library (nvme)
-        # (reference: runtime/swap_tensor/partitioned_optimizer_swapper.py,
-        # stage_1_and_2.py CPU-offload path)
+        # NVMe-offloaded optimizer state lives in aio-backed files between
+        # steps (reference: runtime/swap_tensor/partitioned_optimizer_swapper)
         self._opt_swapper = None
-        offload_dev = config.zero_optimization.offload_optimizer.device
         if offload_dev == "nvme":
             from .zero.offload import NvmeOptimizerSwapper
             self._opt_swapper = NvmeOptimizerSwapper(
                 config.zero_optimization.offload_optimizer)
-        elif offload_dev == "cpu":
-            from .zero.offload import CpuOptimizerSwapper
-            self._opt_swapper = CpuOptimizerSwapper(
-                self.zero_plan.opt_state_host_shardings(self.state.opt_state))
 
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step() if (eval_fn or loss_fn) else None
@@ -227,6 +234,23 @@ class Engine:
     def _init_state(self, params: Any, rng: jax.Array) -> TrainState:
         # copy=True: the compiled step donates (deletes) state buffers, so the
         # engine must own them — never alias the caller's arrays
+        if self._cpu_opt_mode:
+            # master params + moments must NEVER materialize in HBM — for a
+            # 1.3B model that alone is ~16GB; build them host-side
+            from .zero.cpu_optimizer import cpu_device
+            cpu = cpu_device()
+            params = jax.tree_util.tree_map(
+                lambda x: jax.device_put(jnp.asarray(x), cpu), params)
+            with jax.default_device(cpu):
+                opt_state = self.optimizer.init(params)
+            rng = jax.device_put(jnp.asarray(rng), cpu)
+            return TrainState(
+                step=jax.device_put(jnp.zeros((), jnp.int32), cpu),
+                params=params, opt_state=opt_state,
+                scale_state=jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, cpu),
+                    ls.init_state(self.config.fp16)),
+                rng=rng, comm_state=())
         params = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), params)
         rng = jnp.array(rng, copy=True)
         opt_state = self.optimizer.init(params)
@@ -246,6 +270,17 @@ class Engine:
         )
 
     def _compute_state_shardings(self, state: TrainState) -> TrainState:
+        if self._cpu_opt_mode:
+            from jax.sharding import SingleDeviceSharding
+            from .zero.cpu_optimizer import cpu_device
+            cpu_sh = SingleDeviceSharding(cpu_device())
+            leaf = lambda _: cpu_sh  # noqa: E731
+            return TrainState(
+                step=cpu_sh,
+                params=jax.tree_util.tree_map(leaf, state.params),
+                opt_state=jax.tree_util.tree_map(leaf, state.opt_state),
+                scale_state=jax.tree_util.tree_map(leaf, state.scale_state),
+                rng=cpu_sh, comm_state=())
         repl = self.topology.replicated()
         return TrainState(
             step=repl,
@@ -255,6 +290,14 @@ class Engine:
             rng=repl,
             comm_state=self._comm_shardings,
         )
+
+    def _refresh_device_params(self):
+        """(ZeRO-Offload) re-derive the device compute-dtype params from the
+        host fp32 master — after init and after checkpoint load."""
+        host = cast_floating(self.state.params, self.compute_dtype)
+        self._device_params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s), host,
+            self.zero_plan.param_shardings(self.state.params))
 
     def _place_state(self, state: TrainState) -> TrainState:
         return jax.tree_util.tree_map(
@@ -276,6 +319,9 @@ class Engine:
         return out, ()
 
     def _build_train_step(self):
+        if self._cpu_opt_mode:
+            from .zero.cpu_optimizer import build_cpu_optimizer_step
+            return build_cpu_optimizer_step(self)
         cfg = self.config
         gas = self.gradient_accumulation_steps
         fp16 = cfg.fp16.enabled
@@ -551,6 +597,10 @@ class Engine:
 
         if not self.config.compile:
             return eval_fn
+        if self._cpu_opt_mode:
+            # eval consumes the DEVICE compute-dtype params, not the host
+            # master (eval_batch passes them); placement follows the inputs
+            return jax.jit(eval_fn)
         return jax.jit(
             eval_fn,
             in_shardings=(self._state_shardings.params, None, None, None))
@@ -607,7 +657,11 @@ class Engine:
     def eval_batch(self, batch: Any, rng: Optional[jax.Array] = None):
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        return self._eval_step(self.state.params, batch, rng, self.state.step)
+        params = (self._device_params if self._cpu_opt_mode
+                  else self.state.params)
+        step = (jax.device_put(self.state.step, self.topology.replicated())
+                if self._cpu_opt_mode else self.state.step)
+        return self._eval_step(params, batch, rng, step)
 
     # --- forward/backward/step trio (API parity) ----------------------- #
 
@@ -709,4 +763,6 @@ class Engine:
                     load_lr_scheduler_states=load_lr_scheduler_states,
                     load_module_only=load_module_only)
         self._evict_opt_state()
+        if self._cpu_opt_mode:
+            self._refresh_device_params()
         return out
