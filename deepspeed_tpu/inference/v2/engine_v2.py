@@ -75,6 +75,7 @@ class InferenceEngineV2:
         self.state = StateManager(self.config, self.kv_cache)
         self.scheduler = SplitFuseScheduler(self.config, self.state)
         self._kv_data = self.kv_cache.data
+        self._step_counter = 0
         log_dist(
             f"InferenceEngineV2 ready: {self.config.max_seqs} slots x "
             f"{self.config.chunk_size} tokens, "
@@ -87,19 +88,71 @@ class InferenceEngineV2:
     def put(self, batch_uids: Sequence[int],
             batch_tokens: Sequence[Sequence[int]]) -> Dict[int, np.ndarray]:
         """Feed tokens, run scheduled steps until all fed work is consumed,
-        return {uid: last-token logits} for sequences with no pending work."""
+        return {uid: last-token logits} for sequences with no pending work.
+
+        The KV pool may be oversubscribed: when the scheduler starves, the
+        engine pauses (host-offloads) least-recently-scheduled idle sequences
+        to free blocks, and resumes paused sequences as room appears — the
+        reference's state manager exists precisely to oversubscribe
+        (``inference/v2/ragged/kv_cache.py:166,176``)."""
         for uid, toks in zip(batch_uids, batch_tokens):
             self.state.put_tokens(uid, toks)
         done: Dict[int, np.ndarray] = {}
         while any(s.in_flight for s in self.state.sequences.values()):
+            self._try_resume()
             n_scheduled, step_done = self._run_step()
-            if n_scheduled == 0:
-                # nothing schedulable but work remains -> KV pool exhausted
+            if n_scheduled == 0 and not self._relieve_kv_pressure():
+                # nothing schedulable, nothing evictable or resumable ->
+                # a single sequence genuinely does not fit the pool
                 raise RuntimeError(
-                    "scheduler starved: KV pool too small for pending work "
+                    "scheduler starved: KV pool too small even after "
+                    "pausing all idle sequences "
                     f"(free blocks={self.kv_cache.free_blocks})")
             done.update(step_done)
         return done
+
+    def _resume_headroom(self, seq) -> int:
+        """Blocks needed to restore ``seq`` AND schedule its next chunk —
+        resuming with less would just thrash (restore, fail to schedule,
+        get evicted again)."""
+        bs = self.config.block_size
+        n = min(seq.in_flight, self.config.chunk_size)
+        total = -(-(seq.seen_tokens + n) // bs)
+        return max(total, seq.paused_blocks)
+
+    def _try_resume(self) -> None:
+        """Restore paused sequences that have pending work, oldest first,
+        while free blocks cover their saved KV plus their next chunk."""
+        paused = sorted(
+            (s for s in self.state.sequences.values()
+             if s.status is SequenceStatus.PAUSED and s.in_flight > 0),
+            key=lambda s: s.last_step)
+        for seq in paused:
+            if self._resume_headroom(seq) > self.kv_cache.free_blocks:
+                break
+            self.resume(seq.uid)
+
+    def _relieve_kv_pressure(self) -> bool:
+        """Pause the least-recently-scheduled block-holder to free blocks.
+        Idle holders (no pending tokens) are evicted first; if every holder
+        is mid-work, the least-recently-scheduled pending holder is paused
+        (its KV up to ``seen_tokens`` is complete, so this is always safe —
+        its queued tokens simply wait for a later resume). Returns False
+        when no sequence holds any blocks: the caller just failed to
+        schedule into an empty-as-possible pool, a true deadlock."""
+        holders = [s for s in self.state.sequences.values()
+                   if s.status is not SequenceStatus.PAUSED and s.kv_blocks]
+        idle = sorted((s for s in holders if not s.in_flight),
+                      key=lambda s: s.last_step)
+        if idle:
+            self.pause(idle[0].uid)
+            return True
+        pending = sorted((s for s in holders if s.in_flight),
+                         key=lambda s: s.last_step)
+        if pending:
+            self.pause(pending[0].uid)
+            return True
+        return False
 
     def query(self, uid: int) -> Tuple[int, int]:
         """(tokens seen, max additional tokens before block exhaustion).
@@ -122,17 +175,19 @@ class InferenceEngineV2:
         """Evict a sequence's KV blocks to host memory and free them — the
         pool can then be oversubscribed by other sequences. Reference:
         ``BlockedKVCache.offload`` (inference/v2/ragged/kv_cache.py:166).
-        The sequence must have no in-flight tokens."""
+        Queued (pending) tokens are allowed: KV is complete up to
+        ``seen_tokens`` after every step, so the pending tokens simply wait
+        in the queue until the sequence is resumed."""
         seq = self.state.get(uid)
         if seq is None:
             raise KeyError(f"unknown sequence {uid}")
         if seq.status is SequenceStatus.PAUSED:
             return
-        if seq.in_flight:
-            raise ValueError(
-                f"sequence {uid} has {seq.in_flight} pending tokens; run "
-                f"them (put) before pausing")
         seq.host_kv = self.kv_cache.offload(self._kv_data, seq.kv_blocks)
+        # capture the exact block count now: resume() must reserve exactly
+        # what was saved, not re-derive it from seen_tokens (the two could
+        # diverge under future allocate-ahead policies)
+        seq.paused_blocks = len(seq.kv_blocks)
         self.kv_cache.free(seq.kv_blocks)
         seq.kv_blocks = []
         seq.status = SequenceStatus.PAUSED
@@ -146,13 +201,12 @@ class InferenceEngineV2:
             raise KeyError(f"unknown sequence {uid}")
         if seq.status is not SequenceStatus.PAUSED:
             return
-        bs = self.config.block_size
-        need = -(-seq.seen_tokens // bs)
-        blocks = self.kv_cache.reserve(need)
+        blocks = self.kv_cache.reserve(seq.paused_blocks)
         self._kv_data = self.kv_cache.restore(self._kv_data, seq.host_kv,
                                               blocks)
         seq.kv_blocks = list(blocks)
         seq.host_kv = None
+        seq.paused_blocks = 0
         seq.status = SequenceStatus.WAITING
 
     @property
@@ -165,6 +219,9 @@ class InferenceEngineV2:
         sched = self.scheduler.schedule()
         if not sched:
             return 0, {}
+        self._step_counter += 1
+        for item in sched:
+            item.seq.last_step = self._step_counter
         cfg = self.config
         S, C, MAXB = cfg.max_seqs, cfg.chunk_size, cfg.max_blocks_per_seq
         tokens = np.zeros((S, C), np.int32)

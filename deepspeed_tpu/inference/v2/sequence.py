@@ -29,6 +29,8 @@ class SequenceDescriptor:
     status: SequenceStatus = SequenceStatus.WAITING
     generated: List[int] = field(default_factory=list)
     host_kv: object = None                # offloaded KV (engine.pause)
+    paused_blocks: int = 0                # block count captured at pause()
+    last_step: int = 0                    # engine step last scheduled (LRU)
 
     @property
     def in_flight(self) -> int:

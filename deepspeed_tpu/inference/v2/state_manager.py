@@ -37,12 +37,10 @@ class StateManager:
 
     def put_tokens(self, uid: int, tokens: Iterable[int]) -> SequenceDescriptor:
         seq = self.get_or_create(uid)
-        if seq.status is SequenceStatus.PAUSED:
-            raise ValueError(
-                f"sequence {uid} is paused (KV offloaded to host); call "
-                f"engine.resume({uid}) before feeding more tokens")
         seq.pending_tokens.extend(int(t) for t in tokens)
-        if seq.status is not SequenceStatus.RUNNING:
+        # PAUSED sequences keep their status: the scheduler skips them and
+        # the engine auto-resumes as blocks free up (engine_v2._try_resume).
+        if seq.status not in (SequenceStatus.RUNNING, SequenceStatus.PAUSED):
             seq.status = SequenceStatus.WAITING
         total = seq.seen_tokens + seq.in_flight
         if total > self.cfg.max_context:
