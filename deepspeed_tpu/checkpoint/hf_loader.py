@@ -353,7 +353,9 @@ def _split_qwen_fused(state: Dict[str, np.ndarray],
             out[f"{base}.self_attn.q_proj.{leaf}"] = q
             out[f"{base}.self_attn.k_proj.{leaf}"] = k
             out[f"{base}.self_attn.v_proj.{leaf}"] = v
-        elif n.endswith(".attn.c_proj.weight"):
+        elif ".attn.c_proj." in n:
+            # weight + bias (bias only exists when no_bias=False; the
+            # shipped Qwen-7B uses no_bias=True so usually weight-only)
             out[n.replace(".attn.c_proj.", ".self_attn.o_proj.")] = arr
         elif ".mlp.w2." in n:                       # silu branch = gate
             out[n.replace(".mlp.w2.", ".mlp.gate_proj.")] = arr

@@ -32,6 +32,11 @@ class RaggedInferenceConfig(ConfigModel):
     greedy: bool = True
     temperature: float = 1.0
     top_k: int = 0
+    # fused greedy decode: tokens generated per device call via the
+    # on-device scan (engine.decode_greedy). Collapses per-token host
+    # round-trips — the decode wall whenever host<->chip latency is
+    # non-trivial. 0/1 disables (every token through put()).
+    decode_loop_steps: int = 16
 
     def __post_init__(self):
         if self.max_seqs <= 0 or self.chunk_size <= 0:

@@ -18,6 +18,7 @@ import numpy as np
 
 from ...utils.dtypes import resolve_dtype
 from ...utils.logging import log_dist
+from .blocked_allocator import OutOfBlocksError
 from ..config import InferenceConfig
 from .config import RaggedInferenceConfig
 from .kv_cache import BlockedKVCache
@@ -86,9 +87,12 @@ class InferenceEngineV2:
     # ------------------------------------------------------------------ #
 
     def put(self, batch_uids: Sequence[int],
-            batch_tokens: Sequence[Sequence[int]]) -> Dict[int, np.ndarray]:
+            batch_tokens: Sequence[Sequence[int]],
+            _greedy: bool = False) -> Dict[int, Any]:
         """Feed tokens, run scheduled steps until all fed work is consumed,
-        return {uid: last-token logits} for sequences with no pending work.
+        return {uid: last-token logits} for sequences with no pending work
+        (or {uid: argmax token id} on the internal ``_greedy`` fast path,
+        which keeps sampling on-device — used by :meth:`generate`).
 
         The KV pool may be oversubscribed: when the scheduler starves, the
         engine pauses (host-offloads) least-recently-scheduled idle sequences
@@ -100,7 +104,7 @@ class InferenceEngineV2:
         done: Dict[int, np.ndarray] = {}
         while any(s.in_flight for s in self.state.sequences.values()):
             self._try_resume()
-            n_scheduled, step_done = self._run_step()
+            n_scheduled, step_done = self._run_step(greedy=_greedy)
             if n_scheduled == 0 and not self._relieve_kv_pressure():
                 # nothing schedulable, nothing evictable or resumable ->
                 # a single sequence genuinely does not fit the pool
@@ -213,9 +217,80 @@ class InferenceEngineV2:
     def free_blocks(self) -> int:
         return self.kv_cache.free_blocks
 
+    def decode_greedy(self, batch_uids: Sequence[int],
+                      first_tokens: Sequence[int],
+                      n: int) -> Dict[int, List[int]]:
+        """Greedy-decode ``n`` tokens for each uid in ONE fused device
+        program (``RaggedRunnerBase.decode_loop``): forward + argmax + KV
+        append scan entirely on-device, so the host pays one round-trip per
+        ``n`` tokens instead of per token. KV blocks for all n positions are
+        reserved up front; raises if the pool cannot cover them (callers
+        wanting oversubscription semantics should fall back to put()).
+
+        first_tokens: each sequence's next INPUT token (its KV is appended
+        at position seen_tokens, exactly like feeding it through put)."""
+        if not hasattr(self.runner, "decode_loop"):
+            raise NotImplementedError(
+                f"{type(self.runner).__name__} has no decode_loop")
+        cfg = self.config
+        if len(batch_uids) > cfg.max_seqs:
+            raise ValueError(f"{len(batch_uids)} uids > max_seqs "
+                             f"{cfg.max_seqs}")
+        seqs = []
+        for uid in batch_uids:
+            seq = self.state.get(uid)
+            if seq is None or seq.status is SequenceStatus.PAUSED:
+                raise ValueError(f"sequence {uid} missing or paused")
+            if seq.in_flight:
+                raise ValueError(f"sequence {uid} has pending tokens; "
+                                 f"drain with put() first")
+            seqs.append(seq)
+        # reserve atomically: check the WHOLE batch's demand first so a
+        # mid-batch failure doesn't leave earlier sequences holding
+        # allocate-ahead blocks that deepen the pool pressure the caller is
+        # about to fall back from
+        bsz = self.config.block_size
+        need = 0
+        for s_ in seqs:
+            nb = s_.blocks_needed(n, bsz)
+            if len(s_.kv_blocks) + nb > cfg.max_blocks_per_seq:
+                raise OutOfBlocksError(
+                    f"sequence {s_.uid} would exceed max_blocks_per_seq")
+            need += nb
+        if need > self.kv_cache.free_blocks:
+            raise OutOfBlocksError(
+                f"decode_greedy needs {need} blocks, "
+                f"{self.kv_cache.free_blocks} free")
+        for seq in seqs:
+            self.state.ensure_blocks(seq, n)       # covers pos seen..seen+n-1
+
+        S, MAXB = cfg.max_seqs, cfg.max_blocks_per_seq
+        tok0 = np.zeros((S,), np.int32)
+        start = np.zeros((S,), np.int32)
+        active = np.zeros((S,), np.int32)
+        tables = np.zeros((S, MAXB), np.int32)
+        for i, (seq, t0) in enumerate(zip(seqs, first_tokens)):
+            tok0[i] = t0
+            start[i] = seq.seen_tokens
+            active[i] = 1
+            tables[i, :len(seq.kv_blocks)] = seq.kv_blocks
+        toks, self._kv_data = self.runner.decode_loop(
+            self.params, self._kv_data, jax.numpy.asarray(tok0),
+            jax.numpy.asarray(start), jax.numpy.asarray(active),
+            jax.numpy.asarray(tables), n)
+        toks = np.asarray(toks)
+        self._step_counter += n
+        out: Dict[int, List[int]] = {}
+        for i, (uid, seq) in enumerate(zip(batch_uids, seqs)):
+            seq.seen_tokens += n       # fed first_tokens + n-1 generated
+            seq.last_step = self._step_counter
+            seq.status = SequenceStatus.WAITING
+            out[uid] = toks[i].tolist()
+        return out
+
     # ------------------------------------------------------------------ #
 
-    def _run_step(self) -> Tuple[int, Dict[int, np.ndarray]]:
+    def _run_step(self, greedy: bool = False) -> Tuple[int, Dict[int, Any]]:
         sched = self.scheduler.schedule()
         if not sched:
             return 0, {}
@@ -223,7 +298,15 @@ class InferenceEngineV2:
         for item in sched:
             item.seq.last_step = self._step_counter
         cfg = self.config
-        S, C, MAXB = cfg.max_seqs, cfg.chunk_size, cfg.max_blocks_per_seq
+        S, MAXB = cfg.max_seqs, cfg.max_blocks_per_seq
+        # shape bucketing: a pure-decode step (every scheduled slot carries
+        # one token) runs the [S, 1] program instead of padding every slot
+        # to chunk_size — chunk_size× fewer wasted positions in the steady
+        # decode state. Two compiled programs total (jit caches by shape);
+        # the reference gets the same effect by flattening tokens into one
+        # ragged array (ragged_wrapper.py), which XLA's static shapes forbid.
+        C = 1 if all(len(item.tokens) == 1 for item in sched) \
+            else cfg.chunk_size
         tokens = np.zeros((S, C), np.int32)
         start = np.zeros((S,), np.int32)
         ntok = np.zeros((S,), np.int32)
@@ -238,13 +321,19 @@ class InferenceEngineV2:
             start_pos=jax.numpy.asarray(start),
             n_tokens=jax.numpy.asarray(ntok),
             block_tables=jax.numpy.asarray(tables))
-        logits, self._kv_data = self.runner.step(self.params, self._kv_data,
-                                                 batch)
-        logits = np.asarray(logits)
-        out: Dict[int, np.ndarray] = {}
+        use_greedy = greedy and hasattr(self.runner, "step_greedy")
+        if use_greedy:
+            result, self._kv_data = self.runner.step_greedy(
+                self.params, self._kv_data, batch)
+        else:
+            result, self._kv_data = self.runner.step(self.params,
+                                                     self._kv_data, batch)
+        result = np.asarray(result)
+        out: Dict[int, Any] = {}
         for i, item in enumerate(sched):
             if item.is_last_chunk:
-                out[item.seq.uid] = logits[i]
+                out[item.seq.uid] = int(result[i]) if use_greedy \
+                    else result[i]
                 item.seq.status = SequenceStatus.WAITING
         return len(sched), out
 
@@ -258,36 +347,77 @@ class InferenceEngineV2:
                  sampling: Optional[InferenceConfig] = None,
                  seed: int = 0) -> List[List[int]]:
         """Continuous-batching generation: prompts enter the scheduler
-        together; decode steps fuse with any remaining prefill chunks."""
+        together; decode steps fuse with any remaining prefill chunks.
+        Greedy decoding batches ``config.decode_loop_steps`` tokens per
+        device call through the fused decode loop when the KV pool covers
+        them; anything else (sampling, KV pressure, tails) runs the
+        step-at-a-time put() path."""
         rng = np.random.default_rng(seed)
+        greedy = sampling is None or sampling.greedy
         uids = list(range(len(prompts)))
+        if max_new_tokens <= 0:
+            return [[] for _ in uids]
         live = set(uids)
         outputs: Dict[int, List[int]] = {u: [] for u in uids}
-        logits = self.put(uids, [list(p) for p in prompts])
-        for _ in range(max_new_tokens):
-            feeds_u, feeds_t = [], []
-            for u in list(live):
-                if u not in logits:
+        last_tok: Dict[int, int] = {}
+        results = self.put(uids, [list(p) for p in prompts], _greedy=greedy)
+        for u in uids:
+            nxt = self._sample(results[u], sampling, rng)
+            outputs[u].append(nxt)
+            if (eos_token_id is not None and nxt == eos_token_id) or \
+                    max_new_tokens <= 1:
+                live.discard(u)
+                self.flush(u)
+            else:
+                last_tok[u] = nxt
+        N = self.config.decode_loop_steps
+        can_loop = greedy and N > 1 and hasattr(self.runner, "decode_loop")
+        while live:
+            lu = sorted(live)
+            need = max_new_tokens - len(outputs[lu[0]])
+            paused = any(
+                self.state.sequences[u].status is SequenceStatus.PAUSED
+                for u in lu if u in self.state.sequences)
+            if can_loop and not paused and need >= N \
+                    and len(lu) <= self.config.max_seqs:
+                try:
+                    outs = self.decode_greedy(lu, [last_tok[u] for u in lu],
+                                              N)
+                except OutOfBlocksError:
+                    outs = None                  # KV pressure: put() path
+                if outs is not None:
+                    for u in lu:
+                        toks = outs[u]
+                        if eos_token_id is not None and eos_token_id in toks:
+                            cut = toks.index(eos_token_id)
+                            outputs[u].extend(toks[:cut + 1])
+                            live.discard(u)
+                            self.flush(u)
+                        else:
+                            outputs[u].extend(toks)
+                            last_tok[u] = toks[-1]
+                            if len(outputs[u]) >= max_new_tokens:
+                                live.discard(u)
+                                self.flush(u)
                     continue
-                nxt = self._sample(logits[u], sampling, rng)
+            results = self.put(lu, [[last_tok[u]] for u in lu],
+                               _greedy=greedy)
+            for u in lu:
+                nxt = self._sample(results[u], sampling, rng)
                 outputs[u].append(nxt)
                 if (eos_token_id is not None and nxt == eos_token_id) or \
                         len(outputs[u]) >= max_new_tokens:
                     live.discard(u)
                     self.flush(u)
                 else:
-                    feeds_u.append(u)
-                    feeds_t.append([nxt])
-            if not feeds_u:
-                break
-            logits = self.put(feeds_u, feeds_t)
-        for u in list(live):
-            self.flush(u)
+                    last_tok[u] = nxt
         return [outputs[u] for u in uids]
 
     @staticmethod
-    def _sample(logits: np.ndarray, cfg: Optional[InferenceConfig],
+    def _sample(logits, cfg: Optional[InferenceConfig],
                 rng: np.random.Generator) -> int:
+        if isinstance(logits, (int, np.integer)):
+            return int(logits)              # on-device greedy already sampled
         if cfg is None or cfg.greedy:
             return int(np.argmax(logits))
         x = logits.astype(np.float64) / max(cfg.temperature, 1e-6)

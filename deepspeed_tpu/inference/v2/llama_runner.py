@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from ...models.llama import LlamaConfig, apply_rope
 from ...models.mixtral import MixtralConfig
 from .config import RaggedInferenceConfig
-from .model_runner import RaggedBatch, paged_attention
+from .model_runner import RaggedBatch, RaggedRunnerBase, paged_attention
 
 
 def _rms(x, scale, eps):
@@ -26,29 +26,10 @@ def _rms(x, scale, eps):
     return y * scale
 
 
-class LlamaRaggedRunner:
-    def __init__(self, model_cfg: LlamaConfig, cfg: RaggedInferenceConfig,
-                 compute_dtype: Any = None):
-        self.model_cfg = model_cfg
-        self.cfg = cfg
-        self.compute_dtype = compute_dtype or model_cfg.dtype
-        self.num_layers = model_cfg.num_layers
-        self.kv_heads = model_cfg.num_kv_heads
-        self.head_dim = model_cfg.head_dim
-        def _step(params, kv_data, batch):
-            # WOQ: int8/int4 leaves (inference/quantization.py) dequantize
-            # here, inside the jit — XLA fuses the dequant into each layer's
-            # matmul while HBM keeps the packed weights
-            from ..quantization import dequantize_tree
-            params = dequantize_tree(params)
-            return _llama_ragged_step(params, kv_data, batch,
-                                      model_cfg=model_cfg, cfg=cfg,
-                                      dtype=self.compute_dtype)
-
-        self._step = jax.jit(_step)
-
-    def step(self, params, kv_data, batch: RaggedBatch):
-        return self._step(params, kv_data, batch)
+class LlamaRaggedRunner(RaggedRunnerBase):
+    """All runner plumbing (jitted step / greedy step / fused decode loop,
+    WOQ dequant-in-jit) comes from RaggedRunnerBase; ``step_fn`` is bound at
+    the bottom of this module."""
 
 
 def _moe_mlp(p_moe, h, cfg: MixtralConfig, dtype):
@@ -151,3 +132,6 @@ def _llama_ragged_step(params, kv, batch: RaggedBatch, *,
         w_out = params["lm_head"]["kernel"]
     logits = x_last.astype(jnp.float32) @ w_out.astype(jnp.float32)
     return logits, kv
+
+
+LlamaRaggedRunner.step_fn = staticmethod(_llama_ragged_step)

@@ -147,17 +147,72 @@ class RaggedRunnerBase:
             model_cfg, "head_dim",
             model_cfg.hidden_size // model_cfg.num_heads)
 
+        dtype = self.compute_dtype
+
         def _step(params, kv_data, batch):
             from ..quantization import dequantize_tree
             return type(self).step_fn(dequantize_tree(params), kv_data,
                                       batch, model_cfg=model_cfg, cfg=cfg,
-                                      dtype=self.compute_dtype)
+                                      dtype=dtype)
 
         self._step = jax.jit(_step)
+        # greedy decode variant: argmax fused into the jit so a decode step
+        # returns [S] int32 token ids instead of shipping [S, V] f32 logits
+        # to the host (the reference's host-side sampler reads full logits;
+        # over a TPU tunnel that transfer would dominate decode latency)
+        def _step_greedy(params, kv_data, batch):
+            logits, kv_out = _step(params, kv_data, batch)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv_out
+
+        self._step_greedy = jax.jit(_step_greedy)
+
+        # fused multi-step greedy decode: n forward+argmax+KV-append steps
+        # in ONE device program (lax.scan), feeding each step's token to the
+        # next. Per-token host round-trips — the decode wall when the host
+        # talks to the chip over a network hop — collapse to one per n
+        # tokens. KV blocks must be pre-reserved for all n tokens
+        # (engine.decode_greedy does this); the kv buffer is donated so the
+        # scan updates it in place.
+        def _decode_loop(params, kv_data, tok0, start, active, tables, *, n):
+            from ..quantization import dequantize_tree
+            params = dequantize_tree(params)
+
+            def body(carry, _):
+                kv, tok, pos = carry
+                batch = RaggedBatch(tokens=tok[:, None], start_pos=pos,
+                                    n_tokens=active, block_tables=tables)
+                logits, kv = type(self).step_fn(
+                    params, kv, batch, model_cfg=model_cfg, cfg=cfg,
+                    dtype=dtype)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (kv, nxt, pos + 1), nxt
+
+            (kv_out, _, _), toks = jax.lax.scan(
+                body, (kv_data, tok0, start), None, length=n)
+            return jnp.transpose(toks), kv_out          # [S, n]
+
+        self._decode_loop = jax.jit(_decode_loop, static_argnames=("n",),
+                                    donate_argnums=(1,))
 
     def step(self, params, kv_data, batch: "RaggedBatch"):
         """Returns (last_token_logits [S, V] f32, new kv_data)."""
         return self._step(params, kv_data, batch)
+
+    def step_greedy(self, params, kv_data, batch: "RaggedBatch"):
+        """Returns (argmax token ids [S] int32, new kv_data)."""
+        return self._step_greedy(params, kv_data, batch)
+
+    def decode_loop(self, params, kv_data, tok0, start_pos, active,
+                    block_tables, n: int):
+        """Greedy-decode ``n`` tokens per active slot on-device.
+
+        tok0 [S] int32: each slot's next input token (KV not yet appended);
+        start_pos [S]: its absolute position; active [S]: 1 live / 0 idle.
+        Returns (tokens [S, n] int32, new kv_data). Slots must have KV
+        blocks covering positions start_pos..start_pos+n-1.
+        """
+        return self._decode_loop(params, kv_data, tok0, start_pos, active,
+                                 block_tables, n=n)
 
 
 class GPT2RaggedRunner(RaggedRunnerBase):

@@ -84,8 +84,16 @@ class CausalSelfAttention(nn.Module):
                 impl = "xla"
             elif jax.device_count() == 1:
                 impl = "flash"
+            elif _topo.has_topology() and \
+                    _topo.get_topology().mesh.shape.get("seq", 1) == 1:
+                impl = "flash_sharded"
             else:
-                impl = "flash_sharded" if _topo.has_topology() else "xla"
+                # sequence-parallel meshes must NOT take flash_sharded: its
+                # in_specs keep the sequence dim unsharded, so GSPMD would
+                # all-gather seq-sharded activations around the kernel,
+                # silently defeating SP — those meshes go through
+                # ulysses/ring attention (parallel/) or plain XLA here
+                impl = "xla"
         if impl == "flash":
             from deepspeed_tpu.ops.kernels import flash_attention
             y = flash_attention(q, k, v, causal=True, layout="BTHD")

@@ -38,13 +38,14 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def _paged_kernel(starts_ref, fetch_ref, nlive_ref, lo_ref, slopes_ref,
+def _paged_kernel(starts_ref, fetch_ref, lo_ref, hi_ref, slopes_ref,
                   q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-                  *, bs, C, H, KV, D, sm_scale, use_alibi, window):
+                  *, bs, Cb, nCb, H, KV, D, sm_scale, use_alibi, window):
     s = pl.program_id(0)
-    j = pl.program_id(1)
-    nb = pl.num_programs(1)
-    HC = H * C
+    qc = pl.program_id(1)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+    sq = s * nCb + qc
     g = H // KV
 
     @pl.when(j == 0)
@@ -53,32 +54,43 @@ def _paged_kernel(starts_ref, fetch_ref, nlive_ref, lo_ref, slopes_ref,
         l_scr[:] = jnp.zeros(l_scr.shape, l_scr.dtype)
         acc_scr[:] = jnp.zeros(acc_scr.shape, acc_scr.dtype)
 
-    @pl.when(jnp.logical_and(j >= lo_ref[s], j < nlive_ref[s]))
+    @pl.when(jnp.logical_and(j >= lo_ref[sq], j < hi_ref[sq]))
     def _compute():
-        q = q_ref[0]                                   # [C, H, D]
+        q = q_ref[0]                                   # [Cb, H, D]
         kb = k_ref[0]                                  # [bs, KV, D]
         vb = v_ref[0]
-        # per-chunk-position query positions and this block's column range
-        pos_q = starts_ref[s] + jax.lax.broadcasted_iota(
-            jnp.int32, (C, bs), 0)                     # [C, bs]
-        col = j * bs + jax.lax.broadcasted_iota(jnp.int32, (C, bs), 1)
+        # per-row query positions at the head-group row layout [g*Cb, bs]:
+        # row r <-> (head i = r // Cb, tile pos c = r % Cb) — built directly
+        # at full width (Mosaic cannot concatenate i1 mask vregs)
+        c_of_row = jax.lax.rem(
+            jax.lax.broadcasted_iota(jnp.int32, (g * Cb, bs), 0), Cb)
+        pos_q = starts_ref[s] + qc * Cb + c_of_row     # [gCb, bs]
+        col = j * bs + jax.lax.broadcasted_iota(jnp.int32, (g * Cb, bs), 1)
         causal = col <= pos_q
         if window is not None:                         # mistral sliding window
             causal = jnp.logical_and(causal, col > pos_q - window)
         dist = (pos_q - col).astype(jnp.float32)
 
-        # rows are head-major: scores row h*C + c <-> (head h, chunk pos c)
+        # rows are head-major: scores row h*Cb + c <-> (head h, tile pos c).
+        # Heads are batched per KV group — one [g*Cb, D] x [D, bs] matmul
+        # per kv head instead of H separate [Cb, D] ones (at decode Cb=1
+        # the per-head variant fed the MXU single-row operands)
         parts = []
-        for h in range(H):
-            qh = q[:, h, :]                            # [C, D]
-            kh = kb[:, h // g, :]                      # [bs, D]
+        for kvh in range(KV):
+            qg = q[:, kvh * g:(kvh + 1) * g, :]        # [Cb, g, D]
+            qg = qg.swapaxes(0, 1).reshape(g * Cb, D)  # rows (i*Cb + c)
+            kh = kb[:, kvh, :]                         # [bs, D]
             sc = jax.lax.dot_general(
-                qh, kh, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * sm_scale
+                qg, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale  # [gCb, bs]
             if use_alibi:
-                sc = sc - slopes_ref[h] * dist         # static-index SMEM read
+                # static SMEM reads per head; rows i*Cb..(i+1)*Cb share one
+                slope_rows = jnp.concatenate(
+                    [jnp.full((Cb, 1), slopes_ref[kvh * g + i], jnp.float32)
+                     for i in range(g)], axis=0)       # [gCb, 1]
+                sc = sc - slope_rows * dist
             parts.append(jnp.where(causal, sc, _NEG_INF))
-        scores = jnp.concatenate(parts, axis=0)        # [HC, bs] f32
+        scores = jnp.concatenate(parts, axis=0)        # [H*Cb, bs] f32
 
         m_prev, l_prev = m_scr[:], l_scr[:]
         m_cur = jnp.max(scores, axis=1, keepdims=True)
@@ -93,19 +105,19 @@ def _paged_kernel(starts_ref, fetch_ref, nlive_ref, lo_ref, slopes_ref,
         l_scr[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         m_scr[:] = m_next
         pv_parts = []
-        for h in range(H):
-            ph = p[h * C:(h + 1) * C, :].astype(vb.dtype)    # [C, bs]
+        for kvh in range(KV):
+            pg = p[kvh * g * Cb:(kvh + 1) * g * Cb, :].astype(vb.dtype)
             pv_parts.append(jax.lax.dot_general(
-                ph, vb[:, h // g, :], (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32))
+                pg, vb[:, kvh, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))   # [gCb, D]
         acc_scr[:] = acc_scr[:] * alpha[:, :1] + jnp.concatenate(pv_parts, 0)
 
     @pl.when(j == nb - 1)
     def _finish():
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)           # idle slots emit zeros
-        o = acc_scr[:] / l_safe                        # [HC, D]
-        o_ref[0] = o.reshape(H, C, D).swapaxes(0, 1).astype(o_ref.dtype)
+        o = acc_scr[:] / l_safe                        # [H*Cb, D]
+        o_ref[0] = o.reshape(H, Cb, D).swapaxes(0, 1).astype(o_ref.dtype)
 
 
 def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
@@ -155,46 +167,64 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     kp = k_pool.reshape(nb_pool, bs, KV, D)
     vp = v_pool.reshape(nb_pool, bs, KV, D)
 
+    # query-chunk tiling: scratch rows are H*Cb, so bound Cb to keep the
+    # online-softmax state (m/l at 128 lanes + f32 acc) well under VMEM —
+    # prefill chunks (C up to 512+) previously sized scratch at H*C and
+    # blew the 16 MB budget on real chips
+    Cb = min(C, max(8, 4096 // H))
+    nCb = -(-C // Cb)
+
     nlive = jnp.minimum((seq_lens + bs - 1) // bs, maxb).astype(jnp.int32)
-    # sliding window: blocks entirely below every query's window are dead too
+    qcs = jnp.arange(nCb, dtype=jnp.int32)[None, :]         # [1, nCb]
+    # per-(seq, q-chunk) live range: blocks past the chunk's last query
+    # position are dead by causality (big win for early prefill chunks)
+    chunk_end = start_pos[:, None] + (qcs + 1) * Cb         # exclusive
+    hi = jnp.minimum(nlive[:, None], (chunk_end - 1) // bs + 1)
+    hi = jnp.maximum(hi, 0).astype(jnp.int32)               # [S, nCb]
+    # sliding window: blocks entirely below every query's window are dead
     if sliding_window is not None:
-        lo = jnp.maximum(start_pos - sliding_window + 1, 0) // bs
-        lo = jnp.minimum(lo.astype(jnp.int32), jnp.maximum(nlive - 1, 0))
+        first_q = start_pos[:, None] + qcs * Cb
+        lo = jnp.maximum(first_q - sliding_window + 1, 0) // bs
+        lo = jnp.minimum(lo.astype(jnp.int32), jnp.maximum(hi - 1, 0))
     else:
-        lo = jnp.zeros_like(nlive)
+        lo = jnp.zeros_like(hi)
     # dead steps re-fetch a live block: no new DMA
     jj = jnp.arange(maxb, dtype=jnp.int32)[None, :]
     fetch = jnp.take_along_axis(
         block_tables.astype(jnp.int32),
-        jnp.clip(jj, lo[:, None], jnp.maximum(nlive[:, None] - 1, 0)), axis=1)
+        jnp.clip(jj, 0, jnp.maximum(nlive[:, None] - 1, 0)), axis=1)
 
     use_alibi = alibi_slopes is not None
     slopes = (jnp.asarray(alibi_slopes, jnp.float32) if use_alibi
               else jnp.zeros((H,), jnp.float32))
 
-    HC = H * C
     kernel = functools.partial(
-        _paged_kernel, bs=bs, C=C, H=H, KV=KV, D=D, sm_scale=float(sm_scale),
-        use_alibi=use_alibi,
+        _paged_kernel, bs=bs, Cb=Cb, nCb=nCb, H=H, KV=KV, D=D,
+        sm_scale=float(sm_scale), use_alibi=use_alibi,
         window=int(sliding_window) if sliding_window is not None else None)
 
-    def kv_index(s, j, starts_ref, fetch_ref, nlive_ref, lo_ref, slopes_ref):
-        del starts_ref, nlive_ref, lo_ref, slopes_ref
-        return (fetch_ref[s * maxb + j], 0, 0, 0)
+    def kv_index(s, qc, j, starts_ref, fetch_ref, lo_ref, hi_ref, slopes_ref):
+        del starts_ref, slopes_ref
+        # clamp into this (s, qc)'s live range so dead grid steps revisit a
+        # fetched block (no DMA) instead of pulling a new one
+        sq = s * nCb + qc
+        jc = jnp.clip(j, lo_ref[sq], jnp.maximum(hi_ref[sq] - 1, 0))
+        return (fetch_ref[s * maxb + jc], 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
-        grid=(S, maxb),
+        grid=(S, nCb, maxb),
         in_specs=[
-            pl.BlockSpec((1, C, H, D), lambda s, j, *_: (s, 0, 0, 0)),
+            pl.BlockSpec((1, Cb, H, D), lambda s, qc, j, *_: (s, qc, 0, 0)),
             pl.BlockSpec((1, bs, KV, D), kv_index),
             pl.BlockSpec((1, bs, KV, D), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, C, H, D), lambda s, j, *_: (s, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, Cb, H, D),
+                               lambda s, qc, j, *_: (s, qc, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((HC, _LANES), jnp.float32),
-            pltpu.VMEM((HC, _LANES), jnp.float32),
-            pltpu.VMEM((HC, D), jnp.float32),
+            pltpu.VMEM((H * Cb, _LANES), jnp.float32),
+            pltpu.VMEM((H * Cb, _LANES), jnp.float32),
+            pltpu.VMEM((H * Cb, D), jnp.float32),
         ],
     )
     return pl.pallas_call(
@@ -202,7 +232,7 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, C, H, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(start_pos.astype(jnp.int32), fetch.reshape(-1),
-      nlive, lo, slopes, q, kp, vp)
+      lo.reshape(-1), hi.reshape(-1), slopes, q, kp, vp)

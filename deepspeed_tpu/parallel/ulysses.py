@@ -69,21 +69,38 @@ class DistributedAttention:
             raise ValueError(
                 f"GQA requires q_heads % kv_heads == 0 ({H}/{Hk})")
         # GQA / uneven heads (reference uneven_heads_all2all,
-        # sequence/layer.py:43). When both head counts divide sp, kv rides
-        # the a2a at its NATIVE width — rank r's q heads [rH/sp,(r+1)H/sp)
-        # map exactly into its kv range [rHk/sp,(r+1)Hk/sp), and the local
-        # attention (flash kernel / jax.nn.dot_product_attention) handles
-        # grouping, so kv comm volume stays 1/group of the broadcast cost.
-        # Otherwise: broadcast kv to H and pad all three up to a multiple of
-        # sp with zero heads, sliced off after the inverse a2a (zero q-heads
-        # emit garbage rows nobody reads; zero kv-heads are never attended).
+        # sequence/layer.py:43). Three ladder rungs, cheapest first:
+        #
+        # 1. native — both head counts divide sp: rank r's q heads
+        #    [rH/sp,(r+1)H/sp) map exactly into its kv range, kv rides the
+        #    a2a at native width (1/group of the broadcast cost).
+        # 2. grouped-gather — Hk does not divide sp (llama-70B kv=8 on
+        #    sp=16, the case that motivates uneven heads). SPMD forbids the
+        #    reference's genuinely uneven per-rank head counts (static
+        #    shapes), so instead kv is GATHERED into an [sp]-head send
+        #    layout where slot r holds exactly the one kv head rank r's q
+        #    group attends to. Comm volume is sp heads — the minimal
+        #    multiple of sp a static a2a can move — vs H for the broadcast
+        #    (llama-70B sp=16: 16 heads instead of 64). Applies when each
+        #    rank's q shard attends one kv head: G % (H/sp) == 0, G = H/Hk.
+        #    (The other uniform case, (H/sp) % G == 0, implies Hk % sp == 0
+        #    and is already rung 1.)
+        # 3. broadcast+pad — anything irregular: kv repeats to H, all three
+        #    pad to a multiple of sp with zero heads sliced off after the
+        #    inverse a2a.
         pad_h = 0
+        G = H // Hk if Hk else 1
+        hq = H // sp if H % sp == 0 else 0
         if H % sp == 0 and Hk % sp == 0:
             pass                                    # native GQA through a2a
+        elif hq and Hk != H and G % hq == 0:
+            idx = jnp.asarray([(r * hq) // G for r in range(sp)], jnp.int32)
+            key = jnp.take(key, idx, axis=2)
+            value = jnp.take(value, idx, axis=2)
         else:
             if Hk != H:
-                key = jnp.repeat(key, H // Hk, axis=2)
-                value = jnp.repeat(value, H // Hk, axis=2)
+                key = jnp.repeat(key, G, axis=2)
+                value = jnp.repeat(value, G, axis=2)
             pad_h = (-H) % sp
             if pad_h:
                 pad = ((0, 0), (0, 0), (0, pad_h), (0, 0))

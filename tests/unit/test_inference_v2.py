@@ -174,6 +174,65 @@ class TestRaggedEngineParity:
         with pytest.raises((RuntimeError, ValueError)):
             eng.put([0], [[1] * 16])              # needs 4 blocks, pool has 2
 
+    def test_fused_decode_loop_matches_per_step(self):
+        # decode_greedy (on-device scan, one host call per N tokens) must be
+        # token-exact vs the step-at-a-time put() path, incl. KV contents
+        # (a follow-on per-step decode reads the KV the loop appended)
+        cfg, mcfg, model, params = _tiny_setup()
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(1, 96, 7).tolist() for _ in range(3)]
+
+        cfg_ref = RaggedInferenceConfig(**{**cfg.__dict__,
+                                           "decode_loop_steps": 0})
+        eng_ref = InferenceEngineV2(mcfg, params, cfg_ref)
+        ref = eng_ref.generate(prompts, max_new_tokens=9)
+
+        cfg_loop = RaggedInferenceConfig(**{**cfg.__dict__,
+                                            "decode_loop_steps": 4})
+        eng = InferenceEngineV2(mcfg, params, cfg_loop)
+        got = eng.generate(prompts, max_new_tokens=9)
+        assert got == ref
+
+    def test_decode_greedy_eos_truncates(self):
+        cfg, mcfg, model, params = _tiny_setup()
+        rng = np.random.default_rng(6)
+        prompt = rng.integers(1, 96, 7).tolist()
+        cfg0 = RaggedInferenceConfig(**{**cfg.__dict__,
+                                        "decode_loop_steps": 0})
+        ref = InferenceEngineV2(mcfg, params, cfg0).generate(
+            [prompt], max_new_tokens=10)[0]
+        eos = ref[4]                     # force an EOS mid-loop-chunk
+        ref_eos = InferenceEngineV2(mcfg, params, cfg0).generate(
+            [prompt], max_new_tokens=10, eos_token_id=eos)[0]
+        cfg_loop = RaggedInferenceConfig(**{**cfg.__dict__,
+                                            "decode_loop_steps": 3})
+        got = InferenceEngineV2(mcfg, params, cfg_loop).generate(
+            [prompt], max_new_tokens=10, eos_token_id=eos)[0]
+        assert got == ref_eos
+
+    def test_oversubscribed_pool_with_decode_loop_enabled(self):
+        # prefill leaves some sequences PAUSED; generate's fused path must
+        # defer to put() (which resumes them) instead of crashing
+        rng = np.random.default_rng(8)
+        prompts = [rng.integers(1, 96, 12).tolist() for _ in range(6)]
+        cfg_big, mcfg, model, params = _tiny_setup(num_blocks=64,
+                                                   block_size=4,
+                                                   max_blocks_per_seq=8)
+        ref = InferenceEngineV2(mcfg, params, cfg_big).generate(
+            prompts, max_new_tokens=6)
+        cfg_small, _, _, _ = _tiny_setup(num_blocks=8, block_size=4,
+                                         max_blocks_per_seq=8)
+        cfg_small = RaggedInferenceConfig(**{**cfg_small.__dict__,
+                                             "decode_loop_steps": 4})
+        got = InferenceEngineV2(mcfg, params, cfg_small).generate(
+            prompts, max_new_tokens=6)
+        assert got == ref
+
+    def test_generate_zero_tokens(self):
+        cfg, mcfg, model, params = _tiny_setup()
+        eng = InferenceEngineV2(mcfg, params, cfg)
+        assert eng.generate([[1, 2, 3]], max_new_tokens=0) == [[]]
+
     def test_oversubscribed_pool_autopauses_and_completes(self):
         # 6 sequences x 4 blocks each = 24 blocks of demand on an 8-block
         # pool (3x oversubscribed): put() must pause/resume via host offload

@@ -242,6 +242,48 @@ def test_ring_attention_kernel_grad(devices8):
                                    atol=2e-4, rtol=1e-3)
 
 
+def test_ulysses_gqa_uneven_kv_volume(devices8, monkeypatch):
+    """kv_heads (2) < sp (4), the llama-70B kv=8/sp=16 class: the kv
+    all-to-all must move sp heads (grouped gather), NOT H heads (broadcast)
+    — reference uneven_heads_all2all (sequence/layer.py:43) pays native kv
+    volume; the static-shape SPMD equivalent is the minimal multiple of sp."""
+    from deepspeed_tpu.parallel import ulysses as ul
+    widths = []
+    orig = ul.comm.all_to_all_single
+
+    def spy(x, **kw):
+        if kw.get("log_name") == "ulysses_qkv":
+            widths.append(x.shape[2])
+        return orig(x, **kw)
+
+    monkeypatch.setattr(ul.comm, "all_to_all_single", spy)
+    topo = build_mesh(MeshConfig(seq=4, data=2))
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = jax.random.normal(ks[0], (2, 32, 8, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 32, 2, 16), jnp.float32)
+    v = jax.random.normal(ks[2], (2, 32, 2, 16), jnp.float32)
+    ref = jax.nn.dot_product_attention(q, jnp.repeat(k, 4, 2),
+                                       jnp.repeat(v, 4, 2), is_causal=True)
+    out = ulysses_attention(q, k, v, topo.mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    # q rides at 8 heads; k and v at sp (4) heads each, not H (8)
+    assert sorted(widths) == [4, 4, 8], widths
+
+
+def test_ulysses_gqa_groups_split_across_ranks(devices8):
+    """Hk=4 not dividing sp=8 (G=4, hq=2): grouped gather at sp heads,
+    every rank attending its single needed kv head."""
+    topo = build_mesh(MeshConfig(seq=8))
+    ks = jax.random.split(jax.random.PRNGKey(10), 3)
+    q = jax.random.normal(ks[0], (2, 32, 16, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 32, 4, 16), jnp.float32)
+    v = jax.random.normal(ks[2], (2, 32, 4, 16), jnp.float32)
+    ref = jax.nn.dot_product_attention(q, jnp.repeat(k, 4, 2),
+                                       jnp.repeat(v, 4, 2), is_causal=True)
+    out = ulysses_attention(q, k, v, topo.mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
 def test_ulysses_gqa_native_width(devices8):
     """When both H and Hk divide sp, kv rides the a2a at native GQA width
     (no broadcast): parity with the broadcast reference."""
