@@ -116,13 +116,19 @@ def bench_serve():
     n_params = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
 
     import os
-    S, PROMPT, GEN = 64, 512, 128
-    bs = int(os.environ.get("DSTPU_BENCH_BLOCK", "64"))
+    S = int(os.environ.get("DSTPU_BENCH_SEQS", "256"))
+    PROMPT, GEN = 512, 128
+    # default: LINEAR layout — one max_context-sized block per sequence.
+    # Each kernel grid step then streams a sequence's whole context as one
+    # DMA (the many-small-blocks layout was grid-overhead-bound at decode),
+    # and the ring decode loop's flush is a per-sequence contiguous DUS.
+    bs = int(os.environ.get("DSTPU_BENCH_BLOCK", str(PROMPT + GEN)))
     impl = os.environ.get("DSTPU_BENCH_IMPL", "paged_flash")
+    blocks_per_seq = (PROMPT + GEN + bs - 1) // bs
     cfg = RaggedInferenceConfig(
         max_seqs=S, chunk_size=PROMPT, block_size=bs,
-        num_blocks=S * ((PROMPT + GEN) // bs + 1) + 32,
-        max_blocks_per_seq=(PROMPT + GEN) // bs + 1,
+        num_blocks=S * blocks_per_seq + 4,
+        max_blocks_per_seq=blocks_per_seq,
         dtype="bfloat16", attention_impl=impl)
     eng = InferenceEngineV2(mcfg, params, cfg)
 

@@ -236,6 +236,10 @@ class InferenceEngineV2:
         if len(batch_uids) > cfg.max_seqs:
             raise ValueError(f"{len(batch_uids)} uids > max_seqs "
                              f"{cfg.max_seqs}")
+        if len(batch_uids) != len(first_tokens):
+            raise ValueError(
+                f"{len(batch_uids)} uids but {len(first_tokens)} "
+                f"first_tokens")
         seqs = []
         for uid in batch_uids:
             seq = self.state.get(uid)

@@ -3,9 +3,11 @@
 Analogue of the reference's ``BlockedKVCache``
 (``inference/v2/ragged/kv_cache.py:40``): a fixed device-resident pool of KV
 blocks addressed through per-sequence block tables. Stored flat —
-``[layers, 2 (k/v), num_blocks * block_size, kv_heads, head_dim]`` — so KV
-append is one scatter and context gather is one take per step; block
-granularity exists only in the allocator and the block tables.
+``[layers, 2 (k/v), (num_blocks + 1) * block_size, kv_heads * head_dim]``
+(the final block is the trash block for padded writes) — so KV append is
+one scatter and context gather is one take per step; block granularity
+exists only in the allocator and the block tables. Rows are lane-aligned
+``kv_heads * head_dim`` flats: see the allocation comment below.
 """
 
 from __future__ import annotations
@@ -30,10 +32,13 @@ class BlockedKVCache:
         # +1 trash BLOCK at the end: padded query positions scatter into its
         # last slot, so they can never corrupt a live sequence's KV (see
         # model_runner) — and the pool stays an exact multiple of block_size,
-        # so the paged flash kernel's [nb, bs, KV, D] view is a free reshape.
+        # so the paged flash kernel's [nb, bs, row] view is a free reshape.
+        # Rows are FLAT [KV*D]: a trailing (KV, D) pair would be stored
+        # (8, 128)-tile padded in HBM (4x footprint and DMA traffic for the
+        # common KV=4, D=64 layouts); lane-aligned flat rows pad nothing.
         slots = (cfg.num_blocks + 1) * cfg.block_size
         self.data = jnp.zeros(
-            (num_layers, 2, slots, kv_heads, head_dim), self.dtype)
+            (num_layers, 2, slots, kv_heads * head_dim), self.dtype)
 
     @property
     def free_blocks(self) -> int:

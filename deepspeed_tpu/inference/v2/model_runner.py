@@ -48,6 +48,71 @@ def _layer_norm(x, p, eps=1e-5):   # GPT2Config.layer_norm_eps default
     return (x - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
 
 
+def _gather_ctx(pool, li, batch, cfg, S, KV, D, dtype):
+    """[S, max_context, KV, D] context gathered through the block tables."""
+    bs = cfg.block_size
+    j = jnp.arange(cfg.max_context, dtype=jnp.int32)
+    ctx_idx = batch.block_tables[:, j // bs] * bs + j % bs
+    k_ctx = pool[li, 0][ctx_idx].reshape(S, -1, KV, D).astype(dtype)
+    v_ctx = pool[li, 1][ctx_idx].reshape(S, -1, KV, D).astype(dtype)
+    return k_ctx, v_ctx
+
+
+def _grouped_dense_attention(q, k_ctx, v_ctx, mask, dist, scale, dtype,
+                             alibi_slopes):
+    """Masked grouped-GQA attention core, shared by the dense (non-kernel)
+    paths. q [S, C, H, D]; k/v_ctx [S, T', KV, D]; mask/dist [S, C, T'] (or
+    [S, 1, T'] broadcasting over C). KV stays at native width — repeating
+    to H heads would multiply the gathered-context traffic by H/KV."""
+    S, C, H, D = q.shape
+    KV = k_ctx.shape[2]
+    g = H // KV
+    qg = q.reshape(S, C, KV, g, D)
+    s_att = jnp.einsum("sckgd,stkd->skgct", qg, k_ctx) * scale
+    s_att = s_att.astype(jnp.float32)
+    if alibi_slopes is not None:
+        s_att = s_att - alibi_slopes.reshape(KV, g)[None, :, :, None, None] \
+            * dist[:, None, None, :, :]
+    s_att = jnp.where(mask[:, None, None, :, :], s_att, -jnp.inf)
+    p_att = jax.nn.softmax(s_att, axis=-1).astype(dtype)
+    # fully-masked rows (idle slots) produce NaN softmax garbage that is
+    # never read; keep numerics finite
+    p_att = jnp.where(jnp.isnan(p_att), 0, p_att)
+    return jnp.einsum("skgct,stkd->sckgd", p_att, v_ctx).reshape(
+        S, C, H * D)
+
+
+def _dense_ring_attention(pool, ring, li, q, batch, cfg, settled_lens,
+                          rcount, scale, dtype, alibi_slopes,
+                          sliding_window):
+    """Ring-mode attention without the Pallas kernel (off-TPU path): the
+    gathered settled context and the ring concatenate along the context
+    axis, with the settled part masked column-exactly at settled_lens."""
+    S, C, H, D = q.shape
+    KV = ring.shape[4] // D
+    T = cfg.max_context
+    k_ctx, v_ctx = _gather_ctx(pool, li, batch, cfg, S, KV, D, dtype)
+    R = ring.shape[0]
+    ring_k = jnp.moveaxis(ring[:, li, 0], 0, 1).reshape(S, R, KV, D)
+    ring_v = jnp.moveaxis(ring[:, li, 1], 0, 1).reshape(S, R, KV, D)
+    k_full = jnp.concatenate([k_ctx, ring_k.astype(dtype)], axis=1)
+    v_full = jnp.concatenate([v_ctx, ring_v.astype(dtype)], axis=1)
+    # columns: [0, T) settled (valid below settled_lens), [T, T+R) ring
+    # (valid below rcount); ring row r sits dist = rcount-1-r behind query
+    jr = jnp.arange(T + R, dtype=jnp.int32)
+    dist = jnp.where(jr < T,
+                     batch.start_pos[:, None] - jr[None, :],
+                     rcount - 1 - (jr[None, :] - T)).astype(jnp.float32)
+    mask = jnp.where(jr[None, :] < T,
+                     jr[None, :] < settled_lens[:, None],
+                     (jr[None, :] - T) < rcount)
+    if sliding_window is not None:
+        mask = jnp.logical_and(mask, dist < sliding_window)
+    return _grouped_dense_attention(q, k_full, v_full, mask[:, None],
+                                    dist[:, None], scale, dtype,
+                                    alibi_slopes)
+
+
 def paged_attention(kv, li, q, k, v, batch: "RaggedBatch",
                     cfg: RaggedInferenceConfig, pos, valid_q, scale, dtype,
                     alibi_slopes=None, sliding_window=None):
@@ -65,24 +130,66 @@ def paged_attention(kv, li, q, k, v, batch: "RaggedBatch",
       "dense" — gather [S, max_context] context and mask (fallback/debug;
         the round-1 path the kernel replaces).
 
+    ``kv`` is either the pool array, or — inside the fused decode loop —
+    a ``(pool, ring, t, rcount)`` tuple (RaggedRunnerBase._decode_loop):
+    the pool is then READ-ONLY and this step's K/V goes into the small
+    ring buffer at index ``t`` (a cheap dynamic-update-slice instead of
+    the TPU scatter slow path), attended by the kernel's ring round. The
+    runners thread ``kv`` opaquely, so every family gets the fast path.
+
     Returns (kv, y[S, C, H*D] in ``dtype``).
     """
     S, C, H, D = q.shape
     KV = k.shape[2]
     bs = cfg.block_size
-    trash = kv.shape[2] - 1
     impl = cfg.attention_impl
     if impl == "auto":
         impl = "paged_flash" if jax.default_backend() == "tpu" else "dense"
 
+    ring_mode = isinstance(kv, tuple)
+    if ring_mode:
+        pool, ring, t, rcount = kv
+        # ring[t, li, 0/1] <- this step's K/V: the ring is R-LEADING so the
+        # per-step write is a leading-index dynamic-update-slice (in-place
+        # in the scan carry; a trailing index forced a ring copy per layer)
+        ring = ring.at[t, li, 0].set(
+            k.reshape(S, KV * D).astype(ring.dtype))
+        ring = ring.at[t, li, 1].set(
+            v.reshape(S, KV * D).astype(ring.dtype))
+        kv = (pool, ring, t, rcount)
+        settled_lens = jnp.where(batch.n_tokens > 0,
+                                 batch.start_pos - t, 0)
+        if impl == "paged_flash":
+            from ...ops.kernels import flash_paged_attention
+            y = flash_paged_attention(
+                q.astype(pool.dtype), pool[li, 0], pool[li, 1],
+                batch.block_tables, batch.start_pos, settled_lens,
+                block_size=bs, sm_scale=scale, alibi_slopes=alibi_slopes,
+                sliding_window=sliding_window, num_kv_heads=KV,
+                # [R, S, KVD] -> [S, R, KVD]: S must sit in an untiled dim
+                # for the kernel's per-sequence BlockSpec slice
+                ring_k=ring[:, li, 0].swapaxes(0, 1),
+                ring_v=ring[:, li, 1].swapaxes(0, 1),
+                ring_count=rcount)
+        elif impl == "dense":
+            y = _dense_ring_attention(
+                pool, ring, li, q, batch, cfg, settled_lens, rcount, scale,
+                dtype, alibi_slopes, sliding_window)
+        else:
+            raise ValueError(
+                f"attention_impl must be 'auto', 'paged_flash' or 'dense', "
+                f"got {cfg.attention_impl!r}")
+        return kv, y.reshape(S, C, H * D).astype(dtype)
+
+    trash = kv.shape[2] - 1
     blk = jnp.take_along_axis(
         batch.block_tables,
         jnp.minimum(pos // bs, cfg.max_blocks_per_seq - 1), axis=1)
     write_idx = jnp.where(valid_q, blk * bs + pos % bs, trash)
     kv = kv.at[li, 0, write_idx.reshape(-1)].set(
-        k.reshape(S * C, KV, D).astype(kv.dtype))
+        k.reshape(S * C, KV * D).astype(kv.dtype))
     kv = kv.at[li, 1, write_idx.reshape(-1)].set(
-        v.reshape(S * C, KV, D).astype(kv.dtype))
+        v.reshape(S * C, KV * D).astype(kv.dtype))
 
     if impl == "paged_flash":
         from ...ops.kernels import flash_paged_attention
@@ -96,34 +203,21 @@ def paged_attention(kv, li, q, k, v, batch: "RaggedBatch",
             q.astype(kv.dtype), kv[li, 0], kv[li, 1],
             batch.block_tables, batch.start_pos, seq_lens,
             block_size=bs, sm_scale=scale, alibi_slopes=alibi_slopes,
-            sliding_window=sliding_window)
+            sliding_window=sliding_window, num_kv_heads=KV)
         return kv, y.reshape(S, C, H * D).astype(dtype)
     if impl != "dense":
         raise ValueError(
             f"attention_impl must be 'auto', 'paged_flash' or 'dense', "
             f"got {cfg.attention_impl!r}")
 
+    k_ctx, v_ctx = _gather_ctx(kv, li, batch, cfg, S, KV, D, dtype)
     j = jnp.arange(cfg.max_context, dtype=jnp.int32)
-    ctx_idx = batch.block_tables[:, j // bs] * bs + j % bs
-    k_ctx = kv[li, 0][ctx_idx].astype(dtype)
-    v_ctx = kv[li, 1][ctx_idx].astype(dtype)
-    if KV != H:
-        k_ctx = jnp.repeat(k_ctx, H // KV, axis=2)
-        v_ctx = jnp.repeat(v_ctx, H // KV, axis=2)
-    s_att = jnp.einsum("schd,skhd->shck", q, k_ctx) * scale
-    s_att = s_att.astype(jnp.float32)
-    if alibi_slopes is not None:
-        dist = (pos[:, None, :, None] - j[None, None, None, :]).astype(
-            jnp.float32)
-        s_att = s_att - alibi_slopes[None, :, None, None] * dist
-    mask = j[None, None, None, :] <= pos[:, None, :, None]
+    dist = (pos[:, :, None] - j[None, None, :]).astype(jnp.float32)
+    mask = j[None, None, :] <= pos[:, :, None]          # [S, C, T]
     if sliding_window is not None:
-        mask = jnp.logical_and(
-            mask, j[None, None, None, :] > pos[:, None, :, None]
-            - sliding_window)
-    s_att = jnp.where(mask, s_att, -jnp.inf)
-    p_att = jax.nn.softmax(s_att, axis=-1).astype(dtype)
-    y = jnp.einsum("shck,skhd->schd", p_att, v_ctx).reshape(S, C, H * D)
+        mask = jnp.logical_and(mask, dist < sliding_window)
+    y = _grouped_dense_attention(q, k_ctx, v_ctx, mask, dist, scale, dtype,
+                                 alibi_slopes)
     return kv, y
 
 
@@ -167,32 +261,70 @@ class RaggedRunnerBase:
         self._step_greedy = jax.jit(_step_greedy)
 
         # fused multi-step greedy decode: n forward+argmax+KV-append steps
-        # in ONE device program (lax.scan), feeding each step's token to the
-        # next. Per-token host round-trips — the decode wall when the host
-        # talks to the chip over a network hop — collapse to one per n
-        # tokens. KV blocks must be pre-reserved for all n tokens
-        # (engine.decode_greedy does this); the kv buffer is donated so the
-        # scan updates it in place.
-        def _decode_loop(params, kv_data, tok0, start, active, tables, *, n):
+        # in ONE device program (lax.scan), feeding each step's token to
+        # the next. Per-token host round-trips — the decode wall when the
+        # host talks to the chip over a network hop — collapse to one per n
+        # tokens. The pool stays READ-ONLY inside the scan; each step's K/V
+        # lands in a small [n, L, 2, S, KV*D] ring carry (n LEADING so the
+        # write is a leading-index dynamic-update-slice, in-place in the
+        # carry), and the attention ring round attends it. This keeps the
+        # per-step pool scatter (TPU scatter slow path) AND the 1-GB pool
+        # carry out of the scan entirely — the ring is flushed once per
+        # loop (_flush_ring).
+        def _decode_loop_ring(params, kv_data, tok0, start, active, tables,
+                              *, n):
             from ..quantization import dequantize_tree
             params = dequantize_tree(params)
+            S = cfg.max_seqs
+            ring = jnp.zeros((n, self.num_layers, 2, S,
+                              self.kv_heads * self.head_dim),
+                             kv_data.dtype)
 
-            def body(carry, _):
-                kv, tok, pos = carry
+            def body(carry, t):
+                ring, tok, pos = carry
                 batch = RaggedBatch(tokens=tok[:, None], start_pos=pos,
                                     n_tokens=active, block_tables=tables)
-                logits, kv = type(self).step_fn(
-                    params, kv, batch, model_cfg=model_cfg, cfg=cfg,
-                    dtype=dtype)
+                logits, kv_out = type(self).step_fn(
+                    params, (kv_data, ring, t, t + 1), batch,
+                    model_cfg=model_cfg, cfg=cfg, dtype=dtype)
+                ring = kv_out[1]
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return (kv, nxt, pos + 1), nxt
+                return (ring, nxt, pos + 1), nxt
 
-            (kv_out, _, _), toks = jax.lax.scan(
-                body, (kv_data, tok0, start), None, length=n)
-            return jnp.transpose(toks), kv_out          # [S, n]
+            (ring, _, _), toks = jax.lax.scan(
+                body, (ring, tok0, start), jnp.arange(n, dtype=jnp.int32))
+            return jnp.transpose(toks), ring               # [S, n], ring
 
-        self._decode_loop = jax.jit(_decode_loop, static_argnames=("n",),
-                                    donate_argnums=(1,))
+        self._decode_loop_ring = jax.jit(_decode_loop_ring,
+                                         static_argnames=("n",))
+
+        # flush: write the loop's ring rows into the pool. Linear layout
+        # (one block per sequence) gets per-sequence dynamic-update-slices
+        # (contiguous runs, no scatter); general blocked layout falls back
+        # to one scatter over all layers at once.
+        def _flush_ring(kv_data, ring, tables, start0, active):
+            R, L, _, S, KVD = ring.shape
+            bs = cfg.block_size
+            slots = kv_data.shape[2]
+            trash_off = slots - bs                     # trash block start
+            ring_sl = jnp.moveaxis(ring, 0, 3)         # [L, 2, S, R, KVD]
+            if cfg.max_blocks_per_seq == 1:
+                for i in range(S):
+                    off = jnp.where(active[i] > 0,
+                                    tables[i, 0] * bs + start0[i],
+                                    trash_off)
+                    kv_data = jax.lax.dynamic_update_slice(
+                        kv_data, ring_sl[:, :, i], (0, 0, off, 0))
+                return kv_data
+            pos = start0[:, None] + jnp.arange(R, dtype=jnp.int32)[None, :]
+            blk = jnp.take_along_axis(
+                tables, jnp.minimum(pos // bs, tables.shape[1] - 1), axis=1)
+            idx = jnp.where(active[:, None] > 0, blk * bs + pos % bs,
+                            slots - 1)
+            rows = ring_sl.reshape(L, 2, S * R, KVD)
+            return kv_data.at[:, :, idx.reshape(-1)].set(rows)
+
+        self._flush_ring = jax.jit(_flush_ring, donate_argnums=(0,))
 
     def step(self, params, kv_data, batch: "RaggedBatch"):
         """Returns (last_token_logits [S, V] f32, new kv_data)."""
@@ -204,15 +336,20 @@ class RaggedRunnerBase:
 
     def decode_loop(self, params, kv_data, tok0, start_pos, active,
                     block_tables, n: int):
-        """Greedy-decode ``n`` tokens per active slot on-device.
+        """Greedy-decode ``n`` tokens per active slot on-device and flush
+        the loop's KV into the pool.
 
         tok0 [S] int32: each slot's next input token (KV not yet appended);
         start_pos [S]: its absolute position; active [S]: 1 live / 0 idle.
         Returns (tokens [S, n] int32, new kv_data). Slots must have KV
         blocks covering positions start_pos..start_pos+n-1.
         """
-        return self._decode_loop(params, kv_data, tok0, start_pos, active,
-                                 block_tables, n=n)
+        toks, ring = self._decode_loop_ring(params, kv_data, tok0,
+                                            start_pos, active, block_tables,
+                                            n=n)
+        kv_data = self._flush_ring(kv_data, ring, block_tables, start_pos,
+                                   active)
+        return toks, kv_data
 
 
 class GPT2RaggedRunner(RaggedRunnerBase):

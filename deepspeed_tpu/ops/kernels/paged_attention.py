@@ -7,16 +7,23 @@ attention reads K/V DIRECTLY through per-sequence block tables, so each step
 touches only the blocks a sequence actually occupies. The block tables ride
 scalar prefetch (their values drive the K/V BlockSpec index maps), and dead
 grid steps (past a sequence's live block count) repeat the previous block
-index — a revisited block costs no DMA (same trick as the splash-style
-sparse kernel in flash_attention.py). Replaces the dense
-``[max_seqs, max_context]`` gather-then-mask attention, whose per-step HBM
-traffic scaled with ``max_context`` regardless of actual lengths.
+index — a revisited block costs no DMA.
 
-Layout contract (matches BlockedKVCache): the flat KV pool
-``[slots, KV_heads, D]`` has ``slots = (num_blocks + 1) * block_size`` — the
-final block is the trash block (padded query positions scatter there), so
-``pool.reshape(num_blocks + 1, block_size, KV, D)`` is a free reshape, never
-a copy. Block tables only ever reference blocks < num_blocks.
+Layout contract (matches BlockedKVCache): the flat KV pool is
+``[slots, KV_heads * D]`` with ``slots = (num_blocks + 1) * block_size`` —
+one LANE-ALIGNED row per token. The earlier ``[slots, KV, D]`` layout let
+XLA pad the trailing ``(4, 64)`` dims to the (8, 128) tile — 4x the HBM
+footprint AND 4x the DMA traffic on the serving hot path. A 3-D pool is
+still accepted and viewed flat (same bytes, contiguous reshape).
+
+GQA is handled by LANE WINDOWING instead of a per-kv-head matmul unroll:
+the caller expands q so the row for head h carries its values in lane
+window ``(h // group) * D .. + D`` and zeros elsewhere; one
+``[H*Cb, KV*D] x [KV*D, width]`` matmul then yields every head's scores
+(cross-head lanes contract against zeros), and the P*V product emits
+``[H*Cb, KV*D]`` rows from which the caller slices each head's window.
+This keeps the MXU on one large operand per grid step — at decode the old
+per-head unroll fed it [1, 64] slivers.
 """
 
 from __future__ import annotations
@@ -34,13 +41,15 @@ _NEG_INF = float("-inf")
 _LANES = 128
 
 
-def _round_up(x: int, m: int) -> int:
-    return ((x + m - 1) // m) * m
-
-
-def _paged_kernel(starts_ref, fetch_ref, lo_ref, hi_ref, slopes_ref,
-                  q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-                  *, bs, Cb, nCb, H, KV, D, sm_scale, use_alibi, window):
+def _paged_kernel(starts_ref, fetch_ref, lo_ref, hi_ref, slopes_ref, *rest,
+                  bs, Cb, nCb, H, KV, D, sm_scale, use_alibi, window, R,
+                  windowed):
+    if R is None:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = rest
+        rcount_ref = lens_ref = rk_ref = rv_ref = None
+    else:
+        (rcount_ref, lens_ref, q_ref, k_ref, v_ref, rk_ref, rv_ref, o_ref,
+         m_scr, l_scr, acc_scr) = rest
     s = pl.program_id(0)
     qc = pl.program_id(1)
     j = pl.program_id(2)
@@ -54,43 +63,42 @@ def _paged_kernel(starts_ref, fetch_ref, lo_ref, hi_ref, slopes_ref,
         l_scr[:] = jnp.zeros(l_scr.shape, l_scr.dtype)
         acc_scr[:] = jnp.zeros(acc_scr.shape, acc_scr.dtype)
 
-    @pl.when(jnp.logical_and(j >= lo_ref[sq], j < hi_ref[sq]))
-    def _compute():
-        q = q_ref[0]                                   # [Cb, H, D]
-        kb = k_ref[0]                                  # [bs, KV, D]
-        vb = v_ref[0]
-        # per-row query positions at the head-group row layout [g*Cb, bs]:
-        # row r <-> (head i = r // Cb, tile pos c = r % Cb) — built directly
-        # at full width (Mosaic cannot concatenate i1 mask vregs)
-        c_of_row = jax.lax.rem(
-            jax.lax.broadcasted_iota(jnp.int32, (g * Cb, bs), 0), Cb)
-        pos_q = starts_ref[s] + qc * Cb + c_of_row     # [gCb, bs]
-        col = j * bs + jax.lax.broadcasted_iota(jnp.int32, (g * Cb, bs), 1)
-        causal = col <= pos_q
-        if window is not None:                         # mistral sliding window
-            causal = jnp.logical_and(causal, col > pos_q - window)
-        dist = (pos_q - col).astype(jnp.float32)
+    def _attend(kb, vb, width, mask, dist):
+        """One online-softmax round over ``width`` columns. kb/vb are
+        [width, KV*D] token rows; mask/dist are [H*Cb, width]. Rows are
+        head-major (row h*Cb + c <-> head h, tile pos c).
 
-        # rows are head-major: scores row h*Cb + c <-> (head h, tile pos c).
-        # Heads are batched per KV group — one [g*Cb, D] x [D, bs] matmul
-        # per kv head instead of H separate [Cb, D] ones (at decode Cb=1
-        # the per-head variant fed the MXU single-row operands)
-        parts = []
-        for kvh in range(KV):
-            qg = q[:, kvh * g:(kvh + 1) * g, :]        # [Cb, g, D]
-            qg = qg.swapaxes(0, 1).reshape(g * Cb, D)  # rows (i*Cb + c)
-            kh = kb[:, kvh, :]                         # [bs, D]
+        windowed (decode, Cb==1): q rows are lane-windowed per head
+        (module docstring) and ONE [H, KV*D] x [KV*D, width] matmul covers
+        every head — at Cb=1 per-head operands would be single-row MXU
+        slivers. grouped (prefill): per-kv-head [g*Cb, D] matmuls against
+        64-lane slices of the flat rows — no zero-lane FLOP inflation
+        (windowing would cost KV x the useful MACs, ruinous for MHA)."""
+        q = q_ref[0]                  # [H*Cb, KV*D] windowed / [H*Cb, D]
+        if use_alibi:
+            slope_rows = jnp.concatenate(
+                [jnp.full((Cb, 1), slopes_ref[h], jnp.float32)
+                 for h in range(H)], axis=0)           # [HCb, 1]
+        if windowed:
             sc = jax.lax.dot_general(
-                qg, kh, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * sm_scale  # [gCb, bs]
+                q, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
             if use_alibi:
-                # static SMEM reads per head; rows i*Cb..(i+1)*Cb share one
-                slope_rows = jnp.concatenate(
-                    [jnp.full((Cb, 1), slopes_ref[kvh * g + i], jnp.float32)
-                     for i in range(g)], axis=0)       # [gCb, 1]
                 sc = sc - slope_rows * dist
-            parts.append(jnp.where(causal, sc, _NEG_INF))
-        scores = jnp.concatenate(parts, axis=0)        # [H*Cb, bs] f32
+            scores = jnp.where(mask, sc, _NEG_INF)     # [HCb, width]
+        else:
+            g = H // KV
+            parts = []
+            for kvh in range(KV):
+                rows = slice(kvh * g * Cb, (kvh + 1) * g * Cb)
+                kh = kb[:, kvh * D:(kvh + 1) * D]      # [width, D]
+                sc = jax.lax.dot_general(
+                    q[rows], kh, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * sm_scale
+                if use_alibi:
+                    sc = sc - slope_rows[rows] * dist[rows]
+                parts.append(jnp.where(mask[rows], sc, _NEG_INF))
+            scores = jnp.concatenate(parts, axis=0)    # [HCb, width]
 
         m_prev, l_prev = m_scr[:], l_scr[:]
         m_cur = jnp.max(scores, axis=1, keepdims=True)
@@ -104,20 +112,61 @@ def _paged_kernel(starts_ref, fetch_ref, lo_ref, hi_ref, slopes_ref,
                               scores - m_safe[:, :1], _NEG_INF))
         l_scr[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         m_scr[:] = m_next
-        pv_parts = []
-        for kvh in range(KV):
-            pg = p[kvh * g * Cb:(kvh + 1) * g * Cb, :].astype(vb.dtype)
-            pv_parts.append(jax.lax.dot_general(
-                pg, vb[:, kvh, :], (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32))   # [gCb, D]
-        acc_scr[:] = acc_scr[:] * alpha[:, :1] + jnp.concatenate(pv_parts, 0)
+        if windowed:
+            pv = jax.lax.dot_general(
+                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)    # [HCb, KV*D]
+        else:
+            g = H // KV
+            pv = jnp.concatenate([
+                jax.lax.dot_general(
+                    p[kvh * g * Cb:(kvh + 1) * g * Cb].astype(vb.dtype),
+                    vb[:, kvh * D:(kvh + 1) * D], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                for kvh in range(KV)], axis=0)         # [HCb, D]
+        acc_scr[:] = acc_scr[:] * alpha[:, :1] + pv
+
+    @pl.when(jnp.logical_and(j >= lo_ref[sq], j < hi_ref[sq]))
+    def _compute():
+        # per-row query positions at the head-major row layout [H*Cb, bs]:
+        # row r <-> (head r // Cb, tile pos r % Cb) — built directly at
+        # full width (Mosaic cannot concatenate i1 mask vregs)
+        c_of_row = jax.lax.rem(
+            jax.lax.broadcasted_iota(jnp.int32, (H * Cb, bs), 0), Cb)
+        pos_q = starts_ref[s] + qc * Cb + c_of_row     # [HCb, bs]
+        col = j * bs + jax.lax.broadcasted_iota(jnp.int32, (H * Cb, bs), 1)
+        causal = col <= pos_q
+        if R is not None:
+            # ring mode: the pool only holds SETTLED rows; positions
+            # lens..pos_q live in the ring, and the pool rows there are
+            # stale — mask them out column-exactly (hi is block-granular)
+            causal = jnp.logical_and(causal, col < lens_ref[s])
+        if window is not None:                         # mistral sliding window
+            causal = jnp.logical_and(causal, col > pos_q - window)
+        _attend(k_ref[0], v_ref[0], bs, causal,
+                (pos_q - col).astype(jnp.float32))
+
+    if R is not None:
+        # decode-loop ring round: this step's (and the loop's prior) K/V
+        # live in a small per-sequence ring buffer that is only flushed
+        # into the pool after the fused loop — ring row r holds the token
+        # at absolute position (start_pos - (rcount-1) + r)
+        @pl.when(j == nb - 1)
+        def _ring():
+            r = jax.lax.broadcasted_iota(jnp.int32, (H * Cb, R), 1)
+            dist = (rcount_ref[0] - 1 - r).astype(jnp.float32)
+            # lens gate keeps idle slots (seq_lens == 0) fully masked so
+            # they emit zeros — their ring rows hold garbage K/V
+            mask = jnp.logical_and(r < rcount_ref[0], lens_ref[s] > 0)
+            if window is not None:
+                mask = jnp.logical_and(mask, dist < window)
+            _attend(rk_ref[0], rv_ref[0], R, mask, dist)
 
     @pl.when(j == nb - 1)
     def _finish():
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)           # idle slots emit zeros
-        o = acc_scr[:] / l_safe                        # [H*Cb, D]
-        o_ref[0] = o.reshape(H, Cb, D).swapaxes(0, 1).astype(o_ref.dtype)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
 
 
 def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
@@ -127,112 +176,213 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                           sm_scale: Optional[float] = None,
                           alibi_slopes: Optional[jnp.ndarray] = None,
                           sliding_window: Optional[int] = None,
+                          ring_k: Optional[jnp.ndarray] = None,
+                          ring_v: Optional[jnp.ndarray] = None,
+                          ring_count: Optional[jnp.ndarray] = None,
+                          num_kv_heads: Optional[int] = None,
                           interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over paged KV.
 
     Args:
       q: [S, C, H, D] — C query tokens per slot (1 for pure decode;
-        SplitFuse prefill chunks are larger). The step's K/V must ALREADY be
-        scattered into the pool (causal masking handles the chunk interior).
-      k_pool/v_pool: [slots, KV, D] with slots = (num_blocks+1)*block_size
-        (trailing trash block).
+        SplitFuse prefill chunks are larger). The step's K/V must ALREADY
+        be in the pool (causal masking handles the chunk interior), except
+        in ring mode where the loop's tokens live in ring_k/ring_v.
+      k_pool/v_pool: [slots, KV*D] flat token rows (or [slots, KV, D],
+        viewed flat) with slots = (num_blocks + 1) * block_size (trailing
+        trash block).
       block_tables: [S, MAXB] int32 — pool block id per sequence block.
       start_pos: [S] int32 — absolute position of q[s, 0].
-      seq_lens: [S] int32 — total live context length (incl. this chunk);
-        0 marks an idle slot (emits zeros).
+      seq_lens: [S] int32 — settled context length (0 marks an idle slot,
+        which emits zeros). In ring mode this EXCLUDES the ring tokens.
+      ring_k/ring_v: optional [S, R, KV*D] decode-loop ring buffers;
+        ring_count: tokens valid in the ring.
       alibi_slopes: optional [H] f32 — in-kernel ALiBi bias (falcon/bloom).
 
-    Returns [S, C, H, D] attention outputs in q.dtype. HBM traffic per step
-    is O(sum of live blocks), not O(S * max_context).
+    Returns [S, C, H, D] attention outputs in q.dtype. HBM traffic per
+    step is O(sum of live blocks) of UNPADDED rows.
     """
     if interpret is None:
         from . import default_interpret
         interpret = default_interpret()
     S, C, H, D = q.shape
-    slots, KV, Dk = k_pool.shape
+    if k_pool.ndim == 3:
+        KV = k_pool.shape[1]
+        k_pool = k_pool.reshape(k_pool.shape[0], -1)
+        v_pool = v_pool.reshape(v_pool.shape[0], -1)
+    else:
+        if num_kv_heads is None:
+            raise ValueError("num_kv_heads required with a flat 2-D pool")
+        KV = num_kv_heads
+    slots, KVD = k_pool.shape
+    if KVD != KV * D:
+        raise ValueError(f"pool rows {KVD} != KV*D = {KV * D}")
     bs = block_size
-    if Dk != D:
-        raise ValueError(f"head_dim mismatch q={D} pool={Dk}")
     if H % KV:
         raise ValueError(f"GQA requires H % KV == 0 ({H}/{KV})")
     if slots % bs:
         raise ValueError(
             f"pool slots ({slots}) must be a multiple of block_size ({bs}); "
             f"allocate (num_blocks+1)*block_size with a trailing trash block")
-    nb_pool = slots // bs
     maxb = block_tables.shape[1]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
+    g = H // KV
 
-    kp = k_pool.reshape(nb_pool, bs, KV, D)
-    vp = v_pool.reshape(nb_pool, bs, KV, D)
+    # processing granularity decouples from the allocator's block size:
+    # decode (C==1, scratch is tiny) streams each block whole — one DMA per
+    # sequence with the linear one-block-per-seq layout; prefill processes
+    # blocks in sub-tiles so KV tiles + the H*Cb softmax scratch fit VMEM.
+    if C == 1:
+        # whole blocks, but capped so a K/V tile stays ~<=2 MB of VMEM
+        # (large linear block_size x wide rows would blow the budget)
+        cap = max(256, (2 << 20) // (KVD * 2))
+        pbs = next(d for d in range(min(bs, cap), 0, -1) if bs % d == 0)
+    else:
+        pbs = next(d for d in range(min(bs, 256), 0, -1) if bs % d == 0)
+    factor = bs // pbs
+    maxb_v = maxb * factor
+    nb_pool = slots // pbs
+
+    kp = k_pool.reshape(nb_pool, pbs, KVD)
+    vp = v_pool.reshape(nb_pool, pbs, KVD)
 
     # query-chunk tiling: scratch rows are H*Cb, so bound Cb to keep the
-    # online-softmax state (m/l at 128 lanes + f32 acc) well under VMEM —
-    # prefill chunks (C up to 512+) previously sized scratch at H*C and
-    # blew the 16 MB budget on real chips
-    Cb = min(C, max(8, 4096 // H))
+    # online-softmax state (m/l at 128 lanes + f32 acc over KV*D) plus the
+    # pipelined KV tiles well under the 16 MB VMEM budget
+    kv_tile_bytes = 4 * pbs * KVD * 2                   # 2x dbl-buffer, k+v
+    row_bytes = (2 * _LANES + KVD) * 4 + 4 * KVD * q.dtype.itemsize
+    row_budget = max(1 << 20, 8 * (1 << 20) - kv_tile_bytes)
+    Cb = min(C, max(8, (row_budget // (H * row_bytes)) // 8 * 8))
     nCb = -(-C // Cb)
 
-    nlive = jnp.minimum((seq_lens + bs - 1) // bs, maxb).astype(jnp.int32)
+    nlive = jnp.minimum((seq_lens + pbs - 1) // pbs,
+                        maxb_v).astype(jnp.int32)
     qcs = jnp.arange(nCb, dtype=jnp.int32)[None, :]         # [1, nCb]
     # per-(seq, q-chunk) live range: blocks past the chunk's last query
     # position are dead by causality (big win for early prefill chunks)
     chunk_end = start_pos[:, None] + (qcs + 1) * Cb         # exclusive
-    hi = jnp.minimum(nlive[:, None], (chunk_end - 1) // bs + 1)
+    hi = jnp.minimum(nlive[:, None], (chunk_end - 1) // pbs + 1)
     hi = jnp.maximum(hi, 0).astype(jnp.int32)               # [S, nCb]
     # sliding window: blocks entirely below every query's window are dead
     if sliding_window is not None:
         first_q = start_pos[:, None] + qcs * Cb
-        lo = jnp.maximum(first_q - sliding_window + 1, 0) // bs
+        lo = jnp.maximum(first_q - sliding_window + 1, 0) // pbs
         lo = jnp.minimum(lo.astype(jnp.int32), jnp.maximum(hi - 1, 0))
     else:
         lo = jnp.zeros_like(hi)
     # dead steps re-fetch a live block: no new DMA
-    jj = jnp.arange(maxb, dtype=jnp.int32)[None, :]
-    fetch = jnp.take_along_axis(
-        block_tables.astype(jnp.int32),
-        jnp.clip(jj, 0, jnp.maximum(nlive[:, None] - 1, 0)), axis=1)
+    jj = jnp.arange(maxb_v, dtype=jnp.int32)[None, :]
+    jjc = jnp.clip(jj, 0, jnp.maximum(nlive[:, None] - 1, 0))
+    fetch = (jnp.take_along_axis(block_tables.astype(jnp.int32),
+                                 jjc // factor, axis=1) * factor
+             + jjc % factor)
 
     use_alibi = alibi_slopes is not None
     slopes = (jnp.asarray(alibi_slopes, jnp.float32) if use_alibi
               else jnp.zeros((H,), jnp.float32))
 
-    kernel = functools.partial(
-        _paged_kernel, bs=bs, Cb=Cb, nCb=nCb, H=H, KV=KV, D=D,
-        sm_scale=float(sm_scale), use_alibi=use_alibi,
-        window=int(sliding_window) if sliding_window is not None else None)
+    has_ring = ring_k is not None
+    if has_ring and C != 1:
+        raise ValueError("ring decode requires C == 1 (pure decode steps)")
+    if has_ring and ring_k.shape[2] != KVD:
+        raise ValueError(f"ring rows must be flat [S, R, {KVD}]")
+    R = ring_k.shape[1] if has_ring else None
 
-    def kv_index(s, qc, j, starts_ref, fetch_ref, lo_ref, hi_ref, slopes_ref):
-        del starts_ref, slopes_ref
-        # clamp into this (s, qc)'s live range so dead grid steps revisit a
-        # fetched block (no DMA) instead of pulling a new one
+    windowed = C == 1
+    if windowed:
+        # lane-window q: row (h, c) carries q[s, c, h] in lane window
+        # (h // g) * D, zeros elsewhere — one matmul covers every head
+        # (module docstring). Tiny next to KV traffic at decode.
+        sel = (jnp.arange(KV)[None, :] == (jnp.arange(H) // g)[:, None])
+        qw = (q.swapaxes(1, 2)[:, :, :, None, :]
+              * sel[None, :, None, :, None].astype(q.dtype))  # [S,H,C,KV,D]
+        qw = qw.reshape(S, H, C, KVD).astype(k_pool.dtype)
+        row_lanes = KVD
+    else:
+        qw = q.swapaxes(1, 2).astype(k_pool.dtype)     # [S, H, C, D]
+        row_lanes = D
+
+    kernel = functools.partial(
+        _paged_kernel, bs=pbs, Cb=Cb, nCb=nCb, H=H, KV=KV, D=D,
+        sm_scale=float(sm_scale), use_alibi=use_alibi,
+        window=int(sliding_window) if sliding_window is not None else None,
+        R=R, windowed=windowed)
+
+    n_pref = 7 if has_ring else 5
+
+    def kv_index(s, qc, j, *pref):
+        fetch_ref, lo_ref, hi_ref = pref[1], pref[2], pref[3]
+        # clamp into this (s, qc)'s live range so dead grid steps (incl.
+        # the ring round) revisit a fetched block (no DMA) instead of
+        # pulling a new one
         sq = s * nCb + qc
         jc = jnp.clip(j, lo_ref[sq], jnp.maximum(hi_ref[sq] - 1, 0))
-        return (fetch_ref[s * maxb + jc], 0, 0, 0)
+        return (fetch_ref[s * maxb_v + jc], 0, 0)
+
+    # q rows for chunk qc must be one contiguous [H*Cb] row block: reorder
+    # chunk-major (pad C up to nCb*Cb first; padded rows compute garbage
+    # nobody reads — their rows are sliced off after the call)
+    Cpad = nCb * Cb
+    if nCb == 1:
+        qw = qw.reshape(S, H * C, row_lanes)
+    else:
+        if Cpad != C:
+            qw = jnp.pad(qw, ((0, 0), (0, 0), (0, Cpad - C), (0, 0)))
+        qw = qw.reshape(S, H, nCb, Cb, row_lanes).swapaxes(1, 2).reshape(
+            S, nCb * H * Cb, row_lanes)
+    q_spec = pl.BlockSpec((1, H * Cb, row_lanes),
+                          lambda s, qc, j, *_: (s, qc, 0))
+    o_spec = pl.BlockSpec((1, H * Cb, row_lanes),
+                          lambda s, qc, j, *_: (s, qc, 0))
+
+    in_specs = [
+        q_spec,
+        pl.BlockSpec((1, pbs, KVD), kv_index),
+        pl.BlockSpec((1, pbs, KVD), kv_index),
+    ]
+    operands = [qw, kp, vp]
+    grid = (S, nCb, maxb_v + 1 if has_ring else maxb_v)
+    if has_ring:
+        ring_spec = pl.BlockSpec((1, R, KVD),
+                                 lambda s, qc, j, *_: (s, 0, 0))
+        in_specs += [ring_spec, ring_spec]
+        operands += [ring_k.astype(k_pool.dtype),
+                     ring_v.astype(v_pool.dtype)]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,
-        grid=(S, nCb, maxb),
-        in_specs=[
-            pl.BlockSpec((1, Cb, H, D), lambda s, qc, j, *_: (s, qc, 0, 0)),
-            pl.BlockSpec((1, bs, KV, D), kv_index),
-            pl.BlockSpec((1, bs, KV, D), kv_index),
-        ],
-        out_specs=pl.BlockSpec((1, Cb, H, D),
-                               lambda s, qc, j, *_: (s, qc, 0, 0)),
+        num_scalar_prefetch=n_pref,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=o_spec,
         scratch_shapes=[
             pltpu.VMEM((H * Cb, _LANES), jnp.float32),
             pltpu.VMEM((H * Cb, _LANES), jnp.float32),
-            pltpu.VMEM((H * Cb, D), jnp.float32),
+            pltpu.VMEM((H * Cb, row_lanes), jnp.float32),
         ],
     )
-    return pl.pallas_call(
+    prefetch = [start_pos.astype(jnp.int32), fetch.reshape(-1),
+                lo.reshape(-1), hi.reshape(-1), slopes]
+    if has_ring:
+        prefetch.append(jnp.reshape(ring_count, (1,)).astype(jnp.int32))
+        prefetch.append(seq_lens.astype(jnp.int32))
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S, C, H, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct(qw.shape, q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=interpret,
-    )(start_pos.astype(jnp.int32), fetch.reshape(-1),
-      lo.reshape(-1), hi.reshape(-1), slopes, q, kp, vp)
+    )(*prefetch, *operands)
+    # undo chunk-major row order, then (windowed mode) slice each head's
+    # lane window out of the [KV*D]-wide accumulator rows
+    if nCb > 1:
+        out = out.reshape(S, nCb, H, Cb, row_lanes).swapaxes(1, 2).reshape(
+            S, H, Cpad, row_lanes)[:, :, :C]
+    else:
+        out = out.reshape(S, H, C, row_lanes)
+    if windowed:
+        head_win = (jnp.arange(H) // g)[:, None] * D \
+            + jnp.arange(D)[None, :]
+        out = jnp.take_along_axis(out, head_win[None, :, None, :], axis=3)
+    return jnp.moveaxis(out, 1, 2)                      # [S, C, H, D]

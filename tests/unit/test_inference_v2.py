@@ -193,6 +193,27 @@ class TestRaggedEngineParity:
         got = eng.generate(prompts, max_new_tokens=9)
         assert got == ref
 
+    def test_fused_decode_loop_linear_layout(self):
+        # linear layout (one max_context block per sequence): the ring
+        # flush takes the per-sequence DUS path instead of the scatter
+        cfg, mcfg, model, params = _tiny_setup(block_size=64, num_blocks=6,
+                                               max_seqs=4,
+                                               max_blocks_per_seq=1)
+        rng = np.random.default_rng(12)
+        prompts = [rng.integers(1, 96, 7).tolist() for _ in range(3)]
+        cfg_ref = RaggedInferenceConfig(**{**cfg.__dict__,
+                                           "decode_loop_steps": 0})
+        ref = InferenceEngineV2(mcfg, params, cfg_ref).generate(
+            prompts, max_new_tokens=9)
+        cfg_loop = RaggedInferenceConfig(**{**cfg.__dict__,
+                                            "decode_loop_steps": 4})
+        eng = InferenceEngineV2(mcfg, params, cfg_loop)
+        got = eng.generate(prompts, max_new_tokens=9)
+        assert got == ref
+        # decode continues cleanly AFTER a flush (pool rows are real)
+        got2 = eng.generate(prompts, max_new_tokens=9)
+        assert got2 == ref
+
     def test_decode_greedy_eos_truncates(self):
         cfg, mcfg, model, params = _tiny_setup()
         rng = np.random.default_rng(6)
