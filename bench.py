@@ -33,14 +33,22 @@ def bench_train():
     import deepspeed_tpu as dstpu
     from deepspeed_tpu.models.gpt2 import GPT2Config, make_model
 
+    import os
     seq = 512
-    micro = 128
+    micro = int(os.environ.get("DSTPU_TRAIN_MICRO", "128"))
     # GPT-2 124M class. remat=True + micro 128 + the 512-block Pallas flash
     # kernel measured fastest on v5e (72 TFLOPS vs 53 for the round-1
     # remat-off/micro-64 config); the chunked fused LM cross-entropy
     # (models/_lm_utils.chunked_lm_xent) is what makes micro 128 fit.
-    cfg_model = GPT2Config(vocab_size=50304, max_seq_len=seq + 1, num_layers=12,
-                           num_heads=12, hidden_size=768, remat=True)
+    cfg_model = GPT2Config(
+        vocab_size=50304, max_seq_len=seq + 1, num_layers=12,
+        num_heads=12, hidden_size=768,
+        remat=os.environ.get("DSTPU_TRAIN_REMAT", "1") == "1",
+        # qkv_out (save qkv + attention output, recompute LN/MLP interiors)
+        # measured 74.3 TFLOPS vs full-block remat's 72.4 at micro 128;
+        # no-remat OOMs at micro >= 96 on the 16 GB chip
+        remat_policy=os.environ.get("DSTPU_TRAIN_POLICY", "qkv_out"),
+        attention_impl=os.environ.get("DSTPU_TRAIN_IMPL", "auto"))
     model, init_fn, loss_fn = make_model(cfg_model)
     params = init_fn(jax.random.PRNGKey(0), batch_size=2, seq_len=seq)
 

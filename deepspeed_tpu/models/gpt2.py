@@ -19,6 +19,7 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,6 +69,7 @@ class CausalSelfAttention(nn.Module):
         H, D = cfg.num_heads, cfg.hidden_size // cfg.num_heads
         qkv = nn.Dense(3 * C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                        use_bias=cfg.use_bias, name="c_attn")(x)
+        qkv = checkpoint_name(qkv, "qkv")
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, H, D)
         k = k.reshape(B, T, H, D)
@@ -112,6 +114,7 @@ class CausalSelfAttention(nn.Module):
         y = y.reshape(B, T, C)
         y = nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                      use_bias=cfg.use_bias, name="c_proj")(y)
+        y = checkpoint_name(y, "attn_out")
         if cfg.dropout > 0:
             y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
         return y
@@ -126,7 +129,11 @@ class MLP(nn.Module):
         h = nn.Dense(cfg.mlp_ratio * cfg.hidden_size, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, use_bias=cfg.use_bias,
                      name="c_fc")(x)
+        # tagged for the "no_mlp" remat policy: the two mlp_ratio-wide
+        # intermediates dominate per-layer activation memory
+        h = checkpoint_name(h, "mlp_pre_act")
         h = nn.gelu(h)
+        h = checkpoint_name(h, "mlp_act")
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, use_bias=cfg.use_bias,
                      name="c_proj")(h)
@@ -161,11 +168,52 @@ class GPT2(nn.Module):
         wpe = nn.Embed(cfg.max_seq_len, cfg.hidden_size,
                        dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="wpe")
         x = wte(tokens) + wpe(jnp.arange(T)[None, :])
+        # pin the embedding output to the natural activation layout (batch
+        # over data, sequence over seq, hidden replicated): without this,
+        # GSPMD resolves the token gather by fully rematerializing the
+        # embedding table on every device ("involuntary full
+        # rematerialization", spmd_partitioner.cc:652) when params carry
+        # ZeRO/TP shardings
+        from deepspeed_tpu.parallel import topology as _topo
+        if _topo.has_topology():
+            mesh = _topo.get_topology().mesh
+            C = cfg.hidden_size
+            dims = [a if mesh.shape.get(a, 1) > 1 and d % mesh.shape[a] == 0
+                    else None
+                    for a, d in (("data", B), ("seq", T), ("model", C))]
+            if any(dims):
+                from jax.sharding import NamedSharding, PartitionSpec
+                # hidden stays sharded over model when TP is active: the
+                # embedding gather's output is already hidden-sharded, and
+                # forcing it replicated is itself a full-remat transition
+                x = jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, PartitionSpec(*dims)))
         block_cls = Block
         if cfg.remat:
             policy = None
             if cfg.remat_policy == "dots":
                 policy = jax.checkpoint_policies.checkpoint_dots
+            elif cfg.remat_policy == "no_mlp":
+                # save every residual/attention activation, recompute only
+                # the two mlp_ratio-wide MLP intermediates in the backward
+                # pass — one fc1 matmul recomputed vs "full"'s entire
+                # forward (which costs 33% extra step FLOPs)
+                policy = jax.checkpoint_policies.save_anything_except_these_names(
+                    "mlp_pre_act", "mlp_act")
+            elif cfg.remat_policy == "no_gelu":
+                # drop only the post-gelu intermediate: recompute is a free
+                # elementwise op, memory still sheds one mlp_ratio-wide
+                # tensor per layer
+                policy = jax.checkpoint_policies.save_anything_except_these_names(
+                    "mlp_act")
+            elif cfg.remat_policy == "qkv_out":
+                # save ONLY the fused qkv and the attention output (4*C per
+                # layer): backward recomputes the cheap LNs, the MLP fc1 +
+                # gelu, and the flash forward (for its lse), but never the
+                # qkv/attn-proj matmuls — a middle point between "full"
+                # (+33% step FLOPs) and no remat (OOM at useful batch)
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    "qkv", "attn_out")
             block_cls = nn.remat(Block, static_argnums=(2,), policy=policy)
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"h_{i}")(x, deterministic)

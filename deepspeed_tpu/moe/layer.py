@@ -109,6 +109,11 @@ class MoE(nn.Module):
     # expert weights additionally shard their HIDDEN dim over the "model"
     # axis (column-parallel wi, row-parallel wo) with one psum after wo.
     expert_tensor_parallel: bool = False
+    # grouped expert GEMM (sharded_moe.grouped_moe_ffn): dropless sorted
+    # ragged_dot dispatch — S*k expert rows instead of S*E. None = auto:
+    # on when tokens aren't dropped and the experts are local (EP/TP keep
+    # the static-capacity a2a dispatch). True/False force.
+    use_grouped_gemm: Optional[bool] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -148,7 +153,25 @@ class MoE(nn.Module):
         tokens = x.reshape(B * T, M)
         tp = (self.expert_tensor_parallel and self.ep_mesh is not None
               and self.ep_mesh.shape.get("model", 1) > 1)
-        if ep <= 1 and not tp:
+        grouped = self.use_grouped_gemm
+        if grouped is None:
+            # stochastic gating (RTS noise / top-2 sampling) stays on the
+            # capacity paths — the grouped dispatch routes deterministically
+            grouped = (not self.drop_tokens and ep <= 1 and not tp
+                       and not needs_rng)
+        if grouped and (ep > 1 or tp):
+            raise ValueError(
+                "use_grouped_gemm requires local experts (no EP/experts-TP):"
+                " the a2a dispatch needs static capacity bins")
+        if grouped and needs_rng:
+            raise ValueError(
+                "use_grouped_gemm routes deterministically; disable "
+                "noisy_gate_policy / top2_2nd_expert_sampling to use it")
+        if grouped:
+            out, l_aux = sharded_moe.grouped_moe_ffn(
+                tokens, tokens.astype(jnp.float32) @ wg, self.k, weights,
+                act, dtype, normalize_weights=self.normalize_weights)
+        elif ep <= 1 and not tp:
             out, l_aux = route_and_run(
                 tokens, lambda d: _ffn(d, weights, act, dtype), rng)
         else:

@@ -184,3 +184,61 @@ def gate(logits: jnp.ndarray, k: int = 1, **kwargs):
     kwargs.pop("rng", None)
     kwargs.pop("top2_2nd_expert_sampling", None)
     return topkgating(logits, k, **kwargs)
+
+
+def grouped_moe_ffn(tokens: jnp.ndarray, logits: jnp.ndarray, k: int,
+                    weights, activation, dtype,
+                    normalize_weights: bool = True,
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dropless top-k MoE via grouped expert matmuls (``jax.lax.ragged_dot``).
+
+    TPU-native answer to the reference's CUTLASS grouped GEMM
+    (``inference/v2/kernels/cutlass_ops/moe_gemm/``) and the
+    megablocks-style dropless dispatch: tokens sort by their routed expert,
+    each expert multiplies ONLY its contiguous run of rows, and the outputs
+    scatter-add back weighted by the router. Computes S*k expert rows
+    instead of the capacity path's S*E (or the serving dense path's
+    every-expert-on-every-token) — E/k x fewer FLOPs — with no capacity
+    drop and no [S, E, C] one-hot tensors.
+
+    tokens [S, M]; logits [S, E]; weights = (wi, wo) or gated
+    (wi_gate, wi_up, wo) stacked [E, ...]. normalize_weights=True
+    renormalizes over the selected experts (mixtral); False keeps
+    full-softmax weights (qwen2-moe). Returns (out [S, M], l_aux).
+    """
+    S, E = logits.shape
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top_vals, top_idx = jax.lax.top_k(logits.astype(jnp.float32), k)
+    if normalize_weights and k > 1:
+        w_sel = jax.nn.softmax(top_vals, axis=-1)          # [S, k]
+    else:
+        # k == 1: the weight IS the softmax prob (top1gating semantics —
+        # renormalizing over one expert would be a constant 1.0, severing
+        # the router's gradient through the output)
+        w_sel = jnp.take_along_axis(gates, top_idx, axis=-1)
+
+    eid = top_idx.reshape(-1)                              # [S*k]
+    order = jnp.argsort(eid, stable=True)
+    tok_of = order // k                                    # source token
+    xs = jnp.take(tokens, tok_of, axis=0).astype(dtype)    # sorted by expert
+    group_sizes = jnp.bincount(eid, length=E).astype(jnp.int32)
+
+    if len(weights) == 3:
+        wi_gate, wi_up, wo = weights
+        g = jax.lax.ragged_dot(xs, wi_gate.astype(dtype), group_sizes)
+        u = jax.lax.ragged_dot(xs, wi_up.astype(dtype), group_sizes)
+        h = activation(g) * u
+    else:
+        wi, wo = weights
+        h = activation(jax.lax.ragged_dot(xs, wi.astype(dtype), group_sizes))
+    ys = jax.lax.ragged_dot(h, wo.astype(dtype), group_sizes)  # [S*k, M]
+
+    ws = jnp.take(w_sel.reshape(-1), order).astype(dtype)
+    out = jnp.zeros_like(tokens, dtype).at[tok_of].add(ys * ws[:, None])
+
+    # load-balance loss — same statistic the capacity paths report
+    # (topkgating: mean gate prob x mean routed fraction, scaled by E)
+    me = gates.mean(axis=0)
+    ce = group_sizes.astype(jnp.float32) / float(S * k)
+    l_aux = (me * ce).sum() * E
+    return out, l_aux

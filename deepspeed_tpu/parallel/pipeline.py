@@ -230,11 +230,19 @@ class PipelineModule:
     fill/drain loop runs m + P - 1 steps, so the bubble fraction is
     (P-1)/(m+P-1) — GPipe's. The reference's 1F1B has the SAME bubble; what
     1F1B buys on GPUs is peak activation memory (P microbatches in flight
-    instead of m). Here that role is played by ``remat=True`` (default):
-    each stage keeps only its boundary activations [m, mb, T, C] and
-    recomputes the interior in backward, which is the memory profile 1F1B
-    targets, without hand-scheduling the reverse stream (autodiff of the
-    scan IS the reverse schedule). Use m >> P to amortize the bubble.
+    instead of m).
+
+    Memory: ``remat=True`` (default) recomputes each stage's INTERIOR in
+    backward, so per step only the boundary activation is saved — but the
+    scan still saves one boundary carry per step: O(m) boundaries resident.
+    ``boundary_windows`` bounds that: the schedule runs as windows of W
+    steps with ``jax.checkpoint`` around each window, so backward keeps
+    O(m/W + W) boundary carries (W ~= sqrt(m+P-1) when "auto") and replays
+    a window's forward during its backward — the classic sqrt-remat trade
+    (~+33% pipeline-forward FLOPs for 1F1B-class boundary memory). For long
+    sequences the boundary IS the activation, so this is the knob that
+    matches 1F1B's O(P) in-flight profile. Use m >> P to amortize the
+    bubble.
 
     The engine consumes this via ``loss_fn`` / ``init`` — train_batch, GAS,
     loss scaling, ZeRO (over data axes), checkpointing all compose unchanged.
@@ -246,13 +254,25 @@ class PipelineModule:
                  input_fn: Optional[Callable] = None,
                  partition_method: str = "uniform",
                  pipe_axis: str = PIPE_AXIS,
-                 remat: bool = True):
+                 remat: bool = True,
+                 boundary_windows: Optional[Any] = None,
+                 param_specs: Optional[Any] = None):
         self.specs = list(layers)
         self.mesh = mesh
         self.pipe_axis = pipe_axis
         self.num_stages = mesh.shape.get(pipe_axis, 1)
         self.num_microbatches = num_microbatches
         self.remat = remat
+        # None = plain scan (O(m) boundary carries in backward); "auto" =
+        # sqrt(m+P-1)-sized checkpointed windows; int = explicit window size
+        self.boundary_windows = boundary_windows
+        # optional params-shaped PartitionSpec tree for tensor parallelism
+        # INSIDE the pipeline: layers see their model-axis shards and own
+        # the psums (Megatron-style), composing pipe x model x data in one
+        # step (reference PipeModelDataParallelTopology,
+        # runtime/pipe/topology.py:244). None = params replicated over the
+        # non-batch axes inside the step.
+        self.param_specs = param_specs
         # batch -> stage-0 input; default: next-token LM on batch["tokens"]
         self.input_fn = input_fn or (lambda b: b["tokens"][:, :-1])
         # (last_layer_out, batch_slice) -> scalar mean loss; default: NLL
@@ -335,14 +355,19 @@ class PipelineModule:
         dp_axes = tuple(a for a in ("data", "data_inner")
                         if self.mesh.shape.get(a, 1) > 1)
         bspec = P(None, dp_axes) if dp_axes else P(None)
-        # Params enter replicated across the pipe axis: with heterogeneous
-        # per-stage subtrees there is no stackable leading dim to shard over
-        # ``pipe`` (each device COMPUTES only its switch branch, but holds
-        # the full tree). Param-memory scaling comes from ZeRO over the data
-        # axes instead (gathered at this boundary per step, like any stage-3
-        # step); for homogeneous block stacks, ``pipeline_apply`` +
-        # ``stack_stage_params`` DOES shard params over ``pipe``.
-        pspec = jax.tree_util.tree_map(lambda _: P(), params)
+        # Params enter replicated across the pipe axis DURING the step:
+        # with heterogeneous per-stage subtrees there is no stackable
+        # leading dim to shard over ``pipe`` (each device COMPUTES only its
+        # switch branch). At-REST residency is a different story: the
+        # engine's sharding plan stores params/grads/opt-state sharded over
+        # pipe x data (ZeroShardingPlan pipe residency), so per-rank live
+        # param bytes scale as total/(P x dp) between the gathers XLA
+        # schedules at this boundary. ``param_specs`` additionally shards
+        # TP'd layers over the model axis inside the step.
+        if self.param_specs is not None:
+            pspec = self.param_specs
+        else:
+            pspec = jax.tree_util.tree_map(lambda _: P(), params)
 
         return shard_map(self._ring_schedule, mesh=self.mesh,
                          in_specs=(pspec, jax.tree_util.tree_map(
@@ -414,8 +439,27 @@ class PipelineModule:
             return (buf_next, loss_acc), None
 
         buf0 = jnp.zeros(bshape, dtype)
-        (_, loss_sum), _ = jax.lax.scan(
-            step, (buf0, jnp.zeros((), jnp.float32)), jnp.arange(total_steps))
+        carry0 = (buf0, jnp.zeros((), jnp.float32))
+        if self.boundary_windows is None:
+            (_, loss_sum), _ = jax.lax.scan(step, carry0,
+                                            jnp.arange(total_steps))
+        else:
+            W = self.boundary_windows
+            if W == "auto":
+                W = max(1, int(np.ceil(np.sqrt(total_steps))))
+            n_win = -(-total_steps // W)
+            # pad with no-op steps: t >= total_steps clamps its microbatch
+            # index and fails the `valid` gate, so nothing is read or
+            # accumulated
+            ts = jnp.arange(n_win * W).reshape(n_win, W)
+
+            @jax.checkpoint
+            def window(carry, t_vec):
+                carry, _ = jax.lax.scan(step, carry, t_vec)
+                return carry
+
+            (_, loss_sum), _ = jax.lax.scan(
+                lambda c, tv: (window(c, tv), None), carry0, ts)
         # only the last stage accumulated loss; psum broadcasts it, and the
         # same psum over the data axes averages the data-parallel shards
         loss = jax.lax.psum(

@@ -120,6 +120,31 @@ class ZeroShardingPlan:
         if self.n_shards == 1 and self.stage > 0:
             log_dist("ZeRO enabled but data-parallel world size is 1; sharding is a no-op")
 
+        # pipeline residency: with pipe > 1 the compiled pipeline replicates
+        # params across the pipe axis DURING the step (shard_map gathers on
+        # entry), so their at-rest storage is free to shard over pipe — the
+        # memory benefit PP exists for (reference partitions layers per
+        # stage, runtime/pipe/module.py:391). Composes multiplicatively with
+        # the ZeRO data-axis sharding; gathers ride ICI and autodiff turns
+        # them into reduce-scatters for the grads.
+        self.pipe_axes: Tuple[str, ...] = ()
+        if topo.axis_size("pipe") > 1:
+            self.pipe_axes = ("pipe",)
+        self.n_pipe = _axis_product(topo, self.pipe_axes) if self.pipe_axes \
+            else 1
+
+    def _merge_pipe(self, specs: Any, tree: Any) -> Any:
+        if not self.pipe_axes:
+            return specs
+
+        def m(spec, leaf):
+            return _merge_axes_into_spec(
+                spec if tuple(spec) else None, tuple(np.shape(leaf)),
+                self.pipe_axes, self.n_pipe)
+
+        return jax.tree_util.tree_map(
+            m, specs, tree, is_leaf=lambda x: isinstance(x, P))
+
     # -------------------------------------------------------------- #
 
     def _tp_spec_for(self, path, leaf) -> Optional[P]:
@@ -155,17 +180,23 @@ class ZeroShardingPlan:
         if self.stage >= 3:
             threshold = int(self.cfg.stage3_param_persistence_threshold) \
                 if not isinstance(self.cfg.stage3_param_persistence_threshold, str) else 100_000
-            return jax.tree_util.tree_map_with_path(
+            specs = jax.tree_util.tree_map_with_path(
                 functools.partial(self._sharded_spec, threshold=threshold,
                                   axes=self.param_axes), params)
-        return jax.tree_util.tree_map_with_path(self._replicated_spec, params)
+        else:
+            specs = jax.tree_util.tree_map_with_path(self._replicated_spec,
+                                                     params)
+        return self._merge_pipe(specs, params)
 
     def grad_specs(self, params: Any) -> Any:
         """PartitionSpec pytree for gradients (stage>=2 → sharded)."""
         if self.stage >= 2:
-            return jax.tree_util.tree_map_with_path(
+            specs = jax.tree_util.tree_map_with_path(
                 functools.partial(self._sharded_spec, threshold=0), params)
-        return jax.tree_util.tree_map_with_path(self._replicated_spec, params)
+        else:
+            specs = jax.tree_util.tree_map_with_path(self._replicated_spec,
+                                                     params)
+        return self._merge_pipe(specs, params)
 
     def opt_state_specs(self, opt_state: Any) -> Any:
         """PartitionSpec pytree for optimizer state (stage>=1 → sharded).
@@ -183,7 +214,8 @@ class ZeroShardingPlan:
             # already reduced to the inner axis in that case)
             return _merge_axes_into_spec(None, shape, self.zero_axes, self.n_shards)
 
-        return jax.tree_util.tree_map(spec_for, opt_state)
+        specs = jax.tree_util.tree_map(spec_for, opt_state)
+        return self._merge_pipe(specs, opt_state)
 
     # ---------------------- NamedSharding trees -------------------- #
 

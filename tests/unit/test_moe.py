@@ -104,6 +104,91 @@ def test_moe_layer_forward():
     assert np.isfinite(out).all() and l_aux > 0
 
 
+def test_grouped_gemm_matches_dropless_capacity():
+    """grouped_moe_ffn (sorted ragged_dot, S*k expert rows) must match the
+    capacity einsum path with drop_tokens=False (C=S: nothing dropped) —
+    the reference's CUTLASS grouped-GEMM capability class
+    (inference/v2/kernels/cutlass_ops/moe_gemm/)."""
+    from deepspeed_tpu.moe.layer import MoE
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 6, 16), jnp.float32)
+    kw = dict(d_model=16, num_experts=4, k=2, hidden=32,
+              drop_tokens=False, gated=True,
+              top2_2nd_expert_sampling=False,
+              activation=jax.nn.silu)
+    ref_layer = MoE(**kw, use_grouped_gemm=False)
+    variables = ref_layer.init(jax.random.PRNGKey(0), x)
+    ref, _ = ref_layer.apply(variables, x)
+    got, _ = MoE(**kw, use_grouped_gemm=True).apply(variables, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_grouped_gemm_matches_dropless_capacity_k1():
+    """k=1: the combine weight must be the router's softmax prob
+    (top1gating semantics), not a renormalized constant 1.0."""
+    from deepspeed_tpu.moe.layer import MoE
+    x = jax.random.normal(jax.random.PRNGKey(5), (2, 6, 16), jnp.float32)
+    kw = dict(d_model=16, num_experts=4, k=1, hidden=32,
+              drop_tokens=False, gated=True, activation=jax.nn.silu)
+    variables = MoE(**kw, use_grouped_gemm=False).init(
+        jax.random.PRNGKey(0), x)
+    ref, _ = MoE(**kw, use_grouped_gemm=False).apply(variables, x)
+    got, _ = MoE(**kw, use_grouped_gemm=True).apply(variables, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_grouped_gemm_rejects_stochastic_gating():
+    from deepspeed_tpu.moe.layer import MoE
+    x = jax.random.normal(jax.random.PRNGKey(6), (2, 6, 16), jnp.float32)
+    layer = MoE(d_model=16, num_experts=4, k=2, hidden=32,
+                drop_tokens=False, gated=True, use_grouped_gemm=True,
+                activation=jax.nn.silu)   # top2 sampling default ON
+    with pytest.raises(ValueError, match="deterministically"):
+        layer.init(jax.random.PRNGKey(0), x)
+    # auto mode silently keeps the sampling capacity path instead
+    auto = MoE(d_model=16, num_experts=4, k=2, hidden=32, drop_tokens=False,
+               gated=True, activation=jax.nn.silu)
+    v = auto.init(jax.random.PRNGKey(0), x)
+    out, _ = auto.apply(v, x, rngs={"gating": jax.random.PRNGKey(1)})
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_grouped_gemm_grad_flows():
+    from deepspeed_tpu.moe.layer import MoE
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 6, 16), jnp.float32)
+    layer = MoE(d_model=16, num_experts=4, k=2, hidden=32,
+                drop_tokens=False, gated=True, use_grouped_gemm=True,
+                top2_2nd_expert_sampling=False,
+                activation=jax.nn.silu)
+    variables = layer.init(jax.random.PRNGKey(0), x)
+
+    def loss(v):
+        out, l_aux = layer.apply(v, x)
+        return (out ** 2).mean() + 0.01 * l_aux
+
+    g = jax.grad(loss)(variables)
+    leaves = jax.tree_util.tree_leaves(g)
+    assert all(np.isfinite(np.asarray(leaf)).all() for leaf in leaves)
+    assert any(float(np.abs(np.asarray(leaf)).sum()) > 0 for leaf in leaves)
+
+
+def test_grouped_gemm_computes_only_routed_rows():
+    """The grouped dispatch feeds ragged_dot exactly S*k rows (the routed
+    tokens), not S*E — assert via the jaxpr's ragged_dot operand shape."""
+    from deepspeed_tpu.moe.sharded_moe import grouped_moe_ffn
+    S, M, H, E, k = 10, 8, 16, 5, 2
+    tok = jnp.ones((S, M)); lg = jnp.ones((S, E))
+    ws = (jnp.ones((E, M, H)), jnp.ones((E, M, H)), jnp.ones((E, H, M)))
+    jaxpr = jax.make_jaxpr(
+        lambda t: grouped_moe_ffn(t, lg, k, ws, jax.nn.silu,
+                                  jnp.float32))(tok)
+    rdots = [e for e in jaxpr.jaxpr.eqns if "ragged" in str(e.primitive)]
+    assert rdots, "expected ragged_dot in the grouped path"
+    for e in rdots:
+        assert e.invars[0].aval.shape[0] == S * k      # routed rows only
+
+
 def test_moe_residual():
     out, l_aux, variables = _run_layer(use_residual=True)
     assert out.shape == (4, 8, 16)
