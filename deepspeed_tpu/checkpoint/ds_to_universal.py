@@ -1,0 +1,256 @@
+"""Reference-checkpoint converter (``ds_to_universal`` CLI).
+
+Reads a checkpoint directory written by the reference framework —
+``mp_rank_*_model_states.pt`` plus per-dp-rank
+``(bf16_|fp16_)?zero_pp_rank_*_mp_rank_*_optim_states.pt`` — and writes this
+framework's name-keyed universal layout, so a training run started on the
+reference can resume here. Mirrors the reference's offline converter
+(``checkpoint/ds_to_universal.py:469`` main: extract zero shards -> merge ->
+universal dir) and the fp32 reconstruction of ``utils/zero_to_fp32.py``.
+
+The torch ``.pt`` containers are read through ``torch.load`` (torch ships in
+the image as a CPU wheel; nothing else in the framework depends on it) —
+only the checkpoint KEY NAMES are reference-compatible surface, the
+reconstruction below is this framework's own.
+
+Scope: ZeRO stage 1/2 checkpoints (per-rank contiguous fp32 flat
+partitions; stage-2's 2*world alignment honored) at any dp world size, and
+plain module-state checkpoints, with tensor-parallel (mp>1) module states
+merged by shape inference. Stage-3 checkpoints should be consolidated with
+the reference's own ``zero_to_fp32`` first.
+
+Output layout (``universal_named``):
+
+    <out_dir>/
+      latest                   # tag
+      <tag>/
+        params.npz             # param name -> fp32 ndarray
+        meta.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# reference checkpoint key names (compatibility surface,
+# /root/reference/deepspeed/checkpoint/constants.py)
+_OPT = "optimizer_state_dict"
+_FLAT_KEYS = ("fp32_flat_groups", "single_partition_of_fp32_groups")
+_PARAM_SHAPES = "param_shapes"
+_ZERO_STAGE = "zero_stage"
+_PARTITION_COUNT = "partition_count"
+_MODULE = "module"
+
+META_FORMAT = "universal_named_v1"
+
+
+def _read_pt(path: str) -> Any:
+    import torch
+    try:
+        return torch.load(path, map_location="cpu", weights_only=True)
+    except Exception:
+        # reference checkpoints carry argparse namespaces etc.; loading a
+        # checkpoint is as trusted as training from it
+        return torch.load(path, map_location="cpu", weights_only=False)
+
+
+def _to_np(t: Any) -> np.ndarray:
+    import torch
+    if isinstance(t, torch.Tensor):
+        if t.dtype == torch.bfloat16:
+            return t.to(torch.float32).numpy()
+        return t.detach().numpy()
+    return np.asarray(t)
+
+
+def _find(dirname: str, pattern: str) -> List[str]:
+    rx = re.compile(pattern)
+    return sorted(f for f in os.listdir(dirname) if rx.fullmatch(f))
+
+
+def _merge_tp_slices(name: str, slices: List[np.ndarray],
+                     full_shape: Optional[tuple] = None,
+                     cat_dim_rules: Optional[Dict[str, int]] = None
+                     ) -> np.ndarray:
+    """Merge one param's mp_rank slices. Equal slices = replicated
+    (layernorms, biases of row-parallel layers). Split tensors concatenate
+    on: the dim a matching ``cat_dim_rules`` regex names, else the unique
+    dim that reproduces ``full_shape`` when known, else dim 0 WITH a
+    warning — the reference resolves the same ambiguity with per-pattern
+    rules (checkpoint/universal_checkpoint.py load_hp_checkpoint_state);
+    pass ``--cat-dim`` rules for row-parallel (dim-1-split) layers."""
+    if len(slices) == 1:
+        return slices[0]
+    first = slices[0]
+    if all(s.shape == first.shape and np.array_equal(s, first)
+           for s in slices[1:]):
+        return first
+    for pat, dim in (cat_dim_rules or {}).items():
+        if re.search(pat, name):
+            return np.concatenate(slices, axis=dim)
+    if full_shape is not None:
+        dims = [d for d in range(first.ndim)
+                if np.concatenate(slices, axis=d).shape == tuple(full_shape)]
+        if len(dims) == 1:
+            return np.concatenate(slices, axis=dims[0])
+    import warnings
+    warnings.warn(
+        f"{name}: tensor-parallel slices merged on dim 0 by default; pass "
+        f"cat_dim_rules (--cat-dim) if this layer was split on another dim")
+    return np.concatenate(slices, axis=0)
+
+
+def extract_fp32_state(ckpt_dir: str,
+                       cat_dim_rules: Optional[Dict[str, int]] = None
+                       ) -> Dict[str, np.ndarray]:
+    """Reconstruct {param name: fp32 array} from a reference tag dir."""
+    model_files = _find(ckpt_dir, r"mp_rank_\d+_model_states\.pt")
+    if not model_files:
+        raise FileNotFoundError(
+            f"no mp_rank_*_model_states.pt under {ckpt_dir}")
+    zero_files = _find(
+        ckpt_dir, r"(bf16_|fp16_)?zero_pp_rank_\d+_mp_rank_\d+"
+                  r"_optim_states\.pt")
+
+    if not zero_files:
+        # plain (non-zero) checkpoint: module state is the source of truth
+        per_name: Dict[str, List[np.ndarray]] = {}
+        for mf in model_files:
+            sd = _read_pt(os.path.join(ckpt_dir, mf))[_MODULE]
+            for k, v in sd.items():
+                per_name.setdefault(k, []).append(_to_np(v))
+        return {k: _merge_tp_slices(k, v, cat_dim_rules=cat_dim_rules)
+                .astype(np.float32) for k, v in per_name.items()}
+
+    if len(model_files) > 1:
+        raise NotImplementedError(
+            "ZeRO fp32 reconstruction with tensor parallelism (mp>1) is "
+            "not supported here — consolidate per mp rank with the "
+            "reference's zero_to_fp32 first, or convert the module states "
+            "by dropping the zero_pp_rank files")
+
+    state = _read_pt(os.path.join(ckpt_dir, model_files[0]))
+    if _PARAM_SHAPES not in state:
+        raise KeyError(
+            f"{model_files[0]} lacks '{_PARAM_SHAPES}' — cannot map flat "
+            f"fp32 partitions back to named parameters")
+    # list of {name: shape} dicts, one per optimizer param group
+    param_shapes = state[_PARAM_SHAPES]
+
+    rank_sds = [_read_pt(os.path.join(ckpt_dir, f))[_OPT]
+                for f in zero_files]
+    stage = int(rank_sds[0].get(_ZERO_STAGE, 1))
+    world = rank_sds[0].get(_PARTITION_COUNT, len(zero_files))
+    if isinstance(world, (list, tuple)):
+        world = int(world[0])
+    world = int(world)
+    if world != len(zero_files):
+        raise ValueError(
+            f"partition_count {world} != {len(zero_files)} zero files")
+
+    flat_key = next((k for k in _FLAT_KEYS if k in rank_sds[0]), None)
+    if flat_key is None:
+        raise KeyError(
+            f"none of {_FLAT_KEYS} in {zero_files[0]}; unsupported layout")
+
+    out: Dict[str, np.ndarray] = {}
+    for g, shapes in enumerate(param_shapes):
+        parts = []
+        for sd in rank_sds:
+            grp = sd[flat_key][g]
+            parts.append(_to_np(grp).reshape(-1).astype(np.float32))
+        full = np.concatenate(parts)
+        total = sum(int(np.prod(tuple(s))) for s in shapes.values())
+        if full.size < total:
+            raise ValueError(
+                f"group {g}: flat partitions hold {full.size} elements, "
+                f"params need {total}")
+        # params pack CONTIGUOUSLY; stage 2 pads only the END of the group
+        # (to 2*world) before splitting across ranks — verify the trailing
+        # pad is within that bound so a mis-read fails loudly
+        align = 2 * world if stage >= 2 else world
+        if full.size - total >= align + world:
+            raise ValueError(
+                f"group {g}: {full.size - total} trailing elements exceeds "
+                f"the stage-{stage} alignment bound ({align + world}); "
+                f"param_shapes do not match these flat partitions")
+        offset = 0
+        for name, shape in shapes.items():
+            shape = tuple(int(x) for x in shape)
+            n = int(np.prod(shape)) if shape else 1
+            out[name] = full[offset:offset + n].reshape(shape)
+            offset += n
+    return out
+
+
+def write_universal(named: Dict[str, np.ndarray], out_dir: str,
+                    tag: str = "global_step0",
+                    extra_meta: Optional[Dict] = None) -> str:
+    tag_dir = os.path.join(out_dir, tag)
+    os.makedirs(tag_dir, exist_ok=True)
+    np.savez(os.path.join(tag_dir, "params.npz"), **named)
+    meta = {"format": META_FORMAT,
+            "n_params": len(named),
+            "names": sorted(named),
+            "shapes": {k: list(v.shape) for k, v in named.items()}}
+    meta.update(extra_meta or {})
+    with open(os.path.join(tag_dir, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2, default=str)
+    with open(os.path.join(out_dir, "latest"), "w") as f:
+        f.write(tag)
+    return tag_dir
+
+
+def load_universal_named(out_dir: str,
+                         tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Read a ``universal_named`` dir back into {name: array}."""
+    if tag is None:
+        with open(os.path.join(out_dir, "latest")) as f:
+            tag = f.read().strip()
+    with np.load(os.path.join(out_dir, tag, "params.npz")) as z:
+        return {k: z[k] for k in z.files}
+
+
+def convert(ckpt_dir: str, out_dir: str, tag: Optional[str] = None,
+            cat_dim_rules: Optional[Dict[str, int]] = None) -> str:
+    """Reference tag dir (or parent with ``latest``) -> universal dir."""
+    if os.path.isfile(os.path.join(ckpt_dir, "latest")):
+        with open(os.path.join(ckpt_dir, "latest")) as f:
+            ckpt_dir = os.path.join(ckpt_dir, f.read().strip())
+    named = extract_fp32_state(ckpt_dir, cat_dim_rules=cat_dim_rules)
+    return write_universal(named, out_dir,
+                           tag=tag or os.path.basename(ckpt_dir.rstrip("/")),
+                           extra_meta={"source": os.path.abspath(ckpt_dir)})
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Convert a reference (torch) checkpoint to the native "
+                    "universal_named layout")
+    ap.add_argument("input_dir", help="reference checkpoint dir (tag dir, "
+                                      "or parent containing 'latest')")
+    ap.add_argument("output_dir")
+    ap.add_argument("--tag", default=None)
+    ap.add_argument("--cat-dim", action="append", default=[],
+                    metavar="REGEX=DIM",
+                    help="concat dim for tensor-parallel slices whose name "
+                         "matches REGEX (e.g. 'dense_4h_to_h.weight=1')")
+    args = ap.parse_args(argv)
+    rules = {}
+    for spec in args.cat_dim:
+        pat, _, dim = spec.rpartition("=")
+        rules[pat] = int(dim)
+    tag_dir = convert(args.input_dir, args.output_dir, args.tag,
+                      cat_dim_rules=rules or None)
+    print(f"wrote {tag_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -167,10 +167,16 @@ class MoE(nn.Module):
             raise ValueError(
                 "use_grouped_gemm routes deterministically; disable "
                 "noisy_gate_policy / top2_2nd_expert_sampling to use it")
+        if grouped and self.drop_tokens:
+            raise ValueError(
+                "use_grouped_gemm is dropless (capacity_factor is ignored); "
+                "set drop_tokens=False to opt in explicitly")
         if grouped:
             out, l_aux = sharded_moe.grouped_moe_ffn(
                 tokens, tokens.astype(jnp.float32) @ wg, self.k, weights,
-                act, dtype, normalize_weights=self.normalize_weights)
+                act, dtype,
+                # k=1 training weight IS the softmax prob (top1gating)
+                normalize_weights=self.normalize_weights and self.k > 1)
         elif ep <= 1 and not tp:
             out, l_aux = route_and_run(
                 tokens, lambda d: _ffn(d, weights, act, dtype), rng)

@@ -209,12 +209,14 @@ def grouped_moe_ffn(tokens: jnp.ndarray, logits: jnp.ndarray, k: int,
     S, E = logits.shape
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     top_vals, top_idx = jax.lax.top_k(logits.astype(jnp.float32), k)
-    if normalize_weights and k > 1:
+    if normalize_weights:
+        # renormalize over the selected experts (HF norm_topk_prob / the
+        # top2gating g/(g1+g2)); at k == 1 this is a constant 1.0 — exactly
+        # HF's renormalized top-1. Training top-1 wants the raw softmax
+        # prob instead (top1gating semantics, and the router's gradient
+        # path): the MoE layer passes normalize_weights=False for k == 1.
         w_sel = jax.nn.softmax(top_vals, axis=-1)          # [S, k]
     else:
-        # k == 1: the weight IS the softmax prob (top1gating semantics —
-        # renormalizing over one expert would be a constant 1.0, severing
-        # the router's gradient through the output)
         w_sel = jnp.take_along_axis(gates, top_idx, axis=-1)
 
     eid = top_idx.reshape(-1)                              # [S*k]

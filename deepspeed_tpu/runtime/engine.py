@@ -209,6 +209,30 @@ class Engine:
             self._opt_swapper = NvmeOptimizerSwapper(
                 config.zero_optimization.offload_optimizer)
 
+        # ZeRO-3 parameter offload (ZeRO-Infinity class, reference
+        # runtime/swap_tensor/partitioned_param_swapper.py wired through
+        # stage3.py): between steps the master params park in host memory
+        # ("cpu", pinned_host shardings) or aio-backed NVMe files ("nvme"),
+        # so HBM at rest holds no parameters; they return to their device
+        # shardings for the step. Same bracket as the optimizer-state
+        # offload above.
+        self._param_swapper = None
+        pdev = config.zero_optimization.offload_param.device
+        if pdev in ("cpu", "nvme") and self.zero_plan.stage < 3:
+            logger.warning(
+                "offload_param requires ZeRO stage 3 (reference semantics); "
+                f"stage {self.zero_plan.stage} keeps params device-resident")
+        if self.zero_plan.stage >= 3 and pdev in ("cpu", "nvme"):
+            from .zero.offload import CpuOptimizerSwapper, NvmeOptimizerSwapper
+            if pdev == "nvme":
+                self._param_swapper = NvmeOptimizerSwapper(
+                    config.zero_optimization.offload_param, name="param")
+            else:
+                self._param_swapper = CpuOptimizerSwapper(
+                    self.zero_plan.param_host_shardings(self.state.params))
+            log_dist(f"ZeRO-3 param offload to {pdev}: params parked "
+                     f"off-device between steps")
+
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step() if (eval_fn or loss_fn) else None
 
@@ -331,6 +355,12 @@ class Engine:
         accum_dtype = self._grad_accum_dtype
         batch_sharding = self._batch_sharding()
 
+        # ZeRO-3 parameter offload parks params in host memory BETWEEN
+        # steps (engine._evict_params / _ensure_params_resident, the same
+        # bracket the optimizer-state offload uses); the compiled step
+        # itself runs with device-resident params — in-jit memory-kind
+        # streaming trips the SPMD partitioner on scalar placement
+        # annotations, the same limitation noted for opt-state offload.
 
         def micro_grads(params, micro_batch, rng, scale_state, step):
             cparams = cast_floating(params, compute_dtype)
@@ -357,6 +387,7 @@ class Engine:
                     x, NamedSharding(batch_sharding.mesh,
                                      P(None, *batch_sharding.spec)))
             micro_batches = jax.tree_util.tree_map(to_micro, batch)
+            params_c = state.params
 
             rngs = jax.random.split(state.rng, gas + 1)
             new_rng, micro_rngs = rngs[0], rngs[1:]
@@ -367,21 +398,21 @@ class Engine:
             def scan_body(carry, xs):
                 grad_acc, loss_acc = carry
                 mb, r = xs
-                loss, grads = micro_grads(state.params, mb, r,
+                loss, grads = micro_grads(params_c, mb, r,
                                           state.scale_state, state.step)
                 grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
                 if plan.stage >= 2:
-                    grad_acc = plan.constrain_grads(grad_acc, state.params)
+                    grad_acc = plan.constrain_grads(grad_acc, params_c)
                 return (grad_acc, loss_acc + loss), None
 
             new_comm = state.comm_state
             if onebit_grads is not None:
                 loss_sum, grads, new_comm = onebit_grads(
-                    state.params, micro_batches, micro_rngs,
+                    params_c, micro_batches, micro_rngs,
                     state.scale_state, state.comm_state, state.step)
             elif gas == 1:
                 mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)
-                loss, grads = micro_grads(state.params, mb, micro_rngs[0],
+                loss, grads = micro_grads(params_c, mb, micro_rngs[0],
                                           state.scale_state, state.step)
                 loss_sum = loss
             else:
@@ -395,7 +426,7 @@ class Engine:
             if fp16:
                 grads = ls.unscale_grads(grads, state.scale_state)
             if plan.stage >= 2:
-                grads = plan.constrain_grads(grads, state.params)
+                grads = plan.constrain_grads(grads, params_c)
 
             finite = ls.grads_finite(grads) if fp16 else jnp.asarray(True)
 
@@ -407,15 +438,18 @@ class Engine:
                 grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
 
             updates, new_opt_state = self.optimizer.update(
-                grads, state.opt_state, state.params)
+                grads, state.opt_state, params_c)
             new_params = jax.tree_util.tree_map(
-                lambda p, u: p + u.astype(p.dtype), state.params, updates)
+                lambda p, u: p + u.astype(p.dtype), params_c, updates)
 
             # overflow gate: keep old params/opt-state on non-finite grads
+            # (params_c == state.params numerically; with param offload it
+            # is the in-step device copy, keeping memory spaces uniform —
+            # out_shardings land new_params back in host memory)
             def select(new, old):
                 return jax.tree_util.tree_map(
                     lambda n, o: jnp.where(finite, n, o), new, old)
-            new_params = select(new_params, state.params)
+            new_params = select(new_params, params_c)
             new_opt_state = select(new_opt_state, state.opt_state)
             if new_comm is not state.comm_state:
                 new_comm = select(new_comm, state.comm_state)
@@ -631,8 +665,10 @@ class Engine:
         if self.flops_profiler is not None:
             self.flops_profiler.maybe_start(self.global_steps, batch)
         self._ensure_opt_state_resident()
+        self._ensure_params_resident()
         self.state, metrics = self._train_step(self.state, batch)
         self._evict_opt_state()
+        self._evict_params()
         self._last_metrics = metrics
 
         self.global_steps += 1
@@ -657,6 +693,7 @@ class Engine:
     def eval_batch(self, batch: Any, rng: Optional[jax.Array] = None):
         if rng is None:
             rng = jax.random.PRNGKey(0)
+        self._ensure_params_resident()
         params = (self._device_params if self._cpu_opt_mode
                   else self.state.params)
         step = (jax.device_put(self.state.step, self.topology.replicated())
@@ -743,10 +780,26 @@ class Engine:
             self.state = self.state._replace(
                 opt_state=self._opt_swapper.swap_out(self.state.opt_state))
 
+    def _ensure_params_resident(self):
+        """(ZeRO-3 param offload) bring parked params back on device."""
+        if self._param_swapper is not None and \
+                self._param_swapper.is_swapped_out:
+            self.state = self.state._replace(
+                params=self._param_swapper.swap_in(
+                    self._state_shardings.params))
+
+    def _evict_params(self):
+        """(ZeRO-3 param offload) park params off-device between steps."""
+        if self._param_swapper is not None and \
+                not self._param_swapper.is_swapped_out:
+            self.state = self.state._replace(
+                params=self._param_swapper.swap_out(self.state.params))
+
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[dict] = None, save_latest: bool = True):
         from ..checkpoint.engine_checkpoint import save_checkpoint as _save
         self._ensure_opt_state_resident()
+        self._ensure_params_resident()
         out = _save(self, save_dir, tag=tag, client_state=client_state,
                     save_latest=save_latest)
         self._evict_opt_state()
@@ -758,10 +811,15 @@ class Engine:
                         load_module_only: bool = False):
         from ..checkpoint.engine_checkpoint import load_checkpoint as _load
         self._ensure_opt_state_resident()
+        self._ensure_params_resident()
         out = _load(self, load_dir, tag=tag,
                     load_optimizer_states=load_optimizer_states,
                     load_lr_scheduler_states=load_lr_scheduler_states,
                     load_module_only=load_module_only)
+        # the loaded params supersede any parked stash: drop it so the next
+        # step cannot swap stale pre-load params back in
+        if self._param_swapper is not None:
+            self._param_swapper.reset()
         self._evict_opt_state()
         if self._cpu_opt_mode:
             self._refresh_device_params()

@@ -49,7 +49,8 @@ class NvmeOptimizerSwapper:
     parallelism, ``swap_tensor/async_swapper.py``).
     """
 
-    def __init__(self, cfg: OffloadConfig, swap_dir: Optional[str] = None):
+    def __init__(self, cfg: OffloadConfig, swap_dir: Optional[str] = None,
+                 name: str = "optimizer"):
         base = swap_dir or cfg.nvme_path
         if base is None:
             base = tempfile.mkdtemp(prefix="ds_tpu_swap_")
@@ -59,17 +60,22 @@ class NvmeOptimizerSwapper:
         # swap paths the same way). Rank-only — no pid — so restarts reuse
         # and overwrite the same directory instead of leaking swap files.
         rank = jax.process_index()
-        self.swap_dir = os.path.join(base, f"optimizer_swap_rank{rank}")
+        self.swap_dir = os.path.join(base, f"{name}_swap_rank{rank}")
         os.makedirs(self.swap_dir, exist_ok=True)
         self.handle = AioHandle()
         self._meta: Optional[List[Tuple[str, np.dtype, Tuple[int, ...]]]] = None
         self._treedef = None
         self._write_reqs: List[int] = []
-        log_dist(f"NVMe optimizer offload → {self.swap_dir}")
+        log_dist(f"NVMe {name} offload → {self.swap_dir}")
 
     @property
     def is_swapped_out(self) -> bool:
         return self._meta is not None
+
+    def reset(self) -> None:
+        """Drop the parked stash (after a checkpoint load supersedes it)."""
+        self.handle.wait_all()
+        self._meta = None
 
     def swap_out(self, opt_state: Any) -> Any:
         """Write every leaf to its swap file (async) and return the evicted
@@ -127,6 +133,10 @@ class CpuOptimizerSwapper:
     @property
     def is_swapped_out(self) -> bool:
         return self._stash is not None
+
+    def reset(self) -> None:
+        """Drop the parked stash (after a checkpoint load supersedes it)."""
+        self._stash = None
 
     def swap_out(self, opt_state: Any) -> Any:
         def put(x, s):

@@ -234,10 +234,15 @@ class ZeroShardingPlan:
                                       is_leaf=lambda x: isinstance(x, P))
 
     def param_shardings(self, params: Any) -> Any:
-        kind = None
-        if self.cfg.offload_param.device == "cpu":
-            kind = "pinned_host"
-        return self._to_sharding(self.param_specs(params), memory_kind=kind)
+        """Device-memory shardings the compiled step runs with."""
+        return self._to_sharding(self.param_specs(params))
+
+    def param_host_shardings(self, params: Any) -> Any:
+        """Pinned-host variant: the between-steps park for ZeRO-3 param
+        offload (engine._evict_params). Scalar-free param trees, so no
+        memory-kind fallback subtleties beyond backend support."""
+        return self._to_sharding(self.param_specs(params),
+                                 memory_kind="pinned_host")
 
     def grad_shardings(self, params: Any) -> Any:
         return self._to_sharding(self.grad_specs(params))

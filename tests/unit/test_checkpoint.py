@@ -2,6 +2,8 @@
 save/load round-trip, elastic resume across different mesh shapes
 (DistributedFixture save-with-2-load-with-4 pattern), fp32 export."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -220,3 +222,87 @@ def test_onebit_comm_state_excluded_from_checkpoint(tmp_path, devices8):
     loss = float(e4.train_batch({"tokens": jnp.asarray(
         np.random.RandomState(9).randint(0, 512, size=(8, 18)), jnp.int32)}))
     assert np.isfinite(loss)
+
+
+class TestDsToUniversal:
+    """Reference-checkpoint interop (VERDICT r2 #9): synthesize a
+    reference-format torch checkpoint, convert, and get back the exact
+    fp32 state (reference checkpoint/ds_to_universal.py:469 +
+    utils/zero_to_fp32.py reconstruction)."""
+
+    def _write_reference_ckpt(self, d, world=2, stage=2):
+        import collections
+
+        import torch
+        rng = np.random.RandomState(0)
+        shapes = collections.OrderedDict(
+            [("transformer.wte.weight", (8, 4)),
+             ("transformer.h.0.mlp.w", (4, 6)),
+             ("transformer.h.0.mlp.b", (6,))])
+        fp32 = {k: rng.randn(*s).astype(np.float32) for k, s in shapes.items()}
+        # reference layout: params pack CONTIGUOUSLY; only the END of the
+        # group pads (stage 2: to 2*world) before splitting across ranks
+        flat = np.concatenate([fp32[k].reshape(-1) for k in shapes])
+        align = 2 * world if stage >= 2 else world
+        pad = (-len(flat)) % align
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+        pad2 = (-len(flat)) % world
+        flat = np.concatenate([flat, np.zeros(pad2, np.float32)])
+        parts = np.split(flat, world)
+
+        tag = os.path.join(d, "global_step7")
+        os.makedirs(tag, exist_ok=True)
+        torch.save(
+            {"module": {k: torch.tensor(v, dtype=torch.bfloat16)
+                        for k, v in fp32.items()},
+             "param_shapes": [shapes]},
+            os.path.join(tag, "mp_rank_00_model_states.pt"))
+        for r, part in enumerate(parts):
+            torch.save(
+                {"optimizer_state_dict": {
+                    "zero_stage": stage,
+                    "partition_count": world,
+                    "fp32_flat_groups": [torch.tensor(part)]}},
+                os.path.join(tag, f"zero_pp_rank_{r}_mp_rank_00"
+                                  f"_optim_states.pt"))
+        with open(os.path.join(d, "latest"), "w") as f:
+            f.write("global_step7")
+        return fp32
+
+    @pytest.mark.parametrize("stage", [1, 2])
+    def test_zero_roundtrip_exact(self, tmp_path, stage):
+        from deepspeed_tpu.checkpoint.ds_to_universal import (
+            convert, load_universal_named)
+        src = str(tmp_path / "ref")
+        os.makedirs(src)
+        fp32 = self._write_reference_ckpt(src, world=2, stage=stage)
+        out = str(tmp_path / "uni")
+        convert(src, out)
+        got = load_universal_named(out)
+        assert set(got) == set(fp32)
+        for k in fp32:
+            # fp32 reconstruction must be EXACT (the module state is bf16;
+            # matching it would mean we read the wrong source)
+            np.testing.assert_array_equal(got[k], fp32[k])
+
+    def test_module_state_fallback_with_tp_merge(self, tmp_path):
+        import torch
+
+        from deepspeed_tpu.checkpoint.ds_to_universal import (
+            convert, load_universal_named)
+        src = str(tmp_path / "ref" / "global_step3")
+        os.makedirs(src)
+        rng = np.random.RandomState(1)
+        full = rng.randn(8, 4).astype(np.float32)
+        ln = rng.randn(4).astype(np.float32)
+        for r in range(2):
+            torch.save(
+                {"module": {
+                    "h.0.w": torch.tensor(full[r * 4:(r + 1) * 4]),
+                    "h.0.ln": torch.tensor(ln)}},
+                os.path.join(src, f"mp_rank_{r:02d}_model_states.pt"))
+        out = str(tmp_path / "uni")
+        convert(src, out)
+        got = load_universal_named(out)
+        np.testing.assert_array_equal(got["h.0.w"], full)    # concat dim 0
+        np.testing.assert_array_equal(got["h.0.ln"], ln)     # replicated
