@@ -71,7 +71,11 @@ def _to_np(t: Any) -> np.ndarray:
 
 def _find(dirname: str, pattern: str) -> List[str]:
     rx = re.compile(pattern)
-    return sorted(f for f in os.listdir(dirname) if rx.fullmatch(f))
+    # numeric sort: reference filenames carry UNPADDED ranks, and a
+    # lexicographic order would interleave rank 10 between 1 and 2 —
+    # silently permuting the concatenated fp32 partitions
+    return sorted((f for f in os.listdir(dirname) if rx.fullmatch(f)),
+                  key=lambda f: [int(x) for x in re.findall(r"\d+", f)])
 
 
 def _merge_tp_slices(name: str, slices: List[np.ndarray],

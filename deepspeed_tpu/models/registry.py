@@ -13,6 +13,7 @@ from typing import Any, Callable, Dict, NamedTuple
 
 from .bert import Bert, BertConfig
 from .bert import make_model as make_bert
+from .diffusion import UNet2DCondition, UNetConfig, VAE, VAEConfig
 from .bloom import Bloom, BloomConfig
 from .bloom import make_model as make_bloom
 from .gpt_neox import (GPTJ, GPTJConfig, GPTNeoX, GPTNeoXConfig,
@@ -206,6 +207,49 @@ def _entry_phi3(d):
     return LlamaConfig(**_hf_llama(d))
 
 
+def _entry_internlm(d):
+    """InternLM v1/v2 are llama-architecture (reference
+    module_inject/containers/internlm.py). v1's optional attention bias
+    covers q/k/v here; configs with bias=True also put a bias on o_proj,
+    which this model family does not carry — flagged loudly."""
+    if d.get("bias", False):
+        raise ValueError(
+            "internlm configs with bias=True (o_proj bias) are not "
+            "supported; bias=False checkpoints load as llama")
+    return LlamaConfig(**_hf_llama(d))
+
+
+def _entry_unet(d):
+    from .diffusion import UNetConfig
+    ahd = d.get("attention_head_dim", 8)
+    if isinstance(ahd, (list, tuple)):
+        if len(set(ahd)) != 1:
+            raise ValueError(
+                f"per-block attention_head_dim {ahd} (SD 2.x style) is not "
+                f"supported — this UNet uses one head dim for all blocks")
+        ahd = ahd[0]
+    return UNetConfig(
+        in_channels=d.get("in_channels", 4),
+        out_channels=d.get("out_channels", 4),
+        block_channels=tuple(d.get("block_out_channels",
+                                   (320, 640, 1280, 1280))),
+        layers_per_block=d.get("layers_per_block", 2),
+        cross_attn_dim=d.get("cross_attention_dim", 768),
+        attn_head_dim=ahd,
+        norm_groups=d.get("norm_num_groups", 32))
+
+
+def _entry_vae(d):
+    from .diffusion import VAEConfig
+    return VAEConfig(
+        in_channels=d.get("in_channels", 3),
+        latent_channels=d.get("latent_channels", 4),
+        block_channels=tuple(d.get("block_out_channels",
+                                   (128, 256, 512, 512))),
+        norm_groups=d.get("norm_num_groups", 32),
+        scaling_factor=d.get("scaling_factor", 0.18215))
+
+
 def _entry_qwen2_moe(d):
     # qwen2-moe = mixtral block + an always-on sigmoid-gated shared expert
     if int(d.get("decoder_sparse_step", 1)) != 1 or d.get("mlp_only_layers"):
@@ -243,7 +287,19 @@ ARCHITECTURES: Dict[str, ArchEntry] = {
     "phi3": ArchEntry(LlamaConfig, Llama, make_llama, _entry_phi3),
     "qwen2_moe": ArchEntry(MixtralConfig, Mixtral, make_mixtral,
                            _entry_qwen2_moe),
+    "internlm": ArchEntry(LlamaConfig, Llama, make_llama, _entry_internlm),
+    "internlm2": ArchEntry(LlamaConfig, Llama, make_llama, _entry_llama),
 }
+
+
+# diffusers model_index components (reference
+# module_inject/containers/unet.py, vae.py +
+# model_implementations/diffusers/)
+ARCHITECTURES.update({
+    "unet2dconditionmodel": ArchEntry(UNetConfig, UNet2DCondition,
+                                      None, _entry_unet),
+    "autoencoderkl": ArchEntry(VAEConfig, VAE, None, _entry_vae),
+})
 
 
 def get_arch(name: str) -> ArchEntry:

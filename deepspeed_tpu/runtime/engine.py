@@ -668,7 +668,6 @@ class Engine:
         self._ensure_params_resident()
         self.state, metrics = self._train_step(self.state, batch)
         self._evict_opt_state()
-        self._evict_params()
         self._last_metrics = metrics
 
         self.global_steps += 1
@@ -687,7 +686,9 @@ class Engine:
         self.tput_timer.stop(global_step=True, report_speed=True)
         self._maybe_log(metrics)
         if self.flops_profiler is not None:
+            # before param eviction: the profiler counts param elements
             self.flops_profiler.maybe_stop(self.global_steps, metrics)
+        self._evict_params()
         return metrics.loss
 
     def eval_batch(self, batch: Any, rng: Optional[jax.Array] = None):
@@ -698,7 +699,9 @@ class Engine:
                   else self.state.params)
         step = (jax.device_put(self.state.step, self.topology.replicated())
                 if self._cpu_opt_mode else self.state.step)
-        return self._eval_step(params, batch, rng, step)
+        out = self._eval_step(params, batch, rng, step)
+        self._evict_params()     # XLA keeps the buffers alive for `out`
+        return out
 
     # --- forward/backward/step trio (API parity) ----------------------- #
 
@@ -802,6 +805,7 @@ class Engine:
         self._ensure_params_resident()
         out = _save(self, save_dir, tag=tag, client_state=client_state,
                     save_latest=save_latest)
+        self._evict_params()
         self._evict_opt_state()
         return out
 
@@ -819,8 +823,11 @@ class Engine:
         # the loaded params supersede any parked stash: drop it so the next
         # step cannot swap stale pre-load params back in
         if self._param_swapper is not None:
+            # NOTE: the pre-load _ensure_params_resident pays one wasted
+            # swap-in for nvme offload; kept for loader-structure safety
             self._param_swapper.reset()
         self._evict_opt_state()
+        self._evict_params()
         if self._cpu_opt_mode:
             self._refresh_device_params()
         return out

@@ -60,9 +60,19 @@ def _set(tree: Any, path: str, value) -> Any:
     return rec(tree, 0)
 
 
+def _params_resident(engine):
+    """(ZeRO-3 param offload) parked params must come back before any
+    fragment read/write — and a write would otherwise be clobbered by the
+    stash at the next step."""
+    f = getattr(engine, "_ensure_params_resident", None)
+    if f is not None:
+        f()
+
+
 def list_param_names(engine) -> List[str]:
     """All addressable param paths."""
     out = []
+    _params_resident(engine)
     flat, _ = jax.tree_util.tree_flatten_with_path(engine.state.params)
     for path, _leaf in flat:
         out.append("/".join(str(getattr(k, "key", getattr(k, "idx",
@@ -72,6 +82,7 @@ def list_param_names(engine) -> List[str]:
 
 def safe_get_full_fp32_param(engine, name: str) -> Optional[np.ndarray]:
     """Full (gathered) fp32 master weight, or None if absent."""
+    _params_resident(engine)
     leaf = _walk(engine.state.params, name)
     if leaf is None:
         return None
@@ -80,6 +91,7 @@ def safe_get_full_fp32_param(engine, name: str) -> Optional[np.ndarray]:
 
 def safe_set_full_fp32_param(engine, name: str, value) -> bool:
     """Overwrite a master weight (re-placed with its sharding)."""
+    _params_resident(engine)
     leaf = _walk(engine.state.params, name)
     if leaf is None:
         return False

@@ -1,0 +1,78 @@
+"""Diffusers-stack tests — the analogue of the reference's stable-diffusion
+lane (``nv-sd.yml``) and UNet/VAE injection tests: shapes, gradients, and an
+end-to-end tiny text-to-image pipeline smoke."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.clip import CLIPTextConfig, CLIPTextEncoder
+from deepspeed_tpu.models.diffusion import (StableDiffusionPipeline,
+                                            UNet2DCondition, UNetConfig,
+                                            VAE, VAEConfig)
+
+
+def test_unet_shapes_and_grad():
+    cfg = UNetConfig.tiny()
+    unet = UNet2DCondition(cfg)
+    x = jnp.ones((2, 8, 8, 4))
+    t = jnp.asarray([1, 7], jnp.int32)
+    ctx = jnp.ones((2, 5, cfg.cross_attn_dim))
+    params = unet.init(jax.random.PRNGKey(0), x, t, ctx)["params"]
+    out = unet.apply({"params": params}, x, t, ctx)
+    assert out.shape == (2, 8, 8, 4)
+
+    g = jax.grad(lambda p: jnp.sum(
+        unet.apply({"params": p}, x, t, ctx) ** 2))(params)
+    gn = sum(float(jnp.abs(leaf).sum())
+             for leaf in jax.tree_util.tree_leaves(g))
+    assert np.isfinite(gn) and gn > 0
+
+
+def test_vae_roundtrip_shapes():
+    cfg = VAEConfig.tiny()
+    vae = VAE(cfg)
+    x = jnp.ones((2, 16, 16, 3))
+    params = vae.init(jax.random.PRNGKey(0), x)["params"]
+    recon, mean, logvar = vae.apply({"params": params}, x)
+    # one downsample level in tiny config -> latents at H/2
+    assert mean.shape == (2, 8, 8, cfg.latent_channels)
+    assert recon.shape == x.shape
+    dec = vae.apply({"params": params}, mean, method=VAE.decode)
+    assert dec.shape == x.shape
+
+
+def test_sd_pipeline_text_to_image_smoke():
+    """CLIP text -> UNet DDIM loop (jitted, CFG pair) -> VAE decode."""
+    tcfg = CLIPTextConfig.tiny()
+    text = CLIPTextEncoder(tcfg)
+    toks = jnp.asarray([[1, 4, 9, 2]], jnp.int32)
+    tparams = text.init(jax.random.PRNGKey(0), toks)["params"]
+    hidden = text.apply({"params": tparams}, toks)
+    if isinstance(hidden, tuple):
+        hidden = hidden[0]
+    D = hidden.shape[-1]
+
+    ucfg = UNetConfig.tiny(cross_attn_dim=D)
+    unet = UNet2DCondition(ucfg)
+    lat = jnp.ones((1, 8, 8, 4))
+    uparams = unet.init(jax.random.PRNGKey(1), lat,
+                        jnp.zeros((1,), jnp.int32), hidden)["params"]
+
+    vcfg = VAEConfig.tiny()
+    vae = VAE(vcfg)
+    vparams = vae.init(jax.random.PRNGKey(2),
+                       jnp.ones((1, 16, 16, 3)))["params"]
+
+    pipe = StableDiffusionPipeline(unet, uparams, vae, vparams,
+                                   text_encoder=text, text_params=tparams)
+    ctx = pipe.encode_text(toks)
+    if isinstance(ctx, tuple):
+        ctx = ctx[0]
+    un = pipe.encode_text(jnp.zeros_like(toks))
+    if isinstance(un, tuple):
+        un = un[0]
+    img = pipe(ctx, un, latent_shape=(1, 8, 8, 4), num_inference_steps=3,
+               guidance_scale=4.0)
+    assert img.shape == (1, 16, 16, 3)
+    assert np.isfinite(np.asarray(img)).all()
