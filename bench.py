@@ -137,6 +137,9 @@ def bench_serve():
         max_seqs=S, chunk_size=PROMPT, block_size=bs,
         num_blocks=S * blocks_per_seq + 4,
         max_blocks_per_seq=blocks_per_seq,
+        # 32-token fused decode chunks measured ~12% faster than 16 (fewer
+        # host round-trips); generate() still checks EOS between chunks
+        decode_loop_steps=int(os.environ.get("DSTPU_BENCH_LOOP", "32")),
         dtype="bfloat16", attention_impl=impl)
     eng = InferenceEngineV2(mcfg, params, cfg)
 
