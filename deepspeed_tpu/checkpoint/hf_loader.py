@@ -161,6 +161,27 @@ _GPT2_MAP = [
      "h_{0}/mlp/c_proj/{w:kernel,b:bias}", "conv1d"),
 ]
 
+_GPT_NEO_MAP = [
+    # GPT-Neo (reference module_inject/containers/gptneo.py): unfused
+    # torch Linears (transposed on load), bias-free q/k/v, tied head
+    (r"(?:transformer\.)?wte\.weight", "wte/embedding", "embed"),
+    (r"(?:transformer\.)?wpe\.weight", "wpe/embedding", "embed"),
+    (r"(?:transformer\.)?ln_f\.(weight|bias)",
+     "ln_f/{w:scale,b:bias}", "vector"),
+    (r"lm_head\.weight", "lm_head/kernel", "linear"),  # dropped when tied
+    (r"(?:transformer\.)?h\.(\d+)\.ln_(1|2)\.(weight|bias)",
+     "h_{0}/ln_{1}/{w:scale,b:bias}", "vector"),
+    (r"(?:transformer\.)?h\.(\d+)\.attn\.attention\.(q|k|v|out)_proj\.weight",
+     "h_{0}/{1}_proj/kernel", "linear"),
+    (r"(?:transformer\.)?h\.(\d+)\.attn\.attention\.out_proj\.bias",
+     "h_{0}/out_proj/bias", "vector"),
+    (r"(?:transformer\.)?h\.(\d+)\.mlp\.c_fc\.(weight|bias)",
+     "h_{0}/c_fc/{w:kernel,b:bias}", "linear"),
+    (r"(?:transformer\.)?h\.(\d+)\.mlp\.c_proj\.(weight|bias)",
+     "h_{0}/c_proj/{w:kernel,b:bias}", "linear"),
+]
+
+
 _PHI_MAP = [
     (r"model\.embed_tokens\.weight", "embed_tokens/embedding", "embed"),
     (r"model\.final_layernorm\.(weight|bias)",
@@ -255,6 +276,7 @@ ARCH_MAPS = {
     "phi": _PHI_MAP,
     "opt": _OPT_MAP,
     "gpt2": _GPT2_MAP,
+    "gpt_neo": _GPT_NEO_MAP,
 }
 
 

@@ -1,0 +1,137 @@
+"""GPT-Neo (EleutherAI) causal transformer (flax).
+
+The last of the reference's v1 injection containers
+(``module_inject/containers/gptneo.py`` — distinct from GPT-NeoX): GPT-2's
+macro-structure with three deviations the container encodes — unfused
+UNSCALED attention (no 1/sqrt(d) on the scores), separate bias-free q/k/v
+projections with a biased out_proj, and alternating global / local
+(windowed, 256) attention layers per ``config.attention_types``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTNeoConfig:
+    vocab_size: int = 50257
+    max_seq_len: int = 2048
+    num_layers: int = 24
+    num_heads: int = 16
+    hidden_size: int = 2048
+    intermediate_size: Optional[int] = None       # default 4*hidden
+    window_size: int = 256
+    # per-layer "global"/"local"; None = alternating starting global
+    attention_layers: Optional[Sequence[str]] = None
+    layer_norm_eps: float = 1e-5
+    tie_embeddings: bool = True
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    def layer_kinds(self):
+        if self.attention_layers is not None:
+            return list(self.attention_layers)
+        return ["global" if i % 2 == 0 else "local"
+                for i in range(self.num_layers)]
+
+    @staticmethod
+    def tiny(**kw):
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("max_seq_len", 64)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("hidden_size", 32)
+        kw.setdefault("window_size", 8)
+        return GPTNeoConfig(**kw)
+
+
+class GPTNeoBlock(nn.Module):
+    cfg: GPTNeoConfig
+    kind: str               # "global" | "local"
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, T, C = x.shape
+        H, D = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ln_1")(x)
+        dense = lambda n, b: nn.Dense(   # noqa: E731
+            C, use_bias=b, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name=n)
+        q = dense("q_proj", False)(h).reshape(B, T, H, D)
+        k = dense("k_proj", False)(h).reshape(B, T, H, D)
+        v = dense("v_proj", False)(h).reshape(B, T, H, D)
+        # GPT-Neo attends UNSCALED (no 1/sqrt(D)) — container-encoded
+        # quirk; q/k go through the matmul in fp32 (HF does the same):
+        # unscaled scores reach O(100s) where bf16 has lost the mantissa
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32))
+        iq = jnp.arange(T)[:, None]
+        ik = jnp.arange(T)[None, :]
+        mask = ik <= iq
+        if self.kind == "local":
+            mask = jnp.logical_and(mask, ik > iq - cfg.window_size)
+        scores = jnp.where(mask[None, None], scores, -1e9)
+        p = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        y = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, C)
+        y = dense("out_proj", True)(y)
+        x = x + y
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ln_2")(x)
+        inter = cfg.intermediate_size or 4 * C
+        m = nn.Dense(inter, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="c_fc")(h)
+        m = nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="c_proj")(nn.gelu(m))
+        return x + m
+
+
+class GPTNeo(nn.Module):
+    cfg: GPTNeoConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        B, T = tokens.shape
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="wte")
+        wpe = nn.Embed(cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="wpe")
+        x = wte(tokens) + wpe(jnp.arange(T)[None, :])
+        for i, kind in enumerate(cfg.layer_kinds()):
+            x = GPTNeoBlock(cfg, kind, name=f"h_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ln_f")(x)
+        if cfg.tie_embeddings:
+            return x.astype(jnp.float32) @ \
+                wte.embedding.astype(jnp.float32).T
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                        param_dtype=cfg.param_dtype, name="lm_head")(
+            x.astype(jnp.float32))
+
+
+def make_model(cfg: GPTNeoConfig):
+    """(model, init_fn, loss_fn) with the engine's loss signature."""
+    model = GPTNeo(cfg)
+
+    def init_fn(rng, batch_size: int = 2, seq_len: Optional[int] = None):
+        T = seq_len or min(cfg.max_seq_len, 64)
+        return model.init(rng, jnp.zeros((batch_size, T), jnp.int32))["params"]
+
+    def loss_fn(params, batch, rng):
+        del rng
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = model.apply({"params": params}, inputs)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return nll.mean()
+
+    return model, init_fn, loss_fn

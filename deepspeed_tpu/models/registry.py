@@ -16,6 +16,8 @@ from .bert import make_model as make_bert
 from .diffusion import UNet2DCondition, UNetConfig, VAE, VAEConfig
 from .bloom import Bloom, BloomConfig
 from .bloom import make_model as make_bloom
+from .gpt_neo import GPTNeo, GPTNeoConfig
+from .gpt_neo import make_model as make_gpt_neo
 from .gpt_neox import (GPTJ, GPTJConfig, GPTNeoX, GPTNeoXConfig,
                        make_model_gptj, make_model_neox)
 from .falcon import Falcon, FalconConfig
@@ -142,6 +144,33 @@ def _entry_bloom(d):
         hidden_size=d.get("hidden_size", d.get("n_embed", 4096)),
         layer_norm_eps=d.get("layer_norm_epsilon", 1e-5),
         tie_embeddings=d.get("tie_word_embeddings", True))
+
+
+def _entry_gpt_neo(d):
+    # attention_types: [[["global","local"], N], ...] expands to per-layer
+    kinds = None
+    at = d.get("attention_types")
+    if at:
+        kinds = []
+        for pattern, n in at:
+            kinds.extend(list(pattern) * int(n))   # pattern repeated n times
+        kinds = tuple(kinds)
+    act = d.get("activation_function", "gelu_new")
+    if act != "gelu_new":
+        raise ValueError(
+            f"gpt_neo activation_function={act!r} is not supported (only "
+            f"gelu_new, the shipped GPT-Neo default)")
+    return GPTNeoConfig(
+        vocab_size=d.get("vocab_size", 50257),
+        max_seq_len=d.get("max_position_embeddings", 2048),
+        num_layers=d.get("num_layers", 24),
+        num_heads=d.get("num_heads", 16),
+        hidden_size=d.get("hidden_size", 2048),
+        intermediate_size=d.get("intermediate_size"),
+        window_size=d.get("window_size", 256),
+        attention_layers=kinds,
+        tie_embeddings=d.get("tie_word_embeddings", True),
+        layer_norm_eps=d.get("layer_norm_epsilon", 1e-5))
 
 
 def _entry_gpt_neox(d):
@@ -287,6 +316,8 @@ ARCHITECTURES: Dict[str, ArchEntry] = {
     "phi3": ArchEntry(LlamaConfig, Llama, make_llama, _entry_phi3),
     "qwen2_moe": ArchEntry(MixtralConfig, Mixtral, make_mixtral,
                            _entry_qwen2_moe),
+    "gpt_neo": ArchEntry(GPTNeoConfig, GPTNeo, make_gpt_neo,
+                         _entry_gpt_neo),
     "internlm": ArchEntry(LlamaConfig, Llama, make_llama, _entry_internlm),
     "internlm2": ArchEntry(LlamaConfig, Llama, make_llama, _entry_llama),
 }

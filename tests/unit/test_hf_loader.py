@@ -510,3 +510,25 @@ class TestBloomNeoXGPTJ:
         with torch.no_grad():
             theirs = hf_model(torch.tensor(toks)).logits.numpy()
         _logit_match(np.asarray(ours), theirs)
+
+
+class TestGPTNeoParity:
+    def test_logits_match_transformers(self, tmp_path):
+        hf_cfg = transformers.GPTNeoConfig(
+            vocab_size=96, max_position_embeddings=64, hidden_size=48,
+            num_layers=2, num_heads=4, intermediate_size=96,
+            attention_types=[[["global", "local"], 1]], window_size=8)
+        hf_model = transformers.GPTNeoForCausalLM(hf_cfg).eval()
+        hf_model.save_pretrained(tmp_path)
+        arch, cfg, params = load_hf_model(str(tmp_path))
+        assert arch == "gpt_neo"
+        assert cfg.layer_kinds() == ["global", "local"]
+        from deepspeed_tpu.models.gpt_neo import GPTNeo
+        model = GPTNeo(cfg)
+        # length > window so the local layer's mask actually bites
+        tokens = np.random.RandomState(3).randint(0, 96, size=(1, 12))
+        ours = model.apply({"params": params},
+                           jnp.asarray(tokens, jnp.int32))
+        with torch.no_grad():
+            theirs = hf_model(torch.tensor(tokens)).logits
+        _logit_match(ours, theirs)
