@@ -182,6 +182,40 @@ _GPT_NEO_MAP = [
 ]
 
 
+_DISTILBERT_MAP = [
+    # DistilBERT (reference module_inject/containers/distil_bert.py):
+    # BERT encoder without token types, pooler-free, tied MLM head
+    (r"distilbert\.embeddings\.word_embeddings\.weight",
+     "word_embeddings/embedding", "embed"),
+    (r"distilbert\.embeddings\.position_embeddings\.weight",
+     "position_embeddings/embedding", "embed"),
+    (r"distilbert\.embeddings\.LayerNorm\.(weight|bias)",
+     "embed_norm/{w:scale,b:bias}", "vector"),
+    (r"distilbert\.transformer\.layer\.(\d+)\.attention\.q_lin\.(weight|bias)",
+     "layer_{0}/query/{w:kernel,b:bias}", "linear"),
+    (r"distilbert\.transformer\.layer\.(\d+)\.attention\.k_lin\.(weight|bias)",
+     "layer_{0}/key/{w:kernel,b:bias}", "linear"),
+    (r"distilbert\.transformer\.layer\.(\d+)\.attention\.v_lin\.(weight|bias)",
+     "layer_{0}/value/{w:kernel,b:bias}", "linear"),
+    (r"distilbert\.transformer\.layer\.(\d+)\.attention\.out_lin\.(weight|bias)",
+     "layer_{0}/attn_out/{w:kernel,b:bias}", "linear"),
+    (r"distilbert\.transformer\.layer\.(\d+)\.sa_layer_norm\.(weight|bias)",
+     "layer_{0}/attn_norm/{w:scale,b:bias}", "vector"),
+    (r"distilbert\.transformer\.layer\.(\d+)\.ffn\.lin1\.(weight|bias)",
+     "layer_{0}/intermediate/{w:kernel,b:bias}", "linear"),
+    (r"distilbert\.transformer\.layer\.(\d+)\.ffn\.lin2\.(weight|bias)",
+     "layer_{0}/output/{w:kernel,b:bias}", "linear"),
+    (r"distilbert\.transformer\.layer\.(\d+)\.output_layer_norm\.(weight|bias)",
+     "layer_{0}/out_norm/{w:scale,b:bias}", "vector"),
+    (r"vocab_transform\.(weight|bias)",
+     "mlm_transform/{w:kernel,b:bias}", "linear"),
+    (r"vocab_layer_norm\.(weight|bias)", "mlm_norm/{w:scale,b:bias}",
+     "vector"),
+    (r"vocab_projector\.bias", "mlm_bias", "vector"),
+    # vocab_projector.weight is the tied word embedding: skipped below
+]
+
+
 _PHI_MAP = [
     (r"model\.embed_tokens\.weight", "embed_tokens/embedding", "embed"),
     (r"model\.final_layernorm\.(weight|bias)",
@@ -277,6 +311,7 @@ ARCH_MAPS = {
     "opt": _OPT_MAP,
     "gpt2": _GPT2_MAP,
     "gpt_neo": _GPT_NEO_MAP,
+    "distilbert": _DISTILBERT_MAP,
 }
 
 
@@ -477,7 +512,7 @@ def _fw_path(template: str, groups: Tuple[str, ...]) -> str:
 
 #: non-parameter tensors present in real Hub checkpoints — skipped silently
 _IGNORED_TENSORS = re.compile(
-    r".*\.((attn|attention)\.(bias|masked_bias)|rotary_emb\.inv_freq)$")
+    r".*\.((attn|attention)\.(bias|masked_bias)|rotary_emb\.inv_freq|embeddings\.position_ids)$")
 
 
 def convert_hf_state(arch: str, state: Dict[str, np.ndarray],
@@ -495,6 +530,8 @@ def convert_hf_state(arch: str, state: Dict[str, np.ndarray],
             continue
         if arch == "gpt2" and name.endswith("lm_head.weight"):
             continue                      # tied duplicate of wte
+        if arch == "distilbert" and name.endswith("vocab_projector.weight"):
+            continue                      # tied duplicate of word embeddings
         hit = None
         for rx, tmpl, kind in rules:
             m = rx.match(name)

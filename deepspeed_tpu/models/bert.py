@@ -70,7 +70,8 @@ class BertLayer(nn.Module):
         y = dense(C, "attn_out")(y.reshape(B, T, C))
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="attn_norm")(x + y)
-        h = nn.gelu(dense(cfg.intermediate_size, "intermediate")(x))
+        h = nn.gelu(dense(cfg.intermediate_size, "intermediate")(x),
+                    approximate=False)
         h = dense(C, "output")(h)
         return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                             name="out_norm")(x + h)
@@ -87,11 +88,14 @@ class Bert(nn.Module):
                        param_dtype=cfg.param_dtype, name="word_embeddings")
         wpe = nn.Embed(cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
                        param_dtype=cfg.param_dtype, name="position_embeddings")
-        wtt = nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
-                       param_dtype=cfg.param_dtype, name="token_type_embeddings")
-        if token_type_ids is None:
-            token_type_ids = jnp.zeros_like(tokens)
-        x = wte(tokens) + wpe(jnp.arange(T)[None, :]) + wtt(token_type_ids)
+        x = wte(tokens) + wpe(jnp.arange(T)[None, :])
+        if cfg.type_vocab_size > 0:       # distilbert has no token types
+            wtt = nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                           dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                           name="token_type_embeddings")
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(tokens)
+            x = x + wtt(token_type_ids)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="embed_norm")(x)
         layer_cls = nn.remat(BertLayer) if cfg.remat else BertLayer
@@ -100,7 +104,7 @@ class Bert(nn.Module):
         # MLM head: transform + tied decoder
         x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="mlm_transform")(x)
-        x = nn.gelu(x)
+        x = nn.gelu(x, approximate=False)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="mlm_norm")(x)
         logits = wte.attend(x.astype(jnp.float32))

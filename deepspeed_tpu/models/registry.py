@@ -121,6 +121,27 @@ def _entry_bert(d):
         layer_norm_eps=d.get("layer_norm_eps", 1e-12))
 
 
+def _entry_distilbert(d):
+    # DistilBERT = BERT encoder, no token-type embeddings, gelu, sinusoidal
+    # optional (sinusoidal_pos_embds default False -> learned, as here)
+    if d.get("sinusoidal_pos_embds", False):
+        raise ValueError("distilbert sinusoidal_pos_embds=True is not "
+                         "supported (learned positions only)")
+    act = d.get("activation", "gelu")
+    if act != "gelu":
+        raise ValueError(f"distilbert activation={act!r} is not supported "
+                         f"(exact gelu only)")
+    return BertConfig(
+        vocab_size=d.get("vocab_size", 30522),
+        max_seq_len=d.get("max_position_embeddings", 512),
+        type_vocab_size=0,
+        num_layers=d.get("n_layers", 6),
+        num_heads=d.get("n_heads", 12),
+        hidden_size=d.get("dim", 768),
+        intermediate_size=d.get("hidden_dim", 3072),
+        layer_norm_eps=1e-12)
+
+
 def _entry_opt(d):
     proj = d.get("word_embed_proj_dim")
     return OPTConfig(
@@ -154,6 +175,10 @@ def _entry_gpt_neo(d):
         kinds = []
         for pattern, n in at:
             kinds.extend(list(pattern) * int(n))   # pattern repeated n times
+        if len(kinds) != d.get("num_layers", 24):
+            raise ValueError(
+                f"attention_types expands to {len(kinds)} layers but "
+                f"num_layers={d.get('num_layers', 24)}")
         kinds = tuple(kinds)
     act = d.get("activation_function", "gelu_new")
     if act != "gelu_new":
@@ -306,6 +331,8 @@ ARCHITECTURES: Dict[str, ArchEntry] = {
     "qwen2": ArchEntry(LlamaConfig, Llama, make_llama, _entry_qwen2),
     "mixtral": ArchEntry(MixtralConfig, Mixtral, make_mixtral, _entry_mixtral),
     "bert": ArchEntry(BertConfig, Bert, make_bert, _entry_bert),
+    "distilbert": ArchEntry(BertConfig, Bert, make_bert,
+                            _entry_distilbert),
     "opt": ArchEntry(OPTConfig, OPT, make_opt, _entry_opt),
     "falcon": ArchEntry(FalconConfig, Falcon, make_falcon, _entry_falcon),
     "bloom": ArchEntry(BloomConfig, Bloom, make_bloom, _entry_bloom),

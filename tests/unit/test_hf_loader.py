@@ -532,3 +532,24 @@ class TestGPTNeoParity:
         with torch.no_grad():
             theirs = hf_model(torch.tensor(tokens)).logits
         _logit_match(ours, theirs)
+
+
+class TestDistilBertParity:
+    def test_mlm_logits_match_transformers(self, tmp_path):
+        hf_cfg = transformers.DistilBertConfig(
+            vocab_size=96, max_position_embeddings=64, dim=48, n_layers=2,
+            n_heads=4, hidden_dim=96)
+        hf_model = transformers.DistilBertForMaskedLM(hf_cfg).eval()
+        hf_model.save_pretrained(tmp_path)
+        arch, cfg, params = load_hf_model(str(tmp_path))
+        assert arch == "distilbert"
+        assert cfg.type_vocab_size == 0
+        cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+        from deepspeed_tpu.models.bert import Bert
+        model = Bert(cfg)
+        tokens = np.random.RandomState(4).randint(0, 96, size=(1, 11))
+        ours = model.apply({"params": params},
+                           jnp.asarray(tokens, jnp.int32))
+        with torch.no_grad():
+            theirs = hf_model(torch.tensor(tokens)).logits
+        _logit_match(ours, theirs)
