@@ -516,8 +516,15 @@ _IGNORED_TENSORS = re.compile(
 
 
 def convert_hf_state(arch: str, state: Dict[str, np.ndarray],
-                     strict: bool = True) -> Dict[str, Any]:
-    """Map an HF state dict onto this framework's nested param dict."""
+                     strict: bool = True,
+                     tied: bool = False) -> Dict[str, Any]:
+    """Map an HF state dict onto this framework's nested param dict.
+
+    ``tied=True`` (tie_word_embeddings archs, e.g. gpt_neo) drops the
+    serialized ``lm_head.weight`` duplicate at convert time — torch .bin
+    checkpoints carry the tied tensor even though the flax model unembeds
+    through the embedding, and keeping it would waste a full-vocab kernel.
+    """
     if arch not in ARCH_MAPS:
         raise ValueError(f"no HF name map for architecture '{arch}' "
                          f"(have {sorted(ARCH_MAPS)})")
@@ -532,6 +539,8 @@ def convert_hf_state(arch: str, state: Dict[str, np.ndarray],
             continue                      # tied duplicate of wte
         if arch == "distilbert" and name.endswith("vocab_projector.weight"):
             continue                      # tied duplicate of word embeddings
+        if tied and name.endswith("lm_head.weight"):
+            continue                      # tied duplicate of the embedding
         hit = None
         for rx, tmpl, kind in rules:
             m = rx.match(name)
@@ -572,9 +581,10 @@ def load_hf_model(model_dir: str, strict: bool = True):
     state = load_hf_state_dict(model_dir)
     if arch in SPECIAL_HANDLERS:
         state = SPECIAL_HANDLERS[arch](state, hf_cfg)
-    params = convert_hf_state(arch, state, strict=strict)
+    params = convert_hf_state(arch, state, strict=strict,
+                              tied=getattr(cfg, "tie_embeddings", False))
     if getattr(cfg, "tie_embeddings", False) and isinstance(params, dict):
-        # tied models unembed through the embedding; drop the duplicate head
+        # belt-and-braces for maps whose head key isn't lm_head.weight
         params.pop("lm_head", None)
     n = sum(int(np.prod(a.shape)) for a in state.values())
     log_dist(f"loaded HF checkpoint {model_dir}: arch={arch}, "

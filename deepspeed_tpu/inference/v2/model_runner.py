@@ -309,6 +309,13 @@ class RaggedRunnerBase:
             trash_off = slots - bs                     # trash block start
             ring_sl = jnp.moveaxis(ring, 0, 3)         # [L, 2, S, R, KVD]
             if cfg.max_blocks_per_seq == 1:
+                # the inactive-slot path parks rows at slots - bs; with
+                # R > bs the DUS start would clamp and overwrite the tail
+                # of the last real block (currently only reachable for an
+                # all-inactive batch, but nothing upstream enforces it)
+                assert R <= bs, (
+                    f"decode_loop_steps ({R}) must be <= block_size ({bs}) "
+                    f"on the linear (one-block-per-seq) layout")
                 for i in range(S):
                     off = jnp.where(active[i] > 0,
                                     tables[i, 0] * bs + start0[i],

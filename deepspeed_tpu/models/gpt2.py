@@ -214,6 +214,14 @@ class GPT2(nn.Module):
                 # (+33% step FLOPs) and no remat (OOM at useful batch)
                 policy = jax.checkpoint_policies.save_only_these_names(
                     "qkv", "attn_out")
+            elif cfg.remat_policy.startswith("save:"):
+                # explicit checkpoint_name list, e.g.
+                # "save:qkv,attn_out,mlp_pre_act" — saves qkv + attention
+                # output + the fc1 pre-activation (8*C per layer), so the
+                # backward recomputes only LNs, gelu and the flash forward:
+                # near-zero repeated MXU work at ~2x the qkv_out residency
+                names = [n for n in cfg.remat_policy[5:].split(",") if n]
+                policy = jax.checkpoint_policies.save_only_these_names(*names)
             block_cls = nn.remat(Block, static_argnums=(2,), policy=policy)
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"h_{i}")(x, deterministic)

@@ -238,9 +238,16 @@ def grouped_moe_ffn(tokens: jnp.ndarray, logits: jnp.ndarray, k: int,
     ws = jnp.take(w_sel.reshape(-1), order).astype(dtype)
     out = jnp.zeros_like(tokens, dtype).at[tok_of].add(ys * ws[:, None])
 
-    # load-balance loss — same statistic the capacity paths report
-    # (topkgating: mean gate prob x mean routed fraction, scaled by E)
+    # load-balance loss — same statistic the capacity path this call
+    # replaces would report: top1gating/top2gating use FIRST-choice counts
+    # only (mask1.mean), topkgating averages all k choices. Matching per-k
+    # keeps the router regularizer identical when the dropless path
+    # auto-replaces the capacity path in MoE.__call__.
     me = gates.mean(axis=0)
-    ce = group_sizes.astype(jnp.float32) / float(S * k)
+    if k <= 2:
+        first = jnp.bincount(top_idx[:, 0], length=E).astype(jnp.float32)
+        ce = first / float(S)
+    else:
+        ce = group_sizes.astype(jnp.float32) / float(S * k)
     l_aux = (me * ce).sum() * E
     return out, l_aux

@@ -236,7 +236,7 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     if C == 1:
         # whole blocks, but capped so a K/V tile stays ~<=2 MB of VMEM
         # (large linear block_size x wide rows would blow the budget)
-        cap = max(256, (2 << 20) // (KVD * 2))
+        cap = max(256, (2 << 20) // (KVD * k_pool.dtype.itemsize))
         pbs = next(d for d in range(min(bs, cap), 0, -1) if bs % d == 0)
     else:
         pbs = next(d for d in range(min(bs, 256), 0, -1) if bs % d == 0)

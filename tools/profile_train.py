@@ -1,0 +1,218 @@
+"""Training-step profiler: where does the step time go?
+
+Round-4 MFU work (VERDICT r3 Next #1): instead of blind knob-turning, run a
+grid of ablations of the compiled train step ON the real chip and record the
+deltas. Each experiment runs in its OWN subprocess (device memory accumulates
+across engines in one tunneled-TPU process — same isolation bench.py uses);
+the parent never imports jax.
+
+Usage:
+    python tools/profile_train.py            # run the default grid
+    python tools/profile_train.py --exp NAME # run one experiment (subprocess)
+
+Results append to profiles/r04_results.jsonl; a profiler trace (when the
+`trace` experiment runs) lands in profiles/r04_trace/.
+
+Ablation axes:
+  mode   step (full engine train_batch) | grad (value_and_grad only) |
+         fwd (loss only)
+  loss   xent8/xent16/xent32 (chunked fused LM xent, N chunks) |
+         none (hidden-mean loss — isolates the unembed+xent cost)
+  model  gpt124 (bench flagship) | large710 (hidden 2048, D=128 heads,
+         seq-2k class — the honest-arithmetic-intensity config)
+  policy remat policy string (gpt2.py remat_policy)
+  impl   flash | xla attention
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "profiles", "r04_results.jsonl")
+
+# name -> overrides
+EXPERIMENTS = {
+    # baseline repro + decomposition
+    "base":        dict(),
+    "grad_only":   dict(mode="grad"),
+    "fwd_only":    dict(mode="fwd"),
+    "no_xent":     dict(loss="none"),
+    "xent32":      dict(loss="xent32"),
+    "xla_attn":    dict(impl="xla"),
+    # finer remat: save mlp_pre_act too -> backward recomputes only
+    # LN/gelu/flash, no repeated matmuls
+    "save_mlp128": dict(policy="save:qkv,attn_out,mlp_pre_act"),
+    "save_mlp96":  dict(policy="save:qkv,attn_out,mlp_pre_act", micro=96),
+    "save_mlp64":  dict(policy="save:qkv,attn_out,mlp_pre_act", micro=64),
+    # honest-arithmetic-intensity model: hidden 2048, head_dim 128, seq 2048
+    "big_qkv8":    dict(model="large710", seq=2048, micro=8),
+    "big_full8":   dict(model="large710", seq=2048, micro=8, policy="full"),
+    "big_save4":   dict(model="large710", seq=2048, micro=4,
+                        policy="save:qkv,attn_out,mlp_pre_act"),
+    "big_save8":   dict(model="large710", seq=2048, micro=8,
+                        policy="save:qkv,attn_out,mlp_pre_act"),
+    # device trace of the baseline (may fail over the tunnel; isolated)
+    "trace":       dict(trace=1, steps=3),
+}
+
+DEFAULTS = dict(mode="step", loss="xent8", model="gpt124", policy="qkv_out",
+                impl="flash", micro=128, seq=512, steps=8, trace=0)
+
+
+def run_one(exp: str):
+    cfg = {**DEFAULTS, **EXPERIMENTS[exp]}
+    sys.path.insert(0, REPO)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2
+
+    seq, micro = cfg["seq"], cfg["micro"]
+    if cfg["model"] == "gpt124":
+        mcfg = GPT2Config(vocab_size=50304, max_seq_len=seq + 1,
+                          num_layers=12, num_heads=12, hidden_size=768,
+                          remat=cfg["policy"] != "none",
+                          remat_policy=cfg["policy"],
+                          attention_impl=cfg["impl"])
+    elif cfg["model"] == "large710":
+        mcfg = GPT2Config(vocab_size=50304, max_seq_len=seq + 1,
+                          num_layers=12, num_heads=16, hidden_size=2048,
+                          remat=cfg["policy"] != "none",
+                          remat_policy=cfg["policy"],
+                          attention_impl=cfg["impl"])
+    else:
+        raise ValueError(cfg["model"])
+
+    model = GPT2(mcfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((2, 16), jnp.int32))["params"]
+    n_params = sum(int(np.prod(np.shape(p)))
+                   for p in jax.tree_util.tree_leaves(params))
+
+    from deepspeed_tpu.models._lm_utils import chunked_lm_xent
+
+    loss_kind = cfg["loss"]
+
+    def loss_fn(p, batch, rng):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        hidden = model.apply({"params": p}, inputs, True, True)
+        if loss_kind == "none":
+            return hidden.astype(jnp.float32).mean()
+        nc = int(loss_kind[4:])
+        return chunked_lm_xent(hidden, p["wte"]["embedding"], targets,
+                               num_chunks=nc)
+
+    rng = np.random.RandomState(0)
+    batch = {"tokens": jnp.asarray(
+        rng.randint(0, 50304, size=(micro, seq + 1)), jnp.int32)}
+
+    mode = cfg["mode"]
+    if mode == "step":
+        import deepspeed_tpu as dstpu
+        engine, _, _, _ = dstpu.initialize(
+            loss_fn=loss_fn, params=params,
+            config={
+                "train_micro_batch_size_per_gpu": micro,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "AdamW",
+                              "params": {"lr": 1e-4, "weight_decay": 0.01}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 0},
+                "gradient_clipping": 1.0,
+                "steps_per_print": 10_000,
+            })
+        step = lambda: engine.train_batch(batch)  # noqa: E731
+    else:
+        from deepspeed_tpu.utils.dtypes import cast_floating
+
+        def fwd(p, b):
+            return loss_fn(cast_floating(p, jnp.bfloat16), b,
+                           jax.random.PRNGKey(0))
+
+        if mode == "fwd":
+            fn = jax.jit(fwd)
+            step = lambda: fn(params, batch)  # noqa: E731
+        else:  # grad
+            gfn = jax.jit(jax.value_and_grad(fwd))
+
+            def step():
+                loss, _g = gfn(params, batch)
+                return loss
+
+    # warmup/compile; float() is the only reliable barrier over the tunnel
+    t0 = time.perf_counter()
+    out = step()
+    first = float(out if not isinstance(out, tuple) else out[0])
+    compile_s = time.perf_counter() - t0
+    out = step()
+    float(out if not isinstance(out, tuple) else out[0])
+
+    tracing = bool(cfg["trace"])
+    if tracing:
+        import jax.profiler
+        tdir = os.path.join(REPO, "profiles", "r04_trace")
+        os.makedirs(tdir, exist_ok=True)
+        jax.profiler.start_trace(tdir)
+
+    steps = int(cfg["steps"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = step()
+    float(out if not isinstance(out, tuple) else out[0])
+    dt = time.perf_counter() - t0
+    if tracing:
+        jax.profiler.stop_trace()
+
+    flops = 6.0 * n_params * micro * seq   # counted (6ND) per step
+    print(json.dumps({
+        "exp": exp, **{k: cfg[k] for k in
+                       ("mode", "loss", "model", "policy", "impl",
+                        "micro", "seq")},
+        "n_params": n_params,
+        "steps": steps,
+        "step_ms": round(1e3 * dt / steps, 2),
+        "tflops_6nd": round(flops * steps / dt / 1e12, 1),
+        "compile_s": round(compile_s, 1),
+        "loss0": first,
+    }))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--exp")
+    ap.add_argument("--grid", default=",".join(EXPERIMENTS))
+    args = ap.parse_args()
+    if args.exp:
+        return run_one(args.exp)
+
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    for exp in args.grid.split(","):
+        if not exp:
+            continue
+        t0 = time.time()
+        # no timeout/kill: interrupting a tunneled TPU client wedges the grant
+        r = subprocess.run([sys.executable, __file__, "--exp", exp],
+                           capture_output=True, text=True)
+        lines = [ln for ln in r.stdout.strip().splitlines()
+                 if ln.startswith("{")]
+        if r.returncode == 0 and lines:
+            rec = json.loads(lines[-1])
+        else:
+            rec = {"exp": exp, "error": f"rc={r.returncode}",
+                   "stderr": r.stderr[-1500:]}
+        rec["wall_s"] = round(time.time() - t0, 1)
+        with open(OUT, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
