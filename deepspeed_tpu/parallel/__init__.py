@@ -3,6 +3,7 @@ from .topology import (Topology, build_mesh, get_topology, set_topology, has_top
                        get_sequence_parallel_world_size, get_expert_parallel_world_size,
                        get_pipe_parallel_world_size)
 from .pipeline import (LayerSpec, TiedLayerSpec, PipelineModule,
-                       partition_layers, pipeline_apply, stack_stage_params)
+                       StackedPipelineModule, partition_layers,
+                       pipeline_apply, stack_stage_params)
 from .ulysses import DistributedAttention, ulysses_attention, sp_cross_entropy
 from .ring_attention import ring_attention

@@ -138,6 +138,14 @@ class ZeroShardingPlan:
             return specs
 
         def m(spec, leaf):
+            # leaves already pipe-sharded by the module itself (e.g.
+            # StackedPipelineModule's [L]-stacked blocks / vocab-sharded
+            # embedding arrive via tp_specs) keep their placement — merging
+            # pipe twice would be an invalid double use of the axis
+            for s in tuple(spec):
+                names = s if isinstance(s, tuple) else (s,)
+                if any(n in self.pipe_axes for n in names if n):
+                    return spec
             return _merge_axes_into_spec(
                 spec if tuple(spec) else None, tuple(np.shape(leaf)),
                 self.pipe_axes, self.n_pipe)
