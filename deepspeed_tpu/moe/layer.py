@@ -111,9 +111,16 @@ class MoE(nn.Module):
     expert_tensor_parallel: bool = False
     # grouped expert GEMM (sharded_moe.grouped_moe_ffn): dropless sorted
     # ragged_dot dispatch — S*k expert rows instead of S*E. None = auto:
-    # on when tokens aren't dropped and the experts are local (EP/TP keep
-    # the static-capacity a2a dispatch). True/False force.
+    # on when tokens aren't dropped and routing is deterministic; under EP
+    # the grouped path composes with the expert all-to-all
+    # (sharded_moe.grouped_moe_ffn_ep). True/False force.
     use_grouped_gemm: Optional[bool] = None
+    # EP grouped dispatch: per-destination a2a slot rows as a multiple of
+    # the balanced share S*k/ep (the static-shape stand-in for the
+    # reference's dynamic moe_scatter row counts). 1.0 = exactly S*k rows
+    # received per rank, drops under any imbalance; the default 2.0 absorbs
+    # 2x imbalance; ep (== num ranks) never drops.
+    ep_grouped_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -157,12 +164,7 @@ class MoE(nn.Module):
         if grouped is None:
             # stochastic gating (RTS noise / top-2 sampling) stays on the
             # capacity paths — the grouped dispatch routes deterministically
-            grouped = (not self.drop_tokens and ep <= 1 and not tp
-                       and not needs_rng)
-        if grouped and (ep > 1 or tp):
-            raise ValueError(
-                "use_grouped_gemm requires local experts (no EP/experts-TP):"
-                " the a2a dispatch needs static capacity bins")
+            grouped = not self.drop_tokens and not needs_rng
         if grouped and needs_rng:
             raise ValueError(
                 "use_grouped_gemm routes deterministically; disable "
@@ -171,7 +173,39 @@ class MoE(nn.Module):
             raise ValueError(
                 "use_grouped_gemm is dropless (capacity_factor is ignored); "
                 "set drop_tokens=False to opt in explicitly")
-        if grouped:
+        if grouped and (ep > 1 or tp):
+            # grouped GEMM composed with the expert all-to-all (VERDICT r3
+            # #5): route rows to expert-owning ranks, ragged_dot locally
+            # over ~S*k received rows, return — replacing the [S, E, C]
+            # capacity einsum on the distributed path (reference
+            # cutlass_ops/moe_gemm behind moe_scatter/moe_gather)
+            def body_grouped(tokens_local, weights_local):
+                S_loc = tokens_local.shape[0]
+                cap = int(-(-S_loc * self.k // ep)
+                          * float(self.ep_grouped_capacity_factor))
+                logits = tokens_local.astype(jnp.float32) @ wg
+                out, l_aux = sharded_moe.grouped_moe_ffn_ep(
+                    tokens_local, logits, self.k, weights_local, act, dtype,
+                    expert_axis=EXPERT_AXIS, num_experts=E,
+                    capacity_rows=cap,
+                    normalize_weights=self.normalize_weights and self.k > 1,
+                    tp_axis="model" if tp else None)
+                return out, jax.lax.pmean(
+                    jax.lax.pmean(l_aux, EXPERT_AXIS), DATA_AXIS)
+
+            if tp:
+                col = P(EXPERT_AXIS, None, "model")
+                row = P(EXPERT_AXIS, "model", None)
+                wspecs = (col, col, row) if self.gated else (col, row)
+            else:
+                wspecs = jax.tree_util.tree_map(lambda _: P(EXPERT_AXIS),
+                                                weights)
+            out, l_aux = shard_map(
+                body_grouped, mesh=self.ep_mesh,
+                in_specs=(P((DATA_AXIS, EXPERT_AXIS)), wspecs),
+                out_specs=(P((DATA_AXIS, EXPERT_AXIS)), P()),
+                check_vma=False)(tokens, weights)
+        elif grouped:
             out, l_aux = sharded_moe.grouped_moe_ffn(
                 tokens, tokens.astype(jnp.float32) @ wg, self.k, weights,
                 act, dtype,

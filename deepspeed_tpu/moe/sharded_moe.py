@@ -251,3 +251,120 @@ def grouped_moe_ffn(tokens: jnp.ndarray, logits: jnp.ndarray, k: int,
         ce = group_sizes.astype(jnp.float32) / float(S * k)
     l_aux = (me * ce).sum() * E
     return out, l_aux
+
+
+def _grouped_aux_loss(gates: jnp.ndarray, top_idx: jnp.ndarray, k: int,
+                      E: int) -> jnp.ndarray:
+    """The grouped paths' shared l_aux statistic (per-k rule above)."""
+    S = gates.shape[0]
+    me = gates.mean(axis=0)
+    if k <= 2:
+        ce = jnp.bincount(top_idx[:, 0], length=E).astype(jnp.float32) / S
+    else:
+        ce = jnp.bincount(top_idx.reshape(-1),
+                          length=E).astype(jnp.float32) / (S * k)
+    return (me * ce).sum() * E
+
+
+def grouped_moe_ffn_ep(tokens: jnp.ndarray, logits: jnp.ndarray, k: int,
+                       weights_local, activation, dtype,
+                       expert_axis: str, num_experts: int,
+                       capacity_rows: int,
+                       normalize_weights: bool = True,
+                       tp_axis: Optional[str] = None,
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Grouped expert GEMM UNDER expert parallelism (runs inside shard_map
+    with ``expert_axis`` manual).
+
+    TPU-native composition of the reference's grouped MoE GEMM
+    (``inference/v2/kernels/cutlass_ops/moe_gemm/``) with its expert
+    all-to-all (``moe/sharded_moe.py:96`` _AllToAll, ``moe_scatter`` /
+    ``moe_gather``): each rank sorts its S*k routed rows by OWNING RANK,
+    packs them into fixed ``capacity_rows``-sized per-destination slots
+    (static shapes — XLA needs them; rows beyond a slot drop, which at the
+    default slack never fires for balanced routing), exchanges slots with
+    one ``all_to_all``, runs the LOCAL ``jax.lax.ragged_dot`` grouped GEMM
+    over the ~S*k received rows (vs the capacity path's [S, E, C] one-hot
+    einsum memory), and returns results through the inverse all-to-all to
+    scatter-add into their source tokens.
+
+    ``tokens`` [S, M] local rows; ``logits`` [S, E] full-expert router
+    logits; ``weights_local`` this rank's expert stack ([E/ep, ...]); with
+    ``tp_axis`` the hidden dim is additionally model-sharded (column wi /
+    row wo, one psum before the return a2a). Returns (out [S, M], l_aux
+    local — caller pmeans over the mesh).
+    """
+    S, E = logits.shape
+    e_loc = jax.tree_util.tree_leaves(weights_local)[0].shape[0]
+    ep = E // e_loc
+    Cs = int(capacity_rows)
+
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top_vals, top_idx = jax.lax.top_k(logits.astype(jnp.float32), k)
+    if normalize_weights:
+        w_sel = jax.nn.softmax(top_vals, axis=-1)
+    else:
+        w_sel = jnp.take_along_axis(gates, top_idx, axis=-1)
+
+    eid = top_idx.reshape(-1)                       # [S*k] global expert id
+    tok_of = jnp.arange(S * k, dtype=jnp.int32) // k
+    # experts are block-assigned to ranks (owner = eid // e_loc), so a sort
+    # by expert id is also a sort by destination rank
+    order = jnp.argsort(eid, stable=True)
+    eid_s = jnp.take(eid, order)
+    tok_s = jnp.take(tok_of, order)
+    w_s = jnp.take(w_sel.reshape(-1), order)
+    dest_s = eid_s // e_loc
+
+    counts = jnp.bincount(dest_s, length=ep)
+    start = jnp.concatenate([jnp.zeros((1,), counts.dtype),
+                             jnp.cumsum(counts)[:-1]])
+    pos = jnp.arange(S * k, dtype=jnp.int32) - start[dest_s].astype(jnp.int32)
+    keep = pos < Cs
+    # OOB scatter indices DROP in jax — overflow rows vanish here
+    slot = jnp.where(keep, dest_s * Cs + pos, ep * Cs)
+
+    x_rows = jnp.take(tokens, tok_s, axis=0).astype(dtype)
+    send_x = jnp.zeros((ep * Cs, tokens.shape[1]), dtype).at[slot].set(x_rows)
+    # local-expert id at the receiver; e_loc marks an empty slot
+    send_leid = jnp.full((ep * Cs,), e_loc, jnp.int32).at[slot].set(
+        eid_s % e_loc)
+    send_w = jnp.zeros((ep * Cs,), jnp.float32).at[slot].set(w_s)
+    send_tok = jnp.full((ep * Cs,), S, jnp.int32).at[slot].set(tok_s)
+
+    def a2a(v):
+        return jax.lax.all_to_all(
+            v.reshape((ep, Cs) + v.shape[1:]), expert_axis, 0, 0,
+            tiled=False).reshape((ep * Cs,) + v.shape[1:])
+
+    recv_x = a2a(send_x)
+    recv_leid = a2a(send_leid)
+    recv_w = a2a(send_w)
+
+    # local grouped GEMM over received rows, sorted by local expert
+    order2 = jnp.argsort(recv_leid, stable=True)     # empties sort last
+    xs = jnp.take(recv_x, order2, axis=0)
+    gs = jnp.bincount(recv_leid, length=e_loc).astype(jnp.int32)
+    if len(weights_local) == 3:
+        wi_gate, wi_up, wo = weights_local
+        g = jax.lax.ragged_dot(xs, wi_gate.astype(dtype), gs)
+        u = jax.lax.ragged_dot(xs, wi_up.astype(dtype), gs)
+        h = activation(g) * u
+    else:
+        wi, wo = weights_local
+        h = activation(jax.lax.ragged_dot(xs, wi.astype(dtype), gs))
+    ys = jax.lax.ragged_dot(h, wo.astype(dtype), gs)
+    if tp_axis is not None:
+        # row-parallel wo: partial sums over the hidden shards
+        ys = jax.lax.psum(ys, tp_axis)
+    # rows past sum(gs) are unspecified — zero them before the return trip
+    valid = jnp.arange(ep * Cs) < gs.sum()
+    ys = jnp.where(valid[:, None], ys, jnp.zeros_like(ys))
+    inv2 = jnp.argsort(order2, stable=True)
+    ys = jnp.take(ys, inv2, axis=0)
+    ys = ys * recv_w[:, None].astype(dtype)
+
+    back = a2a(ys)                                    # my rows' results
+    out = jnp.zeros_like(tokens, dtype).at[send_tok].add(back)
+
+    return out, _grouped_aux_loss(gates, top_idx, k, E)

@@ -376,3 +376,96 @@ def test_moe_ep_zero2_trains(devices8):
             {"tokens": jnp.asarray(seq, jnp.int32)})))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+# -------------- grouped GEMM under expert parallelism ------------------ #
+
+def test_moe_ep_grouped_matches_capacity(devices8):
+    """VERDICT r3 #5: the grouped (a2a + ragged_dot) EP path must match the
+    EP capacity-einsum path with drop_tokens=False (C=S: nothing dropped)
+    under expert=2, for gated top-2 experts."""
+    topo = build_mesh(MeshConfig(expert=2, data=4))
+    x = jax.random.normal(jax.random.PRNGKey(7), (8, 8, 16), jnp.float32)
+    kw = dict(d_model=16, num_experts=4, k=2, hidden=32,
+              drop_tokens=False, gated=True,
+              top2_2nd_expert_sampling=False, activation=jax.nn.silu,
+              ep_mesh=topo.mesh)
+    ref_layer = MoE(**kw, use_grouped_gemm=False)
+    variables = ref_layer.init(jax.random.PRNGKey(0), x)
+    ref, _ = ref_layer.apply(variables, x)
+    # strict-dropless slot capacity (factor == ep) for exact parity
+    got, _ = MoE(**kw, use_grouped_gemm=True,
+                 ep_grouped_capacity_factor=2.0 * 2).apply(variables, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_moe_ep_grouped_k1_and_auxloss(devices8):
+    """k=1 EP grouped: combine weight is the softmax prob; l_aux matches
+    the capacity path's first-choice statistic."""
+    topo = build_mesh(MeshConfig(expert=2, data=4))
+    x = jax.random.normal(jax.random.PRNGKey(9), (8, 8, 16), jnp.float32)
+    kw = dict(d_model=16, num_experts=4, k=1, hidden=32,
+              drop_tokens=False, gated=False, activation=jax.nn.gelu,
+              ep_mesh=topo.mesh)
+    ref_layer = MoE(**kw, use_grouped_gemm=False)
+    variables = ref_layer.init(jax.random.PRNGKey(0), x)
+    ref, aux_ref = ref_layer.apply(variables, x)
+    got, aux_got = MoE(**kw, use_grouped_gemm=True,
+                       ep_grouped_capacity_factor=4.0).apply(variables, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+    np.testing.assert_allclose(float(aux_got), float(aux_ref), rtol=1e-5)
+
+
+def test_moe_ep_grouped_feeds_ragged_dot(devices8):
+    """The EP grouped path lowers to ragged_dot over the a2a'd rows (not
+    the [S, E, C] capacity einsum)."""
+    topo = build_mesh(MeshConfig(expert=2, data=4))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 4, 16), jnp.float32)
+    layer = MoE(d_model=16, num_experts=4, k=2, hidden=32,
+                drop_tokens=False, gated=True,
+                top2_2nd_expert_sampling=False, activation=jax.nn.silu,
+                ep_mesh=topo.mesh, use_grouped_gemm=True)
+    variables = layer.init(jax.random.PRNGKey(0), x)
+    txt = jax.make_jaxpr(lambda v: layer.apply(v, x))(variables).pretty_print()
+    assert "ragged_dot" in txt
+    assert "all_to_all" in txt
+
+
+def test_moe_ep_grouped_grad_flows(devices8):
+    topo = build_mesh(MeshConfig(expert=2, data=4))
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, 4, 16), jnp.float32)
+    layer = MoE(d_model=16, num_experts=4, k=2, hidden=32,
+                drop_tokens=False, gated=True,
+                top2_2nd_expert_sampling=False, activation=jax.nn.silu,
+                ep_mesh=topo.mesh, use_grouped_gemm=True)
+    variables = layer.init(jax.random.PRNGKey(0), x)
+
+    def loss(v):
+        out, l_aux = layer.apply(v, x)
+        return (out ** 2).mean() + 0.01 * l_aux
+
+    g = jax.jit(jax.grad(loss))(variables)
+    leaves = jax.tree_util.tree_leaves(g)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    # expert weights receive gradient through the a2a round-trip
+    assert float(jnp.abs(g["params"]["wi_gate"]).max()) > 0
+
+
+def test_moe_ep_grouped_with_experts_tp(devices8):
+    """EP x experts-TP: hidden-sharded ragged_dot with one psum before the
+    return a2a must still match the capacity path."""
+    topo = build_mesh(MeshConfig(expert=2, model=2, data=2))
+    x = jax.random.normal(jax.random.PRNGKey(4), (4, 8, 16), jnp.float32)
+    kw = dict(d_model=16, num_experts=4, k=2, hidden=32,
+              drop_tokens=False, gated=True,
+              top2_2nd_expert_sampling=False, activation=jax.nn.silu,
+              ep_mesh=topo.mesh, expert_tensor_parallel=True)
+    ref_layer = MoE(**kw, use_grouped_gemm=False)
+    variables = ref_layer.init(jax.random.PRNGKey(0), x)
+    ref, _ = ref_layer.apply(variables, x)
+    got, _ = MoE(**kw, use_grouped_gemm=True,
+                 ep_grouped_capacity_factor=4.0).apply(variables, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)

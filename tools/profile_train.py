@@ -59,10 +59,25 @@ EXPERIMENTS = {
                         policy="save:qkv,attn_out,mlp_pre_act"),
     # device trace of the baseline (may fail over the tunnel; isolated)
     "trace":       dict(trace=1, steps=3),
+    # round 2 of the grid: bf16 grad accumulation frees ~2.8 GB at the big
+    # shape, which is what the lighter remat policies need to fit
+    "big_fwd":     dict(model="large710", seq=2048, micro=8, mode="fwd"),
+    "big_full8_gb": dict(model="large710", seq=2048, micro=8, policy="full",
+                         gdtype="bfloat16"),
+    "big_qkv4_gb": dict(model="large710", seq=2048, micro=4,
+                        gdtype="bfloat16"),
+    "big_qkv8_gb": dict(model="large710", seq=2048, micro=8,
+                        gdtype="bfloat16"),
+    "big_save4_gb": dict(model="large710", seq=2048, micro=4,
+                         policy="save:qkv,attn_out,mlp_pre_act",
+                         gdtype="bfloat16"),
+    "big_qkv8_x32": dict(model="large710", seq=2048, micro=8,
+                         gdtype="bfloat16", loss="xent32"),
 }
 
 DEFAULTS = dict(mode="step", loss="xent8", model="gpt124", policy="qkv_out",
-                impl="flash", micro=128, seq=512, steps=8, trace=0)
+                impl="flash", micro=128, seq=512, steps=8, trace=0,
+                gdtype="float32")
 
 
 def run_one(exp: str):
@@ -125,6 +140,7 @@ def run_one(exp: str):
                 "optimizer": {"type": "AdamW",
                               "params": {"lr": 1e-4, "weight_decay": 0.01}},
                 "bf16": {"enabled": True},
+                "data_types": {"grad_accum_dtype": cfg["gdtype"]},
                 "zero_optimization": {"stage": 0},
                 "gradient_clipping": 1.0,
                 "steps_per_print": 10_000,
@@ -175,7 +191,7 @@ def run_one(exp: str):
     print(json.dumps({
         "exp": exp, **{k: cfg[k] for k in
                        ("mode", "loss", "model", "policy", "impl",
-                        "micro", "seq")},
+                        "micro", "seq", "gdtype")},
         "n_params": n_params,
         "steps": steps,
         "step_ms": round(1e3 * dt / steps, 2),
