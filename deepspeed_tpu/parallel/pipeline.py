@@ -539,7 +539,12 @@ class StackedPipelineModule:
                  pipe_axis: str = PIPE_AXIS,
                  remat: bool = True,
                  boundary_windows: Optional[Any] = None,
-                 tp_block_specs: Optional[Any] = None):
+                 tp_block_specs: Optional[Any] = None,
+                 aux_weight: float = 0.0):
+        # block_fn may return (h, aux_scalar) — e.g. MoE blocks with a
+        # load-balance loss; the schedule accumulates aux over layers and
+        # valid microbatches and adds aux_weight * mean_aux to the loss
+        self.aux_weight = aux_weight
         self.mesh = mesh
         self.pipe_axis = pipe_axis
         self.num_stages = mesh.shape.get(pipe_axis, 1)
@@ -601,11 +606,39 @@ class StackedPipelineModule:
         the jit boundary); ZeRO merges its data axes on other dims."""
         pipe = self.pipe_axis
 
+        return self._spec_tree(params,
+                               lambda tp: P(pipe, *tuple(tp)))
+
+    def _manual_in_specs(self, params: Any) -> Any:
+        """in_specs for the step's shard_map: ONLY the manual axes (pipe,
+        and expert entries from tp_block_specs — MoE weights stay sharded
+        per expert rank inside the ring); auto-axis (model) shardings ride
+        the arguments' actual placements."""
+        pipe = self.pipe_axis
+        manual = set(self._manual_axes())
+
+        def strip(tp_spec):
+            kept = []
+            for s in tuple(tp_spec):
+                names = s if isinstance(s, tuple) else (s,)
+                kept.append(s if all(n in manual for n in names if n)
+                            and s is not None else None)
+            while kept and kept[-1] is None:
+                kept.pop()
+            return P(pipe, *kept)
+
+        return self._spec_tree(params, strip)
+
+    def _spec_tree(self, params: Any, block_leaf_spec: Callable) -> Any:
+        """One builder for at-rest specs AND shard_map in_specs — they must
+        stay structurally identical (a divergence is a silent reshard at
+        the jit boundary). ``block_leaf_spec(tp_spec) -> P`` maps a
+        tp_block_specs leaf to the block leaf's spec."""
+        pipe = self.pipe_axis
         if self.tp_block_specs is not None:
             blocks = jax.tree_util.tree_map(
-                lambda tp, _leaf: P(pipe, *tuple(tp)),
-                self.tp_block_specs, params["blocks"],
-                is_leaf=lambda x: isinstance(x, P))
+                lambda tp, _l: block_leaf_spec(tp), self.tp_block_specs,
+                params["blocks"], is_leaf=lambda x: isinstance(x, P))
         else:
             blocks = jax.tree_util.tree_map(lambda _: P(pipe),
                                             params["blocks"])
@@ -618,25 +651,24 @@ class StackedPipelineModule:
             specs["embed"]["wpe"] = P()
         return specs
 
-    def _manual_in_specs(self, params: Any) -> Any:
-        """in_specs for the step's shard_map: ONLY the manual axes (pipe);
-        auto-axis (model) shardings ride the arguments' actual placements."""
-        pipe = self.pipe_axis
-        specs = {
-            "embed": {"wte": P(pipe)},
-            "blocks": jax.tree_util.tree_map(lambda _: P(pipe),
-                                             params["blocks"]),
-            "final": jax.tree_util.tree_map(lambda _: P(), params["final"]),
-        }
-        if "wpe" in params["embed"]:
-            specs["embed"]["wpe"] = P()
-        return specs
-
     # ----------------------------- loss ------------------------------- #
 
     def _manual_axes(self):
+        """pipe + the batch-carrying axes. ``expert`` is MANUAL (the
+        reference's expert-data-parallel: EP ranks are carved out of the
+        DP world, so expert ranks hold distinct batch shards and MoE
+        blocks run their a2a over the expert axis directly inside the
+        ring). ``model`` stays automatic (GSPMD TP).
+
+        Batch convention (same as the standalone MoE layer's
+        ``P(("data", "expert"))`` dispatch): expert ranks SUBDIVIDE a data
+        rank's shard, and the engine's batch math counts data axes only —
+        ``train_micro_batch_size_per_gpu`` is per DATA rank, so each
+        (data, expert) device runs micro/ep rows through the dense parts
+        too (no duplicated dense compute). micro/m must divide
+        data x expert."""
         axes = [self.pipe_axis]
-        for a in (DATA_AXIS, "data_inner"):
+        for a in (DATA_AXIS, "data_inner", "expert"):
             if self.mesh.shape.get(a, 1) > 1:
                 axes.append(a)
         return tuple(axes)
@@ -645,7 +677,9 @@ class StackedPipelineModule:
         del rng
         m = self.num_microbatches
         tokens = batch["tokens"]
-        if self.num_stages == 1:
+        if self.num_stages == 1 and self.mesh.shape.get("expert", 1) == 1:
+            # pure-EP meshes (pipe=1, expert>1) still need the shard_map
+            # ring: block_fns bind expert-axis collectives
             return self._sequential_loss(params, tokens)
         micro = tokens.reshape((m, tokens.shape[0] // m) + tokens.shape[1:])
 
@@ -702,13 +736,18 @@ class StackedPipelineModule:
         return (lse - tgt).mean()
 
     def _run_blocks(self, blocks_local, h):
+        """Returns (h, aux_sum) — aux is 0 unless block_fn returns
+        (h, aux) pairs (MoE load-balance losses)."""
         bfn = jax.checkpoint(self.block_fn) if self.remat else self.block_fn
 
         def body(h, bp):
-            return bfn(bp, h), None
+            out = bfn(bp, h)
+            if isinstance(out, tuple):
+                return out[0], out[1].astype(jnp.float32)
+            return out, jnp.zeros((), jnp.float32)
 
-        h, _ = jax.lax.scan(body, h, blocks_local)
-        return h
+        h, auxs = jax.lax.scan(body, h, blocks_local)
+        return h, auxs.sum()
 
     def _sequential_loss(self, params, tokens):
         wte = params["embed"]["wte"]
@@ -717,7 +756,8 @@ class StackedPipelineModule:
         x = jnp.take(wte, inp, axis=0)
         if wpe is not None:
             x = x + wpe[: inp.shape[1]]
-        h = self._run_blocks(params["blocks"], x.astype(self.compute_dtype))
+        h, aux = self._run_blocks(params["blocks"],
+                                  x.astype(self.compute_dtype))
         if self.final_fn is not None:
             h = self.final_fn(params["final"], h)
         logits = jax.lax.dot_general(
@@ -725,7 +765,7 @@ class StackedPipelineModule:
             preferred_element_type=jnp.float32)
         lse = jax.nn.logsumexp(logits, axis=-1)
         t = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
-        return (lse - t).mean()
+        return (lse - t).mean() + self.aux_weight * aux
 
     def _ring(self, params, micro):
         """shard_map body. Every leaf is LOCAL: blocks [L/P, ...], wte
@@ -742,12 +782,16 @@ class StackedPipelineModule:
         total_steps = m + P_ - 1
 
         def step(carry, t):
-            buf_in, loss_acc = carry
+            buf_in, loss_acc, aux_acc = carry
             tok_in = jax.lax.dynamic_index_in_dim(
                 micro, jnp.clip(t, 0, m - 1), keepdims=False)   # [mb, T+1]
             x_emb = self._coop_embed(wte, wpe, tok_in[:, :-1])
             x_in = jnp.where(idx == 0, x_emb, buf_in)
-            h = self._run_blocks(blocks, x_in)
+            h, aux_t = self._run_blocks(blocks, x_in)
+            # stage idx processes microbatch t-idx at step t: gate its aux
+            my_t = t - idx
+            aux_valid = jnp.logical_and(my_t >= 0, my_t <= m - 1)
+            aux_acc = aux_acc + jnp.where(aux_valid, aux_t, 0.0)
             # the LAST stage just finished microbatch t-(P-1): broadcast its
             # output and run the cooperative loss on every rank
             t_out = t - (P_ - 1)
@@ -761,19 +805,23 @@ class StackedPipelineModule:
             loss_acc = loss_acc + jnp.where(valid, loss_t, 0.0)
             buf_next = comm.ppermute(h, perm, axis_name=self.pipe_axis,
                                      log_name="pipe_send_activations")
-            return (buf_next, loss_acc), None
+            return (buf_next, loss_acc, aux_acc), None
 
         carry0 = (jnp.zeros(bshape, self.compute_dtype),
-                  jnp.zeros((), jnp.float32))
+                  jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
         if self.boundary_windows is None:
-            (_, loss_sum), _ = jax.lax.scan(step, carry0,
-                                            jnp.arange(total_steps))
+            (_, loss_sum, aux_sum), _ = jax.lax.scan(step, carry0,
+                                                     jnp.arange(total_steps))
         else:
-            (_, loss_sum) = _windowed_schedule(step, carry0, total_steps,
-                                               self.boundary_windows)
+            (_, loss_sum, aux_sum) = _windowed_schedule(
+                step, carry0, total_steps, self.boundary_windows)
 
         loss = loss_sum / m     # already identical on every pipe rank
-        for a in (DATA_AXIS, "data_inner"):
+        if self.aux_weight:
+            # each stage accumulated its own layers' aux: sum over pipe
+            loss = loss + self.aux_weight * jax.lax.psum(
+                aux_sum, self.pipe_axis) / m
+        for a in (DATA_AXIS, "data_inner", "expert"):
             if self.mesh.shape.get(a, 1) > 1:
                 loss = jax.lax.pmean(loss, a)
         return loss

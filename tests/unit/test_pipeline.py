@@ -513,3 +513,114 @@ def test_pipeline_stacked_tp_no_user_psum(devices8):
                     jax.tree_util.tree_leaves(g_ref)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=1e-5)
+
+
+def _moe_block_fns(E=4, K=2, H=32):
+    """Transformer-ish block with a grouped-EP MoE FFN: the EP variant runs
+    the a2a dispatch over the MANUAL expert axis inside the pipeline ring;
+    the reference variant is the mathematically identical local grouped
+    GEMM (for sequential parity)."""
+    from deepspeed_tpu.moe.sharded_moe import (grouped_moe_ffn,
+                                               grouped_moe_ffn_ep)
+
+    def block_init(rng, h):
+        C = h.shape[-1]
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        return {"mlp_w": 0.1 * jax.random.normal(k1, (C, C), jnp.float32),
+                "gate": 0.1 * jax.random.normal(k2, (C, E), jnp.float32),
+                "wi": 0.1 * jax.random.normal(k3, (E, C, H), jnp.float32),
+                "wo": 0.1 * jax.random.normal(k4, (E, H, C), jnp.float32)}
+
+    def common(bp, h):
+        h = h + jnp.tanh(h @ bp["mlp_w"].astype(h.dtype))
+        tokens = h.reshape(-1, h.shape[-1])
+        logits = tokens.astype(jnp.float32) @ bp["gate"]
+        return h, tokens, logits
+
+    def block_fn_ep(bp, h):
+        h, tokens, logits = common(bp, h)
+        out, aux = grouped_moe_ffn_ep(
+            tokens, logits, K, (bp["wi"], bp["wo"]), jax.nn.gelu, h.dtype,
+            expert_axis="expert", num_experts=E,
+            capacity_rows=tokens.shape[0] * K,   # strictly dropless
+            normalize_weights=True)
+        return h + out.reshape(h.shape), aux
+
+    def block_fn_ref(bp, h):
+        h, tokens, logits = common(bp, h)
+        out, aux = grouped_moe_ffn(tokens, logits, K,
+                                   (bp["wi"], bp["wo"]), jax.nn.gelu,
+                                   h.dtype, normalize_weights=True)
+        return h + out.reshape(h.shape), aux
+
+    from jax.sharding import PartitionSpec as PS
+    tp_specs = {"mlp_w": PS(), "gate": PS(),
+                "wi": PS("expert"), "wo": PS("expert")}
+    return block_init, block_fn_ep, block_fn_ref, tp_specs
+
+
+def test_pipeline_stacked_moe_ep_composed(devices8):
+    """VERDICT r3 #7: ONE train step composing pipe=2 x expert=2 x data=2 —
+    MoE blocks (grouped a2a dispatch over the manual expert axis) inside
+    pipeline stages. Main loss must match the sequential (pipe=1, EP-free)
+    reference exactly; expert weights shard over (pipe, expert) at rest."""
+    bi, bf_ep, bf_ref, tp = _moe_block_fns()
+    topo = build_mesh(MeshConfig(pipe=2, expert=2, data=2))
+    pm = StackedPipelineModule(
+        topo.mesh, 4, num_layers=4, hidden_size=16, vocab_size=64,
+        block_init=bi, block_fn=bf_ep, max_seq_len=32,
+        compute_dtype=jnp.float32, tp_block_specs=tp)
+    batch = _tok_batch(16)
+    params = pm.init(jax.random.PRNGKey(0), batch)
+
+    topo1 = build_mesh(MeshConfig(data=8))
+    pm_ref = StackedPipelineModule(
+        topo1.mesh, 4, num_layers=4, hidden_size=16, vocab_size=64,
+        block_init=bi, block_fn=bf_ref, max_seq_len=32,
+        compute_dtype=jnp.float32)
+
+    l_ep, g_ep = jax.jit(jax.value_and_grad(
+        lambda p: pm.loss_fn(p, batch, None)))(params)
+    l_ref, g_ref = jax.jit(jax.value_and_grad(
+        lambda p: pm_ref.loss_fn(p, batch, None)))(params)
+    np.testing.assert_allclose(float(l_ep), float(l_ref), rtol=1e-5)
+    # grad PARITY through pipe ring + expert a2a (the shard_map transpose:
+    # a2a cotangents + psum'd grads for expert-replicated gate/mlp weights)
+    for a, b in zip(jax.tree_util.tree_leaves(g_ep),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-5)
+    assert float(jnp.abs(g_ep["blocks"]["wi"]).max()) > 0
+
+
+def test_pipeline_stacked_moe_ep_engine_trains(devices8):
+    """pp2 x ep2 x dp2 through Engine.train_batch with ZeRO-1: expert
+    weights sharded (pipe, expert) at rest, loss finite and decreasing,
+    aux loss wired through aux_weight."""
+    bi, bf_ep, _, tp = _moe_block_fns()
+    topo = build_mesh(MeshConfig(pipe=2, expert=2, data=2))
+    pm = StackedPipelineModule(
+        topo.mesh, 4, num_layers=4, hidden_size=16, vocab_size=64,
+        block_init=bi, block_fn=bf_ep, max_seq_len=32,
+        compute_dtype=jnp.float32, tp_block_specs=tp, aux_weight=0.01)
+    batch0 = _tok_batch(16)
+    params = pm.init(jax.random.PRNGKey(0), batch0)
+    engine, _, _, _ = dstpu.initialize(
+        loss_fn=pm.loss_fn, params=params, topology=topo,
+        tp_specs=pm.param_specs(params),
+        config={
+            "train_micro_batch_size_per_gpu": 16,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 1},
+            "gradient_clipping": 1.0,
+            "steps_per_print": 10_000,
+        })
+    wi = engine.state.params["blocks"]["wi"]
+    spec = tuple(wi.sharding.spec)
+    assert "pipe" in str(spec[0]) and "expert" in str(spec[1]), spec
+    B = engine.config.train_batch_size
+    losses = [float(engine.train_batch(b))
+              for b in _pipe_batches(B, steps=8)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
