@@ -87,6 +87,12 @@ class OffloadConfig(ConfigModel):
     buffer_size: int = 100_000_000
     max_in_cpu: int = 1_000_000_000
     ratio: float = 1.0
+    # offload_param only — ZeRO-Infinity IN-STEP streaming (TPU-native
+    # form of partitioned_param_swapper.py): large param leaves live in
+    # pinned_host permanently; the model streams windows through device
+    # memory via runtime.zero.param_stream.streamed_scan. False = the
+    # between-step park (round-3 behavior).
+    stream: bool = False
 
 
 @dataclass

