@@ -193,16 +193,167 @@ def bench_serve():
     }))
 
 
+def bench_serve_fastgen():
+    """FastGen-WORKLOAD serving benchmark (VERDICT r3 #4): Poisson request
+    arrivals, mixed prompt/generation lengths, continuous batching through
+    the ragged engine with evict-then-loop under KV pressure. Reports
+    throughput, TTFT and per-token decode latency percentiles (the
+    SLA-style metrics of blogs/deepspeed-fastgen/README.md:139-169) plus
+    decode-phase HBM bandwidth utilization (the honest roofline for
+    bandwidth-bound decode)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceConfig)
+    from deepspeed_tpu.inference.v2.blocked_allocator import OutOfBlocksError
+    from deepspeed_tpu.inference.v2.sequence import SequenceStatus
+    from deepspeed_tpu.models.llama import Llama, LlamaConfig
+
+    import os
+    if os.environ.get("DSTPU_FG_MODEL") == "tiny":   # CPU smoke-test shape
+        mcfg = LlamaConfig(vocab_size=128, max_seq_len=768, num_layers=2,
+                           num_heads=4, num_kv_heads=2, hidden_size=64,
+                           intermediate_size=128, dtype=jnp.float32)
+    else:
+        mcfg = LlamaConfig(vocab_size=32000, max_seq_len=2048, num_layers=22,
+                           num_heads=32, num_kv_heads=4, hidden_size=2048,
+                           intermediate_size=5632, dtype=jnp.bfloat16)
+    model = Llama(mcfg)
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+    params = jax.tree.map(lambda s: jnp.zeros(s.shape, mcfg.dtype), shapes)
+    n_params = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
+
+    S = int(os.environ.get("DSTPU_FG_SEQS", "128"))
+    MAXLEN = 768
+    N = int(os.environ.get("DSTPU_FG_LOOP", "16"))
+    cfg = RaggedInferenceConfig(
+        max_seqs=S, chunk_size=512, block_size=MAXLEN,
+        num_blocks=S + 4, max_blocks_per_seq=1,
+        decode_loop_steps=N, dtype="bfloat16",
+        attention_impl="paged_flash")
+    eng = InferenceEngineV2(mcfg, params, cfg)
+
+    # workload: Poisson arrivals; prompt/gen length mix (short chat /
+    # medium / long-ish) scaled to the 1.1B single-chip shape
+    rng = np.random.RandomState(0)
+    n_req = int(os.environ.get("DSTPU_FG_REQS", "384"))
+    lam = float(os.environ.get("DSTPU_FG_RATE", "60"))    # req/s offered
+    arr = np.cumsum(rng.exponential(1.0 / lam, size=n_req))
+    plens = rng.choice([128, 256, 512], size=n_req, p=[0.4, 0.4, 0.2])
+    glens = rng.choice([32, 64, 128], size=n_req, p=[0.3, 0.5, 0.2])
+    glens = np.maximum(glens, N)            # budgets are multiples of N
+    prompts = [rng.randint(1, 32000, size=int(p)).tolist() for p in plens]
+
+    kv_row_bytes = 2 * mcfg.num_layers * (mcfg.num_kv_heads *
+                                          (mcfg.hidden_size // mcfg.num_heads)) * 2
+    weight_bytes = 2.0 * n_params
+    HBM_BW = 819e9                          # v5e ~819 GB/s
+
+    # warmup compiles: prefill chunk + fused decode loop
+    w = eng.put([99991, 99992], [prompts[0][:8], prompts[1][:8]],
+                _greedy=True)
+    eng.decode_batch([99991, 99992], [w[99991], w[99992]], N)
+    for u in (99991, 99992):
+        eng.flush(u)
+
+    ttft, tok_lat, done_t = {}, [], {}
+    remaining = {}
+    last_tok = {}
+    queued = list(range(n_req))
+    decoding = []
+    t0 = time.perf_counter()
+    decode_time = 0.0
+    decode_bytes = 0.0
+    decode_tokens = 0
+    while queued or decoding:
+        now = time.perf_counter() - t0
+        # admit arrivals into free slots (prefill in arrival order)
+        admit = []
+        while queued and arr[queued[0]] <= now and \
+                len(decoding) + len(admit) < S and \
+                eng.free_blocks - len(admit) > 0:
+            admit.append(queued.pop(0))
+        if admit:
+            res = eng.put(admit, [prompts[u] for u in admit], _greedy=True)
+            tnow = time.perf_counter() - t0
+            for u in admit:
+                ttft[u] = tnow - arr[u]
+                last_tok[u] = res[u]
+                remaining[u] = int(glens[u]) - 1
+                decoding.append(u)
+        if not decoding:
+            if queued:
+                time.sleep(max(0.0, arr[queued[0]] - (time.perf_counter() - t0)))
+            continue
+        # one fused decode chunk over every decoding sequence
+        lu = [u for u in decoding
+              if eng.state.sequences[u].status is not SequenceStatus.PAUSED]
+        if not lu:
+            eng._try_resume()
+            continue
+        ts = time.perf_counter()
+        try:
+            outs = eng.decode_batch(lu, [last_tok[u] for u in lu], N)
+        except OutOfBlocksError:
+            if not eng._relieve_kv_pressure():
+                raise
+            continue
+        dt = time.perf_counter() - ts
+        decode_time += dt
+        ctx = sum(eng.state.sequences[u].seen_tokens for u in lu)
+        decode_bytes += N * (weight_bytes + ctx * kv_row_bytes)
+        decode_tokens += N * len(lu)
+        tok_lat.append(dt / N)
+        tnow = time.perf_counter() - t0
+        for u in lu:
+            remaining[u] -= N
+            last_tok[u] = outs[u][-1]
+            if remaining[u] <= 0:
+                done_t[u] = tnow
+                eng.flush(u)
+                decoding.remove(u)
+        eng._try_resume()
+    total = time.perf_counter() - t0
+
+    lat = np.array(sorted(tok_lat))
+    gen_total = int(sum(glens))
+    print(json.dumps({
+        "workload": {
+            "requests": n_req, "offered_rate_req_s": lam,
+            "prompt_mix": [128, 256, 512], "gen_mix": [32, 64, 128],
+        },
+        "completed_req_per_sec": round(n_req / total, 2),
+        "output_tokens_per_sec": round(gen_total / total, 1),
+        "decode_tokens_per_sec": round(decode_tokens / decode_time, 1),
+        "ttft_ms_p50": round(1e3 * float(np.median(list(ttft.values()))), 1),
+        "ttft_ms_p95": round(1e3 * float(np.percentile(
+            list(ttft.values()), 95)), 1),
+        "decode_token_latency_ms_p50": round(
+            1e3 * float(lat[len(lat) // 2]), 2),
+        "decode_token_latency_ms_p95": round(
+            1e3 * float(np.percentile(lat, 95)), 2),
+        "decode_hbm_bandwidth_util": round(
+            decode_bytes / decode_time / HBM_BW, 3),
+        "wall_s": round(total, 1),
+    }))
+
+
 def main():
     if sys.argv[1:] == ["train"]:
         return bench_train()
     if sys.argv[1:] == ["serve"]:
         return bench_serve()
+    if sys.argv[1:] == ["fastgen"]:
+        return bench_serve_fastgen()
 
     # orchestrator: NO jax import here — each phase gets the TPU alone.
     # No timeout/kill: interrupting a tunneled TPU client wedges the grant.
     out = {}
-    for phase in ("train", "serve"):
+    for phase in ("train", "serve", "fastgen"):
         r = subprocess.run([sys.executable, __file__, phase],
                            capture_output=True, text=True)
         lines = [ln for ln in r.stdout.strip().splitlines()
@@ -216,6 +367,7 @@ def main():
 
     train = out.get("train", {})
     serve = out.get("serve", {})
+    fastgen = out.get("fastgen", {})
     ref_tflops = 64.0  # BERT-large, 1x V100 (BASELINE.md row 1)
     print(json.dumps({
         "metric": "gpt2_124m_train_samples_per_sec",
@@ -223,7 +375,7 @@ def main():
         "unit": "samples/sec",
         "vs_baseline": round(
             train.get("tflops_per_chip", 0.0) / ref_tflops, 3),
-        "detail": {**train, "serving": serve},
+        "detail": {**train, "serving": serve, "fastgen": fastgen},
     }))
 
 

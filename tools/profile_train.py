@@ -84,6 +84,13 @@ EXPERIMENTS = {
                           gdtype="bfloat16", loss="xentnr32"),
     "big_xla4_nr": dict(model="large710", seq=2048, micro=4, impl="xla",
                         gdtype="bfloat16", loss="xentnr8"),
+    # round 4: probe the OOM boundary between micro 4 and 8, and isolate
+    # the optimizer-update cost at the big shape
+    "big_qkv6_gb": dict(model="large710", seq=2048, micro=6,
+                        gdtype="bfloat16"),
+    "big_grad4":   dict(model="large710", seq=2048, micro=4, mode="grad"),
+    "big_xla6_gb": dict(model="large710", seq=2048, micro=6, impl="xla",
+                        gdtype="bfloat16"),
 }
 
 DEFAULTS = dict(mode="step", loss="xent8", model="gpt124", policy="qkv_out",
