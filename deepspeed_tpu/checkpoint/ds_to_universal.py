@@ -14,10 +14,13 @@ only the checkpoint KEY NAMES are reference-compatible surface, the
 reconstruction below is this framework's own.
 
 Scope: ZeRO stage 1/2 checkpoints (per-rank contiguous fp32 flat
-partitions; stage-2's 2*world alignment honored) at any dp world size, and
-plain module-state checkpoints, with tensor-parallel (mp>1) module states
-merged by shape inference. Stage-3 checkpoints should be consolidated with
-the reference's own ``zero_to_fp32`` first.
+partitions; stage-2's 2*world alignment honored), ZeRO stage-3 checkpoints
+(per-PARAM zip partitioning: every param splits into world ceil-sized
+fragments, one per rank, packed in declaration order — the layout
+``utils/zero_to_fp32.py:_zero3_merge_trainable_params`` documents), and
+plain module-state checkpoints — each at any dp world size and any mp
+degree (per-mp-rank reconstruction, then TP-slice merge; ambiguous merges
+REFUSE with a ``--cat-dim`` escape hatch rather than guessing dim 0).
 
 Output layout (``universal_named``):
 
@@ -85,10 +88,12 @@ def _merge_tp_slices(name: str, slices: List[np.ndarray],
     """Merge one param's mp_rank slices. Equal slices = replicated
     (layernorms, biases of row-parallel layers). Split tensors concatenate
     on: the dim a matching ``cat_dim_rules`` regex names, else the unique
-    dim that reproduces ``full_shape`` when known, else dim 0 WITH a
-    warning — the reference resolves the same ambiguity with per-pattern
-    rules (checkpoint/universal_checkpoint.py load_hp_checkpoint_state);
-    pass ``--cat-dim`` rules for row-parallel (dim-1-split) layers."""
+    dim that reproduces ``full_shape`` when known, else REFUSE — a
+    dim-0 default would produce a wrong-shaped-but-plausible merge for
+    row-parallel layers and corrupt the resume silently. The reference
+    resolves the same ambiguity with per-pattern rules
+    (checkpoint/universal_checkpoint.py load_hp_checkpoint_state); pass
+    ``--cat-dim 'regex=dim'`` for each split layer family."""
     if len(slices) == 1:
         return slices[0]
     first = slices[0]
@@ -103,11 +108,11 @@ def _merge_tp_slices(name: str, slices: List[np.ndarray],
                 if np.concatenate(slices, axis=d).shape == tuple(full_shape)]
         if len(dims) == 1:
             return np.concatenate(slices, axis=dims[0])
-    import warnings
-    warnings.warn(
-        f"{name}: tensor-parallel slices merged on dim 0 by default; pass "
-        f"cat_dim_rules (--cat-dim) if this layer was split on another dim")
-    return np.concatenate(slices, axis=0)
+    raise ValueError(
+        f"{name}: cannot determine the tensor-parallel concat dim "
+        f"(slices {[tuple(s.shape) for s in slices]}); pass "
+        f"--cat-dim '<regex matching this name>=<dim>' — e.g. row-parallel "
+        f"torch Linears split dim 1")
 
 
 def extract_fp32_state(ckpt_dir: str,
@@ -132,27 +137,51 @@ def extract_fp32_state(ckpt_dir: str,
         return {k: _merge_tp_slices(k, v, cat_dim_rules=cat_dim_rules)
                 .astype(np.float32) for k, v in per_name.items()}
 
-    if len(model_files) > 1:
-        raise NotImplementedError(
-            "ZeRO fp32 reconstruction with tensor parallelism (mp>1) is "
-            "not supported here — consolidate per mp rank with the "
-            "reference's zero_to_fp32 first, or convert the module states "
-            "by dropping the zero_pp_rank files")
+    # group zero files by mp rank: each mp rank is an independent ZeRO
+    # world whose flat partitions cover that rank's TP slice of the model;
+    # reconstruct per mp rank, then merge the TP slices
+    by_mp: Dict[int, List[str]] = {}
+    for f in zero_files:
+        mp = int(re.search(r"mp_rank_(\d+)", f).group(1))
+        by_mp.setdefault(mp, []).append(f)
+    mp_states = {}
+    for mf in model_files:
+        mp = int(re.search(r"mp_rank_(\d+)", mf).group(1))
+        mp_states[mp] = _read_pt(os.path.join(ckpt_dir, mf))
+    if sorted(by_mp) != sorted(mp_states):
+        raise ValueError(
+            f"mp ranks mismatch: model states {sorted(mp_states)} vs zero "
+            f"files {sorted(by_mp)}")
 
-    state = _read_pt(os.path.join(ckpt_dir, model_files[0]))
-    if _PARAM_SHAPES not in state:
-        raise KeyError(
-            f"{model_files[0]} lacks '{_PARAM_SHAPES}' — cannot map flat "
-            f"fp32 partitions back to named parameters")
-    # list of {name: shape} dicts, one per optimizer param group
-    param_shapes = state[_PARAM_SHAPES]
+    per_mp: List[Dict[str, np.ndarray]] = []
+    for mp in sorted(by_mp):
+        state = mp_states[mp]
+        if _PARAM_SHAPES not in state:
+            raise KeyError(
+                f"mp_rank_{mp:02d}_model_states lacks '{_PARAM_SHAPES}' — "
+                f"cannot map flat fp32 partitions back to named parameters")
+        per_mp.append(_reconstruct_mp_rank(
+            ckpt_dir, by_mp[mp], state[_PARAM_SHAPES]))
 
+    if len(per_mp) == 1:
+        return per_mp[0]
+    per_name: Dict[str, List[np.ndarray]] = {}
+    for d in per_mp:
+        for k, v in d.items():
+            per_name.setdefault(k, []).append(v)
+    return {k: _merge_tp_slices(k, v, cat_dim_rules=cat_dim_rules)
+            for k, v in per_name.items()}
+
+
+def _reconstruct_mp_rank(ckpt_dir: str, zero_files: List[str],
+                         param_shapes) -> Dict[str, np.ndarray]:
+    """One mp rank's ZeRO world -> {name: fp32 array} (that rank's slice)."""
     rank_sds = [_read_pt(os.path.join(ckpt_dir, f))[_OPT]
                 for f in zero_files]
     stage = int(rank_sds[0].get(_ZERO_STAGE, 1))
     world = rank_sds[0].get(_PARTITION_COUNT, len(zero_files))
     if isinstance(world, (list, tuple)):
-        world = int(world[0])
+        world = int(max(world))
     world = int(world)
     if world != len(zero_files):
         raise ValueError(
@@ -162,6 +191,9 @@ def extract_fp32_state(ckpt_dir: str,
     if flat_key is None:
         raise KeyError(
             f"none of {_FLAT_KEYS} in {zero_files[0]}; unsupported layout")
+
+    if stage >= 3:
+        return _reconstruct_stage3(rank_sds, param_shapes, flat_key, world)
 
     out: Dict[str, np.ndarray] = {}
     for g, shapes in enumerate(param_shapes):
@@ -190,6 +222,51 @@ def extract_fp32_state(ckpt_dir: str,
             n = int(np.prod(shape)) if shape else 1
             out[name] = full[offset:offset + n].reshape(shape)
             offset += n
+    return out
+
+
+def _reconstruct_stage3(rank_sds, param_shapes, flat_key: str,
+                        world: int) -> Dict[str, np.ndarray]:
+    """Stage-3 layout (reference ``extract_zero_shards_stage3``,
+    checkpoint/ds_to_universal.py:152, and ``zero_to_fp32.py``
+    ``_zero3_merge_trainable_params``): parameters partition PER PARAM —
+    every param of U elements splits into ``world`` fragments of
+    ceil(U/world) (last one zero-padded), rank i's flat buffer holding
+    fragment i of each param in declaration order. Reconstruction zips the
+    rank buffers at each param boundary and trims the padding."""
+    # stage-3 sub-group flat tensors concatenate into one buffer per rank
+    flats = []
+    for sd in rank_sds:
+        grp = sd[flat_key]
+        if not isinstance(grp, (list, tuple)):
+            grp = [grp]
+        flats.append(np.concatenate(
+            [_to_np(g).reshape(-1).astype(np.float32) for g in grp]))
+    # param_shapes: list of {name: shape} per group -> one ordered dict
+    if isinstance(param_shapes, dict):
+        shapes = dict(param_shapes)
+    else:
+        shapes = {k: v for d in param_shapes for k, v in d.items()}
+
+    out: Dict[str, np.ndarray] = {}
+    offset = 0
+    for name, shape in shapes.items():
+        shape = tuple(int(x) for x in shape)
+        U = int(np.prod(shape)) if shape else 1
+        pn = -(-U // world)
+        if offset + pn > flats[0].size:
+            raise ValueError(
+                f"{name}: stage-3 fragment [{offset}:{offset + pn}] exceeds "
+                f"rank buffer ({flats[0].size} elements); param_shapes do "
+                f"not match these flat partitions")
+        out[name] = np.concatenate(
+            [f[offset:offset + pn] for f in flats])[:U].reshape(shape)
+        offset += pn
+    if offset != flats[0].size:
+        raise ValueError(
+            f"stage-3 reconstruction consumed {offset} of "
+            f"{flats[0].size} elements per rank — leftover data means "
+            f"param_shapes do not match this checkpoint")
     return out
 
 
