@@ -128,9 +128,14 @@ def bench_serve():
                                             RaggedInferenceConfig)
     from deepspeed_tpu.models.llama import Llama, LlamaConfig
 
+    import os as _os
     # TinyLlama-1.1B shape: a real llama-family architecture with GQA, the
-    # single-chip analogue of the FastGen blog's llama-2 targets
-    mcfg = LlamaConfig(vocab_size=32000, max_seq_len=2048, num_layers=22,
+    # single-chip analogue of the FastGen blog's llama-2 targets.
+    # DSTPU_BENCH_LAYERS: profiling knob (layer sweep isolates per-layer
+    # cost from the fixed unembed/scan cost)
+    mcfg = LlamaConfig(vocab_size=32000, max_seq_len=2048,
+                       num_layers=int(_os.environ.get("DSTPU_BENCH_LAYERS",
+                                                      "22")),
                        num_heads=32, num_kv_heads=4, hidden_size=2048,
                        intermediate_size=5632, dtype=jnp.bfloat16)
     model = Llama(mcfg)

@@ -306,12 +306,14 @@ class InferenceEngineV2:
             top_p=sampling.top_p if not greedy else 1.0,
             eos_id=-1 if eos_token_id is None else int(eos_token_id))
         toks = np.asarray(toks)
-        consumed = np.asarray(consumed)
+        # consumed is None when EOS is disabled: every slot fed all n
+        consumed = np.asarray(consumed) if consumed is not None else None
         self._step_counter += n
         out: Dict[int, List[int]] = {}
         for i, (uid, seq) in enumerate(zip(batch_uids, seqs)):
             # fed first_tokens + generated until eos (or all n)
-            seq.seen_tokens += int(consumed[i])
+            seq.seen_tokens += int(consumed[i]) if consumed is not None \
+                else n
             seq.last_step = self._step_counter
             seq.status = SequenceStatus.WAITING
             out[uid] = toks[i].tolist()

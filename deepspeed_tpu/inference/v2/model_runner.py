@@ -293,45 +293,62 @@ class RaggedRunnerBase:
                 idxs, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
 
         def _decode_loop_ring(params, kv_data, tok0, start, active, tables,
-                              key, temp, top_p, eos_id, *, n, mode, top_k,
-                              cand):
+                              key, *, n, mode, top_k, cand, temp, top_p,
+                              eos_id):
+            # temp/top_p/eos_id are STATIC: they change rarely (per
+            # tokenizer / per sampling profile) and passing them as device
+            # scalars cost tunnel round-trips on every fused-loop call
             from ..quantization import dequantize_tree
             params = dequantize_tree(params)
             S = cfg.max_seqs
             ring = jnp.zeros((n, self.num_layers, 2, S,
                               self.kv_heads * self.head_dim),
                              kv_data.dtype)
+            use_eos = eos_id >= 0
             done0 = jnp.zeros((S,), jnp.bool_)
 
             def body(carry, t):
                 ring, tok, pos, k0, done = carry
-                # per-slot EOS freeze: finished slots stop appending KV
-                # (n_tokens 0 -> trash writes) and keep emitting eos_id;
-                # eos_id = -1 (never a token) disables without recompiling
-                alive = active * (1 - done.astype(jnp.int32))
+                if use_eos:
+                    # per-slot EOS freeze: finished slots stop appending KV
+                    # (n_tokens 0 -> trash writes) and keep emitting eos_id
+                    alive = active * (1 - done.astype(jnp.int32))
+                else:
+                    # keep the prefetch/index chain loop-invariant: with no
+                    # EOS the scheduler state is static per call and XLA
+                    # hoists it out of the scan
+                    alive = active
                 batch = RaggedBatch(tokens=tok[:, None], start_pos=pos,
                                     n_tokens=alive, block_tables=tables)
                 logits, kv_out = type(self).step_fn(
                     params, (kv_data, ring, t, t + 1), batch,
                     model_cfg=model_cfg, cfg=cfg, dtype=dtype)
                 ring = kv_out[1]
-                k0, sub = jax.random.split(k0)
-                nxt = _select_next(logits, sub, temp, top_p,
-                                   mode=mode, top_k=top_k, cand=cand)
-                nxt = jnp.where(done, eos_id.astype(jnp.int32), nxt)
-                new_done = jnp.logical_or(done, nxt == eos_id)
-                pos = pos + (1 - done.astype(jnp.int32))
-                return (ring, nxt, pos, k0, new_done), nxt
+                if mode == "greedy":
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                else:
+                    k0, sub = jax.random.split(k0)
+                    nxt = _select_next(logits, sub, jnp.float32(temp),
+                                       jnp.float32(top_p), mode=mode,
+                                       top_k=top_k, cand=cand)
+                if use_eos:
+                    nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+                    new_pos = pos + (1 - done.astype(jnp.int32))
+                    done = jnp.logical_or(done, nxt == eos_id)
+                else:
+                    new_pos = pos + 1
+                return (ring, nxt, new_pos, k0, done), nxt
 
             (ring, _, pos_f, _, _), toks = jax.lax.scan(
                 body, (ring, tok0, start, key, done0),
                 jnp.arange(n, dtype=jnp.int32))
-            # [S, n] tokens + how many KV positions each slot consumed
-            return jnp.transpose(toks), ring, pos_f - start
+            consumed = (pos_f - start) if use_eos else None
+            return jnp.transpose(toks), ring, consumed
 
         self._decode_loop_ring = jax.jit(
-            _decode_loop_ring, static_argnames=("n", "mode", "top_k",
-                                                "cand"))
+            _decode_loop_ring,
+            static_argnames=("n", "mode", "top_k", "cand", "temp", "top_p",
+                             "eos_id"))
 
         # flush: write the loop's ring rows into the pool. Linear layout
         # (one block per sequence) gets per-sequence dynamic-update-slices
@@ -394,13 +411,15 @@ class RaggedRunnerBase:
         """
         mode = "greedy" if key is None else "sample"
         if key is None:
-            key = jax.random.PRNGKey(0)
+            if not hasattr(self, "_dummy_key"):
+                self._dummy_key = jax.random.PRNGKey(0)  # one transfer ever
+            key = self._dummy_key
         cand = min(candidates, getattr(self.model_cfg, "vocab_size", 1 << 30))
         toks, ring, consumed = self._decode_loop_ring(
-            params, kv_data, tok0, start_pos, active, block_tables,
-            key, jnp.float32(temperature), jnp.float32(top_p),
-            jnp.int32(eos_id), n=n, mode=mode,
-            top_k=int(top_k), cand=int(cand))
+            params, kv_data, tok0, start_pos, active, block_tables, key,
+            n=n, mode=mode, top_k=int(top_k), cand=int(cand),
+            temp=float(temperature), top_p=float(top_p),
+            eos_id=int(eos_id))
         kv_data = self._flush_ring(kv_data, ring, block_tables, start_pos,
                                    active)
         return toks, kv_data, consumed
