@@ -166,10 +166,14 @@ def paged_attention(kv, li, q, k, v, batch: "RaggedBatch",
                 batch.block_tables, batch.start_pos, settled_lens,
                 block_size=bs, sm_scale=scale, alibi_slopes=alibi_slopes,
                 sliding_window=sliding_window, num_kv_heads=KV,
-                # [R, S, KVD] -> [S, R, KVD]: S must sit in an untiled dim
-                # for the kernel's per-sequence BlockSpec slice
-                ring_k=ring[:, li, 0].swapaxes(0, 1),
-                ring_v=ring[:, li, 1].swapaxes(0, 1),
+                # the WHOLE ring and pool ride through: the kernel selects
+                # (layer, k/v) itself — per-layer pool[li, x] slices
+                # materialized full-layer pool copies for the Pallas
+                # operands (the device trace measured them at ~45% of the
+                # decode step), and ring[:, li, x].swapaxes added 44
+                # strided 17 MB transposes
+                ring_full=ring, ring_layer=li,
+                pool_full=pool, pool_layer=li,
                 ring_count=rcount)
         elif impl == "dense":
             y = _dense_ring_attention(
@@ -198,12 +202,14 @@ def paged_attention(kv, li, q, k, v, batch: "RaggedBatch",
         # q joins the pool's storage dtype so the kernel's matmuls stay
         # single-dtype (f32 accumulation inside); the pool itself is NEVER
         # cast or copied — that would re-introduce the full-pool traffic
-        # this kernel exists to avoid
+        # this kernel exists to avoid. pool_full lets the grouped decode
+        # path skip even the per-layer slice (dead code when unused).
         y = flash_paged_attention(
             q.astype(kv.dtype), kv[li, 0], kv[li, 1],
             batch.block_tables, batch.start_pos, seq_lens,
             block_size=bs, sm_scale=scale, alibi_slopes=alibi_slopes,
-            sliding_window=sliding_window, num_kv_heads=KV)
+            sliding_window=sliding_window, num_kv_heads=KV,
+            pool_full=kv, pool_layer=li)
         return kv, y.reshape(S, C, H * D).astype(dtype)
     if impl != "dense":
         raise ValueError(

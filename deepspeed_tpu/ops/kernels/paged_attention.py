@@ -170,9 +170,10 @@ def _paged_kernel(starts_ref, fetch_ref, lo_ref, hi_ref, slopes_ref, *rest,
 
 
 def _decode_grouped_kernel(starts_ref, fetch_ref, lens_ref, rcount_ref,
-                           contig_ref, slopes_ref, q_ref, kp_hbm, vp_hbm,
-                           rk_ref, rv_ref, o_ref, k_scr, v_scr, sems, *, G,
-                           bs, H, KV, D, sm_scale, use_alibi, window, R):
+                           contig_ref, layer_ref, slopes_ref, q_ref,
+                           kp_hbm, vp_hbm, rk_ref, rv_ref, o_ref, k_scr,
+                           v_scr, sems, *, G, bs, H, KV, D, sm_scale,
+                           use_alibi, window, R, ring5d, use_pool_full):
     """Grouped decode: G sequences per grid step (VERDICT r3 #4 decode
     roofline work). The BlockSpec path pays one grid step per (sequence,
     layer) — at S=256 x 22 layers that is ~11k grid steps per decode step,
@@ -186,41 +187,63 @@ def _decode_grouped_kernel(starts_ref, fetch_ref, lens_ref, rcount_ref,
     i = pl.program_id(0)
     KVD = KV * D
 
+    if not use_pool_full:
+        def k_src(off, n):
+            return kp_hbm.at[pl.ds(off, n)]
+
+        def v_src(off, n):
+            return vp_hbm.at[pl.ds(off, n)]
+    else:
+        # the WHOLE [L, 2, slots, KVD] pool rides into the kernel and the
+        # layer index lands here, in the DMA source — slicing pool[li, 0/1]
+        # at the model level materialized a full per-layer pool copy for
+        # the Pallas operand (the device trace measured those copies at
+        # ~45 % of the decode step). The layer arrives via SCALAR PREFETCH
+        # (layer_ref), not as a Python constant: all layers then share ONE
+        # Mosaic binary instead of compiling L structurally-identical
+        # kernels.
+        def k_src(off, n):
+            return kp_hbm.at[layer_ref[0], 0, pl.ds(off, n)]
+
+        def v_src(off, n):
+            return kp_hbm.at[layer_ref[0], 1, pl.ds(off, n)]
+
     @pl.when(contig_ref[i] == 1)
     def _copy_contig():
         off = fetch_ref[i * G] * bs
-        pltpu.make_async_copy(kp_hbm.at[pl.ds(off, G * bs)], k_scr,
-                              sems.at[0]).start()
-        pltpu.make_async_copy(vp_hbm.at[pl.ds(off, G * bs)], v_scr,
-                              sems.at[1]).start()
-        pltpu.make_async_copy(kp_hbm.at[pl.ds(off, G * bs)], k_scr,
-                              sems.at[0]).wait()
-        pltpu.make_async_copy(vp_hbm.at[pl.ds(off, G * bs)], v_scr,
-                              sems.at[1]).wait()
+        pltpu.make_async_copy(k_src(off, G * bs), k_scr, sems.at[0]).start()
+        pltpu.make_async_copy(v_src(off, G * bs), v_scr, sems.at[1]).start()
+        pltpu.make_async_copy(k_src(off, G * bs), k_scr, sems.at[0]).wait()
+        pltpu.make_async_copy(v_src(off, G * bs), v_scr, sems.at[1]).wait()
 
     @pl.when(contig_ref[i] == 0)
     def _copy_scattered():
         for g in range(G):
             off = fetch_ref[i * G + g] * bs
             pltpu.make_async_copy(
-                kp_hbm.at[pl.ds(off, bs)], k_scr.at[pl.ds(g * bs, bs)],
+                k_src(off, bs), k_scr.at[pl.ds(g * bs, bs)],
                 sems.at[2 * g]).start()
             pltpu.make_async_copy(
-                vp_hbm.at[pl.ds(off, bs)], v_scr.at[pl.ds(g * bs, bs)],
+                v_src(off, bs), v_scr.at[pl.ds(g * bs, bs)],
                 sems.at[2 * g + 1]).start()
         for g in range(G):
             off = fetch_ref[i * G + g] * bs
             pltpu.make_async_copy(
-                kp_hbm.at[pl.ds(off, bs)], k_scr.at[pl.ds(g * bs, bs)],
+                k_src(off, bs), k_scr.at[pl.ds(g * bs, bs)],
                 sems.at[2 * g]).wait()
             pltpu.make_async_copy(
-                vp_hbm.at[pl.ds(off, bs)], v_scr.at[pl.ds(g * bs, bs)],
+                v_src(off, bs), v_scr.at[pl.ds(g * bs, bs)],
                 sems.at[2 * g + 1]).wait()
 
     # scores per sequence (the matmuls are irreducibly [H, ...] slivers),
     # but ONE batched softmax over the whole group's [G*H, bs(+R)] rows —
     # the per-seq VPU passes (iota/mask/exp/sum), not the DMAs, were the
     # measured wall of the per-seq variant
+    def ring_plane(ref, g):
+        # ring5d: ref block is [R, 1, 1, G, KVD] (the full decode-loop
+        # carry, layer/kv planes picked by the BlockSpec) -> [R, KVD]
+        return ref[:, 0, 0, g] if ring5d else ref[g]
+
     parts = []
     rparts = []
     for g in range(G):
@@ -231,7 +254,7 @@ def _decode_grouped_kernel(starts_ref, fetch_ref, lens_ref, rcount_ref,
             preferred_element_type=jnp.float32))       # [H, bs]
         if R is not None:
             rparts.append(jax.lax.dot_general(
-                q, rk_ref[g], (((1,), (1,)), ((), ())),
+                q, ring_plane(rk_ref, g), (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32))   # [H, R]
     sc = jnp.concatenate(parts, axis=0) * sm_scale     # [G*H, bs]
 
@@ -280,8 +303,9 @@ def _decode_grouped_kernel(starts_ref, fetch_ref, lens_ref, rcount_ref,
             p[rows, :bs].astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # [H, KVD]
         if R is not None:
+            rvb = ring_plane(rv_ref, g)
             pv = pv + jax.lax.dot_general(
-                p[rows, bs:].astype(rv_ref.dtype), rv_ref[g],
+                p[rows, bs:].astype(rvb.dtype), rvb,
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
         o_ref[g] = (pv / l_safe[rows]).astype(o_ref.dtype)
@@ -289,11 +313,14 @@ def _decode_grouped_kernel(starts_ref, fetch_ref, lens_ref, rcount_ref,
 
 def _flash_decode_grouped(qw, kp_flat, vp_flat, fetch, start_pos, seq_lens,
                           *, bs, H, KV, D, sm_scale, slopes, use_alibi,
-                          window, ring_k, ring_v, ring_count, out_dtype,
+                          window, ring_k, ring_v, ring_full, ring_layer,
+                          ring_count, pool_full, pool_layer, out_dtype,
                           interpret):
     """Grouped-decode dispatch: qw [S, H, KV*D] lane-windowed; whole
     contexts (linear layout, one block per sequence) stream via manual
-    DMA, G sequences per grid step."""
+    DMA, G sequences per grid step. The decode-loop ring arrives as the
+    FULL [R, L, 2, S, KVD] carry — the BlockSpec picks this layer's k/v
+    planes, so no per-layer slice/transpose ever materializes in HBM."""
     S = qw.shape[0]
     KVD = KV * D
     itemsize = kp_flat.dtype.itemsize
@@ -302,19 +329,70 @@ def _flash_decode_grouped(qw, kp_flat, vp_flat, fetch, start_pos, seq_lens,
     G = max(1, min(8, budget // max(1, 2 * bs * KVD * itemsize)))
     while S % G:
         G -= 1
-    R = ring_k.shape[1] if ring_k is not None else None
+    if ring_full is not None:
+        R = ring_full.shape[0]
+        ring5d = True
+    elif ring_k is not None:
+        R = ring_k.shape[1]
+        ring5d = False
+    else:
+        R = None
+        ring5d = False
 
+    use_pool_full = pool_full is not None and pool_layer is not None
+    if use_pool_full:
+        if pool_full.ndim != 4 or pool_full.shape[1] != 2 \
+                or pool_full.shape[3] != KVD:
+            raise ValueError(
+                f"pool_full must be [L, 2, slots, {KVD}], got "
+                f"{pool_full.shape}")
+        if not 0 <= int(pool_layer) < pool_full.shape[0]:
+            raise ValueError(
+                f"pool_layer {pool_layer} out of range for L = "
+                f"{pool_full.shape[0]}")
+    if ring5d:
+        if ring_full.ndim != 5 or ring_full.shape[2] != 2:
+            raise ValueError(
+                f"ring_full must be [R, L, 2, S, KVD], got "
+                f"{ring_full.shape}")
+        if not 0 <= int(ring_layer) < ring_full.shape[1]:
+            raise ValueError(
+                f"ring_layer {ring_layer} out of range for L = "
+                f"{ring_full.shape[1]}")
+        pool_dtype = (pool_full.dtype if use_pool_full else kp_flat.dtype)
+        if ring_full.dtype != pool_dtype:
+            raise ValueError(
+                f"ring_full dtype {ring_full.dtype} != pool dtype "
+                f"{pool_dtype} (the grouped kernel does not cast the "
+                f"full ring — allocate it in the pool's dtype)")
     kernel = functools.partial(
         _decode_grouped_kernel, G=G, bs=bs, H=H, KV=KV, D=D,
-        sm_scale=float(sm_scale), use_alibi=use_alibi, window=window, R=R)
+        sm_scale=float(sm_scale), use_alibi=use_alibi, window=window, R=R,
+        ring5d=ring5d, use_pool_full=use_pool_full)
 
     in_specs = [
         pl.BlockSpec((G, H, KVD), lambda i, *_: (i, 0, 0)),
         pl.BlockSpec(memory_space=pl.ANY),
         pl.BlockSpec(memory_space=pl.ANY),
     ]
-    operands = [qw.reshape(S, H, KVD), kp_flat, vp_flat]
-    if R is not None:
+    if use_pool_full:
+        # the un-sliced [L, 2, slots, KVD] pool; the layer offset lives in
+        # the kernel's DMA source (vp operand is a placeholder)
+        operands = [qw.reshape(S, H, KVD), pool_full,
+                    jnp.zeros((8, _LANES), pool_full.dtype)]
+    else:
+        operands = [qw.reshape(S, H, KVD), kp_flat, vp_flat]
+    if ring5d:
+        # the layer index comes from scalar prefetch (refs[5]) so the ring
+        # index maps — like the pool DMA source — stay layer-invariant and
+        # every layer shares one compiled kernel
+        rk_spec = pl.BlockSpec(
+            (R, 1, 1, G, KVD), lambda i, *refs: (0, refs[5][0], 0, i, 0))
+        rv_spec = pl.BlockSpec(
+            (R, 1, 1, G, KVD), lambda i, *refs: (0, refs[5][0], 1, i, 0))
+        in_specs += [rk_spec, rv_spec]
+        operands += [ring_full, ring_full]
+    elif R is not None:
         ring_spec = pl.BlockSpec((G, R, KVD), lambda i, *_: (i, 0, 0))
         in_specs += [ring_spec, ring_spec]
         operands += [ring_k.astype(kp_flat.dtype),
@@ -324,10 +402,6 @@ def _flash_decode_grouped(qw, kp_flat, vp_flat, fetch, start_pos, seq_lens,
         z = jnp.zeros((S, 8, KVD), kp_flat.dtype)
         in_specs += [pl.BlockSpec((G, 8, KVD), lambda i, *_: (i, 0, 0))] * 2
         operands += [z, z]
-        kernel = functools.partial(
-            _decode_grouped_kernel, G=G, bs=bs, H=H, KV=KV, D=D,
-            sm_scale=float(sm_scale), use_alibi=use_alibi, window=window,
-            R=None)
 
     # host-side run check: a group whose G block ids are consecutive takes
     # the single-DMA fast path in the kernel
@@ -336,22 +410,28 @@ def _flash_decode_grouped(qw, kp_flat, vp_flat, fetch, start_pos, seq_lens,
         fg == fg[:, :1] + jnp.arange(G, dtype=jnp.int32)[None, :],
         axis=1).astype(jnp.int32)
 
+    scr_dtype = pool_full.dtype if use_pool_full else kp_flat.dtype
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=6,
+        num_scalar_prefetch=7,
         grid=(S // G,),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((G, H, KVD), lambda i, *_: (i, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((G * bs, KVD), kp_flat.dtype),
-            pltpu.VMEM((G * bs, KVD), vp_flat.dtype),
+            pltpu.VMEM((G * bs, KVD), scr_dtype),
+            pltpu.VMEM((G * bs, KVD), scr_dtype),
             pltpu.SemaphoreType.DMA((2 * G,)),
         ],
     )
+    layer_idx = int(pool_layer) if use_pool_full else (
+        int(ring_layer) if ring5d else 0)
+    if use_pool_full and ring5d and int(pool_layer) != int(ring_layer):
+        raise ValueError("pool_layer and ring_layer must match (one layer "
+                         "index drives both prefetch-indexed operands)")
     prefetch = [start_pos.astype(jnp.int32), fetch.astype(jnp.int32),
                 seq_lens.astype(jnp.int32),
                 (jnp.reshape(ring_count, (1,)).astype(jnp.int32)
                  if ring_count is not None else jnp.zeros((1,), jnp.int32)),
-                contig, slopes]
+                contig, jnp.full((1,), layer_idx, jnp.int32), slopes]
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -373,6 +453,10 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                           ring_k: Optional[jnp.ndarray] = None,
                           ring_v: Optional[jnp.ndarray] = None,
                           ring_count: Optional[jnp.ndarray] = None,
+                          ring_full: Optional[jnp.ndarray] = None,
+                          ring_layer: int = 0,
+                          pool_full: Optional[jnp.ndarray] = None,
+                          pool_layer: Optional[int] = None,
                           num_kv_heads: Optional[int] = None,
                           interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over paged KV.
@@ -391,6 +475,19 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         which emits zeros). In ring mode this EXCLUDES the ring tokens.
       ring_k/ring_v: optional [S, R, KV*D] decode-loop ring buffers;
         ring_count: tokens valid in the ring.
+      ring_full/ring_layer: the PREFERRED ring form — the full
+        [R, L, 2, S, KV*D] decode-loop carry plus this call's (static)
+        layer index; the grouped decode path selects the layer/kv planes
+        in its BlockSpec, so no per-layer slice/transpose materializes.
+        Must share the pool's dtype (never cast).
+      pool_full/pool_layer: the PREFERRED pool form for decode — the
+        un-sliced [L, 2, slots, KV*D] pool plus the layer index; the
+        grouped path indexes the layer inside its DMA source (a
+        model-level pool[layer, 0/1] slice materializes a full per-layer
+        pool copy for the Pallas operand). When both full forms are given
+        the two layer indices must match. k_pool/v_pool remain required
+        (shape probing + the multi-block fallback path; dead code under
+        jit when the grouped path runs).
       alibi_slopes: optional [H] f32 — in-kernel ALiBi bias (falcon/bloom).
 
     Returns [S, C, H, D] attention outputs in q.dtype. HBM traffic per
@@ -476,12 +573,19 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     slopes = (jnp.asarray(alibi_slopes, jnp.float32) if use_alibi
               else jnp.zeros((H,), jnp.float32))
 
-    has_ring = ring_k is not None
+    # ring_full [R, L, 2, S, KVD] + ring_layer: the kernel's BlockSpec
+    # selects the layer/kv planes itself (the grouped path) — no per-layer
+    # host-side slice/transpose ever materializes. ring_k/ring_v
+    # [S, R, KVD] remain for the legacy per-sequence path.
+    has_ring = ring_k is not None or ring_full is not None
     if has_ring and C != 1:
         raise ValueError("ring decode requires C == 1 (pure decode steps)")
-    if has_ring and ring_k.shape[2] != KVD:
+    if ring_k is not None and ring_k.shape[2] != KVD:
         raise ValueError(f"ring rows must be flat [S, R, {KVD}]")
-    R = ring_k.shape[1] if has_ring else None
+    if ring_full is not None and ring_full.shape[4] != KVD:
+        raise ValueError(f"ring_full must be [R, L, 2, S, {KVD}]")
+    R = (ring_k.shape[1] if ring_k is not None
+         else ring_full.shape[0] if ring_full is not None else None)
 
     windowed = C == 1
     if windowed:
@@ -503,9 +607,10 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                 sm_scale=sm_scale, slopes=slopes, use_alibi=use_alibi,
                 window=(int(sliding_window) if sliding_window is not None
                         else None),
-                ring_k=(ring_k if has_ring else None),
-                ring_v=(ring_v if has_ring else None),
+                ring_k=ring_k, ring_v=ring_v,
+                ring_full=ring_full, ring_layer=int(ring_layer),
                 ring_count=(ring_count if has_ring else None),
+                pool_full=pool_full, pool_layer=pool_layer,
                 out_dtype=q.dtype, interpret=interpret)
             out = out.reshape(S, 1, H, KVD).swapaxes(1, 2)  # [S, H, 1, KVD]
             head_win = (jnp.arange(H) // g)[:, None] * D \
@@ -558,6 +663,11 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     operands = [qw, kp, vp]
     grid = (S, nCb, maxb_v + 1 if has_ring else maxb_v)
     if has_ring:
+        if ring_k is None:
+            # legacy per-sequence path fed from the 5-D ring: materialize
+            # the per-layer planes (the grouped fast path above avoids it)
+            ring_k = jnp.moveaxis(ring_full[:, ring_layer, 0], 0, 1)
+            ring_v = jnp.moveaxis(ring_full[:, ring_layer, 1], 0, 1)
         ring_spec = pl.BlockSpec((1, R, KVD),
                                  lambda s, qc, j, *_: (s, 0, 0))
         in_specs += [ring_spec, ring_spec]
