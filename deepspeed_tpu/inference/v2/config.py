@@ -37,6 +37,15 @@ class RaggedInferenceConfig(ConfigModel):
     # round-trips — the decode wall whenever host<->chip latency is
     # non-trivial. 0/1 disables (every token through put()).
     decode_loop_steps: int = 16
+    # Dynamic-SplitFuse FORWARD budget: total tokens per mixed step
+    # (decode rows always fit; prefill chunks — split mid-chunk if needed
+    # — fill up to this). The actual SplitFuse semantics: a near-constant
+    # forward size regardless of arrival pattern. 0 = max_seqs*chunk_size
+    # (every slot can carry a full chunk — prefill activation memory then
+    # scales with max_seqs, which OOMs big-slot configs). 32768 keeps the
+    # prefill activation transient bounded (~370 MB at llama-1.1B width)
+    # while amortizing per-forward weight reads and host round-trips.
+    max_batch_tokens: int = 32768
 
     def __post_init__(self):
         if self.max_seqs <= 0 or self.chunk_size <= 0:
@@ -50,4 +59,7 @@ class RaggedInferenceConfig(ConfigModel):
 
     @property
     def token_budget(self) -> int:
+        if self.max_batch_tokens and self.max_batch_tokens > 0:
+            return min(self.max_batch_tokens,
+                       self.max_seqs * self.chunk_size)
         return self.max_seqs * self.chunk_size

@@ -329,15 +329,25 @@ class InferenceEngineV2:
         for item in sched:
             item.seq.last_step = self._step_counter
         cfg = self.config
-        S, MAXB = cfg.max_seqs, cfg.max_blocks_per_seq
+        MAXB = cfg.max_blocks_per_seq
         # shape bucketing: a pure-decode step (every scheduled slot carries
         # one token) runs the [S, 1] program instead of padding every slot
         # to chunk_size — chunk_size× fewer wasted positions in the steady
-        # decode state. Two compiled programs total (jit caches by shape);
-        # the reference gets the same effect by flattening tokens into one
-        # ragged array (ragged_wrapper.py), which XLA's static shapes forbid.
+        # decode state. The SLOT dim buckets too (powers of two up to
+        # max_seqs): with the SplitFuse token budget a prefill step carries
+        # ~budget/chunk_size sequences, and padding it to max_seqs slots
+        # made prefill activation memory scale with max_seqs (OOM at
+        # max_seqs >= 384). A handful of compiled programs total (jit
+        # caches by shape); the reference gets the same effect by
+        # flattening tokens into one ragged array (ragged_wrapper.py),
+        # which XLA's static shapes forbid.
         C = 1 if all(len(item.tokens) == 1 for item in sched) \
             else cfg.chunk_size
+        S = cfg.max_seqs
+        for b in (16, 32, 64, 128, 256, 512):
+            if b >= len(sched) and b <= cfg.max_seqs:
+                S = b
+                break
         tokens = np.zeros((S, C), np.int32)
         start = np.zeros((S,), np.int32)
         ntok = np.zeros((S,), np.int32)

@@ -46,10 +46,23 @@ class SplitFuseScheduler:
         prefill = sorted((s for s in pending if s.in_flight > 1),
                          key=lambda s: -s.in_flight)
         out: List[ScheduledSeq] = []
+        # Dynamic-SplitFuse forward budget: decode rows always fit (1 token
+        # each, latency-bound); prefill chunks fill — and SPLIT mid-chunk —
+        # up to the remaining budget, keeping every forward's token count
+        # (and its activation memory) near-constant regardless of how many
+        # slots hold fresh prompts
+        budget = cfg.token_budget
+        used = 0
         for seq in decode + prefill:
             if len(out) == cfg.max_seqs:
                 break
-            n = min(seq.in_flight, cfg.chunk_size)
+            if seq.in_flight == 1:
+                n = 1                          # decode rows are budget-EXEMPT
+            else:
+                n = min(seq.in_flight, cfg.chunk_size,
+                        max(budget - used, 0))
+                if n <= 0:
+                    break                      # prefill budget exhausted
             if not self.state.can_schedule(seq.uid, n):
                 continue                       # KV pressure: leave waiting
             self.state.ensure_blocks(seq, n)
@@ -60,4 +73,6 @@ class SplitFuseScheduler:
                 is_last_chunk=seq.in_flight == 0))
             seq.seen_tokens += n
             seq.status = SequenceStatus.RUNNING
+            if n > 1:
+                used += n
         return out

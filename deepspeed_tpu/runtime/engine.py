@@ -354,11 +354,22 @@ class Engine:
 
     def _refresh_device_params(self):
         """(ZeRO-Offload) re-derive the device compute-dtype params from the
-        host fp32 master — after init and after checkpoint load."""
+        host fp32 master — after init and after checkpoint load. With param
+        STREAMING composed in (offload_param.stream), leaves above the
+        persistence threshold land in the accelerator host's pinned memory
+        instead of HBM — the model's streamed_scan windows them through
+        device memory during the step, so HBM never holds the full model
+        (the ZeRO-Infinity composition: host optimizer + streamed params)."""
         host = cast_floating(self.state.params, self.compute_dtype)
+        shardings = self.zero_plan.param_shardings(self.state.params)
+        if self._stream_params:
+            from .zero.param_stream import host_sharding
+            thr = self._stream_threshold
+            shardings = jax.tree_util.tree_map(
+                lambda p, s: host_sharding(s) if p.size > thr else s,
+                self.state.params, shardings)
         self._device_params = jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(x, s), host,
-            self.zero_plan.param_shardings(self.state.params))
+            lambda x, s: jax.device_put(x, s), host, shardings)
 
     def _place_state(self, state: TrainState) -> TrainState:
         return jax.tree_util.tree_map(

@@ -43,6 +43,11 @@ def build_cpu_optimizer_step(engine):
     ``engine._device_params`` (compute dtype) living on the device mesh."""
     cfg = engine.config
     gas = engine.gradient_accumulation_steps
+    if engine._stream_params and gas > 1:
+        raise ValueError(
+            "offload_param.stream composed with the CPU optimizer needs "
+            "gradient_accumulation_steps == 1: the in-jit grad accumulator "
+            "would mix device and pinned-host memory spaces")
     fp16 = cfg.fp16.enabled
     clip = float(cfg.gradient_clipping or 0.0)
     compute_dtype = engine.compute_dtype
@@ -123,6 +128,15 @@ def build_cpu_optimizer_step(engine):
     cpu_update = jax.jit(cpu_update) if cfg.compile else cpu_update
 
     param_shardings = engine.zero_plan.param_shardings(engine.state.params)
+    if engine._stream_params:
+        # streamed leaves stay in the accelerator host's pinned memory
+        # across steps — re-uploading them to plain device shardings here
+        # would migrate the full model into HBM from step 2 on
+        from .param_stream import host_sharding
+        thr = engine._stream_threshold
+        param_shardings = jax.tree_util.tree_map(
+            lambda p, s: host_sharding(s) if p.size > thr else s,
+            engine.state.params, param_shardings)
 
     from ..engine import StepMetrics, TrainState    # deferred: avoids cycle
 

@@ -1,0 +1,123 @@
+"""Bigger-than-HBM training proof (ZeRO-Infinity composition, real chip).
+
+Trains a ~2B-param stacked-block LM on ONE 16 GB chip with:
+  * fp32 master params + Adam moments on the HOST (offload_optimizer=cpu,
+    host update program) — 24 GB of optimizer state that never touches HBM,
+  * bf16 compute params PINNED IN HOST MEMORY, streamed through HBM in
+    per-window jax.checkpoint regions during fwd AND bwd
+    (offload_param {device: cpu, stream: true} +
+    runtime.zero.param_stream.streamed_scan).
+
+Total training state = ~36 GB vs 16 GB HBM. The recorded evidence is the
+device allocator's peak_bytes_in_use across 3 steps — it must stay far
+below what resident params+grads+states would need. Reference capability:
+ZeRO-Infinity / partitioned_param_swapper.py ("13B on one 32 GB V100",
+docs/_pages/training.md:302). Writes STREAM_BIGMODEL_r04.json.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.runtime.zero.param_stream import streamed_scan
+
+C = int(os.environ.get("DSTPU_BIG_C", "3072"))
+L = int(os.environ.get("DSTPU_BIG_L", "24"))
+V = int(os.environ.get("DSTPU_BIG_V", "50304"))
+# streams leaves ABOVE this element count: the stacked block weights
+# (hundreds of M elements) stream; the embedding (the persistent-param
+# class — it feeds gathers/the fused xent) stays device-resident
+THR = int(os.environ.get("DSTPU_BIG_THR", "200000000"))
+T = int(os.environ.get("DSTPU_BIG_T", "1024"))
+MICRO = int(os.environ.get("DSTPU_BIG_MICRO", "2"))
+WINDOW = int(os.environ.get("DSTPU_BIG_WINDOW", "2"))
+
+
+def main():
+    cpu = jax.local_devices(backend="cpu")[0]
+    rng = np.random.RandomState(0)
+    with jax.default_device(cpu):
+        params = {
+            "emb": jnp.asarray(rng.randn(V, C) * 0.02, jnp.float32),
+            "blocks": {
+                "w1": jnp.asarray(
+                    rng.randn(L, C, 4 * C).astype(np.float32)
+                    * (0.02 / np.sqrt(C))),
+                "w2": jnp.asarray(
+                    rng.randn(L, 4 * C, C).astype(np.float32)
+                    * (0.02 / np.sqrt(4 * C))),
+            },
+        }
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    state_bytes = n_params * 12 + n_params * 2     # fp32 p+m+v, bf16 copy
+    print(f"params: {n_params / 1e9:.2f}B; training state "
+          f"{state_bytes / (1 << 30):.1f} GiB vs 16 GiB HBM", flush=True)
+
+    def block_fn(bp, h):
+        return h + jax.nn.gelu(h @ bp["w1"]) @ bp["w2"]
+
+    def loss_fn(p, batch, rng_):
+        from deepspeed_tpu.models._lm_utils import chunked_lm_xent
+        tokens = batch["tokens"]
+        inp, tgt = tokens[:, :-1], tokens[:, 1:]
+        h = jnp.take(p["emb"], inp, axis=0).astype(jnp.bfloat16)
+        h, _ = streamed_scan(block_fn, p["blocks"], h, window=WINDOW,
+                             compute_dtype=jnp.bfloat16)
+        return chunked_lm_xent(h, p["emb"], tgt, num_chunks=8)
+
+    engine, _, _, _ = dstpu.initialize(
+        loss_fn=loss_fn, params=params,
+        config={
+            "train_micro_batch_size_per_gpu": MICRO,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {
+                "stage": 3,
+                "stage3_param_persistence_threshold": THR,
+                "offload_optimizer": {"device": "cpu"},
+                "offload_param": {"device": "cpu", "stream": True},
+            },
+            "gradient_clipping": 1.0,
+            "steps_per_print": 1,
+        })
+
+    dev = jax.devices()[0]
+    B = engine.config.train_batch_size
+    batch = {"tokens": jnp.asarray(
+        rng.randint(0, V, size=(B, T + 1)), jnp.int32)}
+    losses = []
+    t0 = time.time()
+    for i in range(3):
+        losses.append(float(engine.train_batch(batch)))
+        print(f"step {i}: loss {losses[-1]:.4f} "
+              f"({time.time() - t0:.0f}s)", flush=True)
+    stats = dev.memory_stats() or {}
+    peak = stats.get("peak_bytes_in_use", 0)
+    rec = {
+        "n_params_b": round(n_params / 1e9, 3),
+        "training_state_gib": round(state_bytes / (1 << 30), 1),
+        "hbm_gib": 16,
+        "device_peak_bytes_in_use_gib": round(peak / (1 << 30), 2),
+        "losses": [round(x, 4) for x in losses],
+        "seq_len": T, "micro": MICRO, "window": WINDOW,
+        "config": "zero3 + offload_optimizer=cpu + offload_param"
+                  "={cpu, stream} (streamed_scan windows)",
+    }
+    with open(os.path.join(REPO, "STREAM_BIGMODEL_r04.json"), "w") as f:
+        json.dump(rec, f, indent=2)
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()
