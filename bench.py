@@ -28,9 +28,13 @@ import time
 HBM_BW = 819e9        # v5e peak HBM bandwidth (bytes/s)
 
 
-def _kv_row_bytes(mcfg):
-    """Per-token KV bytes across all layers (k+v rows in the pool dtype)."""
+def _kv_row_bytes(mcfg, kv_dtype="bfloat16"):
+    """Per-token KV bytes across all layers (k+v rows in the pool dtype;
+    int8 adds the per-(token, kv-head) f32 scale — kv_quant.py)."""
     head_dim = mcfg.hidden_size // mcfg.num_heads
+    if kv_dtype == "int8":
+        return 2 * mcfg.num_layers * (
+            mcfg.num_kv_heads * head_dim + 4 * mcfg.num_kv_heads)
     return 2 * mcfg.num_layers * mcfg.num_kv_heads * head_dim * 2
 
 
@@ -165,6 +169,12 @@ def bench_serve():
     # and the ring decode loop's flush is a per-sequence contiguous DUS.
     bs = int(os.environ.get("DSTPU_BENCH_BLOCK", str(PROMPT + GEN)))
     impl = os.environ.get("DSTPU_BENCH_IMPL", "paged_flash")
+    # int8 KV (kv_quant.py) is the default serving configuration: decode
+    # is KV-bandwidth bound, so halving the pool bytes is the single
+    # biggest decode lever; the JSON labels it and the roofline math
+    # accounts the int8 rows + scales honestly. DSTPU_BENCH_KV=bfloat16
+    # reproduces the round-3 configuration.
+    kv_dtype = os.environ.get("DSTPU_BENCH_KV", "int8")
     blocks_per_seq = (PROMPT + GEN + bs - 1) // bs
     cfg = RaggedInferenceConfig(
         max_seqs=S, chunk_size=PROMPT, block_size=bs,
@@ -173,7 +183,8 @@ def bench_serve():
         # 32-token fused decode chunks measured ~12% faster than 16 (fewer
         # host round-trips); generate() still checks EOS between chunks
         decode_loop_steps=int(os.environ.get("DSTPU_BENCH_LOOP", "32")),
-        dtype="bfloat16", attention_impl=impl)
+        dtype="bfloat16", attention_impl=impl,
+        kv_cache_dtype="int8" if kv_dtype == "int8" else "auto")
     eng = InferenceEngineV2(mcfg, params, cfg)
 
     rng = np.random.RandomState(0)
@@ -217,11 +228,13 @@ def bench_serve():
     # decode is bandwidth-bound: the honest roofline is HBM traffic
     # (weights once per step + every live KV row), not FLOPs
     avg_ctx = PROMPT + GEN / 2
-    bytes_per_step = 2.0 * n_params + S * avg_ctx * _kv_row_bytes(mcfg)
+    bytes_per_step = 2.0 * n_params + S * avg_ctx * _kv_row_bytes(
+        mcfg, kv_dtype)
     steps_per_sec = decode_tps / S
     bw_util = bytes_per_step * steps_per_sec / HBM_BW
     print(json.dumps({
         "model": "llama-1.1B (TinyLlama shape, GQA 32/4)",
+        "kv_cache_dtype": kv_dtype,
         "n_params": n_params,
         "batch_seqs": S,
         "prompt_len": PROMPT,
@@ -280,32 +293,120 @@ def bench_serve_fastgen():
     S = int(os.environ.get("DSTPU_FG_SEQS", "128"))
     MAXLEN = 768
     N = int(os.environ.get("DSTPU_FG_LOOP", "16"))
+    kv_dtype = os.environ.get("DSTPU_FG_KV", "int8")
     cfg = RaggedInferenceConfig(
         max_seqs=S, chunk_size=512, block_size=MAXLEN,
         num_blocks=S + 4, max_blocks_per_seq=1,
         decode_loop_steps=N, dtype="bfloat16",
-        attention_impl="paged_flash")
+        attention_impl=os.environ.get("DSTPU_FG_IMPL", "paged_flash"),
+        kv_cache_dtype="int8" if kv_dtype == "int8" else "auto")
     eng = InferenceEngineV2(mcfg, params, cfg)
 
-    # workload: Poisson arrivals; prompt/gen length mix (short chat /
-    # medium / long-ish) scaled to the 1.1B single-chip shape
-    rng = np.random.RandomState(0)
-    n_req = int(os.environ.get("DSTPU_FG_REQS", "384"))
-    lam = float(os.environ.get("DSTPU_FG_RATE", "24"))    # req/s offered (near capacity: SLA-meaningful latencies; raise for overload stress)
-    arr = np.cumsum(rng.exponential(1.0 / lam, size=n_req))
-    plens = rng.choice([128, 256, 512], size=n_req, p=[0.4, 0.4, 0.2])
-    glens = rng.choice([32, 64, 128], size=n_req, p=[0.3, 0.5, 0.2])
-    glens = np.maximum(glens, N)            # budgets are multiples of N
-    prompts = [rng.randint(1, 32000, size=int(p)).tolist() for p in plens]
-
-    kv_row_bytes = _kv_row_bytes(mcfg)
+    kv_row_bytes = _kv_row_bytes(mcfg, kv_dtype)
     weight_bytes = 2.0 * n_params
+
+    n_req = int(os.environ.get("DSTPU_FG_REQS", "384"))
+
+    def run_load(lam, n_req, seed):
+        """One Poisson-arrival pass at ``lam`` offered req/s; returns the
+        SLA metrics dict. uids are offset by the seed so passes never
+        collide in the engine's sequence table."""
+        rng = np.random.RandomState(seed)
+        base = seed * 1_000_000
+        arr = np.cumsum(rng.exponential(1.0 / lam, size=n_req))
+        plens = rng.choice([128, 256, 512], size=n_req, p=[0.4, 0.4, 0.2])
+        glens = rng.choice([32, 64, 128], size=n_req, p=[0.3, 0.5, 0.2])
+        glens = np.maximum(glens, N)        # budgets are multiples of N
+        prompts = {base + i: rng.randint(1, 32000, size=int(p)).tolist()
+                   for i, p in enumerate(plens)}
+        glen_of = {base + i: int(g) for i, g in enumerate(glens)}
+
+        ttft, tok_lat = {}, []
+        remaining, last_tok = {}, {}
+        queued = [base + i for i in range(n_req)]
+        arr_of = {base + i: arr[i] for i in range(n_req)}
+        decoding = []
+        t0 = time.perf_counter()
+        decode_time = 0.0
+        decode_bytes = 0.0
+        decode_tokens = 0
+        while queued or decoding:
+            now = time.perf_counter() - t0
+            # admit arrivals into free slots (prefill in arrival order)
+            admit = []
+            while queued and arr_of[queued[0]] <= now and \
+                    len(decoding) + len(admit) < S and \
+                    eng.free_blocks - len(admit) > 0:
+                admit.append(queued.pop(0))
+            if admit:
+                res = eng.put(admit, [prompts[u] for u in admit],
+                              _greedy=True)
+                tnow = time.perf_counter() - t0
+                for u in admit:
+                    ttft[u] = tnow - arr_of[u]
+                    last_tok[u] = res[u]
+                    remaining[u] = glen_of[u] - 1
+                    decoding.append(u)
+            if not decoding:
+                if queued:
+                    time.sleep(max(0.0, arr_of[queued[0]]
+                                   - (time.perf_counter() - t0)))
+                continue
+            # one fused decode chunk over every decoding sequence
+            lu = [u for u in decoding
+                  if eng.state.sequences[u].status
+                  is not SequenceStatus.PAUSED]
+            if not lu:
+                eng._try_resume()
+                continue
+            ts = time.perf_counter()
+            try:
+                outs = eng.decode_batch(lu, [last_tok[u] for u in lu], N)
+            except OutOfBlocksError:
+                if not eng._relieve_kv_pressure():
+                    raise
+                continue
+            dt = time.perf_counter() - ts
+            decode_time += dt
+            ctx = sum(eng.state.sequences[u].seen_tokens for u in lu)
+            decode_bytes += N * (weight_bytes + ctx * kv_row_bytes)
+            decode_tokens += N * len(lu)
+            tok_lat.append(dt / N)
+            tnow = time.perf_counter() - t0
+            for u in lu:
+                remaining[u] -= N
+                last_tok[u] = outs[u][-1]
+                if remaining[u] <= 0:
+                    eng.flush(u)
+                    decoding.remove(u)
+            eng._try_resume()
+        total = time.perf_counter() - t0
+
+        lat = np.array(sorted(tok_lat))
+        gen_total = int(sum(glens))
+        return {
+            "offered_rate_req_s": lam,
+            "completed_req_per_sec": round(n_req / total, 2),
+            "output_tokens_per_sec": round(gen_total / total, 1),
+            "decode_tokens_per_sec": round(decode_tokens / decode_time, 1),
+            "ttft_ms_p50": round(
+                1e3 * float(np.median(list(ttft.values()))), 1),
+            "ttft_ms_p95": round(1e3 * float(np.percentile(
+                list(ttft.values()), 95)), 1),
+            "decode_token_latency_ms_p50": round(
+                1e3 * float(lat[len(lat) // 2]), 2),
+            "decode_token_latency_ms_p95": round(
+                1e3 * float(np.percentile(lat, 95)), 2),
+            "decode_hbm_bandwidth_util": round(
+                decode_bytes / decode_time / HBM_BW, 3),
+            "wall_s": round(total, 1),
+        }
 
     # warmup compiles: fused decode loop + the prefill slot-buckets the
     # arrival pattern will hit (admission batches vary in size; bucketed
     # shapes otherwise compile inside the measured TTFT)
-    w = eng.put([99991, 99992], [prompts[0][:8], prompts[1][:8]],
-                _greedy=True)
+    wp = np.random.RandomState(0).randint(1, 32000, size=256).tolist()
+    w = eng.put([99991, 99992], [wp[:8], wp[8:16]], _greedy=True)
     eng.decode_batch([99991, 99992], [w[99991], w[99992]], N)
     for u in (99991, 99992):
         eng.flush(u)
@@ -316,90 +417,27 @@ def bench_serve_fastgen():
             break
         nb = max(3, b - 2)
         wu = list(range(99000, 99000 + nb))
-        eng.put(wu, [prompts[i % n_req][:256] for i in range(nb)],
-                _greedy=True)
+        eng.put(wu, [wp for _ in range(nb)], _greedy=True)
         for u in wu:
             eng.flush(u)
 
-    ttft, tok_lat, done_t = {}, [], {}
-    remaining = {}
-    last_tok = {}
-    queued = list(range(n_req))
-    decoding = []
-    t0 = time.perf_counter()
-    decode_time = 0.0
-    decode_bytes = 0.0
-    decode_tokens = 0
-    while queued or decoding:
-        now = time.perf_counter() - t0
-        # admit arrivals into free slots (prefill in arrival order)
-        admit = []
-        while queued and arr[queued[0]] <= now and \
-                len(decoding) + len(admit) < S and \
-                eng.free_blocks - len(admit) > 0:
-            admit.append(queued.pop(0))
-        if admit:
-            res = eng.put(admit, [prompts[u] for u in admit], _greedy=True)
-            tnow = time.perf_counter() - t0
-            for u in admit:
-                ttft[u] = tnow - arr[u]
-                last_tok[u] = res[u]
-                remaining[u] = int(glens[u]) - 1
-                decoding.append(u)
-        if not decoding:
-            if queued:
-                time.sleep(max(0.0, arr[queued[0]] - (time.perf_counter() - t0)))
-            continue
-        # one fused decode chunk over every decoding sequence
-        lu = [u for u in decoding
-              if eng.state.sequences[u].status is not SequenceStatus.PAUSED]
-        if not lu:
-            eng._try_resume()
-            continue
-        ts = time.perf_counter()
-        try:
-            outs = eng.decode_batch(lu, [last_tok[u] for u in lu], N)
-        except OutOfBlocksError:
-            if not eng._relieve_kv_pressure():
-                raise
-            continue
-        dt = time.perf_counter() - ts
-        decode_time += dt
-        ctx = sum(eng.state.sequences[u].seen_tokens for u in lu)
-        decode_bytes += N * (weight_bytes + ctx * kv_row_bytes)
-        decode_tokens += N * len(lu)
-        tok_lat.append(dt / N)
-        tnow = time.perf_counter() - t0
-        for u in lu:
-            remaining[u] -= N
-            last_tok[u] = outs[u][-1]
-            if remaining[u] <= 0:
-                done_t[u] = tnow
-                eng.flush(u)
-                decoding.remove(u)
-        eng._try_resume()
-    total = time.perf_counter() - t0
-
-    lat = np.array(sorted(tok_lat))
-    gen_total = int(sum(glens))
+    # pass 1 — saturation: offered rate far above capacity measures the
+    # system's sustained completion throughput (TTFT there is queueing
+    # delay, not a service-latency claim). pass 2 — sustainable: 80% of
+    # the measured capacity gives the SLA-meaningful TTFT/latency numbers
+    # (the FastGen blog's regime: throughput at acceptable latency).
+    sat = run_load(float(os.environ.get("DSTPU_FG_RATE", "24")), n_req, 1)
+    sus_rate = float(os.environ.get(
+        "DSTPU_FG_RATE2", str(round(0.8 * sat["completed_req_per_sec"], 2))))
+    sus = run_load(sus_rate, n_req, 2)
     print(json.dumps({
         "workload": {
-            "requests": n_req, "offered_rate_req_s": lam,
+            "requests": n_req,
             "prompt_mix": [128, 256, 512], "gen_mix": [32, 64, 128],
+            "kv_cache_dtype": kv_dtype,
         },
-        "completed_req_per_sec": round(n_req / total, 2),
-        "output_tokens_per_sec": round(gen_total / total, 1),
-        "decode_tokens_per_sec": round(decode_tokens / decode_time, 1),
-        "ttft_ms_p50": round(1e3 * float(np.median(list(ttft.values()))), 1),
-        "ttft_ms_p95": round(1e3 * float(np.percentile(
-            list(ttft.values()), 95)), 1),
-        "decode_token_latency_ms_p50": round(
-            1e3 * float(lat[len(lat) // 2]), 2),
-        "decode_token_latency_ms_p95": round(
-            1e3 * float(np.percentile(lat, 95)), 2),
-        "decode_hbm_bandwidth_util": round(
-            decode_bytes / decode_time / HBM_BW, 3),
-        "wall_s": round(total, 1),
+        "saturation": sat,
+        "sustainable": sus,
     }))
 
 

@@ -23,6 +23,11 @@ class RaggedInferenceConfig(ConfigModel):
     num_blocks: int = 256             # pool size (blocks of block_size tokens)
     max_blocks_per_seq: int = 32      # static width of the block table
     dtype: str = "bfloat16"
+    # KV pool storage dtype. "auto" = the compute dtype. "int8": symmetric
+    # per-(token, kv-head) quantized pool (kv_quant.py) — halves the
+    # decode step's dominant HBM-traffic term and doubles the sequences a
+    # fixed pool holds; scales ride a [L, 2, KV, slots] side array (~3%).
+    kv_cache_dtype: str = "auto"
     # "auto": Pallas paged-flash kernel on TPU (per-step HBM traffic = live
     # blocks only), dense gather elsewhere (interpret-mode Pallas would be a
     # Python-loop per layer per step off-TPU). "paged_flash"/"dense" force.
@@ -52,6 +57,10 @@ class RaggedInferenceConfig(ConfigModel):
             raise ValueError("max_seqs and chunk_size must be positive")
         if self.block_size <= 0 or self.num_blocks <= 0:
             raise ValueError("block_size and num_blocks must be positive")
+        if self.kv_cache_dtype not in ("auto", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be 'auto' or 'int8', got "
+                f"{self.kv_cache_dtype!r}")
 
     @property
     def max_context(self) -> int:

@@ -75,7 +75,7 @@ class InferenceEngineV2:
             self.runner.head_dim, dtype=resolve_dtype(self.config.dtype))
         self.state = StateManager(self.config, self.kv_cache)
         self.scheduler = SplitFuseScheduler(self.config, self.state)
-        self._kv_data = self.kv_cache.data
+        self._kv_data = self.kv_cache.pool
         self._step_counter = 0
         self._sample_key = jax.random.PRNGKey(0)
         log_dist(

@@ -37,8 +37,28 @@ class BlockedKVCache:
         # (8, 128)-tile padded in HBM (4x footprint and DMA traffic for the
         # common KV=4, D=64 layouts); lane-aligned flat rows pad nothing.
         slots = (cfg.num_blocks + 1) * cfg.block_size
-        self.data = jnp.zeros(
-            (num_layers, 2, slots, kv_heads * head_dim), self.dtype)
+        self.quantized = cfg.kv_cache_dtype == "int8"
+        if self.quantized:
+            # int8 rows + per-(token, kv-head) f32 scales TRANSPOSED so a
+            # context window's scales DMA as KV contiguous runs (kv_quant)
+            self.data = jnp.zeros(
+                (num_layers, 2, slots, kv_heads * head_dim), jnp.int8)
+            self.scales = jnp.zeros((num_layers, 2, kv_heads, slots),
+                                    jnp.float32)
+        else:
+            self.data = jnp.zeros(
+                (num_layers, 2, slots, kv_heads * head_dim), self.dtype)
+            self.scales = None
+
+    @property
+    def pool(self):
+        """The threadable pool pytree: a KVPool when quantized (data +
+        scales travel together through the jitted steps), else the raw
+        data array (byte-identical to the pre-int8 path)."""
+        if self.quantized:
+            from .kv_quant import KVPool
+            return KVPool(self.data, self.scales)
+        return self.data
 
     @property
     def free_blocks(self) -> int:
@@ -51,7 +71,10 @@ class BlockedKVCache:
         self.allocator.free(blocks)
 
     def memory_bytes(self) -> int:
-        return self.data.size * self.data.dtype.itemsize
+        n = self.data.size * self.data.dtype.itemsize
+        if self.scales is not None:
+            n += self.scales.size * self.scales.dtype.itemsize
+        return n
 
     # ------------------- host offload / restore ----------------------- #
     # Reference parity: BlockedKVCache.offload/restore
@@ -66,20 +89,32 @@ class BlockedKVCache:
         blocks = np.asarray(list(blocks), np.int32)
         return (blocks[:, None] * bs + np.arange(bs)[None, :]).reshape(-1)
 
-    def offload(self, kv_data: jnp.ndarray, blocks) -> "Any":
+    def offload(self, kv_data, blocks) -> "Any":
         """Gather ``blocks`` of a (functional) kv buffer to host memory.
-        Returns a numpy array [layers, 2, len(blocks)*bs, KV, D]."""
+        Returns a numpy array [layers, 2, len(blocks)*bs, KV*D] — or, for
+        a quantized KVPool, an (int8 rows, f32 scales) pair."""
         import jax
+        from .kv_quant import pool_parts
+        data, scales = pool_parts(kv_data)
         idx = self._slot_indices(blocks)
-        return jax.device_get(kv_data[:, :, idx])
+        if scales is None:
+            return jax.device_get(data[:, :, idx])
+        return (jax.device_get(data[:, :, idx]),
+                jax.device_get(scales[:, :, :, idx]))
 
-    def restore(self, kv_data: jnp.ndarray, host_buf, blocks) -> jnp.ndarray:
+    def restore(self, kv_data, host_buf, blocks):
         """Scatter a host buffer from :meth:`offload` into ``blocks``;
-        returns the updated kv buffer."""
+        returns the updated kv buffer (same pytree type as ``kv_data``)."""
+        from .kv_quant import pool_parts, repack
+        data, scales = pool_parts(kv_data)
         idx = self._slot_indices(blocks)
-        if host_buf.shape[2] != idx.size:
+        host_rows = host_buf[0] if scales is not None else host_buf
+        if host_rows.shape[2] != idx.size:
             raise ValueError(
-                f"restore: buffer holds {host_buf.shape[2]} slots, "
+                f"restore: buffer holds {host_rows.shape[2]} slots, "
                 f"{idx.size} requested")
-        return kv_data.at[:, :, idx].set(
-            jnp.asarray(host_buf, kv_data.dtype))
+        data = data.at[:, :, idx].set(jnp.asarray(host_rows, data.dtype))
+        if scales is not None:
+            scales = scales.at[:, :, :, idx].set(
+                jnp.asarray(host_buf[1], scales.dtype))
+        return repack(kv_data, data, scales)

@@ -1,0 +1,93 @@
+"""Quantized (int8) KV-cache pool support.
+
+Capability analogue of the reference's KV-cache quantization surface
+(``inference/v2/model_implementations/flat_model_helpers.py`` stores KV in
+the model's quantization dtype; the FastGen blog lists KV-block memory as
+the occupancy limiter). On TPU the decode step is HBM-bandwidth bound and
+the KV pool is the dominant term (measured 7.4 GB/step vs 2.2 GB weights at
+the llama-1.1B bench shape — PROFILE.md), so int8 KV halves the dominant
+traffic term AND doubles the sequences a fixed pool can hold.
+
+Design (TPU-first):
+  * pool data stays the flat ``[L, 2, slots, KV*D]`` row layout, in int8;
+  * scales are PER TOKEN-ROW PER KV-HEAD, stored TRANSPOSED as
+    ``[L, 2, KV, slots]`` f32 — 4 bytes per (row, head) = ~3% of the int8
+    row bytes, and the transposed layout means a context window's scales
+    DMA as ``KV`` contiguous runs (a ``[slots, KV]`` layout would be
+    (8,128)-tile padded to 128 lanes in HBM: 512 bytes/row, destroying
+    the win);
+  * kernels never materialize dequantized K/V tiles: K-scales multiply the
+    SCORE columns after the q@k matmul, V-scales multiply the probability
+    columns before the p@v matmul (both exact — the scale is constant
+    along the contracted D axis).
+
+The decode-loop ring buffer stays in the compute dtype (bf16): ring rows
+are the loop's freshest tokens, rewritten every step; they are quantized
+once, at flush time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+
+
+class KVPool(NamedTuple):
+    """KV pool pytree: ``data`` [L, 2, slots, KV*D]; ``scales`` is None for
+    an unquantized pool, else [L, 2, KV, slots] f32 per-row scales."""
+    data: Any
+    scales: Optional[Any] = None
+
+
+class RingKV(NamedTuple):
+    """Fused-decode-loop KV state threaded through the runners: the pool is
+    READ-ONLY; this step's K/V goes into the [R, L, 2, S, KV*D] ring at
+    index ``t`` (see RaggedRunnerBase._decode_loop)."""
+    pool: Any           # KVPool or raw pool array
+    ring: Any
+    t: Any
+    rcount: Any
+
+
+def pool_parts(kv) -> Tuple[Any, Optional[Any]]:
+    """(data, scales) view of a pool that may be a KVPool or a raw array."""
+    if isinstance(kv, KVPool):
+        return kv.data, kv.scales
+    return kv, None
+
+
+def repack(kv, data, scales):
+    """Rebuild the caller's pool type from updated parts."""
+    if isinstance(kv, KVPool):
+        return KVPool(data, scales)
+    return data
+
+
+def quantize_rows(rows: jnp.ndarray, kv_heads: int
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-(row, kv-head) int8 quantization.
+
+    rows: [N, KV*D] float. Returns (q [N, KV*D] int8,
+    scales [KV, N] f32) — scales TRANSPOSED to match the pool's scale
+    layout. Zero rows get scale 1 (dequantize to exact zeros).
+    """
+    n, kvd = rows.shape
+    d = kvd // kv_heads
+    r = rows.reshape(n, kv_heads, d).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(r), axis=2)                    # [N, KV]
+    s = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(r / s[:, :, None]), -127, 127)
+    return q.astype(jnp.int8).reshape(n, kvd), s.T
+
+
+def dequantize_rows(q: jnp.ndarray, scales_t: jnp.ndarray,
+                    dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Inverse of :func:`quantize_rows` (test/debug path only — the
+    kernels scale scores/probabilities instead). q [N, KV*D],
+    scales_t [KV, N] -> [N, KV*D] in ``dtype``."""
+    n, kvd = q.shape
+    kv = scales_t.shape[0]
+    d = kvd // kv
+    r = q.reshape(n, kv, d).astype(jnp.float32) * scales_t.T[:, :, None]
+    return r.reshape(n, kvd).astype(dtype)

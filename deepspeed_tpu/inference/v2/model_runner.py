@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from ...models.gpt2 import GPT2Config
 from .config import RaggedInferenceConfig
+from .kv_quant import KVPool, RingKV, pool_parts, quantize_rows, repack
 
 
 class RaggedBatch(NamedTuple):
@@ -49,12 +50,21 @@ def _layer_norm(x, p, eps=1e-5):   # GPT2Config.layer_norm_eps default
 
 
 def _gather_ctx(pool, li, batch, cfg, S, KV, D, dtype):
-    """[S, max_context, KV, D] context gathered through the block tables."""
+    """[S, max_context, KV, D] context gathered through the block tables.
+    A quantized KVPool is dequantized per gathered row (dense/debug path
+    only — the Pallas kernel scales scores/probabilities instead)."""
+    data, scales = pool_parts(pool)
     bs = cfg.block_size
     j = jnp.arange(cfg.max_context, dtype=jnp.int32)
     ctx_idx = batch.block_tables[:, j // bs] * bs + j % bs
-    k_ctx = pool[li, 0][ctx_idx].reshape(S, -1, KV, D).astype(dtype)
-    v_ctx = pool[li, 1][ctx_idx].reshape(S, -1, KV, D).astype(dtype)
+    k_ctx = data[li, 0][ctx_idx].reshape(S, -1, KV, D)
+    v_ctx = data[li, 1][ctx_idx].reshape(S, -1, KV, D)
+    if scales is None:
+        return k_ctx.astype(dtype), v_ctx.astype(dtype)
+    ks = scales[li, 0].T[ctx_idx]                      # [S, T, KV]
+    vs = scales[li, 1].T[ctx_idx]
+    k_ctx = (k_ctx.astype(jnp.float32) * ks[..., None]).astype(dtype)
+    v_ctx = (v_ctx.astype(jnp.float32) * vs[..., None]).astype(dtype)
     return k_ctx, v_ctx
 
 
@@ -146,23 +156,27 @@ def paged_attention(kv, li, q, k, v, batch: "RaggedBatch",
     if impl == "auto":
         impl = "paged_flash" if jax.default_backend() == "tpu" else "dense"
 
-    ring_mode = isinstance(kv, tuple)
+    ring_mode = isinstance(kv, RingKV)
     if ring_mode:
         pool, ring, t, rcount = kv
+        data, scales = pool_parts(pool)
         # ring[t, li, 0/1] <- this step's K/V: the ring is R-LEADING so the
         # per-step write is a leading-index dynamic-update-slice (in-place
-        # in the scan carry; a trailing index forced a ring copy per layer)
+        # in the scan carry; a trailing index forced a ring copy per layer).
+        # The ring stays UNQUANTIZED (compute dtype) even over an int8
+        # pool — its rows are rewritten every loop and quantized at flush.
         ring = ring.at[t, li, 0].set(
             k.reshape(S, KV * D).astype(ring.dtype))
         ring = ring.at[t, li, 1].set(
             v.reshape(S, KV * D).astype(ring.dtype))
-        kv = (pool, ring, t, rcount)
+        kv = RingKV(pool, ring, t, rcount)
         settled_lens = jnp.where(batch.n_tokens > 0,
                                  batch.start_pos - t, 0)
         if impl == "paged_flash":
             from ...ops.kernels import flash_paged_attention
             y = flash_paged_attention(
-                q.astype(pool.dtype), pool[li, 0], pool[li, 1],
+                q.astype(data.dtype if scales is None else dtype),
+                data[li, 0], data[li, 1],
                 batch.block_tables, batch.start_pos, settled_lens,
                 block_size=bs, sm_scale=scale, alibi_slopes=alibi_slopes,
                 sliding_window=sliding_window, num_kv_heads=KV,
@@ -173,7 +187,8 @@ def paged_attention(kv, li, q, k, v, batch: "RaggedBatch",
                 # decode step), and ring[:, li, x].swapaxes added 44
                 # strided 17 MB transposes
                 ring_full=ring, ring_layer=li,
-                pool_full=pool, pool_layer=li,
+                pool_full=data, pool_layer=li,
+                scales_full=scales,
                 ring_count=rcount)
         elif impl == "dense":
             y = _dense_ring_attention(
@@ -185,15 +200,29 @@ def paged_attention(kv, li, q, k, v, batch: "RaggedBatch",
                 f"got {cfg.attention_impl!r}")
         return kv, y.reshape(S, C, H * D).astype(dtype)
 
-    trash = kv.shape[2] - 1
+    data, scales = pool_parts(kv)
+    trash = data.shape[2] - 1
     blk = jnp.take_along_axis(
         batch.block_tables,
         jnp.minimum(pos // bs, cfg.max_blocks_per_seq - 1), axis=1)
     write_idx = jnp.where(valid_q, blk * bs + pos % bs, trash)
-    kv = kv.at[li, 0, write_idx.reshape(-1)].set(
-        k.reshape(S * C, KV * D).astype(kv.dtype))
-    kv = kv.at[li, 1, write_idx.reshape(-1)].set(
-        v.reshape(S * C, KV * D).astype(kv.dtype))
+    widx = write_idx.reshape(-1)
+    if scales is None:
+        data = data.at[li, 0, widx].set(
+            k.reshape(S * C, KV * D).astype(data.dtype))
+        data = data.at[li, 1, widx].set(
+            v.reshape(S * C, KV * D).astype(data.dtype))
+    else:
+        qk, sk = quantize_rows(k.reshape(S * C, KV * D), KV)
+        qv, sv = quantize_rows(v.reshape(S * C, KV * D), KV)
+        data = data.at[li, 0, widx].set(qk)
+        data = data.at[li, 1, widx].set(qv)
+        # NumPy advanced-indexing: the (li, 0, widx) advanced indices are
+        # separated by the ':' slice, so the indexed dims move FIRST —
+        # the update value is [N, KV], i.e. the scales untransposed
+        scales = scales.at[li, 0, :, widx].set(sk.T)
+        scales = scales.at[li, 1, :, widx].set(sv.T)
+    kv = repack(kv, data, scales)
 
     if impl == "paged_flash":
         from ...ops.kernels import flash_paged_attention
@@ -204,12 +233,15 @@ def paged_attention(kv, li, q, k, v, batch: "RaggedBatch",
         # cast or copied — that would re-introduce the full-pool traffic
         # this kernel exists to avoid. pool_full lets the grouped decode
         # path skip even the per-layer slice (dead code when unused).
+        # Over an int8 pool q stays in the compute dtype; the kernel
+        # scales scores/probabilities by the side-array scales.
         y = flash_paged_attention(
-            q.astype(kv.dtype), kv[li, 0], kv[li, 1],
+            q.astype(data.dtype if scales is None else dtype),
+            data[li, 0], data[li, 1],
             batch.block_tables, batch.start_pos, seq_lens,
             block_size=bs, sm_scale=scale, alibi_slopes=alibi_slopes,
             sliding_window=sliding_window, num_kv_heads=KV,
-            pool_full=kv, pool_layer=li)
+            pool_full=data, pool_layer=li, scales_full=scales)
         return kv, y.reshape(S, C, H * D).astype(dtype)
     if impl != "dense":
         raise ValueError(
@@ -307,9 +339,14 @@ class RaggedRunnerBase:
             from ..quantization import dequantize_tree
             params = dequantize_tree(params)
             S = cfg.max_seqs
+            pool_arr, pool_scales = pool_parts(kv_data)
+            # over an int8 pool the ring stays in the compute dtype: its
+            # rows are the loop's freshest tokens, rewritten every step,
+            # and are quantized once at flush time
             ring = jnp.zeros((n, self.num_layers, 2, S,
                               self.kv_heads * self.head_dim),
-                             kv_data.dtype)
+                             pool_arr.dtype if pool_scales is None
+                             else dtype)
             use_eos = eos_id >= 0
             done0 = jnp.zeros((S,), jnp.bool_)
 
@@ -327,9 +364,9 @@ class RaggedRunnerBase:
                 batch = RaggedBatch(tokens=tok[:, None], start_pos=pos,
                                     n_tokens=alive, block_tables=tables)
                 logits, kv_out = type(self).step_fn(
-                    params, (kv_data, ring, t, t + 1), batch,
+                    params, RingKV(kv_data, ring, t, t + 1), batch,
                     model_cfg=model_cfg, cfg=cfg, dtype=dtype)
-                ring = kv_out[1]
+                ring = kv_out.ring
                 if mode == "greedy":
                     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 else:
@@ -363,9 +400,24 @@ class RaggedRunnerBase:
         def _flush_ring(kv_data, ring, tables, start0, active):
             R, L, _, S, KVD = ring.shape
             bs = cfg.block_size
-            slots = kv_data.shape[2]
+            data, scales = pool_parts(kv_data)
+            slots = data.shape[2]
             trash_off = slots - bs                     # trash block start
             ring_sl = jnp.moveaxis(ring, 0, 3)         # [L, 2, S, R, KVD]
+            if scales is not None:
+                # quantize the loop's rows once, at flush (the ring itself
+                # runs unquantized): per-(token, kv-head) symmetric int8
+                KV = scales.shape[2]
+                q_rows, sc_kv = quantize_rows(
+                    ring_sl.reshape(L * 2 * S * R, KVD), KV)
+                ring_rows = q_rows.reshape(L, 2, S, R, KVD)
+                # scales come back transposed [KV, N]; re-lay to the
+                # pool's [L, 2, KV, <slots window>] ordering
+                sc_t = sc_kv.T.reshape(L, 2, S, R, KV)
+                sc_t = jnp.moveaxis(sc_t, 4, 2)        # [L, 2, KV, S, R]
+            else:
+                ring_rows = ring_sl
+                sc_t = None
             if cfg.max_blocks_per_seq == 1:
                 # the inactive-slot path parks rows at slots - bs; with
                 # R > bs the DUS start would clamp and overwrite the tail
@@ -378,16 +430,23 @@ class RaggedRunnerBase:
                     off = jnp.where(active[i] > 0,
                                     tables[i, 0] * bs + start0[i],
                                     trash_off)
-                    kv_data = jax.lax.dynamic_update_slice(
-                        kv_data, ring_sl[:, :, i], (0, 0, off, 0))
-                return kv_data
+                    data = jax.lax.dynamic_update_slice(
+                        data, ring_rows[:, :, i], (0, 0, off, 0))
+                    if sc_t is not None:
+                        scales = jax.lax.dynamic_update_slice(
+                            scales, sc_t[:, :, :, i], (0, 0, 0, off))
+                return repack(kv_data, data, scales)
             pos = start0[:, None] + jnp.arange(R, dtype=jnp.int32)[None, :]
             blk = jnp.take_along_axis(
                 tables, jnp.minimum(pos // bs, tables.shape[1] - 1), axis=1)
             idx = jnp.where(active[:, None] > 0, blk * bs + pos % bs,
                             slots - 1)
-            rows = ring_sl.reshape(L, 2, S * R, KVD)
-            return kv_data.at[:, :, idx.reshape(-1)].set(rows)
+            data = data.at[:, :, idx.reshape(-1)].set(
+                ring_rows.reshape(L, 2, S * R, KVD))
+            if sc_t is not None:
+                scales = scales.at[:, :, :, idx.reshape(-1)].set(
+                    sc_t.reshape(L, 2, KV, S * R))
+            return repack(kv_data, data, scales)
 
         self._flush_ring = jax.jit(_flush_ring, donate_argnums=(0,))
 

@@ -43,13 +43,23 @@ _LANES = 128
 
 def _paged_kernel(starts_ref, fetch_ref, lo_ref, hi_ref, slopes_ref, *rest,
                   bs, Cb, nCb, H, KV, D, sm_scale, use_alibi, window, R,
-                  windowed):
+                  windowed, quant=False):
     if R is None:
-        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = rest
+        if quant:
+            (q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_scr, l_scr,
+             acc_scr) = rest
+        else:
+            q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = rest
+            ks_ref = vs_ref = None
         rcount_ref = lens_ref = rk_ref = rv_ref = None
     else:
-        (rcount_ref, lens_ref, q_ref, k_ref, v_ref, rk_ref, rv_ref, o_ref,
-         m_scr, l_scr, acc_scr) = rest
+        if quant:
+            (rcount_ref, lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+             rk_ref, rv_ref, o_ref, m_scr, l_scr, acc_scr) = rest
+        else:
+            (rcount_ref, lens_ref, q_ref, k_ref, v_ref, rk_ref, rv_ref,
+             o_ref, m_scr, l_scr, acc_scr) = rest
+            ks_ref = vs_ref = None
     s = pl.program_id(0)
     qc = pl.program_id(1)
     j = pl.program_id(2)
@@ -63,7 +73,7 @@ def _paged_kernel(starts_ref, fetch_ref, lo_ref, hi_ref, slopes_ref, *rest,
         l_scr[:] = jnp.zeros(l_scr.shape, l_scr.dtype)
         acc_scr[:] = jnp.zeros(acc_scr.shape, acc_scr.dtype)
 
-    def _attend(kb, vb, width, mask, dist):
+    def _attend(kb, vb, width, mask, dist, ks=None, vs=None):
         """One online-softmax round over ``width`` columns. kb/vb are
         [width, KV*D] token rows; mask/dist are [H*Cb, width]. Rows are
         head-major (row h*Cb + c <-> head h, tile pos c).
@@ -73,8 +83,22 @@ def _paged_kernel(starts_ref, fetch_ref, lo_ref, hi_ref, slopes_ref, *rest,
         every head — at Cb=1 per-head operands would be single-row MXU
         slivers. grouped (prefill): per-kv-head [g*Cb, D] matmuls against
         64-lane slices of the flat rows — no zero-lane FLOP inflation
-        (windowing would cost KV x the useful MACs, ruinous for MHA)."""
+        (windowing would cost KV x the useful MACs, ruinous for MHA).
+
+        ks/vs ([KV, width], int8 pool only): per-(token, kv-head) dequant
+        scales — K scales multiply score columns, V scales multiply
+        probability columns (exact; constant along the contracted D axis).
+        The ring round passes None (the ring is never quantized)."""
         q = q_ref[0]                  # [H*Cb, KV*D] windowed / [H*Cb, D]
+        if quant and kb.dtype == jnp.int8:
+            kb = kb.astype(q.dtype)
+        g = H // KV
+
+        def _exp_rows(s):
+            """[KV, width] -> [H*Cb, width] head-major row expansion."""
+            return jnp.broadcast_to(
+                s[:, None, :], (KV, g * Cb, width)).reshape(H * Cb, width)
+
         if use_alibi:
             slope_rows = jnp.concatenate(
                 [jnp.full((Cb, 1), slopes_ref[h], jnp.float32)
@@ -83,11 +107,12 @@ def _paged_kernel(starts_ref, fetch_ref, lo_ref, hi_ref, slopes_ref, *rest,
             sc = jax.lax.dot_general(
                 q, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * sm_scale
+            if ks is not None:
+                sc = sc * _exp_rows(ks)
             if use_alibi:
                 sc = sc - slope_rows * dist
             scores = jnp.where(mask, sc, _NEG_INF)     # [HCb, width]
         else:
-            g = H // KV
             parts = []
             for kvh in range(KV):
                 rows = slice(kvh * g * Cb, (kvh + 1) * g * Cb)
@@ -95,6 +120,8 @@ def _paged_kernel(starts_ref, fetch_ref, lo_ref, hi_ref, slopes_ref, *rest,
                 sc = jax.lax.dot_general(
                     q[rows], kh, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32) * sm_scale
+                if ks is not None:
+                    sc = sc * ks[kvh:kvh + 1, :]
                 if use_alibi:
                     sc = sc - slope_rows[rows] * dist[rows]
                 parts.append(jnp.where(mask[rows], sc, _NEG_INF))
@@ -112,12 +139,15 @@ def _paged_kernel(starts_ref, fetch_ref, lo_ref, hi_ref, slopes_ref, *rest,
                               scores - m_safe[:, :1], _NEG_INF))
         l_scr[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         m_scr[:] = m_next
+        if quant and vb.dtype == jnp.int8:
+            vb = vb.astype(q.dtype)
+        if vs is not None:
+            p = p * _exp_rows(vs)
         if windowed:
             pv = jax.lax.dot_general(
                 p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)    # [HCb, KV*D]
         else:
-            g = H // KV
             pv = jnp.concatenate([
                 jax.lax.dot_general(
                     p[kvh * g * Cb:(kvh + 1) * g * Cb].astype(vb.dtype),
@@ -144,7 +174,9 @@ def _paged_kernel(starts_ref, fetch_ref, lo_ref, hi_ref, slopes_ref, *rest,
         if window is not None:                         # mistral sliding window
             causal = jnp.logical_and(causal, col > pos_q - window)
         _attend(k_ref[0], v_ref[0], bs, causal,
-                (pos_q - col).astype(jnp.float32))
+                (pos_q - col).astype(jnp.float32),
+                ks=ks_ref[0] if quant else None,
+                vs=vs_ref[0] if quant else None)
 
     if R is not None:
         # decode-loop ring round: this step's (and the loop's prior) K/V
@@ -171,9 +203,9 @@ def _paged_kernel(starts_ref, fetch_ref, lo_ref, hi_ref, slopes_ref, *rest,
 
 def _decode_grouped_kernel(starts_ref, fetch_ref, lens_ref, rcount_ref,
                            contig_ref, layer_ref, slopes_ref, q_ref,
-                           kp_hbm, vp_hbm, rk_ref, rv_ref, o_ref, k_scr,
-                           v_scr, sems, *, G, bs, H, KV, D, sm_scale,
-                           use_alibi, window, R, ring5d, use_pool_full):
+                           kp_hbm, vp_hbm, rk_ref, rv_ref, *rest, G, bs,
+                           H, KV, D, sm_scale, use_alibi, window, R,
+                           ring5d, use_pool_full, quant, sc_full):
     """Grouped decode: G sequences per grid step (VERDICT r3 #4 decode
     roofline work). The BlockSpec path pays one grid step per (sequence,
     layer) — at S=256 x 22 layers that is ~11k grid steps per decode step,
@@ -184,6 +216,12 @@ def _decode_grouped_kernel(starts_ref, fetch_ref, lens_ref, rcount_ref,
     sequences admitted in order), ONE [G*bs]-row DMA replaces the G
     per-sequence copies: the per-DMA issue cost, not the bytes, dominates
     at these sizes. ``contig_ref[i]`` carries the host-side run check."""
+    if quant:
+        (sck_hbm, scv_hbm, o_ref, k_scr, v_scr, ks_scr, vs_scr, sems,
+         ssem) = rest
+    else:
+        o_ref, k_scr, v_scr, sems = rest
+        sck_hbm = scv_hbm = ks_scr = vs_scr = ssem = None
     i = pl.program_id(0)
     KVD = KV * D
 
@@ -208,13 +246,40 @@ def _decode_grouped_kernel(starts_ref, fetch_ref, lens_ref, rcount_ref,
         def v_src(off, n):
             return kp_hbm.at[layer_ref[0], 1, pl.ds(off, n)]
 
+    if quant:
+        # int8 pool: the [KV, rows] scale windows ride separate (tiny, ~3%)
+        # DMAs; dequantization happens on scores/probabilities, never on
+        # the K/V tiles (kv_quant.py design)
+        if sc_full:
+            def ks_src(off, n):
+                return sck_hbm.at[layer_ref[0], 0, :, pl.ds(off, n)]
+
+            def vs_src(off, n):
+                return scv_hbm.at[layer_ref[0], 1, :, pl.ds(off, n)]
+        else:
+            def ks_src(off, n):
+                return sck_hbm.at[:, pl.ds(off, n)]
+
+            def vs_src(off, n):
+                return scv_hbm.at[:, pl.ds(off, n)]
+
     @pl.when(contig_ref[i] == 1)
     def _copy_contig():
         off = fetch_ref[i * G] * bs
         pltpu.make_async_copy(k_src(off, G * bs), k_scr, sems.at[0]).start()
         pltpu.make_async_copy(v_src(off, G * bs), v_scr, sems.at[1]).start()
+        if quant:
+            pltpu.make_async_copy(ks_src(off, G * bs), ks_scr,
+                                  ssem.at[0]).start()
+            pltpu.make_async_copy(vs_src(off, G * bs), vs_scr,
+                                  ssem.at[1]).start()
         pltpu.make_async_copy(k_src(off, G * bs), k_scr, sems.at[0]).wait()
         pltpu.make_async_copy(v_src(off, G * bs), v_scr, sems.at[1]).wait()
+        if quant:
+            pltpu.make_async_copy(ks_src(off, G * bs), ks_scr,
+                                  ssem.at[0]).wait()
+            pltpu.make_async_copy(vs_src(off, G * bs), vs_scr,
+                                  ssem.at[1]).wait()
 
     @pl.when(contig_ref[i] == 0)
     def _copy_scattered():
@@ -226,6 +291,13 @@ def _decode_grouped_kernel(starts_ref, fetch_ref, lens_ref, rcount_ref,
             pltpu.make_async_copy(
                 v_src(off, bs), v_scr.at[pl.ds(g * bs, bs)],
                 sems.at[2 * g + 1]).start()
+            if quant:
+                pltpu.make_async_copy(
+                    ks_src(off, bs), ks_scr.at[:, pl.ds(g * bs, bs)],
+                    ssem.at[2 + 2 * g]).start()
+                pltpu.make_async_copy(
+                    vs_src(off, bs), vs_scr.at[:, pl.ds(g * bs, bs)],
+                    ssem.at[3 + 2 * g]).start()
         for g in range(G):
             off = fetch_ref[i * G + g] * bs
             pltpu.make_async_copy(
@@ -234,6 +306,13 @@ def _decode_grouped_kernel(starts_ref, fetch_ref, lens_ref, rcount_ref,
             pltpu.make_async_copy(
                 v_src(off, bs), v_scr.at[pl.ds(g * bs, bs)],
                 sems.at[2 * g + 1]).wait()
+            if quant:
+                pltpu.make_async_copy(
+                    ks_src(off, bs), ks_scr.at[:, pl.ds(g * bs, bs)],
+                    ssem.at[2 + 2 * g]).wait()
+                pltpu.make_async_copy(
+                    vs_src(off, bs), vs_scr.at[:, pl.ds(g * bs, bs)],
+                    ssem.at[3 + 2 * g]).wait()
 
     # scores per sequence (the matmuls are irreducibly [H, ...] slivers),
     # but ONE batched softmax over the whole group's [G*H, bs(+R)] rows —
@@ -244,14 +323,29 @@ def _decode_grouped_kernel(starts_ref, fetch_ref, lens_ref, rcount_ref,
         # carry, layer/kv planes picked by the BlockSpec) -> [R, KVD]
         return ref[:, 0, 0, g] if ring5d else ref[g]
 
+    grp = H // KV
+
+    def _exp_heads(s):
+        """[KV, w] per-kv-head scales -> [H, w] head rows (head h uses
+        kv head h // grp)."""
+        return jnp.broadcast_to(
+            s[:, None, :], (KV, grp, s.shape[1])).reshape(H, s.shape[1])
+
     parts = []
     rparts = []
     for g in range(G):
         q = q_ref[g]                                   # [H, KVD] windowed
         kb = k_scr[pl.ds(g * bs, bs)]                  # [bs, KVD]
-        parts.append(jax.lax.dot_general(
+        if quant:
+            kb = kb.astype(q.dtype)
+        sc_g = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32))       # [H, bs]
+            preferred_element_type=jnp.float32)        # [H, bs]
+        if quant:
+            # K dequant scale is constant along the contracted D axis, so
+            # it factors out of the matmul onto the score columns (exact)
+            sc_g = sc_g * _exp_heads(ks_scr[:, g * bs:(g + 1) * bs])
+        parts.append(sc_g)
         if R is not None:
             rparts.append(jax.lax.dot_general(
                 q, ring_plane(rk_ref, g), (((1,), (1,)), ((), ())),
@@ -299,8 +393,13 @@ def _decode_grouped_kernel(starts_ref, fetch_ref, lens_ref, rcount_ref,
     for g in range(G):
         vb = v_scr[pl.ds(g * bs, bs)]
         rows = slice(g * H, (g + 1) * H)
+        pg = p[rows, :bs]
+        if quant:
+            # V dequant scale folds onto the probability columns
+            pg = pg * _exp_heads(vs_scr[:, g * bs:(g + 1) * bs])
+            vb = vb.astype(q_ref.dtype)
         pv = jax.lax.dot_general(
-            p[rows, :bs].astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            pg.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # [H, KVD]
         if R is not None:
             rvb = ring_plane(rv_ref, g)
@@ -314,8 +413,8 @@ def _decode_grouped_kernel(starts_ref, fetch_ref, lens_ref, rcount_ref,
 def _flash_decode_grouped(qw, kp_flat, vp_flat, fetch, start_pos, seq_lens,
                           *, bs, H, KV, D, sm_scale, slopes, use_alibi,
                           window, ring_k, ring_v, ring_full, ring_layer,
-                          ring_count, pool_full, pool_layer, out_dtype,
-                          interpret):
+                          ring_count, pool_full, pool_layer, scales_full,
+                          k_scales, v_scales, out_dtype, interpret):
     """Grouped-decode dispatch: qw [S, H, KV*D] lane-windowed; whole
     contexts (linear layout, one block per sequence) stream via manual
     DMA, G sequences per grid step. The decode-loop ring arrives as the
@@ -323,10 +422,24 @@ def _flash_decode_grouped(qw, kp_flat, vp_flat, fetch, start_pos, seq_lens,
     planes, so no per-layer slice/transpose ever materializes in HBM."""
     S = qw.shape[0]
     KVD = KV * D
+    quant = kp_flat.dtype == jnp.int8
+    if quant and not interpret and (KVD % 128 or bs % 128):
+        # the manual-DMA path slices [off : off+n] windows out of larger
+        # arrays: int8 rows need (32, 128)-tile-aligned slice shapes and
+        # the f32 scale windows need 128-lane-aligned offsets/widths —
+        # block offsets are block_id * block_size, so block_size % 128
+        # covers both. Real serving shapes (KV*D >= 512, linear-layout
+        # blocks sized to max context) satisfy this naturally.
+        raise ValueError(
+            f"int8 grouped decode requires KV*D ({KVD}) and block_size "
+            f"({bs}) to be multiples of 128 (Mosaic DMA tiling); use an "
+            f"aligned block_size or attention_impl='dense'")
     itemsize = kp_flat.dtype.itemsize
-    # VMEM budget: k+v scratch is G * bs * KVD * itemsize * 2
+    # VMEM budget: k+v scratch is G * bs * KVD * itemsize * 2 (+ the
+    # [KV, G*bs] f32 scale scratches in int8 mode)
     budget = 10 << 20
-    G = max(1, min(8, budget // max(1, 2 * bs * KVD * itemsize)))
+    per_seq = 2 * bs * KVD * itemsize + (2 * KV * bs * 4 if quant else 0)
+    G = max(1, min(8, budget // max(1, per_seq)))
     while S % G:
         G -= 1
     if ring_full is not None:
@@ -359,16 +472,19 @@ def _flash_decode_grouped(qw, kp_flat, vp_flat, fetch, start_pos, seq_lens,
             raise ValueError(
                 f"ring_layer {ring_layer} out of range for L = "
                 f"{ring_full.shape[1]}")
-        pool_dtype = (pool_full.dtype if use_pool_full else kp_flat.dtype)
-        if ring_full.dtype != pool_dtype:
+        # over an int8 pool the ring stays in the COMPUTE dtype (= qw's);
+        # otherwise it must share the pool's dtype (never cast)
+        expect = qw.dtype if quant else (
+            pool_full.dtype if use_pool_full else kp_flat.dtype)
+        if ring_full.dtype != expect:
             raise ValueError(
-                f"ring_full dtype {ring_full.dtype} != pool dtype "
-                f"{pool_dtype} (the grouped kernel does not cast the "
-                f"full ring — allocate it in the pool's dtype)")
+                f"ring_full dtype {ring_full.dtype} != expected {expect} "
+                f"(the grouped kernel does not cast the full ring)")
     kernel = functools.partial(
         _decode_grouped_kernel, G=G, bs=bs, H=H, KV=KV, D=D,
         sm_scale=float(sm_scale), use_alibi=use_alibi, window=window, R=R,
-        ring5d=ring5d, use_pool_full=use_pool_full)
+        ring5d=ring5d, use_pool_full=use_pool_full, quant=quant,
+        sc_full=scales_full is not None)
 
     in_specs = [
         pl.BlockSpec((G, H, KVD), lambda i, *_: (i, 0, 0)),
@@ -402,6 +518,15 @@ def _flash_decode_grouped(qw, kp_flat, vp_flat, fetch, start_pos, seq_lens,
         z = jnp.zeros((S, 8, KVD), kp_flat.dtype)
         in_specs += [pl.BlockSpec((G, 8, KVD), lambda i, *_: (i, 0, 0))] * 2
         operands += [z, z]
+    if quant:
+        # int8 scale windows: the full [L, 2, KV, slots] array rides twice
+        # (k/v planes picked in-kernel) or the per-layer [KV, slots] pair
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 2
+        if scales_full is not None:
+            operands += [scales_full, scales_full]
+        else:
+            operands += [k_scales.astype(jnp.float32),
+                         v_scales.astype(jnp.float32)]
 
     # host-side run check: a group whose G block ids are consecutive takes
     # the single-DMA fast path in the kernel
@@ -416,11 +541,13 @@ def _flash_decode_grouped(qw, kp_flat, vp_flat, fetch, start_pos, seq_lens,
         grid=(S // G,),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((G, H, KVD), lambda i, *_: (i, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((G * bs, KVD), scr_dtype),
-            pltpu.VMEM((G * bs, KVD), scr_dtype),
-            pltpu.SemaphoreType.DMA((2 * G,)),
-        ],
+        scratch_shapes=(
+            [pltpu.VMEM((G * bs, KVD), scr_dtype),
+             pltpu.VMEM((G * bs, KVD), scr_dtype)]
+            + ([pltpu.VMEM((KV, G * bs), jnp.float32)] * 2 if quant else [])
+            + [pltpu.SemaphoreType.DMA((2 * G,))]
+            + ([pltpu.SemaphoreType.DMA((2 * G + 2,))] if quant else [])
+        ),
     )
     layer_idx = int(pool_layer) if use_pool_full else (
         int(ring_layer) if ring5d else 0)
@@ -457,6 +584,9 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                           ring_layer: int = 0,
                           pool_full: Optional[jnp.ndarray] = None,
                           pool_layer: Optional[int] = None,
+                          scales_full: Optional[jnp.ndarray] = None,
+                          k_scales: Optional[jnp.ndarray] = None,
+                          v_scales: Optional[jnp.ndarray] = None,
                           num_kv_heads: Optional[int] = None,
                           interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over paged KV.
@@ -489,6 +619,14 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         (shape probing + the multi-block fallback path; dead code under
         jit when the grouped path runs).
       alibi_slopes: optional [H] f32 — in-kernel ALiBi bias (falcon/bloom).
+      scales_full / k_scales+v_scales: int8-pool dequantization scales
+        (kv_quant.py layout): ``scales_full`` [L, 2, KV, slots] rides whole
+        with the layer picked in-kernel; ``k_scales``/``v_scales``
+        [KV, slots] are the per-layer form for direct callers. Scales are
+        per (token-row, kv-head); the kernel multiplies SCORE columns by
+        the K scale and probability columns by the V scale — exact, and no
+        dequantized K/V tile ever materializes. q then stays in its own
+        (compute) dtype, and the decode-ring stays unquantized.
 
     Returns [S, C, H, D] attention outputs in q.dtype. HBM traffic per
     step is O(sum of live blocks) of UNPADDED rows.
@@ -519,6 +657,35 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
     g = H // KV
+
+    # int8 pool: scales required; normalize to the per-layer [KV, slots]
+    # form for the BlockSpec (prefill) path — the grouped decode path
+    # prefers scales_full (layer picked inside the DMA source)
+    quant = k_pool.dtype == jnp.int8
+    if quant:
+        if scales_full is not None:
+            if scales_full.ndim != 4 or scales_full.shape[1] != 2 \
+                    or scales_full.shape[2] != KV \
+                    or scales_full.shape[3] != slots:
+                raise ValueError(
+                    f"scales_full must be [L, 2, {KV}, {slots}], got "
+                    f"{scales_full.shape}")
+            li = int(pool_layer) if pool_layer is not None else 0
+            if k_scales is None:
+                k_scales = scales_full[li, 0]
+                v_scales = scales_full[li, 1]
+        if k_scales is None or v_scales is None:
+            raise ValueError(
+                "an int8 k_pool needs scales (scales_full or "
+                "k_scales+v_scales, see kv_quant.py)")
+        if k_scales.shape != (KV, slots):
+            raise ValueError(
+                f"k_scales must be [{KV}, {slots}], got {k_scales.shape}")
+        compute_dt = q.dtype if q.dtype != jnp.int8 else jnp.bfloat16
+    elif scales_full is not None or k_scales is not None:
+        raise ValueError("KV scales passed but the pool is not int8")
+    else:
+        compute_dt = k_pool.dtype
 
     # processing granularity decouples from the allocator's block size:
     # decode (C==1, scratch is tiny) streams each block whole — one DMA per
@@ -595,7 +762,7 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         sel = (jnp.arange(KV)[None, :] == (jnp.arange(H) // g)[:, None])
         qw = (q.swapaxes(1, 2)[:, :, :, None, :]
               * sel[None, :, None, :, None].astype(q.dtype))  # [S,H,C,KV,D]
-        qw = qw.reshape(S, H, C, KVD).astype(k_pool.dtype)
+        qw = qw.reshape(S, H, C, KVD).astype(compute_dt)
         row_lanes = KVD
         if maxb_v == 1:
             # linear layout, whole context in one block: the grouped
@@ -611,6 +778,9 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                 ring_full=ring_full, ring_layer=int(ring_layer),
                 ring_count=(ring_count if has_ring else None),
                 pool_full=pool_full, pool_layer=pool_layer,
+                scales_full=scales_full if quant else None,
+                k_scales=k_scales if quant else None,
+                v_scales=v_scales if quant else None,
                 out_dtype=q.dtype, interpret=interpret)
             out = out.reshape(S, 1, H, KVD).swapaxes(1, 2)  # [S, H, 1, KVD]
             head_win = (jnp.arange(H) // g)[:, None] * D \
@@ -619,25 +789,31 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                                       axis=3)
             return jnp.moveaxis(out, 1, 2)              # [S, 1, H, D]
     else:
-        qw = q.swapaxes(1, 2).astype(k_pool.dtype)     # [S, H, C, D]
+        qw = q.swapaxes(1, 2).astype(compute_dt)       # [S, H, C, D]
         row_lanes = D
 
     kernel = functools.partial(
         _paged_kernel, bs=pbs, Cb=Cb, nCb=nCb, H=H, KV=KV, D=D,
         sm_scale=float(sm_scale), use_alibi=use_alibi,
         window=int(sliding_window) if sliding_window is not None else None,
-        R=R, windowed=windowed)
+        R=R, windowed=windowed, quant=quant)
 
     n_pref = 7 if has_ring else 5
 
-    def kv_index(s, qc, j, *pref):
+    def _kv_block(s, qc, j, *pref):
         fetch_ref, lo_ref, hi_ref = pref[1], pref[2], pref[3]
         # clamp into this (s, qc)'s live range so dead grid steps (incl.
         # the ring round) revisit a fetched block (no DMA) instead of
         # pulling a new one
         sq = s * nCb + qc
         jc = jnp.clip(j, lo_ref[sq], jnp.maximum(hi_ref[sq] - 1, 0))
-        return (fetch_ref[s * maxb_v + jc], 0, 0)
+        return fetch_ref[s * maxb_v + jc]
+
+    def kv_index(s, qc, j, *pref):
+        return (_kv_block(s, qc, j, *pref), 0, 0)
+
+    def sc_index(s, qc, j, *pref):
+        return (_kv_block(s, qc, j, *pref), 0, 0)
 
     # q rows for chunk qc must be one contiguous [H*Cb] row block: reorder
     # chunk-major (pad C up to nCb*Cb first; padded rows compute garbage
@@ -661,6 +837,16 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         pl.BlockSpec((1, pbs, KVD), kv_index),
     ]
     operands = [qw, kp, vp]
+    if quant:
+        # per-layer [KV, slots] scales re-laid [nb, KV, pbs] so a block's
+        # minor dims are (KV, pbs) proper tiles; the same clamped block
+        # index feeds both the KV tile and its scale window
+        ksb = k_scales.astype(jnp.float32).reshape(
+            KV, nb_pool, pbs).swapaxes(0, 1)
+        vsb = v_scales.astype(jnp.float32).reshape(
+            KV, nb_pool, pbs).swapaxes(0, 1)
+        in_specs += [pl.BlockSpec((1, KV, pbs), sc_index)] * 2
+        operands += [ksb, vsb]
     grid = (S, nCb, maxb_v + 1 if has_ring else maxb_v)
     if has_ring:
         if ring_k is None:
@@ -671,8 +857,8 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         ring_spec = pl.BlockSpec((1, R, KVD),
                                  lambda s, qc, j, *_: (s, 0, 0))
         in_specs += [ring_spec, ring_spec]
-        operands += [ring_k.astype(k_pool.dtype),
-                     ring_v.astype(v_pool.dtype)]
+        operands += [ring_k.astype(compute_dt),
+                     ring_v.astype(compute_dt)]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=n_pref,

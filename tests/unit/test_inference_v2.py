@@ -695,3 +695,185 @@ class TestOnDeviceSampling:
         # pool drained afterwards
         eng_free = eng.kv_cache.free_blocks
         assert eng_free == 14
+
+
+class TestKVInt8:
+    """int8 KV pool (kv_quant.py): per-(token, kv-head) scales, kernels
+    scale scores/probabilities instead of dequantizing tiles. Capability
+    analogue of the reference's KV-cache quantization surface
+    (inference/v2/model_implementations/flat_model_helpers.py)."""
+
+    def _cfgs(self, **kw):
+        cfg, mcfg, model, params = _tiny_setup(**kw)
+        cfg_i8 = RaggedInferenceConfig(**{**cfg.__dict__,
+                                          "kv_cache_dtype": "int8"})
+        return cfg, cfg_i8, mcfg, model, params
+
+    def test_quant_roundtrip(self):
+        from deepspeed_tpu.inference.v2.kv_quant import (
+            dequantize_rows, quantize_rows)
+        rows = jnp.asarray(
+            np.random.default_rng(0).normal(size=(32, 64)) * 3, jnp.float32)
+        q, s = quantize_rows(rows, 4)
+        assert q.dtype == jnp.int8 and s.shape == (4, 32)
+        deq = dequantize_rows(q, s, jnp.float32)
+        rel = float(jnp.max(jnp.abs(deq - rows))) / float(
+            jnp.max(jnp.abs(rows)))
+        assert rel < 0.01
+        # zero rows survive exactly
+        qz, sz = quantize_rows(jnp.zeros((4, 64)), 4)
+        assert float(jnp.max(jnp.abs(dequantize_rows(qz, sz)))) == 0.0
+
+    def test_engine_int8_close_to_fp(self):
+        cfg, cfg_i8, mcfg, model, params = self._cfgs(chunk=8)
+        rng = np.random.default_rng(3)
+        prompts = {0: rng.integers(1, 96, 21).tolist(),
+                   1: rng.integers(1, 96, 7).tolist()}
+        out_fp = InferenceEngineV2(mcfg, params, cfg).put(
+            list(prompts), list(prompts.values()))
+        out_i8 = InferenceEngineV2(mcfg, params, cfg_i8).put(
+            list(prompts), list(prompts.values()))
+        for uid in prompts:
+            ref = np.abs(np.asarray(out_fp[uid])).max()
+            diff = np.abs(np.asarray(out_fp[uid])
+                          - np.asarray(out_i8[uid])).max()
+            assert diff / ref < 0.05
+
+    def test_engine_int8_kernel_matches_dense(self):
+        # same quantized data through the Pallas kernel vs the dense
+        # dequantize path -> near-exact agreement
+        _, cfg_i8, mcfg, model, params = self._cfgs(chunk=8, block_size=4)
+        cfg_dense = RaggedInferenceConfig(**{**cfg_i8.__dict__,
+                                             "attention_impl": "dense"})
+        prompt = list(np.random.default_rng(4).integers(1, 96, 13))
+        g_kern = InferenceEngineV2(mcfg, params, cfg_i8).generate(
+            [prompt], max_new_tokens=5)[0]
+        g_dense = InferenceEngineV2(mcfg, params, cfg_dense).generate(
+            [prompt], max_new_tokens=5)[0]
+        assert g_kern == g_dense
+
+    def test_engine_int8_decode_loop_linear_layout(self):
+        # fused decode loop + ring flush quantization on the linear
+        # (one-block-per-seq) layout
+        _, cfg_i8, mcfg, model, params = self._cfgs(
+            block_size=32, num_blocks=8, max_blocks_per_seq=1, chunk=8)
+        cfg_loop = RaggedInferenceConfig(**{**cfg_i8.__dict__,
+                                            "decode_loop_steps": 4})
+        cfg_ref = RaggedInferenceConfig(**{**cfg_i8.__dict__,
+                                           "decode_loop_steps": 0})
+        prompts = [list(np.random.default_rng(5).integers(1, 96, 9))]
+        got = InferenceEngineV2(mcfg, params, cfg_loop).generate(
+            prompts, max_new_tokens=8)
+        ref = InferenceEngineV2(mcfg, params, cfg_ref).generate(
+            prompts, max_new_tokens=8)
+        assert got == ref
+
+    def test_engine_int8_pause_resume(self):
+        # oversubscription offload/restore must carry the scales with the
+        # int8 blocks (kv_cache.offload returns a (rows, scales) pair)
+        rng = np.random.default_rng(6)
+        prompts = [rng.integers(1, 96, 9).tolist() for _ in range(6)]
+        _, cfg_big, mcfg, model, params = self._cfgs(
+            num_blocks=64, block_size=4, max_blocks_per_seq=8)
+        ref = InferenceEngineV2(mcfg, params, cfg_big).generate(
+            prompts, max_new_tokens=5)
+        _, cfg_small, _, _, _ = self._cfgs(num_blocks=8, block_size=4,
+                                           max_blocks_per_seq=8)
+        eng = InferenceEngineV2(mcfg, params, cfg_small)
+        got = eng.generate(prompts, max_new_tokens=5)
+        assert got == ref
+        assert eng.free_blocks == cfg_small.num_blocks
+
+    def test_pool_memory_halves(self):
+        cfg, cfg_i8, mcfg, _, _ = self._cfgs()
+        # realistic head_dim (128): the [KV] f32 scale row is ~3% of the
+        # int8 data row, so the pool lands just over half the bf16 bytes
+        fp = BlockedKVCache(cfg, 2, 4, 128, jnp.bfloat16)
+        i8 = BlockedKVCache(cfg_i8, 2, 4, 128, jnp.bfloat16)
+        # int8 rows + f32 scales: well under the bf16 pool, and the data
+        # plane is exactly half
+        assert i8.data.dtype == jnp.int8
+        assert i8.data.size == fp.data.size
+        assert i8.memory_bytes() < 0.6 * fp.memory_bytes()
+
+    def test_kernel_direct_int8_parity(self):
+        # direct kernel call: quantized pool + per-layer scales vs the fp
+        # pool, prefill (multi-block BlockSpec path) and grouped decode
+        # (linear layout) both
+        from deepspeed_tpu.inference.v2.kv_quant import quantize_rows
+        from deepspeed_tpu.ops.kernels import flash_paged_attention
+        rng = np.random.default_rng(7)
+        S, H, KV, D = 4, 8, 2, 16
+        KVD = KV * D
+
+        # prefill: blocked layout
+        bs, nb, maxb = 16, 12, 3
+        slots = (nb + 1) * bs
+        kf = jnp.asarray(rng.normal(size=(slots, KVD)), jnp.float32)
+        vf = jnp.asarray(rng.normal(size=(slots, KVD)), jnp.float32)
+        qk, sk = quantize_rows(kf, KV)
+        qv, sv = quantize_rows(vf, KV)
+        tables = jnp.asarray(
+            rng.permutation(nb)[:S * maxb].reshape(S, maxb), jnp.int32)
+        lens = jnp.asarray([40, 33, 17, 0], jnp.int32)
+        C = 8
+        q = jnp.asarray(rng.normal(size=(S, C, H, D)), jnp.float32)
+        start = jnp.maximum(lens - C, 0)
+        o_fp = flash_paged_attention(q, kf, vf, tables, start, lens,
+                                     block_size=bs, num_kv_heads=KV,
+                                     interpret=True)
+        o_i8 = flash_paged_attention(q, qk, qv, tables, start, lens,
+                                     block_size=bs, num_kv_heads=KV,
+                                     k_scales=sk, v_scales=sv,
+                                     interpret=True)
+        rel = float(jnp.max(jnp.abs(o_fp - o_i8))) / float(
+            jnp.max(jnp.abs(o_fp)))
+        assert rel < 0.05
+
+        # grouped decode: linear layout, full pool + scales_full + ring
+        bs2 = 64
+        slots2 = (S + 1) * bs2
+        kf2 = jnp.asarray(rng.normal(size=(slots2, KVD)), jnp.float32)
+        vf2 = jnp.asarray(rng.normal(size=(slots2, KVD)), jnp.float32)
+        qk2, sk2 = quantize_rows(kf2, KV)
+        qv2, sv2 = quantize_rows(vf2, KV)
+        L, li = 3, 1
+        pool = jnp.zeros((L, 2, slots2, KVD), jnp.int8)
+        pool = pool.at[li, 0].set(qk2).at[li, 1].set(qv2)
+        scales = jnp.ones((L, 2, KV, slots2), jnp.float32)
+        scales = scales.at[li, 0].set(sk2).at[li, 1].set(sv2)
+        tables2 = jnp.arange(S, dtype=jnp.int32)[:, None]
+        lens2 = jnp.asarray([40, 20, 64, 0], jnp.int32)
+        q2 = jnp.asarray(rng.normal(size=(S, 1, H, D)), jnp.float32)
+        R = 4
+        ring = jnp.asarray(rng.normal(size=(R, L, 2, S, KVD)), jnp.float32)
+        rcount = jnp.asarray(2, jnp.int32)
+        o_full = flash_paged_attention(
+            q2, pool[li, 0], pool[li, 1], tables2, lens2 + rcount, lens2,
+            block_size=bs2, num_kv_heads=KV,
+            pool_full=pool, pool_layer=li, scales_full=scales,
+            ring_full=ring, ring_layer=li, ring_count=rcount,
+            interpret=True)
+        # dense reference over the dequantized pool + ring tokens
+        from deepspeed_tpu.inference.v2.kv_quant import dequantize_rows
+        kd = dequantize_rows(qk2, sk2, jnp.float32)
+        vd = dequantize_rows(qv2, sv2, jnp.float32)
+        g = H // KV
+        for s_i in range(S):
+            if int(lens2[s_i]) == 0:
+                continue
+            base = int(tables2[s_i, 0]) * bs2
+            T = int(lens2[s_i])
+            kk = jnp.concatenate(
+                [kd[base:base + T], ring[:int(rcount), li, 0, s_i]], 0)
+            vv = jnp.concatenate(
+                [vd[base:base + T], ring[:int(rcount), li, 1, s_i]], 0)
+            for h in range(H):
+                kvh = h // g
+                kh = kk.reshape(-1, KV, D)[:, kvh]
+                vh = vv.reshape(-1, KV, D)[:, kvh]
+                sc = (q2[s_i, 0, h] @ kh.T) / np.sqrt(D)
+                want = jax.nn.softmax(sc) @ vh
+                np.testing.assert_allclose(
+                    np.asarray(o_full[s_i, 0, h]), np.asarray(want),
+                    atol=5e-5, rtol=5e-5)
