@@ -60,7 +60,8 @@ def bench_train(model_kind: str = "gpt124"):
             num_heads=16, hidden_size=2048,
             remat=os.environ.get("DSTPU_TRAIN_REMAT", "1") == "1",
             remat_policy=os.environ.get("DSTPU_TRAIN_POLICY", "qkv_out"),
-            attention_impl=os.environ.get("DSTPU_TRAIN_IMPL", "auto"))
+            attention_impl=os.environ.get("DSTPU_TRAIN_IMPL", "auto"),
+            xent_impl=os.environ.get("DSTPU_TRAIN_XENT", "chunked"))
         grad_accum_dtype = "bfloat16"
         steps = 12
     else:
@@ -77,7 +78,8 @@ def bench_train(model_kind: str = "gpt124"):
             num_heads=12, hidden_size=768,
             remat=os.environ.get("DSTPU_TRAIN_REMAT", "1") == "1",
             remat_policy=os.environ.get("DSTPU_TRAIN_POLICY", "qkv_out"),
-            attention_impl=os.environ.get("DSTPU_TRAIN_IMPL", "auto"))
+            attention_impl=os.environ.get("DSTPU_TRAIN_IMPL", "auto"),
+            xent_impl=os.environ.get("DSTPU_TRAIN_XENT", "chunked"))
         grad_accum_dtype = "float32"
         steps = 30
     model, init_fn, loss_fn = make_model(cfg_model)

@@ -45,9 +45,13 @@ class GPT2Config:
     attention_impl: str = "auto"
     # fused LM-head xent chunking (models/_lm_utils.chunked_lm_xent):
     # xent_remat=False keeps chunk logits for backward (no unembed
-    # recompute) — faster when the fp32 chunks fit HBM
+    # recompute) — faster when the fp32 chunks fit HBM.
+    # xent_impl "chunked" | "fused": "fused" routes through the streaming
+    # Pallas kernel (ops/kernels/fused_xent.py) — logits never touch HBM
+    # in either direction, at +1 N*V*C recompute matmul in backward
     xent_chunks: int = 8
     xent_remat: bool = True
+    xent_impl: str = "chunked"
 
     @staticmethod
     def tiny(**kw):
@@ -260,6 +264,13 @@ def make_model(cfg: GPT2Config):
                              deterministic=cfg.dropout == 0,
                              return_hidden=True,
                              rngs={"dropout": rng} if cfg.dropout > 0 else None)
+        if cfg.xent_impl not in ("chunked", "fused"):
+            raise ValueError(
+                f"xent_impl must be 'chunked' or 'fused', got "
+                f"{cfg.xent_impl!r}")
+        if cfg.xent_impl == "fused":
+            from ..ops.kernels import fused_lm_xent
+            return fused_lm_xent(hidden, params["wte"]["embedding"], targets)
         return chunked_lm_xent(hidden, params["wte"]["embedding"], targets,
                                num_chunks=cfg.xent_chunks,
                                remat=cfg.xent_remat)

@@ -29,3 +29,4 @@ from .quantization import (  # noqa: E402,F401
     quantize_blockwise,
 )
 from .fused_optimizer import fused_adamw_update  # noqa: E402,F401
+from .fused_xent import fused_lm_xent  # noqa: E402,F401

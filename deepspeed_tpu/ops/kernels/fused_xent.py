@@ -1,0 +1,285 @@
+"""Streaming fused LM-head cross-entropy (Pallas TPU) — fwd + bwd.
+
+The LM-head matmul + softmax cross-entropy is the single largest non-layer
+cost of causal-LM training (measured 23% of the 124M step — PROFILE.md):
+``[N, C] @ [V, C]^T`` logits are V-wide (50k+), and every implementation
+that materializes them pays O(N*V) HBM traffic in fp32. The reference
+always pays full-logits cost (training goes through torch cross_entropy);
+the in-tree ``chunked_lm_xent`` (models/_lm_utils.py) bounds the LIVE
+footprint by chunking + remat but still streams each fp32 chunk through
+HBM and serializes chunks in a scan.
+
+This kernel never writes logits to HBM at all:
+
+  forward  — grid (token tiles × vocab tiles), online logsumexp exactly
+    like flash attention's softmax, plus the target logit extracted via an
+    in-tile one-hot reduction. Outputs per-token ``lse`` and ``tgt`` only.
+  backward — two passes with OPPOSITE grid orders, each recomputing the
+    logits tile on the fly (bf16 MXU, f32 accumulation):
+      dh   = (P - onehot) @ E   — token-tile outer, dh accumulates in VMEM
+             across the inner vocab walk;
+      dE   = (P - onehot)^T @ H — vocab-tile outer, dE accumulates in VMEM
+             across the inner token walk.
+    Both reductions need the full opposite axis in their inner loop, which
+    is exactly why ONE pass cannot emit both (the second output would be
+    revisited non-consecutively); the extra logits recompute is one more
+    N*V*C matmul — MXU FLOPs traded for zero O(N*V) HBM traffic.
+
+Cost accounting vs the chunked path: 5 MXU passes of N*V*C MACs
+(fwd, 2x recompute, dh, dE) vs the chunked path's 4 plus ~8*N*V bytes of
+fp32 chunk HBM traffic plus scan serialization. Bandwidth-bound shapes
+win; the crossover is measured, not assumed (tools/profile_train.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float("-inf")
+_LANES = 128
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+# --------------------------------------------------------------------- #
+# forward: lse + target logit, no logits in HBM
+# --------------------------------------------------------------------- #
+
+def _fwd_kernel(h_ref, e_ref, t_ref, lse_ref, tgt_ref, m_scr, l_scr, g_scr,
+                *, Tb, Vb, V, Vt):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, _NEG_INF, m_scr.dtype)
+        l_scr[:] = jnp.zeros(l_scr.shape, l_scr.dtype)
+        g_scr[:] = jnp.zeros(g_scr.shape, g_scr.dtype)
+
+    logits = jax.lax.dot_general(
+        h_ref[...], e_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [Tb, Vb]
+    col = j * Vb + jax.lax.broadcasted_iota(jnp.int32, (Tb, Vb), 1)
+    logits = jnp.where(col < V, logits, _NEG_INF)
+
+    m_prev, l_prev = m_scr[:, :1], l_scr[:, :1]
+    m_cur = jnp.max(logits, axis=1, keepdims=True)
+    m_next = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_next)                     # m_prev=-inf -> 0
+    p = jnp.exp(logits - m_next)
+    l_next = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    m_scr[:, :1] = m_next
+    l_scr[:, :1] = l_next
+
+    # target logit: one-hot row reduction inside the tile (a per-row
+    # dynamic gather would leave the VPU's vector regime)
+    t_loc = t_ref[0].astype(jnp.int32)[:, None]          # [Tb, 1] global id
+    hit = col == t_loc
+    g_scr[:, :1] = g_scr[:, :1] + jnp.sum(
+        jnp.where(hit, logits, 0.0), axis=1, keepdims=True)
+
+    @pl.when(j == Vt - 1)
+    def _finish():
+        lse_ref[0] = (m_scr[:, :1]
+                      + jnp.log(jnp.maximum(l_scr[:, :1], 1e-37)))[:, 0]
+        tgt_ref[0] = g_scr[:, 0]
+
+
+def _fwd(h2, emb, tgt2, *, Tb, Vb, interpret):
+    N2, C = h2.shape
+    V = emb.shape[0]
+    Nt, Vt = N2 // Tb, _round_up(V, Vb) // Vb
+    Vpad = Vt * Vb - V
+    e = jnp.pad(emb, ((0, Vpad), (0, 0))) if Vpad else emb
+    e = e.astype(h2.dtype)
+    kernel = functools.partial(_fwd_kernel, Tb=Tb, Vb=Vb, V=V, Vt=Vt)
+    lse, tgt = pl.pallas_call(
+        kernel,
+        grid=(Nt, Vt),
+        in_specs=[
+            pl.BlockSpec((Tb, C), lambda i, j: (i, 0)),
+            pl.BlockSpec((Vb, C), lambda i, j: (j, 0)),
+            pl.BlockSpec((1, Tb), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, Tb), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, Tb), lambda i, j: (i, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((Nt, Tb), jnp.float32)] * 2,
+        scratch_shapes=[pltpu.VMEM((Tb, _LANES), jnp.float32)] * 3,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(h2, e, tgt2.reshape(Nt, Tb))
+    return lse.reshape(-1), tgt.reshape(-1)
+
+
+# --------------------------------------------------------------------- #
+# backward pass 1: dh = scale * (P - onehot) @ E   (token-tile outer)
+# --------------------------------------------------------------------- #
+
+def _dh_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, dh_ref, acc_scr,
+               *, Tb, Vb, V, Vt):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros(acc_scr.shape, acc_scr.dtype)
+
+    logits = jax.lax.dot_general(
+        h_ref[...], e_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    col = j * Vb + jax.lax.broadcasted_iota(jnp.int32, (Tb, Vb), 1)
+    p = jnp.where(col < V, jnp.exp(logits - lse_ref[0][:, None]), 0.0)
+    t_loc = t_ref[0].astype(jnp.int32)[:, None]
+    p = p - jnp.where(col == t_loc, 1.0, 0.0)
+    acc_scr[:] = acc_scr[:] + jax.lax.dot_general(
+        p.astype(h_ref.dtype), e_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [Tb, C]
+
+    @pl.when(j == Vt - 1)
+    def _finish():
+        dh_ref[0] = (acc_scr[:] * s_ref[0]).astype(dh_ref.dtype)
+
+
+# --------------------------------------------------------------------- #
+# backward pass 2: dE = scale * (P - onehot)^T @ H  (vocab-tile outer)
+# --------------------------------------------------------------------- #
+
+def _de_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, de_ref, acc_scr,
+               *, Tb, Vb, V, N, Nt):
+    i = pl.program_id(1)
+    j = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros(acc_scr.shape, acc_scr.dtype)
+
+    logits = jax.lax.dot_general(
+        h_ref[...], e_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [Tb, Vb]
+    col = j * Vb + jax.lax.broadcasted_iota(jnp.int32, (Tb, Vb), 1)
+    p = jnp.where(col < V, jnp.exp(logits - lse_ref[0][:, None]), 0.0)
+    t_loc = t_ref[0].astype(jnp.int32)[:, None]
+    p = p - jnp.where(col == t_loc, 1.0, 0.0)
+    # padded token rows carry P = uniform garbage (their h rows are zero
+    # but lse is finite): mask them out of the vocab-side reduction
+    row = i * Tb + jax.lax.broadcasted_iota(jnp.int32, (Tb, Vb), 0)
+    p = jnp.where(row < N, p, 0.0)
+    acc_scr[:] = acc_scr[:] + jax.lax.dot_general(
+        p.astype(h_ref.dtype), h_ref[...], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [Vb, C]
+
+    @pl.when(i == Nt - 1)
+    def _finish():
+        de_ref[0] = (acc_scr[:] * s_ref[0]).astype(de_ref.dtype)
+
+
+# --------------------------------------------------------------------- #
+# public op with custom VJP
+# --------------------------------------------------------------------- #
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _xent_core(h2, emb, tgt2, N, Tb, Vb, interpret):
+    """Sum of next-token NLL over the first ``N`` (valid) rows. The SUM —
+    not the mean — is the custom-vjp boundary so the incoming cotangent
+    is a SCALAR (the mean's 1/N folds outside); per-row cotangents would
+    need a non-separable dE scaling the kernels cannot fold."""
+    lse, tgt = _fwd(h2, emb, tgt2, Tb=Tb, Vb=Vb, interpret=interpret)
+    valid = jnp.arange(h2.shape[0]) < N
+    return jnp.where(valid, lse - tgt, 0.0).sum()
+
+
+def _xent_fwd_rule(h2, emb, tgt2, N, Tb, Vb, interpret):
+    lse, tgt = _fwd(h2, emb, tgt2, Tb=Tb, Vb=Vb, interpret=interpret)
+    valid = jnp.arange(h2.shape[0]) < N
+    return jnp.where(valid, lse - tgt, 0.0).sum(), (h2, emb, tgt2, lse)
+
+
+def _xent_bwd_rule(N, Tb, Vb, interpret, res, g):
+    h2, emb, tgt2, lse = res
+    N2, C = h2.shape
+    V = emb.shape[0]
+    Nt, Vt = N2 // Tb, _round_up(V, Vb) // Vb
+    Vpad = Vt * Vb - V
+    e = jnp.pad(emb, ((0, Vpad), (0, 0))) if Vpad else emb
+    e = e.astype(h2.dtype)
+    # d(sum nll)/d(logit) = P - onehot per valid row, all scaled by the
+    # scalar cotangent g. Padded rows: dE masks them in-kernel (row < N);
+    # dh's padded rows are garbage that jnp.pad's own VJP slices off.
+    scale = jnp.reshape(g, (1,)).astype(jnp.float32)
+    t2 = tgt2.reshape(Nt, Tb)
+    lse2 = lse.reshape(Nt, Tb)
+
+    dh = pl.pallas_call(
+        functools.partial(_dh_kernel, Tb=Tb, Vb=Vb, V=V, Vt=Vt),
+        grid=(Nt, Vt),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((Tb, C), lambda i, j: (i, 0)),
+            pl.BlockSpec((Vb, C), lambda i, j: (j, 0)),
+            pl.BlockSpec((1, Tb), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, Tb), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Tb, C), lambda i, j: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((Nt, Tb, C), h2.dtype),
+        scratch_shapes=[pltpu.VMEM((Tb, C), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(scale, h2, e, t2, lse2).reshape(N2, C)
+
+    de = pl.pallas_call(
+        functools.partial(_de_kernel, Tb=Tb, Vb=Vb, V=V, N=N, Nt=Nt),
+        grid=(Vt, Nt),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((Tb, C), lambda j, i: (i, 0)),
+            pl.BlockSpec((Vb, C), lambda j, i: (j, 0)),
+            pl.BlockSpec((1, Tb), lambda j, i: (i, 0)),
+            pl.BlockSpec((1, Tb), lambda j, i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Vb, C), lambda j, i: (j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((Vt, Vb, C), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((Vb, C), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(scale, h2, e, t2, lse2).reshape(Vt * Vb, C)[:V]
+
+    return dh, de.astype(emb.dtype), None
+
+
+_xent_core.defvjp(_xent_fwd_rule, _xent_bwd_rule)
+
+
+def fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
+                  targets: jnp.ndarray, *, token_block: int = 256,
+                  vocab_block: int = 512,
+                  interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Mean next-token NLL with logits never materialized in HBM.
+
+    hidden [B, T, C] (or [N, C]) in the compute dtype, embedding [V, C]
+    (the tied LM head), targets [B, T] (or [N]) int32. Differentiable in
+    (hidden, embedding); the backward recomputes P tiles on the MXU.
+    """
+    if interpret is None:
+        from . import default_interpret
+        interpret = default_interpret()
+    h2 = hidden.reshape(-1, hidden.shape[-1])
+    t1 = targets.reshape(-1).astype(jnp.int32)
+    N, C = h2.shape
+    Tb = min(token_block, _round_up(N, 8))
+    N2 = _round_up(N, Tb)
+    if N2 != N:
+        h2 = jnp.pad(h2, ((0, N2 - N), (0, 0)))
+        t1 = jnp.pad(t1, (0, N2 - N))
+    total = _xent_core(h2, embedding, t1, N, Tb, vocab_block, interpret)
+    return total / N
