@@ -43,6 +43,10 @@ class GPT2Config:
     # "auto": Pallas flash attention on TPU, XLA fused attention elsewhere;
     # "flash" / "xla" force one path.
     attention_impl: str = "auto"
+    # flash kernel tile geometry (ops/kernels/flash_attention.py): 512/512
+    # measured best at seq 512 AND seq 2048 on v5e; exposed for profiling
+    flash_block_q: int = 512
+    flash_block_k: int = 512
     # fused LM-head xent chunking (models/_lm_utils.chunked_lm_xent):
     # xent_remat=False keeps chunk logits for backward (no unembed
     # recompute) — faster when the fp32 chunks fit HBM.
@@ -107,7 +111,9 @@ class CausalSelfAttention(nn.Module):
                 impl = "xla"
         if impl == "flash":
             from deepspeed_tpu.ops.kernels import flash_attention
-            y = flash_attention(q, k, v, causal=True, layout="BTHD")
+            y = flash_attention(q, k, v, causal=True, layout="BTHD",
+                                block_q=cfg.flash_block_q,
+                                block_k=cfg.flash_block_k)
         elif impl == "flash_sharded":
             from deepspeed_tpu.ops.kernels import sharded_flash_attention
             from deepspeed_tpu.parallel.topology import get_topology

@@ -261,7 +261,7 @@ _xent_core.defvjp(_xent_fwd_rule, _xent_bwd_rule)
 
 
 def fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
-                  targets: jnp.ndarray, *, token_block: int = 256,
+                  targets: jnp.ndarray, *, token_block: Optional[int] = None,
                   vocab_block: int = 512,
                   interpret: Optional[bool] = None) -> jnp.ndarray:
     """Mean next-token NLL with logits never materialized in HBM.
@@ -276,6 +276,12 @@ def fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
     h2 = hidden.reshape(-1, hidden.shape[-1])
     t1 = targets.reshape(-1).astype(jnp.int32)
     N, C = h2.shape
+    if token_block is None:
+        # grid-step fixed costs dominate when the per-step matmul is small
+        # (Tb*Vb*C MACs): widen token tiles at narrow models. The VMEM
+        # budget (h tile + f32 dh accumulator + double-buffered emb tiles)
+        # caps Tb at 256 for C ~ 2048.
+        token_block = 512 if C <= 1024 else 256
     Tb = min(token_block, _round_up(N, 8))
     N2 = _round_up(N, Tb)
     if N2 != N:

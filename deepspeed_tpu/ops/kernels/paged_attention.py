@@ -778,7 +778,13 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                 ring_full=ring_full, ring_layer=int(ring_layer),
                 ring_count=(ring_count if has_ring else None),
                 pool_full=pool_full, pool_layer=pool_layer,
-                scales_full=scales_full if quant else None,
+                # the full-scales form indexes layers with the same
+                # prefetched layer id as the full pool — without pool_full
+                # that id defaults to 0, so fall back to the (already
+                # layer-sliced) per-layer scales instead
+                scales_full=(scales_full
+                             if quant and pool_full is not None
+                             and pool_layer is not None else None),
                 k_scales=k_scales if quant else None,
                 v_scales=v_scales if quant else None,
                 out_dtype=q.dtype, interpret=interpret)

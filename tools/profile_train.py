@@ -91,11 +91,34 @@ EXPERIMENTS = {
     "big_grad4":   dict(model="large710", seq=2048, micro=4, mode="grad"),
     "big_xla6_gb": dict(model="large710", seq=2048, micro=6, impl="xla",
                         gdtype="bfloat16"),
+    # round 5: streaming fused LM-head xent (ops/kernels/fused_xent.py) —
+    # no fp32 logit chunks in HBM at all; the freed memory may also admit
+    # a bigger micro batch or lighter remat
+    "big_qkv6_fx": dict(model="large710", seq=2048, micro=6,
+                        gdtype="bfloat16", loss="fused"),
+    "big_qkv8_fx": dict(model="large710", seq=2048, micro=8,
+                        gdtype="bfloat16", loss="fused"),
+    "big_save4_fx": dict(model="large710", seq=2048, micro=4,
+                         policy="save:qkv,attn_out,mlp_pre_act",
+                         gdtype="bfloat16", loss="fused"),
+    "big_save6_fx": dict(model="large710", seq=2048, micro=6,
+                         policy="save:qkv,attn_out,mlp_pre_act",
+                         gdtype="bfloat16", loss="fused"),
+    "fx124":       dict(loss="fused"),
+    # flash tile geometry at seq 2048 (512/512 was tuned at seq 512)
+    "big_bq1024":  dict(model="large710", seq=2048, micro=6,
+                        gdtype="bfloat16", bq=1024, bk=512),
+    "big_bk1024":  dict(model="large710", seq=2048, micro=6,
+                        gdtype="bfloat16", bq=512, bk=1024),
+    "big_bq256":   dict(model="large710", seq=2048, micro=6,
+                        gdtype="bfloat16", bq=256, bk=512),
+    "big_bqk1024": dict(model="large710", seq=2048, micro=6,
+                        gdtype="bfloat16", bq=1024, bk=1024),
 }
 
 DEFAULTS = dict(mode="step", loss="xent8", model="gpt124", policy="qkv_out",
                 impl="flash", micro=128, seq=512, steps=8, trace=0,
-                gdtype="float32")
+                gdtype="float32", bq=512, bk=512)
 
 
 def run_one(exp: str):
@@ -113,13 +136,15 @@ def run_one(exp: str):
                           num_layers=12, num_heads=12, hidden_size=768,
                           remat=cfg["policy"] != "none",
                           remat_policy=cfg["policy"],
-                          attention_impl=cfg["impl"])
+                          attention_impl=cfg["impl"],
+                          flash_block_q=cfg["bq"], flash_block_k=cfg["bk"])
     elif cfg["model"] == "large710":
         mcfg = GPT2Config(vocab_size=50304, max_seq_len=seq + 1,
                           num_layers=12, num_heads=16, hidden_size=2048,
                           remat=cfg["policy"] != "none",
                           remat_policy=cfg["policy"],
-                          attention_impl=cfg["impl"])
+                          attention_impl=cfg["impl"],
+                          flash_block_q=cfg["bq"], flash_block_k=cfg["bk"])
     else:
         raise ValueError(cfg["model"])
 
@@ -139,6 +164,9 @@ def run_one(exp: str):
         hidden = model.apply({"params": p}, inputs, True, True)
         if loss_kind == "none":
             return hidden.astype(jnp.float32).mean()
+        if loss_kind == "fused":
+            from deepspeed_tpu.ops.kernels import fused_lm_xent
+            return fused_lm_xent(hidden, p["wte"]["embedding"], targets)
         if loss_kind.startswith("xentnr"):
             return chunked_lm_xent(hidden, p["wte"]["embedding"], targets,
                                    num_chunks=int(loss_kind[6:]),

@@ -110,6 +110,62 @@ def main():
                                                  interpret=False))(x),
                 ref_rms)
 
+    # int8 paged decode (grouped path: linear layout, 128-aligned blocks)
+    from deepspeed_tpu.inference.v2.kv_quant import quantize_rows
+    S8, H8, KV8, D8, bs8 = 8, 8, 4, 128, 256
+    KVD8 = KV8 * D8
+    slots8 = (S8 + 1) * bs8
+    kf = jax.random.normal(ks[0], (slots8, KVD8), jnp.float32)
+    vf = jax.random.normal(ks[1], (slots8, KVD8), jnp.float32)
+    qk8, sk8 = quantize_rows(kf, KV8)
+    qv8, sv8 = quantize_rows(vf, KV8)
+    t8 = jnp.arange(S8, dtype=jnp.int32)[:, None]
+    l8 = jnp.asarray([256, 100, 17, 256, 64, 0, 128, 200], jnp.int32)
+    q8 = jax.random.normal(ks[2], (S8, 1, H8, D8), jnp.bfloat16)
+    o8 = jax.jit(lambda a: flash_paged_attention(
+        a, qk8, qv8, t8, l8, l8, block_size=bs8, num_kv_heads=KV8,
+        k_scales=sk8, v_scales=sv8, interpret=False))(q8)
+    ofp = flash_paged_attention(
+        q8, kf.astype(jnp.bfloat16), vf.astype(jnp.bfloat16), t8, l8, l8,
+        block_size=bs8, num_kv_heads=KV8, interpret=True)
+    ok &= check("paged_decode_int8", o8, ofp, atol=6e-2)
+
+    # int8 prefill path (BlockSpec, multi-block): same pool viewed as
+    # 2x blocks of half size (+1 trash block of the halved size)
+    slots_p = (S8 * 2 + 1) * (bs8 // 2)
+    qp = jax.random.normal(ks[2], (S8, 8, H8, D8), jnp.bfloat16)
+    tb = jnp.asarray(np.random.RandomState(1).permutation(S8 * 2)
+                     .reshape(S8, 2), jnp.int32)
+    st = jnp.maximum(l8 - 8, 0)
+    o8p = jax.jit(lambda a: flash_paged_attention(
+        a, qk8[:slots_p], qv8[:slots_p],
+        tb, st, l8, block_size=bs8 // 2, num_kv_heads=KV8,
+        k_scales=sk8[:, :slots_p], v_scales=sv8[:, :slots_p],
+        interpret=False))(qp)
+    ofpp = flash_paged_attention(
+        qp, kf.astype(jnp.bfloat16)[:slots_p],
+        vf.astype(jnp.bfloat16)[:slots_p],
+        tb, st, l8, block_size=bs8 // 2, num_kv_heads=KV8, interpret=True)
+    ok &= check("paged_prefill_int8", o8p, ofpp, atol=6e-2)
+
+    # streaming fused LM-head xent: loss + grads vs the chunked reference
+    from deepspeed_tpu.models._lm_utils import chunked_lm_xent
+    from deepspeed_tpu.ops.kernels import fused_lm_xent
+    hx = jax.random.normal(ks[0], (4, 128, 512), jnp.bfloat16) * 0.5
+    ex = jax.random.normal(ks[1], (4000, 512), jnp.bfloat16) * 0.2
+    tx = jax.random.randint(ks[2], (4, 128), 0, 4000)
+    lf = jax.jit(lambda a, b: fused_lm_xent(a, b, tx, interpret=False))
+    lr = float(chunked_lm_xent(hx, ex, tx, num_chunks=4))
+    ok &= check("fused_xent_fwd", lf(hx, ex), lr, atol=2e-2)
+    gf = jax.jit(jax.grad(lambda a, b: fused_lm_xent(
+        a, b, tx, interpret=False), argnums=(0, 1)))(hx, ex)
+    gr2 = jax.grad(lambda a, b: chunked_lm_xent(
+        a, b, tx, 4), argnums=(0, 1))(hx, ex)
+    ok &= check("fused_xent_dh", gf[0].astype(jnp.float32),
+                gr2[0].astype(jnp.float32), atol=2e-3)
+    ok &= check("fused_xent_dE", gf[1].astype(jnp.float32),
+                gr2[1].astype(jnp.float32), atol=2e-3)
+
     print("TPU_SMOKE " + ("PASS" if ok else "FAIL"), flush=True)
     return 0 if ok else 1
 
