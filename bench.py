@@ -61,6 +61,10 @@ def bench_train(model_kind: str = "gpt124"):
             remat=os.environ.get("DSTPU_TRAIN_REMAT", "1") == "1",
             remat_policy=os.environ.get("DSTPU_TRAIN_POLICY", "qkv_out"),
             attention_impl=os.environ.get("DSTPU_TRAIN_IMPL", "auto"),
+            # flash 1024/1024 tiles measured +3.3 TFLOPS over 512/512 at
+            # seq 2048 (profiles/r04_results.jsonl: big_bqk1024)
+            flash_block_q=int(os.environ.get("DSTPU_TRAIN_BQ", "1024")),
+            flash_block_k=int(os.environ.get("DSTPU_TRAIN_BK", "1024")),
             xent_impl=os.environ.get("DSTPU_TRAIN_XENT", "chunked"))
         grad_accum_dtype = "bfloat16"
         steps = 12

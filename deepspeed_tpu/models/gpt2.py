@@ -43,8 +43,10 @@ class GPT2Config:
     # "auto": Pallas flash attention on TPU, XLA fused attention elsewhere;
     # "flash" / "xla" force one path.
     attention_impl: str = "auto"
-    # flash kernel tile geometry (ops/kernels/flash_attention.py): 512/512
-    # measured best at seq 512 AND seq 2048 on v5e; exposed for profiling
+    # flash kernel tile geometry (ops/kernels/flash_attention.py):
+    # 512/512 measured best at seq 512; 1024/1024 measured +3.3 TFLOPS at
+    # seq 2048 (profiles/r04_results.jsonl big_bqk1024) — the bench sets
+    # it per shape
     flash_block_q: int = 512
     flash_block_k: int = 512
     # fused LM-head xent chunking (models/_lm_utils.chunked_lm_xent):
@@ -118,7 +120,9 @@ class CausalSelfAttention(nn.Module):
             from deepspeed_tpu.ops.kernels import sharded_flash_attention
             from deepspeed_tpu.parallel.topology import get_topology
             y = sharded_flash_attention(q, k, v, get_topology().mesh,
-                                        causal=True, layout="BTHD")
+                                        causal=True, layout="BTHD",
+                                        block_q=cfg.flash_block_q,
+                                        block_k=cfg.flash_block_k)
         elif impl == "xla":
             # jax.nn.dot_product_attention lowers to a fused attention on TPU
             y = jax.nn.dot_product_attention(q, k, v, is_causal=True)

@@ -601,6 +601,7 @@ def sharded_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                             mesh, *, causal: bool = True,
                             sm_scale: Optional[float] = None,
                             layout: str = "BTHD",
+                            block_q: int = 512, block_k: int = 512,
                             batch_axes=("data", "data_inner"),
                             head_axis: str = "model",
                             interpret: Optional[bool] = None) -> jnp.ndarray:
@@ -642,11 +643,13 @@ def sharded_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     pspec = P(*spec)
     if pspec == P(None, None, None, None):
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
-                               layout=layout, interpret=interpret)
+                               layout=layout, block_q=block_q,
+                               block_k=block_k, interpret=interpret)
 
     def local(q_, k_, v_):
         return flash_attention(q_, k_, v_, causal=causal, sm_scale=sm_scale,
-                               layout=layout, interpret=interpret)
+                               layout=layout, block_q=block_q,
+                               block_k=block_k, interpret=interpret)
 
     return shard_map(local, mesh=mesh, in_specs=(pspec, pspec, pspec),
                      out_specs=pspec, check_vma=False)(q, k, v)

@@ -39,7 +39,9 @@ def capture():
     cfg = RaggedInferenceConfig(max_seqs=S, chunk_size=PROMPT, block_size=bs,
                                 num_blocks=S + 4, max_blocks_per_seq=1,
                                 decode_loop_steps=NL, dtype="bfloat16",
-                                attention_impl="paged_flash")
+                                attention_impl="paged_flash",
+                                kv_cache_dtype=os.environ.get(
+                                    "DSTPU_PROF_KV", "auto"))
     eng = InferenceEngineV2(mcfg, params, cfg)
     rng = np.random.RandomState(0)
     prompts = [rng.randint(1, 32000, size=PROMPT).tolist() for _ in range(S)]

@@ -114,6 +114,17 @@ EXPERIMENTS = {
                         gdtype="bfloat16", bq=256, bk=512),
     "big_bqk1024": dict(model="large710", seq=2048, micro=6,
                         gdtype="bfloat16", bq=1024, bk=1024),
+    # round 6: combine the flash 1024-tile win with the fused xent, and
+    # probe whether the xent memory savings admit micro 8
+    "big_b6_fx":   dict(model="large710", seq=2048, micro=6,
+                        gdtype="bfloat16", bq=1024, bk=1024, loss="fused"),
+    "big_b8_fx":   dict(model="large710", seq=2048, micro=8,
+                        gdtype="bfloat16", bq=1024, bk=1024, loss="fused"),
+    "big_b8_gb":   dict(model="large710", seq=2048, micro=8,
+                        gdtype="bfloat16", bq=1024, bk=1024),
+    "big_b6s_fx":  dict(model="large710", seq=2048, micro=6,
+                        policy="save:qkv,attn_out,mlp_pre_act",
+                        gdtype="bfloat16", bq=1024, bk=1024, loss="fused"),
 }
 
 DEFAULTS = dict(mode="step", loss="xent8", model="gpt124", policy="qkv_out",
