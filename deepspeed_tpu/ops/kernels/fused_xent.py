@@ -80,16 +80,16 @@ def _fwd_kernel(h_ref, e_ref, t_ref, lse_ref, tgt_ref, m_scr, l_scr, g_scr,
 
     # target logit: one-hot row reduction inside the tile (a per-row
     # dynamic gather would leave the VPU's vector regime)
-    t_loc = t_ref[...].astype(jnp.int32)[:, None]        # [Tb, 1] global id
+    t_loc = t_ref[...].astype(jnp.int32)                 # [Tb, 1] global id
     hit = col == t_loc
     g_scr[:, :1] = g_scr[:, :1] + jnp.sum(
         jnp.where(hit, logits, 0.0), axis=1, keepdims=True)
 
     @pl.when(j == Vt - 1)
     def _finish():
-        lse_ref[...] = (m_scr[:, :1]
-                        + jnp.log(jnp.maximum(l_scr[:, :1], 1e-37)))[:, 0]
-        tgt_ref[...] = g_scr[:, 0]
+        lse_ref[...] = m_scr[:, :1] + jnp.log(
+            jnp.maximum(l_scr[:, :1], 1e-37))
+        tgt_ref[...] = g_scr[:, :1]
 
 
 def _fwd(h2, emb, tgt2, *, Tb, Vb, interpret):
@@ -106,19 +106,19 @@ def _fwd(h2, emb, tgt2, *, Tb, Vb, interpret):
         in_specs=[
             pl.BlockSpec((Tb, C), lambda i, j: (i, 0)),
             pl.BlockSpec((Vb, C), lambda i, j: (j, 0)),
-            pl.BlockSpec((Tb,), lambda i, j: (i,)),
+            pl.BlockSpec((Tb, 1), lambda i, j: (i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((Tb,), lambda i, j: (i,)),
-            pl.BlockSpec((Tb,), lambda i, j: (i,)),
+            pl.BlockSpec((Tb, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((Tb, 1), lambda i, j: (i, 0)),
         ],
-        out_shape=[jax.ShapeDtypeStruct((N2,), jnp.float32)] * 2,
+        out_shape=[jax.ShapeDtypeStruct((N2, 1), jnp.float32)] * 2,
         scratch_shapes=[pltpu.VMEM((Tb, _LANES), jnp.float32)] * 3,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
-    )(h2, e, tgt2)
-    return lse, tgt
+    )(h2, e, tgt2[:, None])
+    return lse[:, 0], tgt[:, 0]
 
 
 # --------------------------------------------------------------------- #
@@ -137,8 +137,8 @@ def _dh_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, dh_ref, acc_scr,
         h_ref[...], e_ref[...], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
     col = j * Vb + jax.lax.broadcasted_iota(jnp.int32, (Tb, Vb), 1)
-    p = jnp.where(col < V, jnp.exp(logits - lse_ref[...][:, None]), 0.0)
-    t_loc = t_ref[...].astype(jnp.int32)[:, None]
+    p = jnp.where(col < V, jnp.exp(logits - lse_ref[...]), 0.0)
+    t_loc = t_ref[...].astype(jnp.int32)                 # [Tb, 1]
     p = p - jnp.where(col == t_loc, 1.0, 0.0)
     acc_scr[:] = acc_scr[:] + jax.lax.dot_general(
         p.astype(h_ref.dtype), e_ref[...], (((1,), (0,)), ((), ())),
@@ -166,8 +166,8 @@ def _de_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, de_ref, acc_scr,
         h_ref[...], e_ref[...], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)              # [Tb, Vb]
     col = j * Vb + jax.lax.broadcasted_iota(jnp.int32, (Tb, Vb), 1)
-    p = jnp.where(col < V, jnp.exp(logits - lse_ref[...][:, None]), 0.0)
-    t_loc = t_ref[...].astype(jnp.int32)[:, None]
+    p = jnp.where(col < V, jnp.exp(logits - lse_ref[...]), 0.0)
+    t_loc = t_ref[...].astype(jnp.int32)                 # [Tb, 1]
     p = p - jnp.where(col == t_loc, 1.0, 0.0)
     # padded token rows carry P = uniform garbage (their h rows are zero
     # but lse is finite): mask them out of the vocab-side reduction
@@ -223,8 +223,8 @@ def _xent_bwd_rule(N, Tb, Vb, interpret, res, g):
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((Tb, C), lambda i, j: (i, 0)),
             pl.BlockSpec((Vb, C), lambda i, j: (j, 0)),
-            pl.BlockSpec((Tb,), lambda i, j: (i,)),
-            pl.BlockSpec((Tb,), lambda i, j: (i,)),
+            pl.BlockSpec((Tb, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((Tb, 1), lambda i, j: (i, 0)),
         ],
         out_specs=pl.BlockSpec((1, Tb, C), lambda i, j: (i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((Nt, Tb, C), h2.dtype),
@@ -232,7 +232,7 @@ def _xent_bwd_rule(N, Tb, Vb, interpret, res, g):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
-    )(scale, h2, e, tgt2, lse).reshape(N2, C)
+    )(scale, h2, e, tgt2[:, None], lse[:, None]).reshape(N2, C)
 
     de = pl.pallas_call(
         functools.partial(_de_kernel, Tb=Tb, Vb=Vb, V=V, N=N, Nt=Nt),
@@ -241,8 +241,8 @@ def _xent_bwd_rule(N, Tb, Vb, interpret, res, g):
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((Tb, C), lambda j, i: (i, 0)),
             pl.BlockSpec((Vb, C), lambda j, i: (j, 0)),
-            pl.BlockSpec((Tb,), lambda j, i: (i,)),
-            pl.BlockSpec((Tb,), lambda j, i: (i,)),
+            pl.BlockSpec((Tb, 1), lambda j, i: (i, 0)),
+            pl.BlockSpec((Tb, 1), lambda j, i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((1, Vb, C), lambda j, i: (j, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((Vt, Vb, C), jnp.float32),
@@ -250,7 +250,7 @@ def _xent_bwd_rule(N, Tb, Vb, interpret, res, g):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
-    )(scale, h2, e, tgt2, lse).reshape(Vt * Vb, C)[:V]
+    )(scale, h2, e, tgt2[:, None], lse[:, None]).reshape(Vt * Vb, C)[:V]
 
     return dh, de.astype(emb.dtype), None
 

@@ -103,12 +103,20 @@ def main():
         print(f"step {i}: loss {losses[-1]:.4f} "
               f"({time.time() - t0:.0f}s)", flush=True)
     stats = dev.memory_stats() or {}
-    peak = stats.get("peak_bytes_in_use", 0)
+    peak = stats.get("peak_bytes_in_use")
     rec = {
         "n_params_b": round(n_params / 1e9, 3),
         "training_state_gib": round(state_bytes / (1 << 30), 1),
         "hbm_gib": 16,
-        "device_peak_bytes_in_use_gib": round(peak / (1 << 30), 2),
+        # allocator stats are not exposed over the axon tunnel
+        # (memory_stats() comes back empty) — record None rather than a
+        # misleading 0.0; the in-step device budget is asserted by
+        # tests/unit/test_offload.py::test_param_streaming_in_step
+        "device_peak_bytes_in_use_gib": (round(peak / (1 << 30), 2)
+                                         if peak else None),
+        "note": (None if peak else
+                 "device allocator stats unavailable over the tunnel; "
+                 "budget asserted by test_param_streaming_in_step"),
         "losses": [round(x, 4) for x in losses],
         "seq_len": T, "micro": MICRO, "window": WINDOW,
         "config": "zero3 + offload_optimizer=cpu + offload_param"
