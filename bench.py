@@ -192,7 +192,12 @@ def bench_serve():
         # 18.8k decode tok/s (int8 pool) — 64 is the sweet spot
         decode_loop_steps=int(os.environ.get("DSTPU_BENCH_LOOP", "64")),
         dtype="bfloat16", attention_impl=impl,
-        kv_cache_dtype="int8" if kv_dtype == "int8" else "auto")
+        kv_cache_dtype="int8" if kv_dtype == "int8" else "auto",
+        # S=256 x 512-token prompts fit in one prefill forward (the r3
+        # 40.5k prefill configuration) so the default is uncapped there;
+        # bigger-slot configs keep the 32768 budget (S=384 OOMs uncapped)
+        max_batch_tokens=int(os.environ.get(
+            "DSTPU_BENCH_BUDGET", "0" if S <= 256 else "32768")))
     eng = InferenceEngineV2(mcfg, params, cfg)
 
     rng = np.random.RandomState(0)

@@ -70,6 +70,26 @@ class InferenceEngineV2:
         self.config = config or RaggedInferenceConfig()
         self.params = params
         self.runner = runner or _runner_for(model_cfg, self.config)
+        if self.config.kv_cache_dtype == "int8" \
+                and self.config.attention_impl in ("auto", "paged_flash") \
+                and jax.default_backend() == "tpu":
+            # surface the Mosaic DMA-tiling constraint of the int8 decode
+            # kernel at engine construction, not deep inside a compile
+            # (the dense fallback dequantizes per row and has no such
+            # constraint — it is exempt)
+            kvd = self.runner.kv_heads * self.runner.head_dim
+            if kvd % 128:
+                raise ValueError(
+                    f"kv_cache_dtype='int8' with the paged-flash kernel "
+                    f"needs kv_heads*head_dim ({kvd}) to be a multiple of "
+                    f"128 (int8 DMA tiling); use attention_impl='dense' "
+                    f"or the bf16 pool for this head geometry")
+            if self.config.block_size % 128:
+                raise ValueError(
+                    f"kv_cache_dtype='int8' with the paged-flash kernel "
+                    f"needs block_size ({self.config.block_size}) to be a "
+                    f"multiple of 128 (int8 DMA tiling); round block_size "
+                    f"up, or use attention_impl='dense' or the bf16 pool")
         self.kv_cache = BlockedKVCache(
             self.config, self.runner.num_layers, self.runner.kv_heads,
             self.runner.head_dim, dtype=resolve_dtype(self.config.dtype))

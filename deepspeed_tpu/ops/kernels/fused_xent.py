@@ -260,7 +260,7 @@ _xent_core.defvjp(_xent_fwd_rule, _xent_bwd_rule)
 
 def fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
                   targets: jnp.ndarray, *, token_block: Optional[int] = None,
-                  vocab_block: int = 512,
+                  vocab_block: Optional[int] = None,
                   interpret: Optional[bool] = None) -> jnp.ndarray:
     """Mean next-token NLL with logits never materialized in HBM.
 
@@ -280,6 +280,13 @@ def fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
         # budget (h tile + f32 dh accumulator + double-buffered emb tiles)
         # caps Tb at 256 for C ~ 2048.
         token_block = 512 if C <= 1024 else 256
+    if vocab_block is None:
+        # prefer a lane-aligned tile that DIVIDES V: the pad path copies
+        # the whole [V, C] embedding (fwd + both bwd passes) just to add
+        # the tail rows. 50304 (gpt2 padded vocab) -> 384; 32000 -> 256.
+        V = embedding.shape[0]
+        vocab_block = next((c for c in (512, 384, 256, 128)
+                            if V % c == 0), 512)
     Tb = min(token_block, _round_up(N, 8))
     N2 = _round_up(N, Tb)
     if N2 != N:

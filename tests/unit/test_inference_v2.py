@@ -877,3 +877,11 @@ class TestKVInt8:
                 np.testing.assert_allclose(
                     np.asarray(o_full[s_i, 0, h]), np.asarray(want),
                     atol=5e-5, rtol=5e-5)
+
+    def test_int8_alignment_guard_on_tpu(self, monkeypatch):
+        # the Mosaic DMA-tiling constraint must surface at engine
+        # construction on TPU, not deep inside a kernel compile
+        _, cfg_i8, mcfg, _, params = self._cfgs(block_size=4)
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        with pytest.raises(ValueError, match="multiples of 128"):
+            InferenceEngineV2(mcfg, params, cfg_i8)
