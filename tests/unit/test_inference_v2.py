@@ -883,5 +883,9 @@ class TestKVInt8:
         # construction on TPU, not deep inside a kernel compile
         _, cfg_i8, mcfg, _, params = self._cfgs(block_size=4)
         monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-        with pytest.raises(ValueError, match="multiples of 128"):
+        with pytest.raises(ValueError, match="multiple of 128"):
             InferenceEngineV2(mcfg, params, cfg_i8)
+        # the dense fallback has no Mosaic constraint — exempt
+        cfg_dense = RaggedInferenceConfig(**{**cfg_i8.__dict__,
+                                             "attention_impl": "dense"})
+        InferenceEngineV2(mcfg, params, cfg_dense)
