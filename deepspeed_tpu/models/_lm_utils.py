@@ -29,7 +29,8 @@ def make_causal_lm(model, cfg):
 
 def chunked_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
                     targets: jnp.ndarray, num_chunks: int = 8,
-                    remat: bool = True) -> jnp.ndarray:
+                    remat: bool = True,
+                    ignore_index: int = None) -> jnp.ndarray:
     """Mean next-token NLL without ever materializing the full logits.
 
     ``hidden`` [B, T, C] (compute dtype, e.g. bf16), ``embedding`` [V, C]
@@ -42,7 +43,9 @@ def chunked_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
     bytes resident, but the backward skips the whole unembed recompute —
     measured worth ~2 TFLOPS/chip at the 710M/seq-2k bench shape where the
     memory fits. The reference always pays the full-logits cost (training
-    goes through torch xent).
+    goes through torch xent). ``ignore_index`` (torch cross_entropy
+    semantics, e.g. -100) drops those positions from the loss AND the
+    mean divisor.
     """
     B, T, C = hidden.shape
     nc = num_chunks
@@ -52,12 +55,16 @@ def chunked_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
 
     def chunk_nll(h, t):
         # [B, Tc, C] @ [V, C]^T -> [B, Tc, V] fp32 (bf16 MXU, f32 accum)
+        tc = jnp.clip(t, 0, emb.shape[0] - 1)       # ignore ids may be -100
         logits = jax.lax.dot_general(
             h, emb, (((2,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         lse = jax.nn.logsumexp(logits, axis=-1)
-        tgt = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
-        return (lse - tgt).sum()
+        tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        nll = lse - tgt
+        if ignore_index is not None:
+            nll = jnp.where(t == ignore_index, 0.0, nll)
+        return nll.sum()
 
     if remat:
         chunk_nll = jax.checkpoint(chunk_nll)
@@ -70,6 +77,9 @@ def chunked_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
         return acc + chunk_nll(h, t), None
 
     total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ts))
+    if ignore_index is not None:
+        count = jnp.maximum((targets != ignore_index).sum(), 1)
+        return total / count
     return total / (B * T)
 
 

@@ -58,6 +58,9 @@ class GPT2Config:
     xent_chunks: int = 8
     xent_remat: bool = True
     xent_impl: str = "chunked"
+    # torch cross_entropy ignore_index semantics (e.g. -100 for padded
+    # labels): dropped from the loss, the divisor, and both gradients
+    xent_ignore_index: Any = None
 
     @staticmethod
     def tiny(**kw):
@@ -280,9 +283,11 @@ def make_model(cfg: GPT2Config):
                 f"{cfg.xent_impl!r}")
         if cfg.xent_impl == "fused":
             from ..ops.kernels import fused_lm_xent
-            return fused_lm_xent(hidden, params["wte"]["embedding"], targets)
+            return fused_lm_xent(hidden, params["wte"]["embedding"], targets,
+                                 ignore_index=cfg.xent_ignore_index)
         return chunked_lm_xent(hidden, params["wte"]["embedding"], targets,
                                num_chunks=cfg.xent_chunks,
-                               remat=cfg.xent_remat)
+                               remat=cfg.xent_remat,
+                               ignore_index=cfg.xent_ignore_index)
 
     return model, init_fn, loss_fn

@@ -126,7 +126,7 @@ def _fwd(h2, emb, tgt2, *, Tb, Vb, interpret):
 # --------------------------------------------------------------------- #
 
 def _dh_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, dh_ref, acc_scr,
-               *, Tb, Vb, V, Vt):
+               *, Tb, Vb, V, Vt, ignore):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -140,6 +140,9 @@ def _dh_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, dh_ref, acc_scr,
     p = jnp.where(col < V, jnp.exp(logits - lse_ref[...]), 0.0)
     t_loc = t_ref[...].astype(jnp.int32)                 # [Tb, 1]
     p = p - jnp.where(col == t_loc, 1.0, 0.0)
+    if ignore is not None:
+        # ignored positions contribute zero gradient
+        p = jnp.where(t_loc == ignore, 0.0, p)
     acc_scr[:] = acc_scr[:] + jax.lax.dot_general(
         p.astype(h_ref.dtype), e_ref[...], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)              # [Tb, C]
@@ -154,7 +157,7 @@ def _dh_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, dh_ref, acc_scr,
 # --------------------------------------------------------------------- #
 
 def _de_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, de_ref, acc_scr,
-               *, Tb, Vb, V, N, Nt):
+               *, Tb, Vb, V, N, Nt, ignore):
     i = pl.program_id(1)
     j = pl.program_id(0)
 
@@ -169,6 +172,8 @@ def _de_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, de_ref, acc_scr,
     p = jnp.where(col < V, jnp.exp(logits - lse_ref[...]), 0.0)
     t_loc = t_ref[...].astype(jnp.int32)                 # [Tb, 1]
     p = p - jnp.where(col == t_loc, 1.0, 0.0)
+    if ignore is not None:
+        p = jnp.where(t_loc == ignore, 0.0, p)
     # padded token rows carry P = uniform garbage (their h rows are zero
     # but lse is finite): mask them out of the vocab-side reduction
     row = i * Tb + jax.lax.broadcasted_iota(jnp.int32, (Tb, Vb), 0)
@@ -186,24 +191,31 @@ def _de_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, de_ref, acc_scr,
 # public op with custom VJP
 # --------------------------------------------------------------------- #
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _xent_core(h2, emb, tgt2, N, Tb, Vb, interpret):
-    """Sum of next-token NLL over the first ``N`` (valid) rows. The SUM —
-    not the mean — is the custom-vjp boundary so the incoming cotangent
-    is a SCALAR (the mean's 1/N folds outside); per-row cotangents would
-    need a non-separable dE scaling the kernels cannot fold."""
+def _valid_rows(tgt2, N, ignore):
+    valid = jnp.arange(tgt2.shape[0]) < N
+    if ignore is not None:
+        valid = jnp.logical_and(valid, tgt2 != ignore)
+    return valid
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _xent_core(h2, emb, tgt2, N, Tb, Vb, ignore, interpret):
+    """Sum of next-token NLL over the first ``N`` (valid, non-ignored)
+    rows. The SUM — not the mean — is the custom-vjp boundary so the
+    incoming cotangent is a SCALAR (the mean's 1/count folds outside);
+    per-row cotangents would need a non-separable dE scaling the kernels
+    cannot fold."""
     lse, tgt = _fwd(h2, emb, tgt2, Tb=Tb, Vb=Vb, interpret=interpret)
-    valid = jnp.arange(h2.shape[0]) < N
-    return jnp.where(valid, lse - tgt, 0.0).sum()
+    return jnp.where(_valid_rows(tgt2, N, ignore), lse - tgt, 0.0).sum()
 
 
-def _xent_fwd_rule(h2, emb, tgt2, N, Tb, Vb, interpret):
+def _xent_fwd_rule(h2, emb, tgt2, N, Tb, Vb, ignore, interpret):
     lse, tgt = _fwd(h2, emb, tgt2, Tb=Tb, Vb=Vb, interpret=interpret)
-    valid = jnp.arange(h2.shape[0]) < N
-    return jnp.where(valid, lse - tgt, 0.0).sum(), (h2, emb, tgt2, lse)
+    total = jnp.where(_valid_rows(tgt2, N, ignore), lse - tgt, 0.0).sum()
+    return total, (h2, emb, tgt2, lse)
 
 
-def _xent_bwd_rule(N, Tb, Vb, interpret, res, g):
+def _xent_bwd_rule(N, Tb, Vb, ignore, interpret, res, g):
     h2, emb, tgt2, lse = res
     N2, C = h2.shape
     V = emb.shape[0]
@@ -217,7 +229,8 @@ def _xent_bwd_rule(N, Tb, Vb, interpret, res, g):
     scale = jnp.reshape(g, (1,)).astype(jnp.float32)
 
     dh = pl.pallas_call(
-        functools.partial(_dh_kernel, Tb=Tb, Vb=Vb, V=V, Vt=Vt),
+        functools.partial(_dh_kernel, Tb=Tb, Vb=Vb, V=V, Vt=Vt,
+                          ignore=ignore),
         grid=(Nt, Vt),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -235,7 +248,8 @@ def _xent_bwd_rule(N, Tb, Vb, interpret, res, g):
     )(scale, h2, e, tgt2[:, None], lse[:, None]).reshape(N2, C)
 
     de = pl.pallas_call(
-        functools.partial(_de_kernel, Tb=Tb, Vb=Vb, V=V, N=N, Nt=Nt),
+        functools.partial(_de_kernel, Tb=Tb, Vb=Vb, V=V, N=N, Nt=Nt,
+                          ignore=ignore),
         grid=(Vt, Nt),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -261,12 +275,15 @@ _xent_core.defvjp(_xent_fwd_rule, _xent_bwd_rule)
 def fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
                   targets: jnp.ndarray, *, token_block: Optional[int] = None,
                   vocab_block: Optional[int] = None,
+                  ignore_index: Optional[int] = None,
                   interpret: Optional[bool] = None) -> jnp.ndarray:
     """Mean next-token NLL with logits never materialized in HBM.
 
     hidden [B, T, C] (or [N, C]) in the compute dtype, embedding [V, C]
     (the tied LM head), targets [B, T] (or [N]) int32. Differentiable in
     (hidden, embedding); the backward recomputes P tiles on the MXU.
+    ``ignore_index`` (torch cross_entropy semantics, e.g. -100) drops
+    those positions from the loss, the divisor, and both gradients.
     """
     if interpret is None:
         from . import default_interpret
@@ -292,5 +309,13 @@ def fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
     if N2 != N:
         h2 = jnp.pad(h2, ((0, N2 - N), (0, 0)))
         t1 = jnp.pad(t1, (0, N2 - N))
-    total = _xent_core(h2, embedding, t1, N, Tb, vocab_block, interpret)
-    return total / N
+    # out-of-range ids (e.g. -100) need no clamping: the kernels never
+    # index with targets — the one-hot compare simply never hits, and
+    # the ignore masks zero those rows' loss and gradients
+    total = _xent_core(h2, embedding, t1, N, Tb, vocab_block,
+                       ignore_index, interpret)
+    if ignore_index is None:
+        return total / N
+    count = jnp.maximum(
+        (targets.reshape(-1) != ignore_index).sum(), 1)
+    return total / count

@@ -889,3 +889,36 @@ class TestKVInt8:
         cfg_dense = RaggedInferenceConfig(**{**cfg_i8.__dict__,
                                              "attention_impl": "dense"})
         InferenceEngineV2(mcfg, params, cfg_dense)
+
+    def test_kernel_int8_sliding_window(self):
+        # mistral-class sliding window over an int8 pool: the window mask
+        # must compose with score/prob scaling (scale applied pre-mask)
+        from deepspeed_tpu.inference.v2.kv_quant import quantize_rows
+        from deepspeed_tpu.ops.kernels import flash_paged_attention
+        rng = np.random.default_rng(8)
+        S, H, KV, D = 2, 4, 2, 16
+        KVD = KV * D
+        bs = 64
+        slots = (S + 1) * bs
+        kf = jnp.asarray(rng.normal(size=(slots, KVD)), jnp.float32)
+        vf = jnp.asarray(rng.normal(size=(slots, KVD)), jnp.float32)
+        qk, sk = quantize_rows(kf, KV)
+        qv, sv = quantize_rows(vf, KV)
+        tables = jnp.arange(S, dtype=jnp.int32)[:, None]
+        lens = jnp.asarray([60, 33], jnp.int32)
+        # kernel contract: start_pos is the query's own position and its
+        # K/V row is already in the pool — the engine always calls with
+        # start = seq_len - 1 at decode
+        start = lens - 1
+        q = jnp.asarray(rng.normal(size=(S, 1, H, D)), jnp.float32)
+        win = 16
+        o_fp = flash_paged_attention(q, kf, vf, tables, start, lens,
+                                     block_size=bs, num_kv_heads=KV,
+                                     sliding_window=win, interpret=True)
+        o_i8 = flash_paged_attention(q, qk, qv, tables, start, lens,
+                                     block_size=bs, num_kv_heads=KV,
+                                     k_scales=sk, v_scales=sv,
+                                     sliding_window=win, interpret=True)
+        rel = float(jnp.max(jnp.abs(o_fp - o_i8))) / float(
+            jnp.max(jnp.abs(o_fp)))
+        assert rel < 0.05

@@ -488,3 +488,43 @@ class TestFusedXent:
         gnorm = sum(float(jnp.sum(g * g))
                     for g in jax.tree_util.tree_leaves(grads))
         assert gnorm > 0
+
+    def test_ignore_index(self):
+        # torch cross_entropy ignore_index semantics: dropped from loss,
+        # divisor, and BOTH gradients, in both implementations
+        from deepspeed_tpu.models._lm_utils import chunked_lm_xent
+        from deepspeed_tpu.ops.kernels import fused_lm_xent
+        h, emb, tgt = self._data(T=20)
+        mask = np.zeros((2, 20), bool)
+        mask[0, 3:7] = True
+        mask[1, -5:] = True
+        tgt_ig = jnp.where(jnp.asarray(mask), -100, tgt)
+
+        # reference: mean over kept positions only
+        logits = (h.astype(jnp.float32)
+                  @ emb.astype(jnp.float32).T)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        t_c = jnp.clip(tgt_ig, 0, emb.shape[0] - 1)
+        nll = lse - jnp.take_along_axis(logits, t_c[..., None], -1)[..., 0]
+        want = float(jnp.where(tgt_ig == -100, 0, nll).sum()
+                     / (~mask).sum())
+
+        got_c = chunked_lm_xent(h, emb, tgt_ig, num_chunks=4,
+                                ignore_index=-100)
+        got_f = fused_lm_xent(h, emb, tgt_ig, token_block=16,
+                              vocab_block=128, ignore_index=-100,
+                              interpret=True)
+        assert abs(float(got_c) - want) < 1e-4
+        assert abs(float(got_f) - want) < 1e-4
+
+        # gradients: zero flow through ignored positions
+        gh_c, ge_c = jax.grad(lambda a, b: chunked_lm_xent(
+            a, b, tgt_ig, 4, ignore_index=-100), (0, 1))(h, emb)
+        gh_f, ge_f = jax.grad(lambda a, b: fused_lm_xent(
+            a, b, tgt_ig, token_block=16, vocab_block=128,
+            ignore_index=-100, interpret=True), (0, 1))(h, emb)
+        m3 = jnp.asarray(mask)[..., None]
+        assert float(jnp.abs(jnp.where(m3, gh_f, 0)).max()) == 0.0
+        for a, b in ((gh_c, gh_f), (ge_c, ge_f)):
+            d = float(jnp.max(jnp.abs(a - b))) / float(jnp.max(jnp.abs(a)))
+            assert d < 1e-3
