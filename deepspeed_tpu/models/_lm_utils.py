@@ -30,7 +30,7 @@ def make_causal_lm(model, cfg):
 def chunked_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
                     targets: jnp.ndarray, num_chunks: int = 8,
                     remat: bool = True,
-                    ignore_index: int = None) -> jnp.ndarray:
+                    ignore_index: Optional[int] = None) -> jnp.ndarray:
     """Mean next-token NLL without ever materializing the full logits.
 
     ``hidden`` [B, T, C] (compute dtype, e.g. bf16), ``embedding`` [V, C]

@@ -60,7 +60,7 @@ class GPT2Config:
     xent_impl: str = "chunked"
     # torch cross_entropy ignore_index semantics (e.g. -100 for padded
     # labels): dropped from the loss, the divisor, and both gradients
-    xent_ignore_index: Any = None
+    xent_ignore_index: Optional[int] = None
 
     @staticmethod
     def tiny(**kw):

@@ -126,7 +126,7 @@ def _fwd(h2, emb, tgt2, *, Tb, Vb, interpret):
 # --------------------------------------------------------------------- #
 
 def _dh_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, dh_ref, acc_scr,
-               *, Tb, Vb, V, Vt, ignore):
+               *, Tb, Vb, V, Vt, ignore, z):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -138,6 +138,9 @@ def _dh_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, dh_ref, acc_scr,
         preferred_element_type=jnp.float32)
     col = j * Vb + jax.lax.broadcasted_iota(jnp.int32, (Tb, Vb), 1)
     p = jnp.where(col < V, jnp.exp(logits - lse_ref[...]), 0.0)
+    if z:
+        # d[nll + z*lse^2]/dlogits = (1 + 2z*lse)*P - onehot
+        p = p * (1.0 + 2.0 * z * lse_ref[...])
     t_loc = t_ref[...].astype(jnp.int32)                 # [Tb, 1]
     p = p - jnp.where(col == t_loc, 1.0, 0.0)
     if ignore is not None:
@@ -157,7 +160,7 @@ def _dh_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, dh_ref, acc_scr,
 # --------------------------------------------------------------------- #
 
 def _de_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, de_ref, acc_scr,
-               *, Tb, Vb, V, N, Nt, ignore):
+               *, Tb, Vb, V, N, Nt, ignore, z):
     i = pl.program_id(1)
     j = pl.program_id(0)
 
@@ -170,6 +173,8 @@ def _de_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, de_ref, acc_scr,
         preferred_element_type=jnp.float32)              # [Tb, Vb]
     col = j * Vb + jax.lax.broadcasted_iota(jnp.int32, (Tb, Vb), 1)
     p = jnp.where(col < V, jnp.exp(logits - lse_ref[...]), 0.0)
+    if z:
+        p = p * (1.0 + 2.0 * z * lse_ref[...])
     t_loc = t_ref[...].astype(jnp.int32)                 # [Tb, 1]
     p = p - jnp.where(col == t_loc, 1.0, 0.0)
     if ignore is not None:
@@ -198,24 +203,31 @@ def _valid_rows(tgt2, N, ignore):
     return valid
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _xent_core(h2, emb, tgt2, N, Tb, Vb, ignore, interpret):
-    """Sum of next-token NLL over the first ``N`` (valid, non-ignored)
-    rows. The SUM — not the mean — is the custom-vjp boundary so the
-    incoming cotangent is a SCALAR (the mean's 1/count folds outside);
-    per-row cotangents would need a non-separable dE scaling the kernels
-    cannot fold."""
+def _core_total(lse, tgt, tgt2, N, ignore, z):
+    valid = _valid_rows(tgt2, N, ignore)
+    nll = lse - tgt
+    if z:
+        nll = nll + z * lse * lse       # PaLM-style z-loss stabilizer
+    return jnp.where(valid, nll, 0.0).sum()
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _xent_core(h2, emb, tgt2, N, Tb, Vb, ignore, z, interpret):
+    """Sum of next-token NLL (+ optional z-loss) over the first ``N``
+    (valid, non-ignored) rows. The SUM — not the mean — is the
+    custom-vjp boundary so the incoming cotangent is a SCALAR (the
+    mean's 1/count folds outside); per-row cotangents would need a
+    non-separable dE scaling the kernels cannot fold."""
     lse, tgt = _fwd(h2, emb, tgt2, Tb=Tb, Vb=Vb, interpret=interpret)
-    return jnp.where(_valid_rows(tgt2, N, ignore), lse - tgt, 0.0).sum()
+    return _core_total(lse, tgt, tgt2, N, ignore, z)
 
 
-def _xent_fwd_rule(h2, emb, tgt2, N, Tb, Vb, ignore, interpret):
+def _xent_fwd_rule(h2, emb, tgt2, N, Tb, Vb, ignore, z, interpret):
     lse, tgt = _fwd(h2, emb, tgt2, Tb=Tb, Vb=Vb, interpret=interpret)
-    total = jnp.where(_valid_rows(tgt2, N, ignore), lse - tgt, 0.0).sum()
-    return total, (h2, emb, tgt2, lse)
+    return _core_total(lse, tgt, tgt2, N, ignore, z), (h2, emb, tgt2, lse)
 
 
-def _xent_bwd_rule(N, Tb, Vb, ignore, interpret, res, g):
+def _xent_bwd_rule(N, Tb, Vb, ignore, z, interpret, res, g):
     h2, emb, tgt2, lse = res
     N2, C = h2.shape
     V = emb.shape[0]
@@ -230,7 +242,7 @@ def _xent_bwd_rule(N, Tb, Vb, ignore, interpret, res, g):
 
     dh = pl.pallas_call(
         functools.partial(_dh_kernel, Tb=Tb, Vb=Vb, V=V, Vt=Vt,
-                          ignore=ignore),
+                          ignore=ignore, z=z),
         grid=(Nt, Vt),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -249,7 +261,7 @@ def _xent_bwd_rule(N, Tb, Vb, ignore, interpret, res, g):
 
     de = pl.pallas_call(
         functools.partial(_de_kernel, Tb=Tb, Vb=Vb, V=V, N=N, Nt=Nt,
-                          ignore=ignore),
+                          ignore=ignore, z=z),
         grid=(Vt, Nt),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -276,6 +288,7 @@ def fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
                   targets: jnp.ndarray, *, token_block: Optional[int] = None,
                   vocab_block: Optional[int] = None,
                   ignore_index: Optional[int] = None,
+                  z_loss: float = 0.0,
                   interpret: Optional[bool] = None) -> jnp.ndarray:
     """Mean next-token NLL with logits never materialized in HBM.
 
@@ -284,6 +297,9 @@ def fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
     (hidden, embedding); the backward recomputes P tiles on the MXU.
     ``ignore_index`` (torch cross_entropy semantics, e.g. -100) drops
     those positions from the loss, the divisor, and both gradients.
+    ``z_loss`` adds the PaLM-style ``z * logsumexp^2`` stabilizer per
+    valid position (folded into the same kernels: the backward's P
+    factor becomes ``1 + 2z*lse``).
     """
     if interpret is None:
         from . import default_interpret
@@ -313,7 +329,7 @@ def fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
     # index with targets — the one-hot compare simply never hits, and
     # the ignore masks zero those rows' loss and gradients
     total = _xent_core(h2, embedding, t1, N, Tb, vocab_block,
-                       ignore_index, interpret)
+                       ignore_index, float(z_loss), interpret)
     if ignore_index is None:
         return total / N
     count = jnp.maximum(
