@@ -53,8 +53,8 @@ def _round_up(x: int, m: int) -> int:
 # forward: lse + target logit, no logits in HBM
 # --------------------------------------------------------------------- #
 
-def _fwd_kernel(h_ref, e_ref, t_ref, lse_ref, tgt_ref, m_scr, l_scr, g_scr,
-                *, Tb, Vb, V, Vt):
+def _fwd_kernel(h_ref, e_ref, t_ref, lse_ref, tgt_ref, lsum_ref, m_scr,
+                l_scr, g_scr, s_scr, *, Tb, Vb, V, Vt, eps):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -62,11 +62,18 @@ def _fwd_kernel(h_ref, e_ref, t_ref, lse_ref, tgt_ref, m_scr, l_scr, g_scr,
         m_scr[:] = jnp.full(m_scr.shape, _NEG_INF, m_scr.dtype)
         l_scr[:] = jnp.zeros(l_scr.shape, l_scr.dtype)
         g_scr[:] = jnp.zeros(g_scr.shape, g_scr.dtype)
+        s_scr[:] = jnp.zeros(s_scr.shape, s_scr.dtype)
 
     logits = jax.lax.dot_general(
         h_ref[...], e_ref[...], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)              # [Tb, Vb]
     col = j * Vb + jax.lax.broadcasted_iota(jnp.int32, (Tb, Vb), 1)
+    if eps:
+        # label smoothing's uniform term wants sum_j logits_j over the
+        # REAL vocab columns — accumulated pre-mask (the -inf form can't
+        # be summed). Statically skipped when smoothing is off.
+        s_scr[:, :1] = s_scr[:, :1] + jnp.sum(
+            jnp.where(col < V, logits, 0.0), axis=1, keepdims=True)
     logits = jnp.where(col < V, logits, _NEG_INF)
 
     m_prev, l_prev = m_scr[:, :1], l_scr[:, :1]
@@ -90,17 +97,19 @@ def _fwd_kernel(h_ref, e_ref, t_ref, lse_ref, tgt_ref, m_scr, l_scr, g_scr,
         lse_ref[...] = m_scr[:, :1] + jnp.log(
             jnp.maximum(l_scr[:, :1], 1e-37))
         tgt_ref[...] = g_scr[:, :1]
+        lsum_ref[...] = s_scr[:, :1]
 
 
-def _fwd(h2, emb, tgt2, *, Tb, Vb, interpret):
+def _fwd(h2, emb, tgt2, *, Tb, Vb, eps, interpret):
     N2, C = h2.shape
     V = emb.shape[0]
     Nt, Vt = N2 // Tb, _round_up(V, Vb) // Vb
     Vpad = Vt * Vb - V
     e = jnp.pad(emb, ((0, Vpad), (0, 0))) if Vpad else emb
     e = e.astype(h2.dtype)
-    kernel = functools.partial(_fwd_kernel, Tb=Tb, Vb=Vb, V=V, Vt=Vt)
-    lse, tgt = pl.pallas_call(
+    kernel = functools.partial(_fwd_kernel, Tb=Tb, Vb=Vb, V=V, Vt=Vt,
+                               eps=eps)
+    lse, tgt, lsum = pl.pallas_call(
         kernel,
         grid=(Nt, Vt),
         in_specs=[
@@ -111,22 +120,40 @@ def _fwd(h2, emb, tgt2, *, Tb, Vb, interpret):
         out_specs=[
             pl.BlockSpec((Tb, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((Tb, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((Tb, 1), lambda i, j: (i, 0)),
         ],
-        out_shape=[jax.ShapeDtypeStruct((N2, 1), jnp.float32)] * 2,
-        scratch_shapes=[pltpu.VMEM((Tb, _LANES), jnp.float32)] * 3,
+        out_shape=[jax.ShapeDtypeStruct((N2, 1), jnp.float32)] * 3,
+        scratch_shapes=[pltpu.VMEM((Tb, _LANES), jnp.float32)] * 4,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(h2, e, tgt2[:, None])
-    return lse[:, 0], tgt[:, 0]
+    return lse[:, 0], tgt[:, 0], lsum[:, 0]
 
 
 # --------------------------------------------------------------------- #
 # backward pass 1: dh = scale * (P - onehot) @ E   (token-tile outer)
 # --------------------------------------------------------------------- #
 
+def _grad_p(logits, lse_col, t_loc, col, *, V, z, eps, ignore):
+    """d loss_row / d logits for one tile (pure jnp, shared by both
+    backward kernels so the ignore/z/eps semantics can never diverge):
+    ``(1 + 2z*lse) * P - (1-eps)*onehot - eps/V`` over real vocab
+    columns, zeroed at ignored positions."""
+    p = jnp.where(col < V, jnp.exp(logits - lse_col), 0.0)
+    if z:
+        p = p * (1.0 + 2.0 * z * lse_col)
+    p = p - jnp.where(col == t_loc, 1.0 - eps, 0.0)
+    if eps:
+        p = p - jnp.where(col < V, eps / V, 0.0)
+    if ignore is not None:
+        p = jnp.where(t_loc == ignore, 0.0, p)
+    return p
+
+
+
 def _dh_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, dh_ref, acc_scr,
-               *, Tb, Vb, V, Vt, ignore, z):
+               *, Tb, Vb, V, Vt, ignore, z, eps):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -137,15 +164,8 @@ def _dh_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, dh_ref, acc_scr,
         h_ref[...], e_ref[...], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
     col = j * Vb + jax.lax.broadcasted_iota(jnp.int32, (Tb, Vb), 1)
-    p = jnp.where(col < V, jnp.exp(logits - lse_ref[...]), 0.0)
-    if z:
-        # d[nll + z*lse^2]/dlogits = (1 + 2z*lse)*P - onehot
-        p = p * (1.0 + 2.0 * z * lse_ref[...])
-    t_loc = t_ref[...].astype(jnp.int32)                 # [Tb, 1]
-    p = p - jnp.where(col == t_loc, 1.0, 0.0)
-    if ignore is not None:
-        # ignored positions contribute zero gradient
-        p = jnp.where(t_loc == ignore, 0.0, p)
+    p = _grad_p(logits, lse_ref[...], t_ref[...].astype(jnp.int32), col,
+                V=V, z=z, eps=eps, ignore=ignore)
     acc_scr[:] = acc_scr[:] + jax.lax.dot_general(
         p.astype(h_ref.dtype), e_ref[...], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)              # [Tb, C]
@@ -160,7 +180,7 @@ def _dh_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, dh_ref, acc_scr,
 # --------------------------------------------------------------------- #
 
 def _de_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, de_ref, acc_scr,
-               *, Tb, Vb, V, N, Nt, ignore, z):
+               *, Tb, Vb, V, N, Nt, ignore, z, eps):
     i = pl.program_id(1)
     j = pl.program_id(0)
 
@@ -172,13 +192,8 @@ def _de_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, de_ref, acc_scr,
         h_ref[...], e_ref[...], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)              # [Tb, Vb]
     col = j * Vb + jax.lax.broadcasted_iota(jnp.int32, (Tb, Vb), 1)
-    p = jnp.where(col < V, jnp.exp(logits - lse_ref[...]), 0.0)
-    if z:
-        p = p * (1.0 + 2.0 * z * lse_ref[...])
-    t_loc = t_ref[...].astype(jnp.int32)                 # [Tb, 1]
-    p = p - jnp.where(col == t_loc, 1.0, 0.0)
-    if ignore is not None:
-        p = jnp.where(t_loc == ignore, 0.0, p)
+    p = _grad_p(logits, lse_ref[...], t_ref[...].astype(jnp.int32), col,
+                V=V, z=z, eps=eps, ignore=ignore)
     # padded token rows carry P = uniform garbage (their h rows are zero
     # but lse is finite): mask them out of the vocab-side reduction
     row = i * Tb + jax.lax.broadcasted_iota(jnp.int32, (Tb, Vb), 0)
@@ -203,31 +218,40 @@ def _valid_rows(tgt2, N, ignore):
     return valid
 
 
-def _core_total(lse, tgt, tgt2, N, ignore, z):
+def _core_total(lse, tgt, lsum, V, tgt2, N, ignore, z, eps):
     valid = _valid_rows(tgt2, N, ignore)
-    nll = lse - tgt
+    # smoothed NLL: lse - (1-eps)*tgt_logit - (eps/V)*sum_j logits_j
+    nll = lse - (1.0 - eps) * tgt
+    if eps:
+        nll = nll - (eps / V) * lsum
     if z:
         nll = nll + z * lse * lse       # PaLM-style z-loss stabilizer
     return jnp.where(valid, nll, 0.0).sum()
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _xent_core(h2, emb, tgt2, N, Tb, Vb, ignore, z, interpret):
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _xent_core(h2, emb, tgt2, N, Tb, Vb, ignore, z, eps, interpret):
     """Sum of next-token NLL (+ optional z-loss) over the first ``N``
     (valid, non-ignored) rows. The SUM — not the mean — is the
     custom-vjp boundary so the incoming cotangent is a SCALAR (the
     mean's 1/count folds outside); per-row cotangents would need a
     non-separable dE scaling the kernels cannot fold."""
-    lse, tgt = _fwd(h2, emb, tgt2, Tb=Tb, Vb=Vb, interpret=interpret)
-    return _core_total(lse, tgt, tgt2, N, ignore, z)
+    lse, tgt, lsum = _fwd(h2, emb, tgt2, Tb=Tb, Vb=Vb, eps=eps,
+                          interpret=interpret)
+    return _core_total(lse, tgt, lsum, emb.shape[0], tgt2, N, ignore, z,
+                       eps)
 
 
-def _xent_fwd_rule(h2, emb, tgt2, N, Tb, Vb, ignore, z, interpret):
-    lse, tgt = _fwd(h2, emb, tgt2, Tb=Tb, Vb=Vb, interpret=interpret)
-    return _core_total(lse, tgt, tgt2, N, ignore, z), (h2, emb, tgt2, lse)
+def _xent_fwd_rule(h2, emb, tgt2, N, Tb, Vb, ignore, z, eps, interpret):
+    lse, tgt, lsum = _fwd(h2, emb, tgt2, Tb=Tb, Vb=Vb, eps=eps,
+                          interpret=interpret)
+    total = _core_total(lse, tgt, lsum, emb.shape[0], tgt2, N, ignore, z,
+                        eps)
+    return total, (h2, emb, tgt2, lse)
 
 
-def _xent_bwd_rule(N, Tb, Vb, ignore, z, interpret, res, g):
+def _xent_bwd_rule(N, Tb, Vb, ignore, z, eps, interpret, res, g):
     h2, emb, tgt2, lse = res
     N2, C = h2.shape
     V = emb.shape[0]
@@ -242,7 +266,7 @@ def _xent_bwd_rule(N, Tb, Vb, ignore, z, interpret, res, g):
 
     dh = pl.pallas_call(
         functools.partial(_dh_kernel, Tb=Tb, Vb=Vb, V=V, Vt=Vt,
-                          ignore=ignore, z=z),
+                          ignore=ignore, z=z, eps=eps),
         grid=(Nt, Vt),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -261,7 +285,7 @@ def _xent_bwd_rule(N, Tb, Vb, ignore, z, interpret, res, g):
 
     de = pl.pallas_call(
         functools.partial(_de_kernel, Tb=Tb, Vb=Vb, V=V, N=N, Nt=Nt,
-                          ignore=ignore, z=z),
+                          ignore=ignore, z=z, eps=eps),
         grid=(Vt, Nt),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -289,6 +313,7 @@ def fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
                   vocab_block: Optional[int] = None,
                   ignore_index: Optional[int] = None,
                   z_loss: float = 0.0,
+                  label_smoothing: float = 0.0,
                   interpret: Optional[bool] = None) -> jnp.ndarray:
     """Mean next-token NLL with logits never materialized in HBM.
 
@@ -299,7 +324,10 @@ def fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
     those positions from the loss, the divisor, and both gradients.
     ``z_loss`` adds the PaLM-style ``z * logsumexp^2`` stabilizer per
     valid position (folded into the same kernels: the backward's P
-    factor becomes ``1 + 2z*lse``).
+    factor becomes ``1 + 2z*lse``). ``label_smoothing`` mixes the
+    target with the uniform distribution (the backward subtracts the
+    smoothed one-hot ``(1-eps)*onehot + eps/V``; the forward's uniform
+    term rides a third per-row accumulator).
     """
     if interpret is None:
         from . import default_interpret
@@ -329,7 +357,8 @@ def fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
     # index with targets — the one-hot compare simply never hits, and
     # the ignore masks zero those rows' loss and gradients
     total = _xent_core(h2, embedding, t1, N, Tb, vocab_block,
-                       ignore_index, float(z_loss), interpret)
+                       ignore_index, float(z_loss),
+                       float(label_smoothing), interpret)
     if ignore_index is None:
         return total / N
     count = jnp.maximum(

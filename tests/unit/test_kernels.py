@@ -557,3 +557,31 @@ class TestFusedXent:
         for a, b in zip(gr, gg):
             d = float(jnp.max(jnp.abs(a - b))) / float(jnp.max(jnp.abs(a)))
             assert d < 1e-3
+
+    def test_label_smoothing(self):
+        # smoothed target distribution (1-eps)*onehot + eps/V — loss and
+        # both gradients vs autodiff of the explicit formula
+        from deepspeed_tpu.ops.kernels import fused_lm_xent
+        h, emb, tgt = self._data()
+        eps = 0.1
+
+        def ref_loss(a, b):
+            logits = (a.astype(jnp.float32).reshape(-1, a.shape[-1])
+                      @ b.astype(jnp.float32).T)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            t = tgt.reshape(-1)
+            V = b.shape[0]
+            q = (1 - eps) * jax.nn.one_hot(t, V) + eps / V
+            return -(q * logp).sum(-1).mean()
+
+        want = ref_loss(h, emb)
+        got = fused_lm_xent(h, emb, tgt, token_block=16, vocab_block=128,
+                            label_smoothing=eps, interpret=True)
+        assert abs(float(want) - float(got)) < 1e-4
+        gr = jax.grad(ref_loss, (0, 1))(h, emb)
+        gg = jax.grad(lambda a, b: fused_lm_xent(
+            a, b, tgt, token_block=16, vocab_block=128,
+            label_smoothing=eps, interpret=True), (0, 1))(h, emb)
+        for a, b in zip(gr, gg):
+            d = float(jnp.max(jnp.abs(a - b))) / float(jnp.max(jnp.abs(a)))
+            assert d < 1e-3
