@@ -364,3 +364,55 @@ def fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
     count = jnp.maximum(
         (targets.reshape(-1) != ignore_index).sum(), 1)
     return total / count
+
+
+def sharded_fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
+                          targets: jnp.ndarray, mesh,
+                          batch_axes=("data", "data_inner"),
+                          **kwargs) -> jnp.ndarray:
+    """``fused_lm_xent`` under ``shard_map``: token rows shard over the
+    data axes, the embedding stays replicated, and the loss reduces via
+    ``psum`` of per-shard (sum, count) pairs — the same wrapping
+    ``sharded_flash_attention`` gives the attention kernel (Pallas custom
+    calls carry no GSPMD rules, so a multi-device jit would otherwise
+    all-gather the hidden states around the kernel). The embedding
+    cotangent is psum'd by shard_map's transpose of the replicated input.
+
+    Falls back to the unsharded kernel when no batch axis divides the
+    leading dim.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    ignore = kwargs.get("ignore_index")
+    h3 = hidden if hidden.ndim == 3 else hidden[None]
+    t2 = targets if targets.ndim == 2 else targets[None]
+    bat = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    bsz = 1
+    for a in bat:
+        bsz *= mesh.shape[a]
+    if not bat or h3.shape[0] % bsz:
+        return fused_lm_xent(hidden, embedding, targets, **kwargs)
+
+    def local(h_, e_, t_):
+        # per-shard sum + RAW valid count; the global mean is the psum
+        # ratio with the zero-guard applied AFTER the psum — clamping
+        # per shard would inflate the divisor whenever one shard's rows
+        # are all ignore_index (loc * max(raw, 1) recovers the exact
+        # per-shard total either way: loc is 0 when raw is 0)
+        n_loc = h_.shape[0] * h_.shape[1]
+        loc = fused_lm_xent(h_, e_, t_, **kwargs)
+        if ignore is not None:
+            raw = (t_ != ignore).sum().astype(jnp.float32)
+        else:
+            raw = jnp.float32(n_loc)
+        total = jax.lax.psum(loc * jnp.maximum(raw, 1.0), bat)
+        count = jax.lax.psum(raw, bat)
+        return total / jnp.maximum(count, 1.0)
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(bat), P(), P(bat)),
+        out_specs=P(),
+        check_vma=False,
+    )(h3, embedding, t2)

@@ -585,3 +585,42 @@ class TestFusedXent:
         for a, b in zip(gr, gg):
             d = float(jnp.max(jnp.abs(a - b))) / float(jnp.max(jnp.abs(a)))
             assert d < 1e-3
+
+    def test_sharded_wrapper_matches_chunked(self, devices8):
+        # shard_map wrapping (rows over data, emb replicated, psum'd
+        # loss): values AND both grads — incl. the psum'd embedding
+        # cotangent and per-shard ignore_index counts — must match
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from deepspeed_tpu.models._lm_utils import chunked_lm_xent
+        from deepspeed_tpu.ops.kernels.fused_xent import (
+            sharded_fused_lm_xent)
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+        rng = np.random.RandomState(0)
+        B, T, C, V = 16, 24, 64, 300
+        h = jnp.asarray(rng.randn(B, T, C) * 0.5, jnp.float32)
+        emb = jnp.asarray(rng.randn(V, C) * 0.2, jnp.float32)
+        tgt = jnp.asarray(rng.randint(0, V, size=(B, T)), jnp.int32)
+        # shard 0's rows are ENTIRELY ignored: the divisor must be the
+        # global valid count (a per-shard clamp would inflate it by 1)
+        tgt = tgt.at[0].set(-100)
+        tgt = tgt.at[1].set(-100)
+        h = jax.device_put(h, NamedSharding(mesh, P("data")))
+        tgt = jax.device_put(tgt, NamedSharding(mesh, P("data")))
+        emb = jax.device_put(emb, NamedSharding(mesh, P()))
+
+        def loss_sh(h_, e_):
+            return sharded_fused_lm_xent(
+                h_, e_, tgt, mesh, token_block=16, vocab_block=128,
+                ignore_index=-100, interpret=True)
+
+        def loss_ref(h_, e_):
+            return chunked_lm_xent(h_, e_, tgt, num_chunks=4,
+                                   ignore_index=-100)
+
+        assert abs(float(jax.jit(loss_sh)(h, emb))
+                   - float(jax.jit(loss_ref)(h, emb))) < 1e-4
+        g1 = jax.jit(jax.grad(loss_sh, argnums=(0, 1)))(h, emb)
+        g2 = jax.jit(jax.grad(loss_ref, argnums=(0, 1)))(h, emb)
+        for a, b in zip(g1, g2):
+            d = float(jnp.max(jnp.abs(a - b))) / float(jnp.max(jnp.abs(b)))
+            assert d < 1e-3
