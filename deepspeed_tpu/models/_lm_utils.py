@@ -27,6 +27,59 @@ def make_causal_lm(model, cfg):
     return model, init_fn, loss_fn
 
 
+def lm_head_xent(hidden: jnp.ndarray, head: jnp.ndarray,
+                 targets: jnp.ndarray, cfg) -> jnp.ndarray:
+    """Shared LM-head loss dispatch for the model zoo (gpt2/llama/...):
+    reads the ``xent_*`` knobs off ``cfg`` (with defaults, so configs may
+    omit them) and routes to the chunked scan, the streaming fused Pallas
+    kernel, or its shard_map wrapper — with the manual-seam and
+    seq-parallel guards applied once, here, instead of per model.
+
+    ``head`` is [V, C] (tied embedding, or the transposed lm_head kernel
+    — XLA folds the transpose into the chunk/tile dots).
+    """
+    import jax as _jax
+
+    impl = getattr(cfg, "xent_impl", "chunked")
+    if impl not in ("chunked", "fused"):
+        raise ValueError(
+            f"xent_impl must be 'chunked' or 'fused', got {impl!r}")
+    chunks = getattr(cfg, "xent_chunks", 8)
+    remat = getattr(cfg, "xent_remat", True)
+    ignore = getattr(cfg, "xent_ignore_index", None)
+
+    def _chunked():
+        return chunked_lm_xent(hidden, head, targets, num_chunks=chunks,
+                               remat=remat, ignore_index=ignore)
+
+    if impl == "fused":
+        from ..ops.kernels import fused_lm_xent
+        from ..ops.kernels.fused_xent import sharded_fused_lm_xent
+        from ..parallel import topology as _topo
+        manual = getattr(_jax.sharding.get_abstract_mesh(),
+                         "manual_axes", ())
+        if manual:
+            # already inside an engine manual seam (ZeRO++/1-bit
+            # shard_map): hidden is per-rank local and the seam pmeans
+            # the loss — run the kernel plainly on the shard
+            return fused_lm_xent(hidden, head, targets,
+                                 ignore_index=ignore)
+        if _jax.device_count() > 1 and _topo.has_topology():
+            mesh = _topo.get_topology().mesh
+            if mesh.shape.get("seq", 1) > 1:
+                # SP meshes: hidden arrives seq-sharded; the row-sharding
+                # wrapper would all-gather T (the chunked einsum shards
+                # naturally under GSPMD instead)
+                return _chunked()
+            # Pallas custom calls carry no GSPMD rules — without the
+            # shard_map wrapping a multi-device jit would all-gather the
+            # [B, T, C] hidden states around the kernel
+            return sharded_fused_lm_xent(hidden, head, targets, mesh,
+                                         ignore_index=ignore)
+        return fused_lm_xent(hidden, head, targets, ignore_index=ignore)
+    return _chunked()
+
+
 def chunked_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
                     targets: jnp.ndarray, num_chunks: int = 8,
                     remat: bool = True,

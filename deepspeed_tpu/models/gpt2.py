@@ -270,51 +270,14 @@ def make_model(cfg: GPT2Config):
         return model.init(rng, tokens)["params"]
 
     def loss_fn(params, batch, rng):
-        from ._lm_utils import chunked_lm_xent
+        from ._lm_utils import lm_head_xent
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         hidden = model.apply({"params": params}, inputs,
                              deterministic=cfg.dropout == 0,
                              return_hidden=True,
                              rngs={"dropout": rng} if cfg.dropout > 0 else None)
-        if cfg.xent_impl not in ("chunked", "fused"):
-            raise ValueError(
-                f"xent_impl must be 'chunked' or 'fused', got "
-                f"{cfg.xent_impl!r}")
-        if cfg.xent_impl == "fused":
-            from ..ops.kernels import fused_lm_xent
-            from ..ops.kernels.fused_xent import sharded_fused_lm_xent
-            from ..parallel import topology as _topo
-            manual = getattr(jax.sharding.get_abstract_mesh(),
-                             "manual_axes", ())
-            if manual:
-                # already inside an engine manual seam (ZeRO++/1-bit
-                # shard_map): hidden is per-rank local and the seam
-                # pmeans the loss — run the kernel plainly on the shard
-                return fused_lm_xent(
-                    hidden, params["wte"]["embedding"], targets,
-                    ignore_index=cfg.xent_ignore_index)
-            if jax.device_count() > 1 and _topo.has_topology():
-                mesh = _topo.get_topology().mesh
-                if mesh.shape.get("seq", 1) > 1:
-                    # SP meshes: hidden arrives seq-sharded; the row-
-                    # sharding wrapper would all-gather T (the chunked
-                    # einsum shards naturally under GSPMD instead)
-                    return chunked_lm_xent(
-                        hidden, params["wte"]["embedding"], targets,
-                        num_chunks=cfg.xent_chunks, remat=cfg.xent_remat,
-                        ignore_index=cfg.xent_ignore_index)
-                # Pallas custom calls carry no GSPMD rules — without the
-                # shard_map wrapping a multi-device jit would all-gather
-                # the [B, T, C] hidden states around the kernel
-                return sharded_fused_lm_xent(
-                    hidden, params["wte"]["embedding"], targets, mesh,
-                    ignore_index=cfg.xent_ignore_index)
-            return fused_lm_xent(hidden, params["wte"]["embedding"], targets,
-                                 ignore_index=cfg.xent_ignore_index)
-        return chunked_lm_xent(hidden, params["wte"]["embedding"], targets,
-                               num_chunks=cfg.xent_chunks,
-                               remat=cfg.xent_remat,
-                               ignore_index=cfg.xent_ignore_index)
+        return lm_head_xent(hidden, params["wte"]["embedding"], targets,
+                            cfg)
 
     return model, init_fn, loss_fn

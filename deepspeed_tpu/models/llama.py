@@ -35,6 +35,12 @@ class LlamaConfig:
     sliding_window: Optional[int] = None   # mistral local attention
     qkv_bias: bool = False                 # qwen2
     tie_embeddings: bool = False
+    # LM-head cross-entropy knobs (models/_lm_utils.lm_head_xent):
+    # "chunked" scan or the streaming "fused" Pallas kernel
+    xent_impl: str = "chunked"
+    xent_chunks: int = 8
+    xent_remat: bool = True
+    xent_ignore_index: Optional[int] = None
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False
@@ -187,7 +193,7 @@ class Llama(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden: bool = False):
         cfg = self.cfg
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="embed")
@@ -196,6 +202,10 @@ class Llama(nn.Module):
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"layer_{i}")(x)
         x = RMSNorm(cfg.rms_eps, jnp.float32, name="final_norm")(x)
+        if return_hidden:
+            # training loss path: the caller fuses the LM head into the
+            # chunked/streaming cross-entropy instead of [B, T, V] logits
+            return x
         if cfg.tie_embeddings:
             return embed.attend(x.astype(jnp.float32))
         head = nn.Dense(cfg.vocab_size, dtype=jnp.float32,
@@ -214,11 +224,19 @@ def make_model(cfg: LlamaConfig):
         return model.init(rng, jnp.zeros((batch_size, T), jnp.int32))["params"]
 
     def loss_fn(params, batch, rng):
+        from ._lm_utils import lm_head_xent
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits = model.apply({"params": params}, inputs)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return nll.mean()
+        # final_norm emits fp32; cast back to the compute dtype so the
+        # unembed chunk/tile matmuls ride the bf16 MXU path (f32 accum
+        # happens inside the xent implementations regardless)
+        hidden = model.apply({"params": params}, inputs,
+                             return_hidden=True).astype(cfg.dtype)
+        # [V, C] head for the fused chunk matmuls: tied = the embedding;
+        # untied = the lm_head kernel transposed (XLA folds the transpose
+        # into the chunk dot — no [C, V] copy materializes)
+        head = (params["embed"]["embedding"] if cfg.tie_embeddings
+                else params["lm_head"]["kernel"].T)
+        return lm_head_xent(hidden, head, targets, cfg)
 
     return model, init_fn, loss_fn

@@ -350,3 +350,27 @@ def test_bloom_neox_gptj_train():
             losses.append(float(engine.train_batch(
                 {"tokens": jnp.asarray(seq, jnp.int32)})))
         assert losses[-1] < losses[0], f"{type(cfg).__name__}: {losses}"
+
+
+class TestLlamaChunkedLoss:
+    def test_loss_matches_full_logits(self):
+        # make_model's loss fuses the LM head into chunked_lm_xent; it
+        # must equal the full-logits log_softmax NLL for BOTH head modes
+        from deepspeed_tpu.models import llama
+        for tie in (True, False):
+            cfg = llama.LlamaConfig(
+                vocab_size=64, max_seq_len=33, num_layers=2, num_heads=2,
+                num_kv_heads=1, hidden_size=32, intermediate_size=64,
+                dtype=jnp.float32, tie_embeddings=tie)
+            model, init_fn, loss_fn = llama.make_model(cfg)
+            params = init_fn(jax.random.PRNGKey(0), batch_size=2,
+                             seq_len=16)
+            toks = jnp.asarray(
+                np.random.RandomState(0).randint(0, 64, (2, 17)),
+                jnp.int32)
+            logits = model.apply({"params": params}, toks[:, :-1])
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            want = float(-jnp.take_along_axis(
+                logp, toks[:, 1:][..., None], axis=-1)[..., 0].mean())
+            got = float(loss_fn(params, {"tokens": toks}, None))
+            assert abs(want - got) < 1e-5
