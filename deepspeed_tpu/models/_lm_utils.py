@@ -28,17 +28,23 @@ def make_causal_lm(model, cfg):
 
 
 def lm_head_xent(hidden: jnp.ndarray, head: jnp.ndarray,
-                 targets: jnp.ndarray, cfg) -> jnp.ndarray:
+                 targets: jnp.ndarray, cfg, *,
+                 head_layout: str = "vc") -> jnp.ndarray:
     """Shared LM-head loss dispatch for the model zoo (gpt2/llama/...):
     reads the ``xent_*`` knobs off ``cfg`` (with defaults, so configs may
     omit them) and routes to the chunked scan, the streaming fused Pallas
     kernel, or its shard_map wrapper — with the manual-seam and
     seq-parallel guards applied once, here, instead of per model.
 
-    ``head`` is [V, C] (tied embedding, or the transposed lm_head kernel
-    — XLA folds the transpose into the chunk/tile dots).
+    ``head_layout``: "vc" for a [V, C] head (tied embedding), "cv" for
+    the natural [C, V] Dense kernel — the chunked path contracts either
+    orientation directly (no transpose ever materializes); the fused
+    Pallas kernel wants [V, C] rows, so "cv" there pays ONE transposed
+    copy per step (XLA CSEs it across the fwd/bwd tile passes).
     """
-    import jax as _jax
+    if head_layout not in ("vc", "cv"):
+        raise ValueError(f"head_layout must be 'vc' or 'cv', "
+                         f"got {head_layout!r}")
 
     impl = getattr(cfg, "xent_impl", "chunked")
     if impl not in ("chunked", "fused"):
@@ -50,13 +56,16 @@ def lm_head_xent(hidden: jnp.ndarray, head: jnp.ndarray,
 
     def _chunked():
         return chunked_lm_xent(hidden, head, targets, num_chunks=chunks,
-                               remat=remat, ignore_index=ignore)
+                               remat=remat, ignore_index=ignore,
+                               head_layout=head_layout)
 
     if impl == "fused":
         from ..ops.kernels import fused_lm_xent
         from ..ops.kernels.fused_xent import sharded_fused_lm_xent
         from ..parallel import topology as _topo
-        manual = getattr(_jax.sharding.get_abstract_mesh(),
+        if head_layout == "cv":
+            head = head.T
+        manual = getattr(jax.sharding.get_abstract_mesh(),
                          "manual_axes", ())
         if manual:
             # already inside an engine manual seam (ZeRO++/1-bit
@@ -64,7 +73,7 @@ def lm_head_xent(hidden: jnp.ndarray, head: jnp.ndarray,
             # the loss — run the kernel plainly on the shard
             return fused_lm_xent(hidden, head, targets,
                                  ignore_index=ignore)
-        if _jax.device_count() > 1 and _topo.has_topology():
+        if jax.device_count() > 1 and _topo.has_topology():
             mesh = _topo.get_topology().mesh
             if mesh.shape.get("seq", 1) > 1:
                 # SP meshes: hidden arrives seq-sharded; the row-sharding
@@ -83,7 +92,8 @@ def lm_head_xent(hidden: jnp.ndarray, head: jnp.ndarray,
 def chunked_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
                     targets: jnp.ndarray, num_chunks: int = 8,
                     remat: bool = True,
-                    ignore_index: Optional[int] = None) -> jnp.ndarray:
+                    ignore_index: Optional[int] = None,
+                    head_layout: str = "vc") -> jnp.ndarray:
     """Mean next-token NLL without ever materializing the full logits.
 
     ``hidden`` [B, T, C] (compute dtype, e.g. bf16), ``embedding`` [V, C]
@@ -105,12 +115,16 @@ def chunked_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
     while T % nc:           # degrade gracefully for odd T
         nc -= 1
     emb = embedding.astype(hidden.dtype)
+    # "cv" = the natural [C, V] Dense kernel: contract dim 0 directly —
+    # no transpose ever materializes for either orientation
+    e_dim = 1 if head_layout == "vc" else 0
+    V = emb.shape[0] if head_layout == "vc" else emb.shape[1]
 
     def chunk_nll(h, t):
-        # [B, Tc, C] @ [V, C]^T -> [B, Tc, V] fp32 (bf16 MXU, f32 accum)
-        tc = jnp.clip(t, 0, emb.shape[0] - 1)       # ignore ids may be -100
+        # [B, Tc, C] @ head -> [B, Tc, V] fp32 (bf16 MXU, f32 accum)
+        tc = jnp.clip(t, 0, V - 1)                  # ignore ids may be -100
         logits = jax.lax.dot_general(
-            h, emb, (((2,), (1,)), ((), ())),
+            h, emb, (((2,), (e_dim,)), ((), ())),
             preferred_element_type=jnp.float32)
         lse = jax.nn.logsumexp(logits, axis=-1)
         tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]

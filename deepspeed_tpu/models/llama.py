@@ -232,11 +232,12 @@ def make_model(cfg: LlamaConfig):
         # happens inside the xent implementations regardless)
         hidden = model.apply({"params": params}, inputs,
                              return_hidden=True).astype(cfg.dtype)
-        # [V, C] head for the fused chunk matmuls: tied = the embedding;
-        # untied = the lm_head kernel transposed (XLA folds the transpose
-        # into the chunk dot — no [C, V] copy materializes)
-        head = (params["embed"]["embedding"] if cfg.tie_embeddings
-                else params["lm_head"]["kernel"].T)
-        return lm_head_xent(hidden, head, targets, cfg)
+        if cfg.tie_embeddings:
+            return lm_head_xent(hidden, params["embed"]["embedding"],
+                                targets, cfg)
+        # untied: the NATURAL [C, V] Dense kernel — the dispatch contracts
+        # it directly (chunked) or transposes once per step (fused)
+        return lm_head_xent(hidden, params["lm_head"]["kernel"], targets,
+                            cfg, head_layout="cv")
 
     return model, init_fn, loss_fn

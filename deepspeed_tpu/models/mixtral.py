@@ -98,7 +98,8 @@ class Mixtral(nn.Module):
     ep_mesh: Any = None
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True):
+    def __call__(self, tokens, train: bool = True,
+                 return_hidden: bool = False):
         cfg = self.cfg
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="embed")
@@ -109,6 +110,11 @@ class Mixtral(nn.Module):
         head = nn.Dense(cfg.vocab_size, dtype=jnp.float32,
                         param_dtype=cfg.param_dtype, use_bias=False,
                         name="lm_head")
+        if return_hidden:
+            # training loss path: the caller fuses the head into the
+            # chunked/streaming cross-entropy (lm_head params exist from
+            # init, which traces the logits path)
+            return x
         return head(x.astype(jnp.float32))
 
 
@@ -124,15 +130,19 @@ def make_model(cfg: MixtralConfig, ep_mesh=None):
         return variables["params"]
 
     def loss_fn(params, batch, rng):
+        from ._lm_utils import lm_head_xent
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits, aux = model.apply(
+        hidden, aux = model.apply(
             {"params": params}, inputs, rngs={"gating": rng},
-            mutable=["losses"])
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+            mutable=["losses"], return_hidden=True)
         moe_aux = sum(jnp.sum(v) for v in
                       jax.tree_util.tree_leaves(aux.get("losses", {})))
-        return nll.mean() + cfg.router_aux_loss_coef * moe_aux
+        # head fused into the chunked/streaming xent — [B, T, V] fp32
+        # logits never materialize (the MoE flagship's vocab is 32k)
+        nll = lm_head_xent(hidden.astype(cfg.dtype),
+                           params["lm_head"]["kernel"], targets, cfg,
+                           head_layout="cv")
+        return nll + cfg.router_aux_loss_coef * moe_aux
 
     return model, init_fn, loss_fn

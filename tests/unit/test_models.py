@@ -374,3 +374,29 @@ class TestLlamaChunkedLoss:
                 logp, toks[:, 1:][..., None], axis=-1)[..., 0].mean())
             got = float(loss_fn(params, {"tokens": toks}, None))
             assert abs(want - got) < 1e-5
+
+
+class TestMixtralChunkedLoss:
+    def test_loss_matches_full_logits(self):
+        # mixtral's fused-head loss == full-logits NLL + router aux
+        from deepspeed_tpu.models import mixtral as mx
+        cfg = mx.MixtralConfig(
+            vocab_size=64, max_seq_len=33, num_layers=2, num_heads=2,
+            num_kv_heads=1, hidden_size=32, intermediate_size=64,
+            num_experts=4, experts_top_k=2, dtype=jnp.float32)
+        model, init_fn, loss_fn = mx.make_model(cfg)
+        params = init_fn(jax.random.PRNGKey(0), batch_size=2, seq_len=16)
+        toks = jnp.asarray(
+            np.random.RandomState(1).randint(0, 64, (2, 17)), jnp.int32)
+        rng = jax.random.PRNGKey(2)
+        logits, aux = model.apply({"params": params}, toks[:, :-1],
+                                  rngs={"gating": rng},
+                                  mutable=["losses"])
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = float(-jnp.take_along_axis(
+            logp, toks[:, 1:][..., None], axis=-1)[..., 0].mean())
+        moe = float(sum(jnp.sum(v) for v in
+                        jax.tree_util.tree_leaves(aux.get("losses", {}))))
+        want = nll + cfg.router_aux_loss_coef * moe
+        got = float(loss_fn(params, {"tokens": toks}, rng))
+        assert abs(want - got) < 1e-5
