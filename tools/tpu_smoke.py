@@ -148,12 +148,15 @@ def main():
         tb, st, l8, block_size=bs8 // 2, num_kv_heads=KV8, interpret=True)
     ok &= check("paged_prefill_int8", o8p, ofpp, atol=6e-2)
 
-    # streaming fused LM-head xent: loss + grads vs the chunked reference
+    # streaming fused LM-head xent: loss + grads vs the chunked reference.
+    # N = 1536 tokens at C = 512 -> Tb = 512, THREE token tiles: the
+    # multi-tile grid is what exercises the [N, 1] scalar-operand layout
+    # (a single-tile shape compiles even under layouts that fail at Nt>1)
     from deepspeed_tpu.models._lm_utils import chunked_lm_xent
     from deepspeed_tpu.ops.kernels import fused_lm_xent
-    hx = jax.random.normal(ks[0], (4, 128, 512), jnp.bfloat16) * 0.5
+    hx = jax.random.normal(ks[0], (4, 384, 512), jnp.bfloat16) * 0.5
     ex = jax.random.normal(ks[1], (4000, 512), jnp.bfloat16) * 0.2
-    tx = jax.random.randint(ks[2], (4, 128), 0, 4000)
+    tx = jax.random.randint(ks[2], (4, 384), 0, 4000)
     lf = jax.jit(lambda a, b: fused_lm_xent(a, b, tx, interpret=False))
     lr = float(chunked_lm_xent(hx, ex, tx, num_chunks=4))
     ok &= check("fused_xent_fwd", lf(hx, ex), lr, atol=2e-2)
