@@ -73,7 +73,22 @@ def lm_head_xent(hidden: jnp.ndarray, head: jnp.ndarray,
             # the loss — run the kernel plainly on the shard
             return fused_lm_xent(hidden, head, targets,
                                  ignore_index=ignore)
-        if jax.device_count() > 1 and _topo.has_topology():
+        if jax.device_count() > 1:
+            if not _topo.has_topology():
+                # plain GSPMD data-parallel jit with no framework mesh:
+                # the Pallas custom call carries no sharding rules, so XLA
+                # would silently all-gather the full [B, T, C] hidden
+                # states around it — the exact traffic the shard_map
+                # wrapper exists to avoid. The chunked einsum shards
+                # naturally under GSPMD instead.
+                import warnings
+                warnings.warn(
+                    "xent_impl='fused' with multiple devices but no "
+                    "deepspeed_tpu topology registered: falling back to "
+                    "the chunked path (the fused kernel would all-gather "
+                    "hidden states). Build a mesh via dstpu.initialize / "
+                    "parallel.topology to use the fused kernel here.")
+                return _chunked()
             mesh = _topo.get_topology().mesh
             if mesh.shape.get("seq", 1) > 1:
                 # SP meshes: hidden arrives seq-sharded; the row-sharding
@@ -129,8 +144,16 @@ def chunked_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
         lse = jax.nn.logsumexp(logits, axis=-1)
         tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
         nll = lse - tgt
+        # out-of-range ids (t < 0 or t >= V, e.g. a corrupt label) train
+        # against NOTHING: zero their nll here and drop them from the
+        # divisor below — torch cross_entropy raises for them; silently
+        # training against the clamped id V-1 is the one behavior that is
+        # never right. (ignore_index ids are a subset of this mask when
+        # negative, which is the torch default -100.)
+        valid = (t >= 0) & (t < V)
         if ignore_index is not None:
-            nll = jnp.where(t == ignore_index, 0.0, nll)
+            valid &= t != ignore_index
+        nll = jnp.where(valid, nll, 0.0)
         return nll.sum()
 
     if remat:
@@ -144,10 +167,10 @@ def chunked_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
         return acc + chunk_nll(h, t), None
 
     total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ts))
+    valid = (targets >= 0) & (targets < V)
     if ignore_index is not None:
-        count = jnp.maximum((targets != ignore_index).sum(), 1)
-        return total / count
-    return total / (B * T)
+        valid &= targets != ignore_index
+    return total / jnp.maximum(valid.sum(), 1)
 
 
 def alibi_slopes(num_heads: int) -> jnp.ndarray:

@@ -74,6 +74,18 @@ def _fwd_kernel(h_ref, e_ref, t_ref, lse_ref, tgt_ref, lsum_ref, m_scr,
         # be summed). Statically skipped when smoothing is off.
         s_scr[:, :1] = s_scr[:, :1] + jnp.sum(
             jnp.where(col < V, logits, 0.0), axis=1, keepdims=True)
+
+    # target logit: one-hot row reduction inside the tile (a per-row
+    # dynamic gather would leave the VPU's vector regime). Accumulated
+    # from the PRE-mask logits: a corrupt id in [V, Vt*Vb) then picks up
+    # a finite padded-column value (zeros-padded embedding rows) instead
+    # of -inf poisoning the whole loss — the row is excluded from loss
+    # and gradients by the valid mask either way.
+    t_loc = t_ref[...].astype(jnp.int32)                 # [Tb, 1] global id
+    hit = col == t_loc
+    g_scr[:, :1] = g_scr[:, :1] + jnp.sum(
+        jnp.where(hit, logits, 0.0), axis=1, keepdims=True)
+
     logits = jnp.where(col < V, logits, _NEG_INF)
 
     m_prev, l_prev = m_scr[:, :1], l_scr[:, :1]
@@ -84,13 +96,6 @@ def _fwd_kernel(h_ref, e_ref, t_ref, lse_ref, tgt_ref, lsum_ref, m_scr,
     l_next = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
     m_scr[:, :1] = m_next
     l_scr[:, :1] = l_next
-
-    # target logit: one-hot row reduction inside the tile (a per-row
-    # dynamic gather would leave the VPU's vector regime)
-    t_loc = t_ref[...].astype(jnp.int32)                 # [Tb, 1] global id
-    hit = col == t_loc
-    g_scr[:, :1] = g_scr[:, :1] + jnp.sum(
-        jnp.where(hit, logits, 0.0), axis=1, keepdims=True)
 
     @pl.when(j == Vt - 1)
     def _finish():
@@ -146,6 +151,10 @@ def _grad_p(logits, lse_col, t_loc, col, *, V, z, eps, ignore):
     p = p - jnp.where(col == t_loc, 1.0 - eps, 0.0)
     if eps:
         p = p - jnp.where(col < V, eps / V, 0.0)
+    # rows whose target id is out of range — negative (ignore ids like
+    # -100) or >= V (corrupt labels) — contribute NO gradient, matching
+    # their exclusion from the loss and the divisor
+    p = jnp.where((t_loc < 0) | (t_loc >= V), 0.0, p)
     if ignore is not None:
         p = jnp.where(t_loc == ignore, 0.0, p)
     return p
@@ -211,15 +220,18 @@ def _de_kernel(s_ref, h_ref, e_ref, t_ref, lse_ref, de_ref, acc_scr,
 # public op with custom VJP
 # --------------------------------------------------------------------- #
 
-def _valid_rows(tgt2, N, ignore):
-    valid = jnp.arange(tgt2.shape[0]) < N
+def _valid_rows(tgt2, N, ignore, V):
+    # in-range check mirrors chunked_lm_xent: out-of-range non-ignored
+    # ids (corrupt labels) are dropped from loss + divisor, never
+    # trained against
+    valid = (jnp.arange(tgt2.shape[0]) < N) & (tgt2 >= 0) & (tgt2 < V)
     if ignore is not None:
         valid = jnp.logical_and(valid, tgt2 != ignore)
     return valid
 
 
 def _core_total(lse, tgt, lsum, V, tgt2, N, ignore, z, eps):
-    valid = _valid_rows(tgt2, N, ignore)
+    valid = _valid_rows(tgt2, N, ignore, V)
     # smoothed NLL: lse - (1-eps)*tgt_logit - (eps/V)*sum_j logits_j
     nll = lse - (1.0 - eps) * tgt
     if eps:
@@ -353,17 +365,21 @@ def fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
     if N2 != N:
         h2 = jnp.pad(h2, ((0, N2 - N), (0, 0)))
         t1 = jnp.pad(t1, (0, N2 - N))
-    # out-of-range ids (e.g. -100) need no clamping: the kernels never
-    # index with targets — the one-hot compare simply never hits, and
-    # the ignore masks zero those rows' loss and gradients
+    # NEGATIVE ids (e.g. -100) need no clamping: the kernels never index
+    # with targets — the one-hot compare simply never hits, and the
+    # validity masks zero those rows' loss and gradients. Positive
+    # out-of-range ids (corrupt labels) are likewise excluded from loss,
+    # gradients, and the divisor (chunked_lm_xent semantics — torch
+    # cross_entropy would raise; silently training against a clamped id
+    # is the one behavior that is never right).
     total = _xent_core(h2, embedding, t1, N, Tb, vocab_block,
                        ignore_index, float(z_loss),
                        float(label_smoothing), interpret)
-    if ignore_index is None:
-        return total / N
-    count = jnp.maximum(
-        (targets.reshape(-1) != ignore_index).sum(), 1)
-    return total / count
+    tflat = targets.reshape(-1)
+    valid = (tflat >= 0) & (tflat < embedding.shape[0])
+    if ignore_index is not None:
+        valid &= tflat != ignore_index
+    return total / jnp.maximum(valid.sum(), 1)
 
 
 def sharded_fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
@@ -398,14 +414,14 @@ def sharded_fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
         # per-shard sum + RAW valid count; the global mean is the psum
         # ratio with the zero-guard applied AFTER the psum — clamping
         # per shard would inflate the divisor whenever one shard's rows
-        # are all ignore_index (loc * max(raw, 1) recovers the exact
-        # per-shard total either way: loc is 0 when raw is 0)
-        n_loc = h_.shape[0] * h_.shape[1]
+        # are all ignored (loc * max(raw, 1) recovers the exact
+        # per-shard total either way: loc is 0 when raw is 0). The count
+        # must mirror fused_lm_xent's own divisor: in-range, non-ignored.
         loc = fused_lm_xent(h_, e_, t_, **kwargs)
+        vld = (t_ >= 0) & (t_ < e_.shape[0])
         if ignore is not None:
-            raw = (t_ != ignore).sum().astype(jnp.float32)
-        else:
-            raw = jnp.float32(n_loc)
+            vld &= t_ != ignore
+        raw = vld.sum().astype(jnp.float32)
         total = jax.lax.psum(loc * jnp.maximum(raw, 1.0), bat)
         count = jax.lax.psum(raw, bat)
         return total / jnp.maximum(count, 1.0)

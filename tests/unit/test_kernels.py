@@ -529,6 +529,49 @@ class TestFusedXent:
             d = float(jnp.max(jnp.abs(a - b))) / float(jnp.max(jnp.abs(a)))
             assert d < 1e-3
 
+    def test_out_of_range_ids_excluded(self):
+        # corrupt labels (>= V, or >= the padded vocab grid) must not
+        # poison the loss (ADVICE r4: the -inf masked column), must carry
+        # zero gradient, and both impls must agree — torch raises here;
+        # we exclude from loss + divisor (documented divergence)
+        from deepspeed_tpu.models._lm_utils import chunked_lm_xent
+        from deepspeed_tpu.ops.kernels import fused_lm_xent
+        h, emb, tgt = self._data(T=20, V=300)
+        bad = np.zeros((2, 20), bool)
+        bad[0, 2] = bad[0, 11] = bad[1, 0] = True
+        # 305 lands inside the padded vocab tile ([V, Vt*Vb)); 7000 is
+        # beyond the whole padded grid — both failure modes from ADVICE
+        tgt_bad = jnp.asarray(
+            np.where(bad, np.array([[305] * 20, [7000] * 20]), tgt),
+            jnp.int32)
+
+        logits = h.astype(jnp.float32) @ emb.astype(jnp.float32).T
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        t_c = jnp.clip(tgt_bad, 0, emb.shape[0] - 1)
+        nll = lse - jnp.take_along_axis(logits, t_c[..., None], -1)[..., 0]
+        want = float(jnp.where(jnp.asarray(bad), 0, nll).sum()
+                     / (~bad).sum())
+
+        got_c = chunked_lm_xent(h, emb, tgt_bad, num_chunks=4)
+        got_f = fused_lm_xent(h, emb, tgt_bad, token_block=16,
+                              vocab_block=128, interpret=True)
+        assert np.isfinite(float(got_c)) and np.isfinite(float(got_f))
+        assert abs(float(got_c) - want) < 1e-4
+        assert abs(float(got_f) - want) < 1e-4
+
+        gh_c, ge_c = jax.grad(lambda a, b: chunked_lm_xent(
+            a, b, tgt_bad, 4), (0, 1))(h, emb)
+        gh_f, ge_f = jax.grad(lambda a, b: fused_lm_xent(
+            a, b, tgt_bad, token_block=16, vocab_block=128,
+            interpret=True), (0, 1))(h, emb)
+        m3 = jnp.asarray(bad)[..., None]
+        assert float(jnp.abs(jnp.where(m3, gh_f, 0)).max()) == 0.0
+        assert np.isfinite(np.asarray(gh_f)).all()
+        assert np.isfinite(np.asarray(ge_f)).all()
+        for a, b in ((gh_c, gh_f), (ge_c, ge_f)):
+            d = float(jnp.max(jnp.abs(a - b))) / float(jnp.max(jnp.abs(a)))
+            assert d < 1e-3
+
     def test_z_loss(self):
         # PaLM-style z-loss: loss + z*lse^2 per position, gradients via
         # the in-kernel (1 + 2z*lse)*P - onehot factor — checked against
