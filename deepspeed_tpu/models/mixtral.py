@@ -104,8 +104,10 @@ class Mixtral(nn.Module):
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="embed")
         x = embed(tokens)
+        block_cls = (nn.remat(MixtralBlock, static_argnums=(2,))
+                     if cfg.remat else MixtralBlock)
         for i in range(cfg.num_layers):
-            x = MixtralBlock(cfg, self.ep_mesh, name=f"layer_{i}")(x, train)
+            x = block_cls(cfg, self.ep_mesh, name=f"layer_{i}")(x, train)
         x = RMSNorm(cfg.rms_eps, jnp.float32, name="final_norm")(x)
         head = nn.Dense(cfg.vocab_size, dtype=jnp.float32,
                         param_dtype=cfg.param_dtype, use_bias=False,
