@@ -47,6 +47,7 @@ def initialize(
     rng: Any = None,
     config: Any = None,
     config_params: Any = None,
+    model_cfg: Any = None,
 ) -> Tuple[Engine, Any, Any, Any]:
     """Build a training engine. Returns ``(engine, optimizer, dataloader,
     lr_scheduler)`` for signature parity with the reference ``initialize``
@@ -82,6 +83,10 @@ def initialize(
         engine_cls = HybridEngine
         engine_kwargs["apply_fn"] = model if callable(model) and \
             model is not loss_fn else None
+        # with a model config the rollout defaults to the KV-cached v2
+        # ragged engine (TPU extension arg; the reference reads module
+        # structure off the torch model instead)
+        engine_kwargs["model_cfg"] = model_cfg
 
     engine = engine_cls(
         loss_fn=loss_fn,

@@ -37,18 +37,24 @@ from .engine import Engine
 class HybridEngine(Engine):
     def __init__(self, *args, apply_fn: Optional[Callable] = None,
                  generate_fn: Optional[Callable] = None,
+                 model_cfg: Any = None,
                  lora_fuse_fn: Optional[Callable] = None,
                  lora_unfuse_fn: Optional[Callable] = None, **kwargs):
         super().__init__(*args, **kwargs)
         self.apply_fn = apply_fn
-        # escape hatch for KV-cached decode: the built-in loop recomputes the
-        # full context per token (O(new * total^2) attention); plug a cached
-        # decoder (e.g. the v2 ragged engine's model runner) here for long
-        # rollouts
+        # custom rollout hook: (params, prompt, rng, max_new) -> (ctx, new)
         self.generate_fn = generate_fn
+        # with a model config the DEFAULT rollout is KV-cached through the
+        # v2 ragged engine (prefill once + fused incremental decode) — the
+        # reference's hybrid engine exists precisely to make rollouts fast
+        # (runtime/hybrid_engine.py:30 swaps in the inference containers);
+        # without it the fallback scan recomputes the full context per
+        # token, O(new * total^2) attention
+        self.model_cfg = model_cfg
         self._lora_fuse = lora_fuse_fn
         self._lora_unfuse = lora_unfuse_fn
         self._gen_cache = {}
+        self._ragged_cache = {}
         hcfg = self.config.hybrid_engine
         self.max_out_tokens = int(hcfg.max_out_tokens)
         self._latency = []
@@ -124,6 +130,12 @@ class HybridEngine(Engine):
             jax.block_until_ready(out)
             self._latency.append(time.perf_counter() - t0)
             return out
+        if self.model_cfg is not None:
+            t0 = time.perf_counter()
+            out = self._ragged_generate(params, prompt_tokens, rng,
+                                        max_new, temperature)
+            self._latency.append(time.perf_counter() - t0)
+            return out
         if self.apply_fn is None:
             raise RuntimeError("HybridEngine needs apply_fn(params, tokens) "
                                "-> logits (or generate_fn) to generate")
@@ -141,6 +153,55 @@ class HybridEngine(Engine):
         jax.block_until_ready(new)
         self._latency.append(time.perf_counter() - t0)
         return ctx, new
+
+    # ------------------------- cached rollout -------------------------- #
+
+    def _ragged_generate(self, params, prompt_tokens, rng, max_new: int,
+                         temperature: float):
+        """Default KV-cached rollout: the v2 ragged engine prefills the
+        prompt once and decodes incrementally (fused multi-token device
+        loop), vs the fallback scan's full-context recompute per token.
+        Engines are cached per (batch, total-length) bucket; params are
+        refreshed every call so rollouts always see the CURRENT training
+        weights (cast + compression applied, like the train step)."""
+        import numpy as np
+
+        from ..inference.config import InferenceConfig
+        from ..inference.v2 import InferenceEngineV2, RaggedInferenceConfig
+        from ..utils.dtypes import cast_floating
+
+        pt = np.asarray(prompt_tokens)
+        B, P = pt.shape
+        total = P + max_new
+        # key on the full (B, P, max_new) split: chunk_size and the fused
+        # decode loop length are sized from P/max_new, so a same-total
+        # different-split call must not reuse a mis-sized engine
+        key = (B, P, max_new)
+        eng = self._ragged_cache.get(key)
+        if eng is None:
+            eng = InferenceEngineV2(
+                self.model_cfg, None, RaggedInferenceConfig(
+                    max_seqs=B, chunk_size=max(P, 8), block_size=total,
+                    num_blocks=B + 2, max_blocks_per_seq=1,
+                    decode_loop_steps=min(max_new, 32),
+                    dtype=jnp.dtype(self.compute_dtype).name,
+                    attention_impl="auto"))
+            self._ragged_cache[key] = eng
+        p = cast_floating(params, self.compute_dtype)
+        if self._compression is not None:
+            p = self._compression.apply(p, self.state.step)
+        eng.params = p
+        sampling = None if temperature <= 0.0 else InferenceConfig(
+            greedy=False, temperature=float(temperature))
+        seed = int(jax.random.randint(rng, (), 0, 2**31 - 1))
+        new = eng.generate([row.tolist() for row in pt],
+                           max_new_tokens=max_new, sampling=sampling,
+                           seed=seed)
+        new = np.asarray([t + [0] * (max_new - len(t)) for t in new],
+                         np.int32)
+        ctx = np.concatenate([pt, new], axis=1)
+        return jnp.asarray(ctx, prompt_tokens.dtype), jnp.asarray(
+            new, jnp.int32)
 
     # RLHF helpers mirroring the reference's bookkeeping ----------------- #
 
