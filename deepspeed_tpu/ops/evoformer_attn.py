@@ -47,12 +47,18 @@ def DS4Sci_EvoformerAttention(q: jnp.ndarray, k: jnp.ndarray,
                               v: jnp.ndarray,
                               biases: Optional[Sequence[Optional[jnp.ndarray]]]
                               = None,
-                              chunk_size: Optional[int] = None) -> jnp.ndarray:
+                              chunk_size: Optional[int] = None,
+                              use_kernel: Optional[bool] = None) -> jnp.ndarray:
     """Fused evoformer attention (reference-API name kept verbatim).
 
     ``chunk_size``: query-dim tile for the memory-bounded path. None = auto
     (fused below ~1 GiB of fp32 scores, 128-wide chunks above); pass
     ``q.shape[2]`` to force fusion.
+
+    ``use_kernel``: route through the Pallas flash kernel
+    (``ops.kernels.evoformer``) when the biases are the two canonical
+    reference layouts. None = auto (kernel on TPU, jnp elsewhere);
+    non-canonical bias layouts always take the jnp path.
     """
     if q.ndim != 5:
         raise ValueError(f"expected [B, N, S, H, D] tensors, got {q.shape}")
@@ -71,6 +77,22 @@ def DS4Sci_EvoformerAttention(q: jnp.ndarray, k: jnp.ndarray,
         # reference bias layouts are [B, N, 1, 1, Sk] / [B, 1, H, Sq, Sk] —
         # already aligned with [B, N, H, Sq, Sk]
         bs.append(b)
+
+    if use_kernel is None:
+        use_kernel = jax.default_backend() == "tpu"
+    if use_kernel:
+        mb = pb = None
+        ok = True
+        for b in bs:
+            if b.shape[1:4] == (N, 1, 1) and mb is None:
+                mb = b[:, :, 0, 0, :]                  # [B, N, Sk]
+            elif b.shape[1] == 1 and b.shape[2:4] == (H, Sq) and pb is None:
+                pb = b[:, 0]                           # [B, H, Sq, Sk]
+            else:
+                ok = False                             # non-canonical layout
+        if ok:
+            from .kernels.evoformer import evoformer_flash
+            return evoformer_flash(q, k, v, mb, pb)
 
     if chunk_size is None:
         score_bytes = 4 * B * N * H * Sq * Sk

@@ -337,6 +337,71 @@ class TestEvoformer:
         np.testing.assert_allclose(np.asarray(out), 2.5, rtol=1e-5)
 
 
+class TestEvoformerKernel:
+    """Pallas flash evoformer (ops/kernels/evoformer.py) vs the chunked
+    jnp path (VERDICT r4 #9 — the last csrc kernel family:
+    csrc/deepspeed4science/evoformer_attn/)."""
+
+    def _data(self, B=2, N=3, Sq=24, Sk=24, H=2, D=8):
+        ks = jax.random.split(jax.random.PRNGKey(0), 5)
+        q = jax.random.normal(ks[0], (B, N, Sq, H, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, N, Sk, H, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, N, Sk, H, D), jnp.float32)
+        mb = jnp.where(jax.random.uniform(ks[3], (B, N, 1, 1, Sk)) < 0.2,
+                       -1e9, 0.0)
+        pb = jax.random.normal(ks[4], (B, 1, H, Sq, Sk), jnp.float32)
+        return q, k, v, mb, pb
+
+    @pytest.mark.parametrize("which", ["both", "mask", "pair", "none"])
+    def test_forward_parity(self, which):
+        from deepspeed_tpu.ops.evoformer_attn import DS4Sci_EvoformerAttention
+        q, k, v, mb, pb = self._data()
+        biases = {"both": [mb, pb], "mask": [mb], "pair": [pb],
+                  "none": None}[which]
+        ref = DS4Sci_EvoformerAttention(q, k, v, biases, use_kernel=False)
+        got = DS4Sci_EvoformerAttention(q, k, v, biases, use_kernel=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_unaligned_seq_padding(self):
+        # Sq/Sk not multiples of the tiles: padded keys must be masked,
+        # padded query rows sliced off
+        from deepspeed_tpu.ops.evoformer_attn import DS4Sci_EvoformerAttention
+        q, k, v, mb, pb = self._data(Sq=19, Sk=21)
+        ref = DS4Sci_EvoformerAttention(q, k, v, [mb, pb], use_kernel=False)
+        got = DS4Sci_EvoformerAttention(q, k, v, [mb, pb], use_kernel=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_grad_parity_recompute_bwd(self):
+        from deepspeed_tpu.ops.evoformer_attn import DS4Sci_EvoformerAttention
+        q, k, v, mb, pb = self._data(B=1, N=2, Sq=16, Sk=16)
+
+        def loss(fn_kernel):
+            def f(q_, k_, v_, pb_):
+                o = DS4Sci_EvoformerAttention(q_, k_, v_, [mb, pb_],
+                                              use_kernel=fn_kernel)
+                return (o.astype(jnp.float32) ** 2).sum()
+            return f
+
+        gr = jax.grad(loss(False), (0, 1, 2, 3))(q, k, v, pb)
+        gg = jax.grad(loss(True), (0, 1, 2, 3))(q, k, v, pb)
+        for a, b in zip(gr, gg):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=3e-5, rtol=3e-5)
+
+    def test_noncanonical_bias_falls_back(self):
+        # a [B, N, H, Sq, Sk] dense bias is NOT kernel-eligible; the
+        # dispatcher must take the jnp path, not mis-route
+        from deepspeed_tpu.ops.evoformer_attn import DS4Sci_EvoformerAttention
+        q, k, v, _, _ = self._data(B=1, N=2, Sq=8, Sk=8, H=2, D=4)
+        dense = jax.random.normal(jax.random.PRNGKey(9), (1, 2, 2, 8, 8))
+        ref = DS4Sci_EvoformerAttention(q, k, v, [dense], use_kernel=False)
+        got = DS4Sci_EvoformerAttention(q, k, v, [dense], use_kernel=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-6)
+
+
 class TestOPTRaggedRunner:
     @pytest.mark.parametrize("variant", ["pre_ln", "opt350m"])
     def test_decode_matches_full_forward(self, variant):

@@ -169,6 +169,23 @@ def main():
     ok &= check("fused_xent_dE", gf[1].astype(jnp.float32),
                 gr2[1].astype(jnp.float32), atol=2e-3)
 
+    # evoformer flash (ops/kernels/evoformer.py): fused bias-added
+    # attention vs the chunked jnp path, canonical mask + pair biases
+    from deepspeed_tpu.ops.evoformer_attn import DS4Sci_EvoformerAttention
+    Be, Ne, Se, He, De = 1, 4, 256, 4, 64
+    kse = jax.random.split(jax.random.PRNGKey(7), 5)
+    qe = jax.random.normal(kse[0], (Be, Ne, Se, He, De), jnp.bfloat16)
+    ke = jax.random.normal(kse[1], (Be, Ne, Se, He, De), jnp.bfloat16)
+    ve = jax.random.normal(kse[2], (Be, Ne, Se, He, De), jnp.bfloat16)
+    mbe = jnp.where(jax.random.uniform(kse[3], (Be, Ne, 1, 1, Se)) < 0.2,
+                    -1e9, 0.0)
+    pbe = jax.random.normal(kse[4], (Be, 1, He, Se, Se), jnp.float32)
+    oe = jax.jit(lambda a, b, c: DS4Sci_EvoformerAttention(
+        a, b, c, [mbe, pbe], use_kernel=True))(qe, ke, ve)
+    oer = DS4Sci_EvoformerAttention(qe, ke, ve, [mbe, pbe],
+                                    use_kernel=False)
+    ok &= check("evoformer_flash", oe, oer, atol=4e-2)
+
     print("TPU_SMOKE " + ("PASS" if ok else "FAIL"), flush=True)
     return 0 if ok else 1
 
