@@ -1,2 +1,3 @@
 from .autotuner import Autotuner, Experiment
+from .scheduler import ResourceManager, report_metrics
 from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner, build_tuner

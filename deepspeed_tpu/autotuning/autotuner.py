@@ -72,11 +72,16 @@ class Autotuner:
     """
 
     def __init__(self, loss_fn: Callable, params: Any,
-                 base_config: Dict[str, Any], batch_fn: Callable[[int], Any]):
+                 base_config: Dict[str, Any], batch_fn: Callable[[int], Any],
+                 resource_manager: Any = None):
         self.loss_fn = loss_fn
         self.params = params
         self.base_config = dict(base_config)
         self.batch_fn = batch_fn
+        # multi-experiment launch mode (reference scheduler.py
+        # ResourceManager): experiments run as user-script subprocesses
+        # over a host pool instead of in-process engine builds
+        self.resource_manager = resource_manager
         # single source of defaults: the AutotuningConfig dataclass
         from ..config.config import AutotuningConfig
         at = self.base_config.get("autotuning", {})
@@ -179,6 +184,8 @@ class Autotuner:
         tuner = build_tuner(self.tuner_type, space)
         log_dist(f"autotuning: {len(space)} candidates, tuner="
                  f"{self.tuner_type}, metric={self.metric}")
+        if self.resource_manager is not None:
+            return self._tune_scheduled(space, tuner)
         since_best = 0
         best_score = float("-inf")
         for trial in range(min(self.num_trials, len(space))):
@@ -197,6 +204,50 @@ class Autotuner:
                 if since_best >= self.early_stopping:
                     log_dist(f"autotuning early stop after {trial + 1} trials")
                     break
+        best, score = tuner.best()
+        self._write_results(best, score)
+        return best or {}
+
+    def _tune_scheduled(self, space, tuner) -> Dict[str, Any]:
+        """Scheduler mode: propose wave-sized batches of candidates from
+        the tuner and launch them over the ResourceManager's host pool
+        (reference autotuner.run_tuning + scheduler.run_job — experiments
+        run in parallel up to the pool size; the tuner sees every wave's
+        scores before proposing the next)."""
+        wave = max(1, len(self.resource_manager.hosts))
+        remaining = min(self.num_trials, len(space))
+        since_best = 0
+        best_score = float("-inf")
+        while remaining > 0:
+            cands = []
+            for _ in range(min(wave, remaining)):
+                c = tuner.next()
+                if c is None:
+                    break
+                # tentative mark so the tuner proposes DISTINCT candidates
+                # within one wave (update() appends; the real score lands
+                # after the wave, and -inf placeholders are ignored by
+                # best() / the model fit)
+                tuner.update(c, float("-inf"))
+                cands.append(c)
+            if not cands:
+                break
+            exps = [Experiment(overrides=c) for c in cands]
+            self.resource_manager.run(exps, self.base_config,
+                                      metric=self.metric)
+            for cand, exp in zip(cands, exps):
+                self.experiments.append(exp)
+                tuner.update(cand, exp.score)
+                log_dist(f"autotuning exp: {cand} -> {exp.status} "
+                         f"score={exp.score:.2f}")
+                if exp.score > best_score:
+                    best_score, since_best = exp.score, 0
+                else:
+                    since_best += 1
+            remaining -= len(cands)
+            if since_best >= self.early_stopping:
+                log_dist("autotuning early stop (scheduled mode)")
+                break
         best, score = tuner.best()
         self._write_results(best, score)
         return best or {}

@@ -106,3 +106,147 @@ class TestAutotuner:
                                       "zero_optimization.stage": [0]})
         t.tune()
         assert all(e.status == "failed" for e in t.experiments)
+
+
+# fake training script for scheduler tests: reads the candidate config,
+# scores it as stage*10 + micro (so stage 2 / micro 2 wins), writes metrics
+_FAKE_SCRIPT = (
+    "import json, os\n"
+    "cfg = json.load(open(os.environ['DSTPU_AT_CONFIG']))\n"
+    "s = cfg['zero_optimization']['stage'] * 10 \\\n"
+    "    + cfg['train_micro_batch_size_per_gpu']\n"
+    "with open(os.environ['DSTPU_AT_METRICS'], 'w') as f:\n"
+    "    json.dump({'score': s, 'throughput': s}, f)\n"
+)
+
+
+class TestResourceManager:
+    """Multi-experiment launch scheduler (reference autotuning/scheduler.py
+    ResourceManager): user-script subprocesses over a host pool, metrics
+    files collected back."""
+
+    def _rm(self, tmp_path, script=_FAKE_SCRIPT, **kw):
+        import sys
+
+        from deepspeed_tpu.autotuning import ResourceManager
+        sc = tmp_path / "train_fake.py"
+        sc.write_text(script)
+        kw.setdefault("exp_dir", str(tmp_path / "exps"))
+        return ResourceManager([sys.executable, str(sc)], **kw)
+
+    def test_runs_and_collects(self, tmp_path):
+        from deepspeed_tpu.autotuning import Experiment
+        rm = self._rm(tmp_path, max_parallel=2)
+        exps = [Experiment(overrides={"zero_optimization.stage": s,
+                                      "train_micro_batch_size_per_gpu": m})
+                for s in (0, 2) for m in (1, 2)]
+        rm.run(exps, {"zero_optimization": {"stage": 0},
+                      "train_micro_batch_size_per_gpu": 1})
+        assert all(e.status == "ok" for e in exps)
+        scores = [e.score for e in exps]
+        assert scores == [1, 2, 21, 22]
+        # per-experiment artifacts on disk (reference exps/ layout)
+        assert (tmp_path / "exps" / "exp_0000" / "ds_config.json").exists()
+        assert (tmp_path / "exps" / "exp_0003" / "metrics.json").exists()
+
+    def test_failure_and_missing_metrics(self, tmp_path):
+        from deepspeed_tpu.autotuning import Experiment
+        rm = self._rm(tmp_path, script="import sys; sys.exit(3)\n")
+        exps = [Experiment(overrides={"zero_optimization.stage": 0,
+                                      "train_micro_batch_size_per_gpu": 1})]
+        rm.run(exps, {"zero_optimization": {"stage": 0}})
+        assert exps[0].status == "failed" and "rc=3" in exps[0].error
+
+        rm2 = self._rm(tmp_path, script="pass\n",
+                       exp_dir=str(tmp_path / "exps2"))
+        exps2 = [Experiment(overrides={"zero_optimization.stage": 0,
+                                       "train_micro_batch_size_per_gpu": 1})]
+        rm2.run(exps2, {"zero_optimization": {"stage": 0}})
+        assert exps2[0].status == "failed"
+        assert "metrics" in exps2[0].error
+
+    def test_timeout_kills_stuck_experiment(self, tmp_path):
+        from deepspeed_tpu.autotuning import Experiment
+        rm = self._rm(tmp_path, script="import time; time.sleep(60)\n",
+                      exp_timeout=1.5)
+        exps = [Experiment(overrides={"zero_optimization.stage": 0,
+                                      "train_micro_batch_size_per_gpu": 1})]
+        t0 = __import__("time").time()
+        rm.run(exps, {"zero_optimization": {"stage": 0}})
+        assert exps[0].status == "failed"
+        assert "timeout" in exps[0].error
+        assert __import__("time").time() - t0 < 30
+
+    def test_strips_stale_batch_keys(self, tmp_path):
+        # base config carries train_batch_size; candidate overrides the
+        # micro batch — the written candidate config must drop the stale
+        # batch math (review r5: every candidate would fail the engine's
+        # batch invariant otherwise)
+        import json as _json
+
+        from deepspeed_tpu.autotuning import Experiment
+        rm = self._rm(tmp_path)
+        exps = [Experiment(overrides={"zero_optimization.stage": 1,
+                                      "train_micro_batch_size_per_gpu": 4})]
+        rm.run(exps, {"zero_optimization": {"stage": 0},
+                      "train_batch_size": 32,
+                      "gradient_accumulation_steps": 2,
+                      "autotuning": {"enabled": True},
+                      "train_micro_batch_size_per_gpu": 1})
+        cfg = _json.load(open(tmp_path / "exps" / "exp_0000"
+                              / "ds_config.json"))
+        assert "train_batch_size" not in cfg
+        assert "gradient_accumulation_steps" not in cfg
+        assert "autotuning" not in cfg
+        assert cfg["train_micro_batch_size_per_gpu"] == 4
+
+    def test_missing_score_key_fails(self, tmp_path):
+        from deepspeed_tpu.autotuning import Experiment
+        rm = self._rm(
+            tmp_path,
+            script=("import json, os\n"
+                    "with open(os.environ['DSTPU_AT_METRICS'],'w') as f:\n"
+                    "    json.dump({'samples_per_sec': 310}, f)\n"))
+        exps = [Experiment(overrides={"zero_optimization.stage": 0,
+                                      "train_micro_batch_size_per_gpu": 1})]
+        rm.run(exps, {"zero_optimization": {"stage": 0}})
+        assert exps[0].status == "failed"
+        assert "none of" in exps[0].error
+
+    def test_latency_metric_negated(self, tmp_path):
+        from deepspeed_tpu.autotuning import Experiment
+        rm = self._rm(
+            tmp_path,
+            script=("import json, os\n"
+                    "cfg = json.load(open(os.environ['DSTPU_AT_CONFIG']))\n"
+                    "lat = 10 - cfg['train_micro_batch_size_per_gpu']\n"
+                    "with open(os.environ['DSTPU_AT_METRICS'],'w') as f:\n"
+                    "    json.dump({'latency': lat}, f)\n"))
+        exps = [Experiment(overrides={"zero_optimization.stage": 0,
+                                      "train_micro_batch_size_per_gpu": m})
+                for m in (1, 4)]
+        rm.run(exps, {"zero_optimization": {"stage": 0}},
+               metric="latency")
+        # micro 4 has LOWER latency (6 vs 9) => higher (less negative) score
+        assert exps[1].score > exps[0].score
+
+    def test_report_metrics_helper(self, tmp_path, monkeypatch):
+        import json as _json
+
+        from deepspeed_tpu.autotuning import report_metrics
+        out = tmp_path / "m" / "metrics.json"
+        monkeypatch.setenv("DSTPU_AT_METRICS", str(out))
+        report_metrics({"score": 7.5})
+        assert _json.load(open(out)) == {"score": 7.5}
+
+    def test_autotuner_scheduled_mode(self, tmp_path):
+        t = TestAutotuner()._tuner(
+            tmp_path, num_tuning_micro_batch_sizes=2,
+            tuning_space={"zero_optimization.stage": [0, 2]})
+        t.resource_manager = self._rm(tmp_path, max_parallel=2)
+        best = t.tune()
+        # fake script scores stage*10 + micro: stage 2 micro 2 must win
+        assert best == {"zero_optimization.stage": 2,
+                        "train_micro_batch_size_per_gpu": 2}
+        assert all(e.status == "ok" for e in t.experiments)
+        assert len(t.experiments) == 4
