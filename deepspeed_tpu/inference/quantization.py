@@ -42,6 +42,11 @@ def quantize_model_params(params: Any, cfg: Dict) -> Any:
     group = int(block.get("group_size", 128))
     modules = list(block.get("modules", [".*"]))
     excluded = list(block.get("excluded_modules", []))
+    # num_bits 6/12 select the MINIFLOAT serving dtypes (reference FP6
+    # serving path, inference/v2/kernels/core_ops/cuda_linear/): storage
+    # is real 6 (12) bits/value via ops/fp_quantizer bit packing; the
+    # fused-GEMM fast path is ops/kernels/fp6_gemm.fp6_matmul
+    fp_mode = bits in (6, 12)
     count = [0]
 
     import jax.numpy as jnp
@@ -58,36 +63,50 @@ def quantize_model_params(params: Any, cfg: Dict) -> Any:
         if not _matches(ps, modules):
             return x
         count[0] += 1
+        if fp_mode:
+            from ..ops.fp_quantizer import fp_quantize
+            return fp_quantize(x, q_bits=bits, group_size=group)
         return quantize_blockwise(x, bits=bits, group_size=group)
 
     out = jax.tree_util.tree_map_with_path(leaf, params)
-    log_dist(f"WOQ: quantized {count[0]} weight tensors to int{bits} "
-             f"(group {group})")
+    log_dist(f"WOQ: quantized {count[0]} weight tensors to "
+             f"{'fp' if fp_mode else 'int'}{bits} (group {group})")
     return out
 
 
 def dequantize_tree(params: Any, dtype=None) -> Any:
     """Dequantized view of a WOQ params tree (jit-safe; XLA fuses)."""
+    import jax.numpy as jnp
+
+    from ..ops.fp_quantizer import FPQuantizedTensor, fp_dequantize
+
     def leaf(x):
         if isinstance(x, QuantizedTensor):
             out = dequantize_blockwise(x)
             return out.astype(dtype) if dtype is not None else out
+        if isinstance(x, FPQuantizedTensor):
+            return fp_dequantize(x, dtype=dtype if dtype is not None
+                                 else jnp.float32)
         return x
 
-    return jax.tree_util.tree_map(
-        leaf, params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+    is_q = lambda x: isinstance(x, (QuantizedTensor, FPQuantizedTensor))  # noqa: E731
+    return jax.tree_util.tree_map(leaf, params, is_leaf=is_q)
 
 
 def woq_memory_bytes(params: Any) -> int:
     """Weight-storage bytes of a (possibly WOQ) params tree."""
+    from ..ops.fp_quantizer import FPQuantizedTensor
     total = 0
     for leaf in jax.tree_util.tree_leaves(
-            params, is_leaf=lambda x: isinstance(x, QuantizedTensor)):
+            params, is_leaf=lambda x: isinstance(
+                x, (QuantizedTensor, FPQuantizedTensor))):
         if isinstance(leaf, QuantizedTensor):
             total += leaf.values.size * leaf.values.dtype.itemsize
             total += leaf.scale.size * 4
             if leaf.zero is not None:
                 total += leaf.zero.size * 4
+        elif isinstance(leaf, FPQuantizedTensor):
+            total += leaf.codes.size + leaf.scale.size * 4
         else:
             # metadata only — no device transfer
             total += int(np.prod(np.shape(leaf)) *

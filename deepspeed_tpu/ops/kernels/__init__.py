@@ -30,3 +30,10 @@ from .quantization import (  # noqa: E402,F401
 )
 from .fused_optimizer import fused_adamw_update  # noqa: E402,F401
 from .fused_xent import fused_lm_xent  # noqa: E402,F401
+from .evoformer import evoformer_flash  # noqa: E402,F401
+from .fp6_gemm import (  # noqa: E402,F401
+    Fp6GemmWeight,
+    fp6_gemm_pack,
+    fp6_gemm_unpack,
+    fp6_matmul,
+)

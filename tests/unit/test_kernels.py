@@ -667,3 +667,83 @@ class TestFusedXent:
         for a, b in zip(g1, g2):
             d = float(jnp.max(jnp.abs(a - b))) / float(jnp.max(jnp.abs(b)))
             assert d < 1e-3
+
+
+class TestFp6Gemm:
+    """Fused FP6 weight-only GEMM (ops/kernels/fp6_gemm.py) — the
+    reference's FP6 serving path (inference/v2/kernels/core_ops/
+    cuda_linear/), TPU form."""
+
+    def _w(self, K=256, N=512, seed=0):
+        return jax.random.normal(jax.random.PRNGKey(seed), (K, N),
+                                 jnp.float32) * 0.1
+
+    def test_pack_unpack_quantization_error(self):
+        from deepspeed_tpu.ops.kernels import fp6_gemm_pack, fp6_gemm_unpack
+        w = self._w()
+        wq = fp6_gemm_unpack(fp6_gemm_pack(w))
+        assert wq.shape == w.shape
+        # e3m2 with per-column scaling: ~2 mantissa bits => relative
+        # error bounded by ~2^-3 of the column max
+        colmax = jnp.max(jnp.abs(w), axis=0)
+        err = jnp.max(jnp.abs(wq - w) / colmax[None, :])
+        assert float(err) < 0.14, float(err)
+
+    def test_matmul_matches_unpacked(self):
+        from deepspeed_tpu.ops.kernels import (fp6_gemm_pack,
+                                               fp6_gemm_unpack, fp6_matmul)
+        w = self._w()
+        fw = fp6_gemm_pack(w)
+        x = jax.random.normal(jax.random.PRNGKey(1), (24, 256), jnp.float32)
+        ref = x @ fp6_gemm_unpack(fw)
+        got = fp6_matmul(x, fw, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=3e-4, rtol=3e-4)
+
+    def test_batched_and_padded_rows(self):
+        from deepspeed_tpu.ops.kernels import (fp6_gemm_pack,
+                                               fp6_gemm_unpack, fp6_matmul)
+        fw = fp6_gemm_pack(self._w())
+        x = jax.random.normal(jax.random.PRNGKey(2), (3, 5, 256),
+                              jnp.float32)          # M=15: pads to tile
+        ref = x @ fp6_gemm_unpack(fw)
+        got = fp6_matmul(x, fw, interpret=True)
+        assert got.shape == (3, 5, 512)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=3e-4, rtol=3e-4)
+
+    def test_unaligned_falls_back(self):
+        from deepspeed_tpu.ops.kernels import (fp6_gemm_pack,
+                                               fp6_gemm_unpack, fp6_matmul)
+        w = self._w(K=100, N=40)                    # no 128-divisor tiles
+        fw = fp6_gemm_pack(w)
+        x = jax.random.normal(jax.random.PRNGKey(3), (4, 100), jnp.float32)
+        ref = x @ fp6_gemm_unpack(fw)
+        got = fp6_matmul(x, fw, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_storage_is_6_bits(self):
+        from deepspeed_tpu.ops.kernels import fp6_gemm_pack
+        fw = fp6_gemm_pack(self._w(K=256, N=512))
+        assert fw.bytes3.dtype == jnp.uint8
+        # 3 bytes per 4 values = 6 bits/value
+        assert fw.bytes3.size == 256 * 512 * 6 // 8
+
+    def test_woq_fp6_serving_dtype(self):
+        # inference/quantization num_bits=6 path: FPQuantizedTensor leaves,
+        # dequantize_tree view, memory accounting
+        from deepspeed_tpu.inference.quantization import (
+            dequantize_tree, quantize_model_params, woq_memory_bytes)
+        from deepspeed_tpu.ops.fp_quantizer import FPQuantizedTensor
+        params = {"proj": {"kernel": self._w(K=128, N=256)},
+                  "norm": {"scale": jnp.ones((256,))}}
+        q = quantize_model_params(
+            params, {"quantized_weights": {"enabled": True, "num_bits": 6,
+                                           "group_size": 128}})
+        assert isinstance(q["proj"]["kernel"], FPQuantizedTensor)
+        deq = dequantize_tree(q)
+        colmax = float(jnp.max(jnp.abs(params["proj"]["kernel"])))
+        assert float(jnp.max(jnp.abs(
+            deq["proj"]["kernel"] - params["proj"]["kernel"]))) < 0.14 * colmax
+        assert woq_memory_bytes(q) < woq_memory_bytes(params) / 2

@@ -186,6 +186,17 @@ def main():
                                     use_kernel=False)
     ok &= check("evoformer_flash", oe, oer, atol=4e-2)
 
+    # fused FP6 weight-only GEMM (ops/kernels/fp6_gemm.py)
+    from deepspeed_tpu.ops.kernels import (fp6_gemm_pack, fp6_gemm_unpack,
+                                           fp6_matmul)
+    w6 = jax.random.normal(jax.random.PRNGKey(8), (512, 2048),
+                           jnp.float32) * 0.1
+    fw6 = fp6_gemm_pack(w6)
+    x6 = jax.random.normal(jax.random.PRNGKey(9), (64, 512), jnp.bfloat16)
+    o6 = jax.jit(lambda a: fp6_matmul(a, fw6, interpret=False))(x6)
+    o6r = x6.astype(jnp.float32) @ fp6_gemm_unpack(fw6)
+    ok &= check("fp6_gemm", o6, o6r, atol=6e-2)
+
     print("TPU_SMOKE " + ("PASS" if ok else "FAIL"), flush=True)
     return 0 if ok else 1
 
