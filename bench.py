@@ -59,10 +59,11 @@ def bench_train(model_kind: str = "gpt124"):
         # V100-32GB via ZeRO offload; here the 16 GiB chip holds it
         # resident). DSTPU_1P3B_MODE=stream switches to the ZeRO-Infinity
         # param_stream path instead (host-resident fp32 state).
-        seq = 2048
+        seq = int(os.environ.get("DSTPU_1P3B_SEQ", "2048"))
         micro = int(os.environ.get("DSTPU_TRAIN_MICRO", "2"))
         cfg_model = GPT2Config(
-            vocab_size=50304, max_seq_len=seq + 1, num_layers=24,
+            vocab_size=50304, max_seq_len=seq + 1,
+            num_layers=int(os.environ.get("DSTPU_1P3B_LAYERS", "24")),
             num_heads=16, hidden_size=2048,
             param_dtype=jnp.bfloat16,
             remat=True,

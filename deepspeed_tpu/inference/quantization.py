@@ -42,11 +42,18 @@ def quantize_model_params(params: Any, cfg: Dict) -> Any:
     group = int(block.get("group_size", 128))
     modules = list(block.get("modules", [".*"]))
     excluded = list(block.get("excluded_modules", []))
-    # num_bits 6/12 select the MINIFLOAT serving dtypes (reference FP6
-    # serving path, inference/v2/kernels/core_ops/cuda_linear/): storage
-    # is real 6 (12) bits/value via ops/fp_quantizer bit packing; the
-    # fused-GEMM fast path is ops/kernels/fp6_gemm.fp6_matmul
-    fp_mode = bits in (6, 12)
+    # num_bits 6/12 (or an explicit dtype: "fp6"/"fp8"/"fp12") select the
+    # MINIFLOAT serving dtypes (reference FP6 serving path,
+    # inference/v2/kernels/core_ops/cuda_linear/): storage is real
+    # q_bits/value via ops/fp_quantizer bit packing; the fused-GEMM fast
+    # path is ops/kernels/fp6_gemm.fp6_matmul. Bare num_bits=8 keeps its
+    # historical int8 meaning — fp8 (e4m3) needs the explicit dtype key.
+    dtype_key = str(block.get("dtype", "")).lower()
+    if dtype_key.startswith("fp"):
+        bits = int(dtype_key[2:])
+        fp_mode = True
+    else:
+        fp_mode = bits in (6, 12)
     count = [0]
 
     import jax.numpy as jnp

@@ -122,7 +122,7 @@ def fp6_matmul(x: jnp.ndarray, fw: Fp6GemmWeight,
     x2 = x.reshape(-1, K)
     M = x2.shape[0]
     Kt, Jt = _pick_tile(K), _pick_tile(J)
-    if not Kt or not Jt:
+    if not Kt or not Jt or M == 0:
         return (x @ fp6_gemm_unpack(fw).astype(x.dtype)).reshape(
             *lead, N)
     Mt = min(256, ((M + 7) // 8) * 8)

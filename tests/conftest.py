@@ -35,3 +35,122 @@ def devices8():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
     return devs
+
+# Tests measured >= 10 s on the 1-core reference box (full-suite
+# --durations run, round 5) — the 'full' tier. The fast tier
+# (-m 'not full') covers every subsystem with the quick cases and
+# finishes in well under 10 minutes.
+_FULL_TESTS = frozenset([
+    "test_autotuning.py::TestAutotuner::test_tune_end_to_end",
+    "test_checkpoint.py::test_onebit_comm_state_excluded_from_checkpoint",
+    "test_checkpoint.py::test_save_load_roundtrip",
+    "test_diffusion.py::test_sd_pipeline_text_to_image_smoke",
+    "test_diffusion.py::test_unet_shapes_and_grad",
+    "test_diffusion.py::test_vae_roundtrip_shapes",
+    "test_engine.py::test_bf16_training",
+    "test_engine.py::test_forward_backward_step_trio",
+    "test_engine.py::test_fp16_dynamic_loss_scale",
+    "test_engine.py::test_global_samples_counter",
+    "test_engine.py::test_grad_accumulation_equivalence",
+    "test_engine.py::test_lr_schedule_applied",
+    "test_engine.py::test_zero_stage_matches_stage0",
+    "test_hf_loader.py::TestBuildHfEngine::test_quantized_engine_runs",
+    "test_hf_loader.py::TestLlamaParity::test_generate_through_hybrid_engine",
+    "test_hf_loader.py::TestLlamaParity::test_logits_match_transformers",
+    "test_hf_loader.py::TestMoEParity::test_qwen2_moe_norm_topk_variants",
+    "test_hf_loader.py::TestQwen2MoeRaggedRunner::test_shared_expert_in_ragged_decode",
+    "test_hf_loader.py::TestQwenV1::test_qwen_checkpoint_serves",
+    "test_hybrid_engine.py::TestHybridEngine::test_train_generate_train",
+    "test_inference.py::test_bert_classification_head_through_v1",
+    "test_inference.py::test_bert_encoder_through_v1_engine",
+    "test_inference.py::test_generate_matches_stepwise_argmax",
+    "test_inference.py::test_v1_engine_zoo",
+    "test_inference_v2.py::TestEvoformer::test_bias_shapes_and_grad",
+    "test_inference_v2.py::TestFalconPhiRaggedRunners::test_falcon_decode_matches_full_forward",
+    "test_inference_v2.py::TestFalconPhiRaggedRunners::test_phi_decode_matches_full_forward",
+    "test_inference_v2.py::TestKVInt8::test_engine_int8_decode_loop_linear_layout",
+    "test_inference_v2.py::TestKVInt8::test_engine_int8_pause_resume",
+    "test_inference_v2.py::TestKVInt8::test_kernel_direct_int8_parity",
+    "test_inference_v2.py::TestKVOffloadRestore::test_pause_evict_resume_token_exact",
+    "test_inference_v2.py::TestOPTRaggedRunner::test_decode_matches_full_forward",
+    "test_inference_v2.py::TestOnDeviceSampling::test_decode_batch_eos_freeze_accounting",
+    "test_inference_v2.py::TestOnDeviceSampling::test_sampled_topk1_equals_greedy",
+    "test_inference_v2.py::TestPagedFlashKernel::test_engine_tokens_identical_dense_vs_kernel",
+    "test_inference_v2.py::TestPagedFlashKernel::test_gqa_and_chunk_parity",
+    "test_inference_v2.py::TestPagedFlashKernel::test_long_context_8k",
+    "test_inference_v2.py::TestRaggedEngineParity::test_decode_greedy_eos_truncates",
+    "test_inference_v2.py::TestRaggedEngineParity::test_decode_matches_full_forward",
+    "test_inference_v2.py::TestRaggedEngineParity::test_fused_decode_loop_linear_layout",
+    "test_inference_v2.py::TestRaggedEngineParity::test_fused_decode_loop_matches_per_step",
+    "test_inference_v2.py::TestRaggedEngineParity::test_interleaved_sequences_isolated",
+    "test_inference_v2.py::TestRaggedEngineParity::test_oversubscribed_pool_autopauses_and_completes",
+    "test_inference_v2.py::TestRaggedEngineParity::test_oversubscribed_pool_with_decode_loop_enabled",
+    "test_inference_v2.py::TestRaggedEngineParity::test_prefill_logits_match_full_forward",
+    "test_inference_v2.py::TestWOQRunner::test_woq_llama_generate_close_to_fp",
+    "test_kernels.py::TestFusedXent::test_model_config_routes_fused",
+    "test_kernels.py::TestFusedXent::test_sharded_wrapper_matches_chunked",
+    "test_kernels.py::TestShardedFlash::test_batch_and_head_sharded",
+    "test_kernels.py::TestShardedFlash::test_grad_matches_reference",
+    "test_kernels.py::TestShardedFlash::test_lse_output_grad",
+    "test_linear_quant.py::TestFpQuantizer::test_exact_for_representable",
+    "test_linear_quant.py::TestFpQuantizer::test_roundtrip_error",
+    "test_models.py::TestBert::test_mlm_forward_and_mask",
+    "test_models.py::TestLlama::test_forward_shapes_gqa",
+    "test_models.py::TestLlama::test_trains_through_engine",
+    "test_models.py::TestLlamaRaggedParity::test_llama_prefill_decode_parity",
+    "test_models.py::TestMixtral::test_experts_contribute",
+    "test_models.py::TestMixtral::test_forward_and_loss",
+    "test_models.py::TestNewArchFamilies::test_trains_through_engine",
+    "test_models.py::test_bloom_neox_gptj_train",
+    "test_moe.py::test_experts_tp_matches_plain",
+    "test_moe.py::test_grouped_gemm_grad_flows",
+    "test_moe.py::test_moe_ep_both_orderings_run",
+    "test_moe.py::test_moe_ep_grad_flows",
+    "test_moe.py::test_moe_ep_grouped_feeds_ragged_dot",
+    "test_moe.py::test_moe_ep_grouped_grad_flows",
+    "test_moe.py::test_moe_ep_grouped_k1_and_auxloss",
+    "test_moe.py::test_moe_ep_grouped_matches_capacity",
+    "test_moe.py::test_moe_ep_grouped_with_experts_tp",
+    "test_moe.py::test_moe_ep_matches_single_group",
+    "test_moe.py::test_moe_ep_zero2_trains",
+    "test_moe.py::test_moe_layer_forward",
+    "test_moe.py::test_qwen2_moe_shared_expert",
+    "test_offload.py::test_cpu_offload_checkpoint_roundtrip",
+    "test_offload.py::test_cpu_offload_matches_resident",
+    "test_offload.py::test_nvme_offload_checkpoint_roundtrip",
+    "test_offload.py::test_nvme_offload_matches_resident",
+    "test_offload.py::test_param_offload_nvme_matches_resident",
+    "test_offload.py::test_param_offload_streams_and_matches_resident",
+    "test_offload.py::test_param_streaming_grad_parity",
+    "test_offload.py::test_param_streaming_in_step",
+    "test_onebit.py::TestOnebitAllreduce::test_error_feedback_unbiased",
+    "test_onebit.py::TestOnebitEngine::test_training_through_freeze_boundary",
+    "test_parallel.py::test_ring_attention_kernel_grad",
+    "test_parallel.py::test_tp_training_matches_no_tp",
+    "test_pipeline.py::test_pipeline_engine_matches_unpipelined",
+    "test_pipeline.py::test_pipeline_module_checkpoint_roundtrip",
+    "test_pipeline.py::test_pipeline_stacked_moe_ep_composed",
+    "test_pipeline.py::test_pipeline_stacked_moe_ep_engine_trains",
+    "test_zeropp.py::TestHpzMics::test_hpz_matches_plain_stage3",
+    "test_zeropp.py::TestHpzMics::test_training_with_inner_sharding",
+    "test_zeropp.py::TestQuantizedCollectives::test_gather_roundtrip_and_grad",
+    "test_zeropp.py::TestZeroPlusPlus::test_qwz_qgz_training_matches_baseline",
+    "test_zeropp.py::test_fused_xent_inside_manual_seam",
+])
+
+
+def pytest_collection_modifyitems(config, items):
+    matched = set()
+    for item in items:
+        base = item.nodeid.split('[')[0].replace('tests/unit/', '')
+        if base in _FULL_TESTS:
+            item.add_marker(pytest.mark.full)
+            matched.add(base)
+    # a renamed/deleted test must not SILENTLY fall out of the full tier
+    # (it would land in the fast tier and break its timing guarantee) —
+    # only meaningful when the whole suite was collected
+    stale = _FULL_TESTS - matched
+    if stale and len(items) > 400:
+        import warnings
+        warnings.warn("stale _FULL_TESTS entries (renamed tests?): "
+                      + ", ".join(sorted(stale)))

@@ -58,3 +58,50 @@ def test_schedule_as_lr():
     new = _step(tx, PARAMS, GRADS)
     np.testing.assert_allclose(np.asarray(new["w"]),
                                np.asarray(PARAMS["w"]) - 0.1 * 0.5, rtol=1e-5)
+
+
+class TestCompactAdamW:
+    """bf16-stored-moment AdamW (ops/optimizers.adamw_compact) — the
+    chip-residency optimizer behind the 1.3B single-chip bench phase."""
+
+    def test_moment_dtypes_and_dispatch(self):
+        import optax
+        tx = build_optimizer("AdamW", {"lr": 1e-2, "weight_decay": 0.01,
+                                       "moment_dtype": "bfloat16"})
+        p = {"w": jnp.ones((8, 8), jnp.float32)}
+        st = tx.init(p)
+        assert jax.tree_util.tree_leaves(st.mu)[0].dtype == jnp.bfloat16
+        assert jax.tree_util.tree_leaves(st.nu)[0].dtype == jnp.bfloat16
+
+    def test_trajectory_tracks_fp32_adamw(self):
+        import optax
+        tx = build_optimizer("AdamW", {"lr": 1e-2, "weight_decay": 0.01,
+                                       "moment_dtype": "bfloat16"})
+        ref = optax.adamw(1e-2, weight_decay=0.01)
+        key = jax.random.PRNGKey(0)
+        p = pr = {"w": jax.random.normal(key, (16, 16))}
+        st, str_ = tx.init(p), ref.init(pr)
+        for i in range(25):
+            g = {"w": jax.random.normal(jax.random.PRNGKey(i), (16, 16))}
+            u, st = tx.update(g, st, p)
+            p = optax.apply_updates(p, u)
+            ur, str_ = ref.update(g, str_, pr)
+            pr = optax.apply_updates(pr, ur)
+        # bf16 moments: trajectories agree to ~bf16 relative precision
+        d = float(jnp.max(jnp.abs(p["w"] - pr["w"])))
+        s = float(jnp.max(jnp.abs(pr["w"])))
+        assert d / s < 0.05, (d, s)
+
+    def test_sqrt_nu_storage_preserves_small_variance(self):
+        # nu stored as sqrt(nu) in bf16: a grad of 1e-3 gives nu ~ 1e-8,
+        # far below bf16's tiny-value resolution if stored directly, but
+        # sqrt(nu) ~ 1e-4 survives — the update must be nonzero and sane
+        tx = build_optimizer("AdamW", {"lr": 1e-2, "weight_decay": 0.0,
+                                       "moment_dtype": "bfloat16"})
+        p = {"w": jnp.ones((4,), jnp.float32)}
+        st = tx.init(p)
+        g = {"w": jnp.full((4,), 1e-3)}
+        for _ in range(10):
+            u, st = tx.update(g, st, p)
+        # adam normalizes: update magnitude ~ lr regardless of grad scale
+        assert 1e-3 < abs(float(u["w"][0])) < 2e-2
