@@ -50,6 +50,11 @@ def quantize_model_params(params: Any, cfg: Dict) -> Any:
     # historical int8 meaning — fp8 (e4m3) needs the explicit dtype key.
     dtype_key = str(block.get("dtype", "")).lower()
     if dtype_key.startswith("fp"):
+        if dtype_key not in ("fp6", "fp8", "fp12"):
+            raise ValueError(
+                f"quantized_weights.dtype must be one of "
+                f"'fp6'/'fp8'/'fp12' (minifloat serving formats), "
+                f"got {dtype_key!r}")
         bits = int(dtype_key[2:])
         fp_mode = True
     else:

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Round-5 on-chip sequence (PROFILE.md round-5 checklist). Run on first
+# TPU contact; strictly sequential (one process owns the chip), no
+# timeouts around TPU clients (a killed client wedges the grant).
+cd /root/repo || exit 1
+LOG=profiles/r05_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round5 start $(date -u +%FT%TZ)"
+
+echo "--- [1/4] tpu_smoke"
+python tools/tpu_smoke.py | tee SMOKE_TPU_r05.txt
+
+echo "--- [2/4] profile_train fused-xent + micro-8 grid"
+python tools/profile_train.py --grid big_b6_fx,big_b8_gb,big_b8_fx,fx124
+
+echo "--- [3/4] profile_longctx"
+python tools/profile_longctx.py --grid seq8k,seq16k,seq32k,seq64k,seq128k,ring32k
+
+echo "--- [3.5] rollout cached-vs-uncached"
+python tools/profile_rollout.py
+
+echo "--- [4/4] bench (self-run; driver runs it again at round end)"
+# pick the xent impl the grid just measured: fused wins if any fused row
+# beats the chunked 99.2 TFLOPS baseline
+XENT=$(python - <<'EOF'
+import json
+best_fused = 0.0
+try:
+    for line in open("profiles/r04_results.jsonl"):
+        r = json.loads(line)
+        if r.get("loss") == "fused" and r.get("exp", "").startswith("big_"):
+            best_fused = max(best_fused, r.get("tflops_6nd", 0.0))
+except FileNotFoundError:
+    pass
+print("fused" if best_fused > 99.2 else "chunked")
+EOF
+)
+echo "xent decision: $XENT"
+DSTPU_TRAIN_XENT=$XENT python bench.py > BENCH_SELF_r05.json
+tail -c 600 BENCH_SELF_r05.json
+echo "=== tpu_round5 done $(date -u +%FT%TZ)"
