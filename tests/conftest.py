@@ -36,11 +36,33 @@ def devices8():
     assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
     return devs
 
-# Tests measured >= 10 s on the 1-core reference box (full-suite
+# Tests measured >= 7 s on the 1-core reference box (full-suite
 # --durations run, round 5) — the 'full' tier. The fast tier
 # (-m 'not full') covers every subsystem with the quick cases and
-# finishes in well under 10 minutes.
+# finishes in ~8 minutes (measured 376 tests, round 5).
 _FULL_TESTS = frozenset([
+    "test_checkpoint.py::test_load_old_format_version",
+    "test_compression.py::TestEngineIntegration::test_training_with_compression",
+    "test_elasticity.py::TestEngineIntegration::test_elastic_batch_applied",
+    "test_hf_loader.py::TestGPT2Parity::test_logits_match_transformers",
+    "test_hybrid_engine.py::TestCachedRollout::test_cached_matches_uncached_greedy",
+    "test_inference_v2.py::TestEvoformerChunked::test_chunked_grad_matches_fused",
+    "test_inference_v2.py::TestEvoformerKernel::test_grad_parity_recompute_bwd",
+    "test_inference_v2.py::TestEvoformerKernel::test_noncanonical_bias_falls_back",
+    "test_inference_v2.py::TestEvoformerKernel::test_unaligned_seq_padding",
+    "test_inference_v2.py::TestKVInt8::test_engine_int8_kernel_matches_dense",
+    "test_inference_v2.py::TestOnDeviceSampling::test_generate_sampled_oversubscribed_pool",
+    "test_inference_v2.py::TestOnDeviceSampling::test_sampled_loop_runs_fused_and_reproducible",
+    "test_kernels.py::TestFusedXent::test_ignore_index",
+    "test_models.py::TestLlamaRaggedParity::test_mixtral_prefill_parity",
+    "test_moe.py::test_grouped_gemm_matches_dropless_capacity",
+    "test_parallel.py::test_ulysses_gqa_groups_split_across_ranks",
+    "test_parallel.py::test_ulysses_gqa_native_width",
+    "test_parallel.py::test_ulysses_matches_local_attention",
+    "test_pipeline.py::test_pipeline_boundary_windows_parity",
+    "test_pipeline.py::test_pipeline_engine_tied_grads_flow",
+    "test_pipeline.py::test_pipeline_param_residency_total_over_p",
+    "test_zeropp.py::TestZeroPlusPlus::test_stage2_falls_back",
     "test_autotuning.py::TestAutotuner::test_tune_end_to_end",
     "test_checkpoint.py::test_onebit_comm_state_excluded_from_checkpoint",
     "test_checkpoint.py::test_save_load_roundtrip",
