@@ -8,6 +8,38 @@ import jax
 import jax.numpy as jnp
 
 
+def constrain_activations(x: jnp.ndarray) -> jnp.ndarray:
+    """Pin [B, T, C] activations to the framework's natural layout (batch
+    over data, sequence over seq, hidden over model when TP divides it).
+    Applied at the embedding output: without it, GSPMD can resolve the
+    token gather by fully rematerializing the embedding table per device
+    ("involuntary full rematerialization", spmd_partitioner.cc:652) when
+    params carry ZeRO/TP shardings, and seq-axis meshes silently
+    replicate activations instead of sharding the sequence."""
+    from ..parallel import topology as _topo
+    if not _topo.has_topology():
+        return x
+    mesh = _topo.get_topology().mesh
+    B, T, C = x.shape
+    # batch over ALL data axes (hpZ/MiCS's data_inner included — the
+    # engine's batch_sharding uses the same tuple; pinning batch to
+    # "data" alone would force replication across the inner group)
+    bat = tuple(a for a in ("data", "data_inner")
+                if mesh.shape.get(a, 1) > 1)
+    bsz = 1
+    for a in bat:
+        bsz *= mesh.shape[a]
+    dims = [bat if bat and B % bsz == 0 else None]
+    dims += [a if mesh.shape.get(a, 1) > 1 and d % mesh.shape[a] == 0
+             else None
+             for a, d in (("seq", T), ("model", C))]
+    if not any(dims):
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(*dims)))
+
+
 def make_causal_lm(model, cfg):
     """(model, init_fn, loss_fn) with the engine's ``(params, batch, rng)``
     contract — batch = {"tokens": [B, T+1] int32}, next-token NLL loss."""

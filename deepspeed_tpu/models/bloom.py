@@ -97,7 +97,8 @@ class Bloom(nn.Module):
         cfg = self.cfg
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="word_embeddings")
-        x = embed(tokens)
+        from ._lm_utils import constrain_activations
+        x = constrain_activations(embed(tokens))
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype,
                          name="word_embeddings_layernorm")(x)

@@ -127,7 +127,8 @@ class Falcon(nn.Module):
         cfg = self.cfg
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="word_embeddings")
-        x = embed(tokens)
+        from ._lm_utils import constrain_activations
+        x = constrain_activations(embed(tokens))
         block_cls = nn.remat(FalconBlock) if cfg.remat else FalconBlock
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"layer_{i}")(x)

@@ -190,26 +190,10 @@ class GPT2(nn.Module):
         wpe = nn.Embed(cfg.max_seq_len, cfg.hidden_size,
                        dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="wpe")
         x = wte(tokens) + wpe(jnp.arange(T)[None, :])
-        # pin the embedding output to the natural activation layout (batch
-        # over data, sequence over seq, hidden replicated): without this,
-        # GSPMD resolves the token gather by fully rematerializing the
-        # embedding table on every device ("involuntary full
-        # rematerialization", spmd_partitioner.cc:652) when params carry
-        # ZeRO/TP shardings
-        from deepspeed_tpu.parallel import topology as _topo
-        if _topo.has_topology():
-            mesh = _topo.get_topology().mesh
-            C = cfg.hidden_size
-            dims = [a if mesh.shape.get(a, 1) > 1 and d % mesh.shape[a] == 0
-                    else None
-                    for a, d in (("data", B), ("seq", T), ("model", C))]
-            if any(dims):
-                from jax.sharding import NamedSharding, PartitionSpec
-                # hidden stays sharded over model when TP is active: the
-                # embedding gather's output is already hidden-sharded, and
-                # forcing it replicated is itself a full-remat transition
-                x = jax.lax.with_sharding_constraint(
-                    x, NamedSharding(mesh, PartitionSpec(*dims)))
+        # pin the embedding output to the natural activation layout
+        # (shared helper — see _lm_utils.constrain_activations for why)
+        from ._lm_utils import constrain_activations
+        x = constrain_activations(x)
         block_cls = Block
         if cfg.remat:
             policy = None

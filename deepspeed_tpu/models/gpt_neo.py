@@ -105,6 +105,8 @@ class GPTNeo(nn.Module):
         wpe = nn.Embed(cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
                        param_dtype=cfg.param_dtype, name="wpe")
         x = wte(tokens) + wpe(jnp.arange(T)[None, :])
+        from ._lm_utils import constrain_activations
+        x = constrain_activations(x)
         for i, kind in enumerate(cfg.layer_kinds()):
             x = GPTNeoBlock(cfg, kind, name=f"h_{i}")(x)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,

@@ -127,7 +127,8 @@ class GPTNeoX(nn.Module):
         cfg = self.cfg
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="embed_in")
-        x = embed(tokens)
+        from ._lm_utils import constrain_activations
+        x = constrain_activations(embed(tokens))
         block_cls = nn.remat(GPTNeoXBlock) if cfg.remat else GPTNeoXBlock
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"layer_{i}")(x)

@@ -198,6 +198,8 @@ class Llama(nn.Module):
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="embed")
         x = embed(tokens)
+        from ._lm_utils import constrain_activations
+        x = constrain_activations(x)
         block_cls = nn.remat(LlamaBlock) if cfg.remat else LlamaBlock
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"layer_{i}")(x)

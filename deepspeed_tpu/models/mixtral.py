@@ -104,6 +104,8 @@ class Mixtral(nn.Module):
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="embed")
         x = embed(tokens)
+        from ._lm_utils import constrain_activations
+        x = constrain_activations(x)
         block_cls = (nn.remat(MixtralBlock, static_argnums=(2,))
                      if cfg.remat else MixtralBlock)
         for i in range(cfg.num_layers):

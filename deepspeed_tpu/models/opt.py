@@ -112,6 +112,8 @@ class OPT(nn.Module):
             x = nn.Dense(cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="project_in")(x)
         x = x + pos(jnp.arange(T) + cfg.POSITION_OFFSET)
+        from ._lm_utils import constrain_activations
+        x = constrain_activations(x)
         block_cls = nn.remat(OPTBlock) if cfg.remat else OPTBlock
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"layer_{i}")(x)

@@ -107,6 +107,8 @@ class Phi(nn.Module):
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="embed_tokens")(tokens)
+        from ._lm_utils import constrain_activations
+        x = constrain_activations(x)
         block_cls = nn.remat(PhiBlock) if cfg.remat else PhiBlock
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"layer_{i}")(x)
