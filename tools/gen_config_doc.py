@@ -1,0 +1,97 @@
+"""Generate docs/CONFIG.md from the Config dataclass tree.
+
+The ds_config compatibility reference a migrating DeepSpeed user needs:
+every supported key path, its type, and its default — introspected from
+``deepspeed_tpu.config.config.Config`` so the document can never drift
+from the code. Re-run after config changes:
+
+    python tools/gen_config_doc.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import typing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from deepspeed_tpu.config.config import Config  # noqa: E402
+
+HEADER = """# ds_config key reference
+
+Every key `deepspeed_tpu.initialize(config=...)` understands, with types
+and defaults — the same JSON schema as the reference's ds_config
+(`\"auto\"` is accepted wherever the reference accepts it; batch keys
+resolve against each other and the data-parallel world size). Generated
+by `tools/gen_config_doc.py` from the typed config tree
+(`deepspeed_tpu/config/config.py`); do not edit by hand.
+
+Keys the reference has that are intentionally absent here (CUDA-specific
+allocator/stream tuning, `amp`, `comms_config` torch-backend options)
+are collapsed by the TPU design: XLA owns scheduling/fusion and there is
+one backend. `optimizer.params` / `scheduler.params` accept the
+reference's per-optimizer and per-scheduler key sets (see
+`ops/optimizers.py` / `runtime/lr_schedules.py`), plus the TPU extension
+`optimizer.params.moment_dtype: "bfloat16"` (compact chip-resident Adam
+moments).
+
+"""
+
+
+def _type_name(t) -> str:
+    origin = typing.get_origin(t)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(t) if a is not type(None)]
+        inner = " | ".join(_type_name(a) for a in args)
+        return (inner + " | null") if len(typing.get_args(t)) > len(args) \
+            else inner
+    if origin in (dict, typing.Dict):
+        return "object"
+    if origin in (list, typing.List):
+        return "array"
+    return getattr(t, "__name__", str(t)).replace("NoneType", "null")
+
+
+def walk(cls, prefix: str, rows: list) -> None:
+    hints = typing.get_type_hints(cls)
+    for f in dataclasses.fields(cls):
+        if f.name.isupper() or f.name.startswith("_"):
+            continue
+        t = hints.get(f.name, f.type)
+        key = f"{prefix}{f.name}"
+        if dataclasses.is_dataclass(t):
+            rows.append((key, "section", ""))
+            walk(t, key + ".", rows)
+            continue
+        if f.default is not dataclasses.MISSING:
+            default = f.default
+        elif f.default_factory is not dataclasses.MISSING:  # type: ignore
+            default = f.default_factory()                   # type: ignore
+        else:
+            default = ""
+        rows.append((key, _type_name(t), repr(default)))
+
+
+def main():
+    rows: list = []
+    walk(Config, "", rows)
+    out = [HEADER, "| key | type | default |", "|---|---|---|"]
+    for key, tname, default in rows:
+        if tname == "section":
+            out.append(f"| **`{key}`** | — | — |")
+        else:
+            d = default.replace("|", "\\|")
+            t = tname.replace("|", "\\|")
+            out.append(f"| `{key}` | {t} | `{d}` |")
+    os.makedirs(os.path.join(REPO, "docs"), exist_ok=True)
+    path = os.path.join(REPO, "docs", "CONFIG.md")
+    with open(path, "w") as f:
+        f.write("\n".join(out) + "\n")
+    print(f"wrote {path} ({len(rows)} keys)")
+
+
+if __name__ == "__main__":
+    main()
