@@ -48,7 +48,14 @@ def quantize_model_params(params: Any, cfg: Dict) -> Any:
     # q_bits/value via ops/fp_quantizer bit packing; the fused-GEMM fast
     # path is ops/kernels/fp6_gemm.fp6_matmul. Bare num_bits=8 keeps its
     # historical int8 meaning — fp8 (e4m3) needs the explicit dtype key.
+    fused = bool(block.get("fused_gemm", False))
     dtype_key = str(block.get("dtype", "")).lower()
+    if fused and (dtype_key not in ("", "fp6") or
+                  (not dtype_key and bits != 6)):
+        raise ValueError(
+            "quantized_weights.fused_gemm is only implemented for the "
+            f"fp6 serving dtype (got dtype={dtype_key or bits!r}); drop "
+            "fused_gemm or use dtype: 'fp6'")
     if dtype_key.startswith("fp"):
         if dtype_key not in ("fp6", "fp8", "fp12"):
             raise ValueError(
@@ -76,6 +83,18 @@ def quantize_model_params(params: Any, cfg: Dict) -> Any:
             return x
         count[0] += 1
         if fp_mode:
+            # fused packing is for MATMUL weights only: embedding tables
+            # (flax leaf name "embedding") are consumed by gather/attend,
+            # which needs a dense array
+            if fused and bits == 6 and np.ndim(x) == 2 \
+                    and x.shape[1] % 4 == 0 \
+                    and not ps.endswith("embedding"):
+                # fused-GEMM layout: the Pallas kernel streams these at
+                # 6 bits/value and decodes tiles in VMEM (the runner's
+                # woq_mm dispatch); non-eligible leaves fall through to
+                # the generic packed form
+                from ..ops.kernels.fp6_gemm import fp6_gemm_pack
+                return fp6_gemm_pack(x)
             from ..ops.fp_quantizer import fp_quantize
             return fp_quantize(x, q_bits=bits, group_size=group)
         return quantize_blockwise(x, bits=bits, group_size=group)
@@ -86,11 +105,17 @@ def quantize_model_params(params: Any, cfg: Dict) -> Any:
     return out
 
 
-def dequantize_tree(params: Any, dtype=None) -> Any:
-    """Dequantized view of a WOQ params tree (jit-safe; XLA fuses)."""
+def dequantize_tree(params: Any, dtype=None, keep_fused: bool = False) -> Any:
+    """Dequantized view of a WOQ params tree (jit-safe; XLA fuses).
+
+    ``keep_fused=True`` leaves ``Fp6GemmWeight`` leaves INTACT for
+    runners that dispatch their matmuls through ``woq_mm`` (the Pallas
+    fused path); the default unpacks them so plain ``@`` consumers
+    always see dense arrays."""
     import jax.numpy as jnp
 
     from ..ops.fp_quantizer import FPQuantizedTensor, fp_dequantize
+    from ..ops.kernels.fp6_gemm import Fp6GemmWeight, fp6_gemm_unpack
 
     def leaf(x):
         if isinstance(x, QuantizedTensor):
@@ -99,19 +124,24 @@ def dequantize_tree(params: Any, dtype=None) -> Any:
         if isinstance(x, FPQuantizedTensor):
             return fp_dequantize(x, dtype=dtype if dtype is not None
                                  else jnp.float32)
+        if isinstance(x, Fp6GemmWeight) and not keep_fused:
+            out = fp6_gemm_unpack(x)
+            return out.astype(dtype) if dtype is not None else out
         return x
 
-    is_q = lambda x: isinstance(x, (QuantizedTensor, FPQuantizedTensor))  # noqa: E731
+    is_q = lambda x: isinstance(x, (QuantizedTensor, FPQuantizedTensor,  # noqa: E731
+                                    Fp6GemmWeight))
     return jax.tree_util.tree_map(leaf, params, is_leaf=is_q)
 
 
 def woq_memory_bytes(params: Any) -> int:
     """Weight-storage bytes of a (possibly WOQ) params tree."""
     from ..ops.fp_quantizer import FPQuantizedTensor
+    from ..ops.kernels.fp6_gemm import Fp6GemmWeight
     total = 0
     for leaf in jax.tree_util.tree_leaves(
             params, is_leaf=lambda x: isinstance(
-                x, (QuantizedTensor, FPQuantizedTensor))):
+                x, (QuantizedTensor, FPQuantizedTensor, Fp6GemmWeight))):
         if isinstance(leaf, QuantizedTensor):
             total += leaf.values.size * leaf.values.dtype.itemsize
             total += leaf.scale.size * 4
@@ -119,6 +149,8 @@ def woq_memory_bytes(params: Any) -> int:
                 total += leaf.zero.size * 4
         elif isinstance(leaf, FPQuantizedTensor):
             total += leaf.codes.size + leaf.scale.size * 4
+        elif isinstance(leaf, Fp6GemmWeight):
+            total += leaf.bytes3.size + leaf.scale.size * 4
         else:
             # metadata only — no device transfer
             total += int(np.prod(np.shape(leaf)) *

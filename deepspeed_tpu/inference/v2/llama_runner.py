@@ -17,7 +17,8 @@ import jax.numpy as jnp
 from ...models.llama import LlamaConfig, apply_rope
 from ...models.mixtral import MixtralConfig
 from .config import RaggedInferenceConfig
-from .model_runner import RaggedBatch, RaggedRunnerBase, paged_attention
+from .model_runner import (RaggedBatch, RaggedRunnerBase,
+                           paged_attention, woq_mm)
 
 
 def _rms(x, scale, eps):
@@ -29,7 +30,11 @@ def _rms(x, scale, eps):
 class LlamaRaggedRunner(RaggedRunnerBase):
     """All runner plumbing (jitted step / greedy step / fused decode loop,
     WOQ dequant-in-jit) comes from RaggedRunnerBase; ``step_fn`` is bound at
-    the bottom of this module."""
+    the bottom of this module. Matmul sites dispatch through ``woq_mm``,
+    so fused fp6 weights (quantized_weights.fused_gemm) stream through
+    the Pallas 6-bit GEMM instead of a full dequant."""
+
+    supports_fused_woq = True
 
 
 def _moe_mlp(p_moe, h, cfg: MixtralConfig, dtype):
@@ -40,8 +45,14 @@ def _moe_mlp(p_moe, h, cfg: MixtralConfig, dtype):
     reference's CUTLASS grouped GEMM
     (inference/v2/kernels/cutlass_ops/moe_gemm/)."""
     from ...moe.sharded_moe import grouped_moe_ffn
+    from ...ops.kernels.fp6_gemm import Fp6GemmWeight, fp6_gemm_unpack
     S, C, M = h.shape
-    logits = h.astype(jnp.float32).reshape(S * C, M) @ p_moe["gate"]
+    gate_w = p_moe["gate"]
+    if isinstance(gate_w, Fp6GemmWeight):
+        # the router weight [hidden, E] is fused-packable (E % 4 == 0)
+        # but tiny — unpack rather than kernel-dispatch the [*, E] GEMV
+        gate_w = fp6_gemm_unpack(gate_w)
+    logits = h.astype(jnp.float32).reshape(S * C, M) @ gate_w
     if "wi_gate" in p_moe:                                    # SwiGLU experts
         weights = (p_moe["wi_gate"], p_moe["wi_up"], p_moe["wo"])
     else:
@@ -73,9 +84,9 @@ def _llama_ragged_step(params, kv, batch: RaggedBatch, *,
         h = _rms(x, p["input_norm"]["scale"],
                  model_cfg.rms_eps).astype(dtype)
         pa = p["attn"]
-        q = (h @ pa["q_proj"]["kernel"].astype(dtype))
-        k = (h @ pa["k_proj"]["kernel"].astype(dtype))
-        v = (h @ pa["v_proj"]["kernel"].astype(dtype))
+        q = woq_mm(h, pa["q_proj"]["kernel"], dtype)
+        k = woq_mm(h, pa["k_proj"]["kernel"], dtype)
+        v = woq_mm(h, pa["v_proj"]["kernel"], dtype)
         if model_cfg.qkv_bias:
             q = q + pa["q_proj"]["bias"].astype(dtype)
             k = k + pa["k_proj"]["bias"].astype(dtype)
@@ -89,7 +100,7 @@ def _llama_ragged_step(params, kv, batch: RaggedBatch, *,
         kv, y = paged_attention(kv, li, q, k, v, batch, cfg, pos, valid_q,
                                 scale, dtype,
                                 sliding_window=model_cfg.sliding_window)
-        y = y @ pa["o_proj"]["kernel"].astype(dtype)
+        y = woq_mm(y, pa["o_proj"]["kernel"], dtype)
         x = x + y
 
         h = _rms(x, p["post_attn_norm"]["scale"],
@@ -98,10 +109,10 @@ def _llama_ragged_step(params, kv, batch: RaggedBatch, *,
             y = _moe_mlp(p["moe"], h, model_cfg, dtype)
             if getattr(model_cfg, "shared_expert_size", 0):
                 # qwen2-moe always-on shared expert (sigmoid scalar gate)
-                gate = h @ p["shared_gate_proj"]["kernel"].astype(dtype)
-                up = h @ p["shared_up_proj"]["kernel"].astype(dtype)
-                shared = (jax.nn.silu(gate) * up) @ \
-                    p["shared_down_proj"]["kernel"].astype(dtype)
+                gate = woq_mm(h, p["shared_gate_proj"]["kernel"], dtype)
+                up = woq_mm(h, p["shared_up_proj"]["kernel"], dtype)
+                shared = woq_mm(jax.nn.silu(gate) * up,
+                                p["shared_down_proj"]["kernel"], dtype)
                 sg = jax.nn.sigmoid(
                     (h @ p["shared_expert_gate"]["kernel"].astype(dtype)
                      ).astype(jnp.float32))
@@ -109,18 +120,24 @@ def _llama_ragged_step(params, kv, batch: RaggedBatch, *,
             x = x + y
         else:
             pm = p["mlp"]
-            gate = h @ pm["gate_proj"]["kernel"].astype(dtype)
-            up = h @ pm["up_proj"]["kernel"].astype(dtype)
+            gate = woq_mm(h, pm["gate_proj"]["kernel"], dtype)
+            up = woq_mm(h, pm["up_proj"]["kernel"], dtype)
             m = jax.nn.silu(gate) * up
-            x = x + m @ pm["down_proj"]["kernel"].astype(dtype)
+            x = x + woq_mm(m, pm["down_proj"]["kernel"], dtype)
 
     x = _rms(x, params["final_norm"]["scale"], model_cfg.rms_eps)
     last = jnp.maximum(batch.n_tokens - 1, 0)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    from ...ops.kernels.fp6_gemm import Fp6GemmWeight
     if model_cfg.tie_embeddings:
+        # embedding tables are never fused-packed (the quantizer's
+        # structural exclusion — the token gather needs a dense array)
         w_out = params["embed"]["embedding"].T
     else:
         w_out = params["lm_head"]["kernel"]
+        if isinstance(w_out, Fp6GemmWeight):
+            return woq_mm(x_last.astype(jnp.float32), w_out,
+                          jnp.float32), kv
     logits = x_last.astype(jnp.float32) @ w_out.astype(jnp.float32)
     return logits, kv
 

@@ -259,6 +259,19 @@ def paged_attention(kv, li, q, k, v, batch: "RaggedBatch",
     return kv, y
 
 
+def woq_mm(h, w, dtype):
+    """``h @ w`` with WOQ-aware dispatch: a dense array multiplies
+    directly; an ``Fp6GemmWeight`` goes through the fused Pallas GEMM
+    (weights stream at 6 bits/value, decoded tile-wise in VMEM). Runners
+    whose matmul sites route through this helper set
+    ``supports_fused_woq = True`` so the base class keeps fused leaves
+    intact through the in-jit dequant pass."""
+    from ...ops.kernels.fp6_gemm import Fp6GemmWeight, fp6_matmul
+    if isinstance(w, Fp6GemmWeight):
+        return fp6_matmul(h, w)
+    return h @ w.astype(dtype)
+
+
 class RaggedRunnerBase:
     """Shared runner plumbing: jitted step closing over the configs, with
     WOQ int8/int4 leaves dequantized INSIDE the jit (XLA fuses the dequant
@@ -266,6 +279,8 @@ class RaggedRunnerBase:
     set ``step_fn``; kv-cache geometry derives from the model config."""
 
     step_fn = None   # staticmethod(params, kv, batch, *, model_cfg, cfg, dtype)
+    #: the runner's matmuls dispatch via ``woq_mm`` (fused fp6 capable)
+    supports_fused_woq = False
 
     def __init__(self, model_cfg: Any, cfg: RaggedInferenceConfig,
                  compute_dtype: Any = None):
@@ -283,9 +298,9 @@ class RaggedRunnerBase:
 
         def _step(params, kv_data, batch):
             from ..quantization import dequantize_tree
-            return type(self).step_fn(dequantize_tree(params), kv_data,
-                                      batch, model_cfg=model_cfg, cfg=cfg,
-                                      dtype=dtype)
+            return type(self).step_fn(
+                dequantize_tree(params, keep_fused=self.supports_fused_woq),
+                kv_data, batch, model_cfg=model_cfg, cfg=cfg, dtype=dtype)
 
         self._step = jax.jit(_step)
         # greedy decode variant: argmax fused into the jit so a decode step
@@ -337,7 +352,8 @@ class RaggedRunnerBase:
             # tokenizer / per sampling profile) and passing them as device
             # scalars cost tunnel round-trips on every fused-loop call
             from ..quantization import dequantize_tree
-            params = dequantize_tree(params)
+            params = dequantize_tree(params,
+                                     keep_fused=self.supports_fused_woq)
             S = cfg.max_seqs
             pool_arr, pool_scales = pool_parts(kv_data)
             # over an int8 pool the ring stays in the compute dtype: its

@@ -43,7 +43,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", default="tiny", choices=["tiny", "7b"])
     ap.add_argument("--woq", default="int8",
-                    choices=["none", "int8", "int4", "fp6"])
+                    choices=["none", "int8", "int4", "fp6", "fp6_fused"])
     ap.add_argument("--seqs", type=int, default=0)
     args = ap.parse_args()
 
@@ -65,7 +65,11 @@ def main():
 
     if args.woq != "none":
         qcfg = ({"num_bits": 8} if args.woq == "int8" else
-                {"num_bits": 4} if args.woq == "int4" else {"dtype": "fp6"})
+                {"num_bits": 4} if args.woq == "int4" else
+                {"dtype": "fp6"} if args.woq == "fp6" else
+                # fused: eligible matmul weights stream through the
+                # Pallas 6-bit GEMM (llama_runner woq_mm dispatch)
+                {"dtype": "fp6", "fused_gemm": True})
         params = quantize_model_params(
             params, {"quantized_weights": {
                 **qcfg, "group_size": 64 if args.size == "tiny" else 128,

@@ -747,3 +747,102 @@ class TestFp6Gemm:
         assert float(jnp.max(jnp.abs(
             deq["proj"]["kernel"] - params["proj"]["kernel"]))) < 0.14 * colmax
         assert woq_memory_bytes(q) < woq_memory_bytes(params) / 2
+
+
+class TestFusedFp6Serving:
+    """fused_gemm WOQ through the ragged engine: Fp6GemmWeight leaves
+    survive the in-jit dequant pass and llama_runner's woq_mm dispatch
+    streams them through the fused kernel (eligible shapes) or the
+    unpack fallback (small projections)."""
+
+    def _engine(self, fused):
+        from deepspeed_tpu.inference.quantization import (
+            quantize_model_params, woq_memory_bytes)
+        from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                                RaggedInferenceConfig)
+        from deepspeed_tpu.models.llama import Llama, LlamaConfig
+
+        mcfg = LlamaConfig.tiny(dtype=jnp.float32, max_seq_len=128,
+                                hidden_size=128, num_heads=4,
+                                num_kv_heads=2, intermediate_size=512)
+        model = Llama(mcfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        q = quantize_model_params(
+            params, {"quantized_weights": {
+                "dtype": "fp6", "group_size": 64, "fused_gemm": fused,
+                "excluded_modules": ["embed", "norm", "lm_head"]}})
+        cfg = RaggedInferenceConfig(max_seqs=2, chunk_size=8, block_size=64,
+                                    num_blocks=8, max_blocks_per_seq=1,
+                                    dtype="float32")
+        return InferenceEngineV2(mcfg, q, cfg), q, woq_memory_bytes
+
+    def test_fused_leaves_and_generate_parity(self):
+        from deepspeed_tpu.inference.quantization import dequantize_tree
+        from deepspeed_tpu.inference.v2 import InferenceEngineV2
+        from deepspeed_tpu.ops.kernels import Fp6GemmWeight
+        eng_f, qf, _ = self._engine(fused=True)
+        # the wide MLP kernels really are in the fused layout
+        mlp = qf["layer_0"]["mlp"]["gate_proj"]["kernel"]
+        assert isinstance(mlp, Fp6GemmWeight)
+
+        # parity against the SAME fused tree served dense (the generic
+        # fp6 engine quantizes with different scale groups, so its
+        # trajectory is a different model — not the comparison)
+        dense_same = dequantize_tree(qf)
+        eng_ref = InferenceEngineV2(eng_f.runner.model_cfg, dense_same,
+                                    eng_f.config)
+        prompt = list(np.random.default_rng(0).integers(1, 512, 12))
+        got_f = eng_f.generate([prompt], max_new_tokens=5)[0]
+        got_r = eng_ref.generate([prompt], max_new_tokens=5)[0]
+        # identical decoded values, different accumulation order: greedy
+        # trajectories must agree at least on the first tokens
+        assert got_f[:2] == got_r[:2], (got_f, got_r)
+
+    def test_fused_moe_router_survives(self):
+        # Mixtral's router weight [hidden, E] is fused-packable; the MoE
+        # path must unpack it rather than crash (review r5 finding)
+        from deepspeed_tpu.inference.quantization import (
+            quantize_model_params)
+        from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                                RaggedInferenceConfig)
+        from deepspeed_tpu.models.mixtral import Mixtral, MixtralConfig
+        mcfg = MixtralConfig.tiny(dtype=jnp.float32, max_seq_len=128,
+                                  hidden_size=128, num_heads=4,
+                                  num_kv_heads=2, intermediate_size=512,
+                                  num_experts=4)
+        model = Mixtral(mcfg)
+        k = jax.random.PRNGKey(0)
+        params = model.init({"params": k, "gating": k},
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        q = quantize_model_params(
+            params, {"quantized_weights": {
+                "dtype": "fp6", "fused_gemm": True,
+                "excluded_modules": ["embed", "norm", "lm_head"]}})
+        eng = InferenceEngineV2(mcfg, q, RaggedInferenceConfig(
+            max_seqs=2, chunk_size=8, block_size=64, num_blocks=8,
+            max_blocks_per_seq=1, dtype="float32"))
+        out = eng.generate([[5, 6, 7, 8]], max_new_tokens=3)[0]
+        assert len(out) == 3
+
+    def test_fused_non_fp6_rejected(self):
+        from deepspeed_tpu.inference.quantization import (
+            quantize_model_params)
+        for bad in ({"dtype": "fp8", "fused_gemm": True},
+                    {"num_bits": 8, "fused_gemm": True}):
+            with pytest.raises(ValueError, match="fused_gemm"):
+                quantize_model_params(
+                    {"k": jnp.ones((8, 8))}, {"quantized_weights": bad})
+
+    def test_plain_consumers_get_dense(self):
+        # default dequantize_tree (no keep_fused) unpacks fused leaves
+        from deepspeed_tpu.inference.quantization import dequantize_tree
+        from deepspeed_tpu.ops.kernels import (Fp6GemmWeight,
+                                               fp6_gemm_pack)
+        w = jax.random.normal(jax.random.PRNGKey(0), (64, 128))
+        tree = {"k": fp6_gemm_pack(w)}
+        out = dequantize_tree(tree)
+        assert not isinstance(out["k"], Fp6GemmWeight)
+        assert out["k"].shape == (64, 128)
+        kept = dequantize_tree(tree, keep_fused=True)
+        assert isinstance(kept["k"], Fp6GemmWeight)
