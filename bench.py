@@ -206,6 +206,29 @@ def bench_serve():
     # host init + transfer (the tree STRUCTURE is the model's real one)
     params = jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.bfloat16), shapes)
     n_params = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
+    # optional WOQ: "int8" / "int4" / "fp6" / "fp6_fused" — decode is
+    # weight-bandwidth bound, so quantized weights move the roofline
+    woq = _os.environ.get("DSTPU_BENCH_WOQ", "")
+    weight_bytes = 2.0 * n_params
+    if woq:
+        from deepspeed_tpu.inference.quantization import (
+            quantize_model_params, woq_memory_bytes)
+        if woq == "fp6_fused":
+            qcfg = {"dtype": "fp6", "fused_gemm": True}
+        elif woq in ("fp6", "fp8", "fp12"):
+            qcfg = {"dtype": woq}
+        elif woq in ("int8", "int4"):
+            qcfg = {"num_bits": int(woq[3:])}
+        else:
+            raise ValueError(
+                f"DSTPU_BENCH_WOQ must be one of int8/int4/fp6/fp8/fp12/"
+                f"fp6_fused, got {woq!r}")
+        params = quantize_model_params(
+            params, {"quantized_weights": {
+                **qcfg, "group_size": 128,
+                "excluded_modules": ["embed", "norm", "lm_head"]}})
+        # the roofline's weight term is what HBM actually streams
+        weight_bytes = float(woq_memory_bytes(params))
 
     import os
     S = int(os.environ.get("DSTPU_BENCH_SEQS", "256"))
@@ -282,12 +305,13 @@ def bench_serve():
     # decode is bandwidth-bound: the honest roofline is HBM traffic
     # (weights once per step + every live KV row), not FLOPs
     avg_ctx = PROMPT + GEN / 2
-    bytes_per_step = 2.0 * n_params + S * avg_ctx * _kv_row_bytes(
+    bytes_per_step = weight_bytes + S * avg_ctx * _kv_row_bytes(
         mcfg, kv_dtype)
     steps_per_sec = decode_tps / S
     bw_util = bytes_per_step * steps_per_sec / HBM_BW
     print(json.dumps({
         "model": "llama-1.1B (TinyLlama shape, GQA 32/4)",
+        "weight_quant": woq or "bf16",
         "kv_cache_dtype": kv_dtype,
         "n_params": n_params,
         "batch_seqs": S,
