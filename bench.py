@@ -760,11 +760,12 @@ def main():
         return 3
 
     # Per-phase watchdog. Killing a RUNNING tunneled TPU client wedges the
-    # grant, so a timeout alone must not kill: on expiry, RE-PROBE the
-    # backend in a throwaway subprocess — if the tunnel is alive the phase
-    # is just slow (first-compile heavy phases over a slow tunnel) and
-    # gets one budget extension; only a dead-probe timeout kills (nothing
-    # left to wedge) and skips the remaining phases. This keeps the round
+    # grant, so a timeout alone must NEVER kill: on expiry, RE-PROBE the
+    # backend in a throwaway subprocess — while the tunnel is alive the
+    # phase is just slow (first-compile heavy phases over a slow tunnel)
+    # and the budget keeps extending, with each extension reported as
+    # timed-out-but-alive; ONLY a dead-probe timeout kills (nothing left
+    # to wedge) and skips the remaining phases. This keeps the round
     # legible to the driver either way (the round-4 rc=124 lesson).
     phase_timeout = float(os.environ.get("DSTPU_PHASE_TIMEOUT", "2400"))
     out = {"probe": probe}
@@ -777,7 +778,7 @@ def main():
         proc = subprocess.Popen([sys.executable, __file__, phase],
                                 stdout=subprocess.PIPE,
                                 stderr=subprocess.PIPE, text=True)
-        extended = False
+        extensions = 0
         while True:
             try:
                 stdout, stderr = proc.communicate(timeout=phase_timeout)
@@ -785,18 +786,23 @@ def main():
                 break
             except subprocess.TimeoutExpired:
                 alive = _probe_backend(120.0)["ok"]
-                if alive and not extended:
-                    sys.stderr.write(f"[bench:{phase}] slow but backend "
-                                     f"alive; extending once\n")
-                    extended = True
+                if alive:
+                    extensions += 1
+                    sys.stderr.write(
+                        f"[bench:{phase}] timed out after "
+                        f"{phase_timeout:.0f}s but backend alive; "
+                        f"extending (x{extensions})\n")
                     continue
                 proc.kill()
                 stdout, stderr = proc.communicate()
                 rc = None
                 break
         if rc is None:
-            sys.stderr.write(f"[bench:{phase}] timeout {phase_timeout}s\n")
-            out[phase] = {"error": f"timeout_{phase_timeout:.0f}s"}
+            sys.stderr.write(f"[bench:{phase}] timeout {phase_timeout}s "
+                             f"with DEAD backend probe\n")
+            out[phase] = {"error": f"timeout_{phase_timeout:.0f}s",
+                          "probe_dead": True,
+                          "watchdog_extensions": extensions}
             dead = True
             continue
         lines = [ln for ln in stdout.strip().splitlines()
@@ -807,6 +813,10 @@ def main():
             out[phase] = {"error": f"rc={rc}"}
         else:
             out[phase] = json.loads(lines[-1])
+        if extensions and isinstance(out[phase], dict):
+            # phase finished but exceeded its budget: report, don't hide
+            out[phase]["timed_out_but_alive"] = True
+            out[phase]["watchdog_extensions"] = extensions
 
     train = out.get("train", {})
     train_xl = out.get("train_xl", {})

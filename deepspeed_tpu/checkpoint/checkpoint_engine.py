@@ -23,7 +23,7 @@ import weakref
 from typing import Any, Dict, Optional
 
 from ..utils.logging import log_dist, logger
-from .engine_checkpoint import LATEST_FILE, save_state_tree
+from .engine_checkpoint import LATEST_FILE, publish_latest, save_state_tree
 
 #: live async engines; flush_all_pending() lets a *different* engine instance
 #: (or process-wide teardown) wait out in-flight background writes before
@@ -42,25 +42,23 @@ import atexit  # noqa: E402
 atexit.register(flush_all_pending)
 
 
-def _write_latest(save_dir: str, tag: str) -> None:
-    with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-        f.write(tag)
-
-
 class CheckpointEngine:
     """create → save → commit lifecycle, one tag at a time.
 
     ``save`` persists the state under ``ckpt_dir``; when ``publish`` is
     given as ``(save_dir, tag)``, the ``latest`` pointer is written only
-    after the tag's files are durable (crash mid-write never corrupts the
-    newest-checkpoint pointer)."""
+    after the tag is fully durable AND re-validated on disk
+    (``engine_checkpoint.publish_latest`` — crash mid-write can never
+    corrupt the newest-checkpoint pointer)."""
 
     def create(self, tag: str) -> None:  # noqa: D401 — reference API name
         """Begin a checkpoint under ``tag``."""
 
     def save(self, state: Any, ckpt_dir: str,
              extra_meta: Optional[Dict] = None,
-             publish: Optional[tuple] = None) -> None:
+             publish: Optional[tuple] = None,
+             retries: Optional[int] = None,
+             retry_backoff_s: Optional[float] = None) -> None:
         raise NotImplementedError
 
     def commit(self) -> None:
@@ -68,10 +66,12 @@ class CheckpointEngine:
 
 
 class SyncCheckpointEngine(CheckpointEngine):
-    def save(self, state, ckpt_dir, extra_meta=None, publish=None):
-        save_state_tree(state, ckpt_dir, extra_meta=extra_meta)
+    def save(self, state, ckpt_dir, extra_meta=None, publish=None,
+             retries=None, retry_backoff_s=None):
+        save_state_tree(state, ckpt_dir, extra_meta=extra_meta,
+                        retries=retries, retry_backoff_s=retry_backoff_s)
         if publish is not None:
-            _write_latest(*publish)
+            publish_latest(*publish)
 
 
 class AsyncCheckpointEngine(CheckpointEngine):
@@ -84,14 +84,17 @@ class AsyncCheckpointEngine(CheckpointEngine):
         self._error: Optional[BaseException] = None
         _LIVE_ASYNC.add(self)
 
-    def save(self, state, ckpt_dir, extra_meta=None, publish=None):
+    def save(self, state, ckpt_dir, extra_meta=None, publish=None,
+             retries=None, retry_backoff_s=None):
         self.commit()
 
         def _write():
             try:
-                save_state_tree(state, ckpt_dir, extra_meta=extra_meta)
+                save_state_tree(state, ckpt_dir, extra_meta=extra_meta,
+                                retries=retries,
+                                retry_backoff_s=retry_backoff_s)
                 if publish is not None:
-                    _write_latest(*publish)
+                    publish_latest(*publish)
             except BaseException as e:  # surfaced on next commit/save
                 self._error = e
 

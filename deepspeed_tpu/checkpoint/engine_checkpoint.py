@@ -17,24 +17,46 @@ Layout (mirrors the reference's tag/latest convention):
       <tag>/
         state_000.npz … (leaf arrays, flattened tree order)
         meta.json                 # versions, counters, tree structure, client state
+
+Self-healing guarantees (docs/resilience.md):
+
+  - saves are ATOMIC: bytes go to ``<tag>.tmp-<pid>/``, every file is
+    fsynced, then one ``rename`` promotes the tag — a crash mid-save can
+    never leave a half-written tag dir;
+  - ``meta.json`` carries per-file sha256 checksums; ``latest`` is only
+    rewritten after the tag re-validates on disk (``publish_latest``);
+  - transient save I/O errors retry with exponential backoff;
+  - ``load_checkpoint`` validates checksums and, when the pointed-to tag is
+    corrupt, QUARANTINES it (``<tag>.corrupt``) and falls back to the
+    newest valid tag.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from ..resilience.fault_injection import get_fault_injector
 from ..utils.logging import log_dist, logger
 
 #: v2: leaf paths recorded; comm_state (1-bit error buffers) excluded
-FORMAT_VERSION = 2
+#: v3: per-file sha256 checksums in meta (v2 files load; no checksum check)
+FORMAT_VERSION = 3
 LATEST_FILE = "latest"
 STATE_FILE = "state.npz"
 META_FILE = "meta.json"
+#: suffix quarantined (corrupt) tags are renamed to; never loaded again
+QUARANTINE_SUFFIX = ".corrupt"
+#: default bounded retry-with-backoff for save I/O errors
+SAVE_RETRIES = 3
+RETRY_BACKOFF_S = 0.5
 
 
 def _tag_for(engine) -> str:
@@ -46,18 +68,85 @@ def _path_str(path) -> str:
                     getattr(k, "name", k)))) for k in path)
 
 
-def save_state_tree(state: Any, ckpt_dir: str, extra_meta: Optional[Dict] = None) -> None:
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return               # platforms without O_RDONLY dir opens
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _retry_io(fn, what: str, retries: int, backoff_s: float):
+    """Bounded retry-with-backoff for transient save I/O errors (NFS blips,
+    quota races). Non-OSError failures propagate immediately."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except OSError as e:
+            if attempt >= retries:
+                raise
+            delay = backoff_s * (2 ** attempt)
+            attempt += 1
+            logger.warning(f"checkpoint {what} I/O error ({e}); retry "
+                           f"{attempt}/{retries} in {delay:.1f}s")
+            time.sleep(delay)
+
+
+def save_state_tree(state: Any, ckpt_dir: str, extra_meta: Optional[Dict] = None,
+                    retries: Optional[int] = None,
+                    retry_backoff_s: Optional[float] = None) -> None:
     """Save any pytree of arrays, fully gathered, with structure metadata.
     Leaf paths are recorded so offline tools (zero_to_fp32) can name params
-    without reconstructing the engine."""
-    os.makedirs(ckpt_dir, exist_ok=True)
+    without reconstructing the engine.
+
+    Atomic: everything is written to ``<ckpt_dir>.tmp-<pid>``, fsynced, and
+    promoted with one rename — a crash at ANY point leaves either the old
+    tag or no tag, never a torn one. Fault-injection sites: ``pre_save``,
+    ``mid_save`` (tears the state file first), see resilience/."""
+    retries = SAVE_RETRIES if retries is None else int(retries)
+    retry_backoff_s = (RETRY_BACKOFF_S if retry_backoff_s is None
+                       else float(retry_backoff_s))
+    inj = get_fault_injector()
+    inj.maybe_fire("pre_save")
+
+    tmp_dir = f"{ckpt_dir}.tmp-{os.getpid()}"
+    shutil.rmtree(tmp_dir, ignore_errors=True)
+    os.makedirs(tmp_dir)
+
     flat, treedef = jax.tree_util.tree_flatten_with_path(state)
     arrays = {}
     paths = []
     for i, (path, leaf) in enumerate(flat):
         arrays[f"leaf_{i:05d}"] = np.asarray(jax.device_get(leaf))
         paths.append(_path_str(path))
-    np.savez(os.path.join(ckpt_dir, STATE_FILE), **arrays)
+    state_path = os.path.join(tmp_dir, STATE_FILE)
+    _retry_io(lambda: np.savez(state_path, **arrays), STATE_FILE,
+              retries, retry_backoff_s)
+    inj.maybe_fire("mid_save", torn_file=state_path)
+    _fsync_file(state_path)
+
     meta = {
         "format_version": FORMAT_VERSION,
         "n_leaves": len(flat),
@@ -65,10 +154,156 @@ def save_state_tree(state: Any, ckpt_dir: str, extra_meta: Optional[Dict] = None
         "paths": paths,
         "shapes": [list(np.shape(a)) for a in arrays.values()],
         "dtypes": [str(a.dtype) for a in arrays.values()],
+        "checksums": {STATE_FILE: _sha256_file(state_path)},
     }
     meta.update(extra_meta or {})
-    with open(os.path.join(ckpt_dir, META_FILE), "w") as f:
-        json.dump(meta, f, indent=2, default=str)
+    meta_path = os.path.join(tmp_dir, META_FILE)
+
+    def _write_meta():
+        with open(meta_path, "w") as f:
+            json.dump(meta, f, indent=2, default=str)
+
+    _retry_io(_write_meta, META_FILE, retries, retry_backoff_s)
+    _fsync_file(meta_path)
+
+    # promote: the tag appears on disk complete or not at all
+    if os.path.isdir(ckpt_dir):
+        shutil.rmtree(ckpt_dir)
+    os.rename(tmp_dir, ckpt_dir)
+    _fsync_dir(os.path.dirname(ckpt_dir) or ".")
+
+
+def validate_checkpoint_dir(ckpt_dir: str, deep: bool = True) -> Tuple[bool, str]:
+    """Structural (+ checksum when ``deep``) validation of one tag dir.
+    Pre-checksum (format_version < 3) tags validate structurally only.
+    Never raises on I/O: a tag vanishing mid-validation (a peer host
+    quarantining it) is just "invalid"."""
+    meta_path = os.path.join(ckpt_dir, META_FILE)
+    if not os.path.isdir(ckpt_dir):
+        return False, "missing directory"
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except FileNotFoundError:
+        return False, f"missing {META_FILE}"
+    except (OSError, ValueError) as e:
+        return False, f"unreadable {META_FILE}: {e}"
+    if "n_leaves" not in meta:
+        return False, f"{META_FILE} lacks n_leaves"
+    if not os.path.exists(os.path.join(ckpt_dir, STATE_FILE)):
+        return False, f"missing {STATE_FILE}"
+    if not deep:
+        return True, "ok (structural)"
+    for fname, want in (meta.get("checksums") or {}).items():
+        fpath = os.path.join(ckpt_dir, fname)
+        try:
+            got = _sha256_file(fpath)
+        except OSError as e:
+            return False, f"unreadable {fname}: {e}"
+        if got != want:
+            return False, (f"checksum mismatch on {fname}: "
+                           f"{got[:12]} != {want[:12]}")
+    return True, "ok"
+
+
+def quarantine_checkpoint(ckpt_dir: str, reason: str) -> Optional[str]:
+    """Rename a corrupt tag out of the resume path (kept for forensics)."""
+    dst = f"{ckpt_dir}{QUARANTINE_SUFFIX}-{int(time.time())}"
+    try:
+        os.rename(ckpt_dir, dst)
+    except OSError as e:
+        logger.error(f"could not quarantine {ckpt_dir}: {e}")
+        return None
+    logger.error(f"QUARANTINED corrupt checkpoint {ckpt_dir} -> {dst} "
+                 f"({reason})")
+    return dst
+
+
+def _tag_step(tag: str) -> int:
+    """Sort key: global_step<N> tags by step, anything else last-resort -1."""
+    if tag.startswith("global_step"):
+        try:
+            return int(tag[len("global_step"):])
+        except ValueError:
+            pass
+    return -1
+
+
+def list_tags(load_dir: str) -> List[str]:
+    """Candidate tags in ``load_dir``, newest first (step number, then
+    mtime). tmp and quarantined dirs are excluded."""
+    tags = []
+    try:
+        entries = os.listdir(load_dir)
+    except OSError:
+        return []
+    for name in entries:
+        full = os.path.join(load_dir, name)
+        if not os.path.isdir(full):
+            continue
+        if QUARANTINE_SUFFIX in name or ".tmp-" in name:
+            continue
+        tags.append(name)
+    def mtime(t):
+        try:   # a peer may quarantine/clean the dir between listdir and here
+            return os.path.getmtime(os.path.join(load_dir, t))
+        except OSError:
+            return 0.0
+
+    return sorted(tags, key=lambda t: (_tag_step(t), mtime(t)), reverse=True)
+
+
+def find_valid_tag(load_dir: str, preferred: Optional[str] = None,
+                   quarantine: bool = True) -> Optional[str]:
+    """Newest tag that passes validation; ``preferred`` (the ``latest``
+    pointer) is tried first. Invalid candidates are quarantined on the way
+    down — self-healing: the next resume never retries a known-bad tag.
+    Directories that carry NO checkpoint files at all (a ``tensorboard/``
+    next to the tags) are skipped, never renamed; pass ``quarantine=False``
+    to make the walk strictly read-only (non-rank-0 hosts, read-only
+    stores)."""
+    candidates = list_tags(load_dir)
+    if preferred is not None:
+        candidates = [preferred] + [t for t in candidates if t != preferred]
+    for tag in candidates:
+        ckpt_dir = os.path.join(load_dir, tag)
+        ok, reason = validate_checkpoint_dir(ckpt_dir)
+        if ok:
+            return tag
+        looks_like_ckpt = (
+            os.path.exists(os.path.join(ckpt_dir, META_FILE))
+            or os.path.exists(os.path.join(ckpt_dir, STATE_FILE)))
+        if quarantine and looks_like_ckpt:
+            quarantine_checkpoint(ckpt_dir, reason)
+        else:
+            logger.warning(f"skipping {ckpt_dir}: {reason}")
+    return None
+
+
+def publish_latest(save_dir: str, tag: str) -> None:
+    """Atomically point ``latest`` at ``tag`` — but only after the tag
+    re-validates on disk. This is the commit point of the save transaction:
+    a crash anywhere before it leaves the previous ``latest`` intact.
+
+    Validation here is structural (files present, meta parses): the
+    checksums were computed from the very bytes just written and fsynced,
+    so re-hashing multi-GB state on the hot save path would only re-read
+    what the page cache holds; the LOAD path does the deep checksum pass,
+    where bit rot can actually have happened."""
+    ckpt_dir = os.path.join(save_dir, tag)
+    ok, reason = validate_checkpoint_dir(ckpt_dir, deep=False)
+    if not ok:
+        raise RuntimeError(
+            f"refusing to publish '{tag}' as latest: {reason}")
+    get_fault_injector().maybe_fire("post_save_pre_latest")
+    latest_path = os.path.join(save_dir, LATEST_FILE)
+    tmp = f"{latest_path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(tag)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, latest_path)
+    _fsync_dir(save_dir)
 
 
 def load_state_tree(ckpt_dir: str, target: Any) -> Tuple[Any, Dict]:
@@ -137,8 +372,12 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         # (donated) device buffers
         host_state = jax.tree_util.tree_map(
             lambda x: np.asarray(jax.device_get(x)), state)
+        ccfg = engine.config.checkpoint
         ck.save(host_state, ckpt_dir, extra_meta=extra,
-                publish=(save_dir, tag) if save_latest else None)
+                publish=(save_dir, tag) if save_latest else None,
+                retries=ccfg.save_retries,
+                retry_backoff_s=ccfg.retry_backoff_s)
+    engine._last_save_dir = save_dir     # preemption urgent-save target
     log_dist(f"saved checkpoint {ckpt_dir}")
     return ckpt_dir
 
@@ -157,10 +396,41 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     if tag is None:
         latest_path = os.path.join(load_dir, LATEST_FILE)
         if not os.path.exists(latest_path):
-            logger.warning(f"no '{LATEST_FILE}' file in {load_dir}; nothing loaded")
+            # no commit pointer: unpublished tags (save_latest=False, or a
+            # crash before the very first publish) are NOT trusted
+            extra = (f" ({len(list_tags(load_dir))} unpublished tag(s) "
+                     f"present)" if list_tags(load_dir) else "")
+            logger.warning(f"no '{LATEST_FILE}' file in {load_dir}; "
+                           f"nothing loaded{extra}")
             return None, {}
         with open(latest_path) as f:
-            tag = f.read().strip()
+            preferred = f.read().strip()
+        # self-healing resume: validate the pointed-to tag; quarantine and
+        # fall back to the newest valid one when it is corrupt. Only the
+        # lead process mutates the store (multi-host races, read-only
+        # snapshot mounts).
+        writer = jax.process_index() == 0
+        tag = find_valid_tag(load_dir, preferred=preferred,
+                             quarantine=writer)
+        if tag is None:
+            logger.error(f"no valid checkpoint tag in {load_dir}; "
+                         f"nothing loaded")
+            return None, {}
+        if tag != preferred:
+            logger.error(f"latest pointed at '{preferred}' but the newest "
+                         f"VALID tag is '{tag}'; healing the pointer")
+            if writer:
+                try:
+                    publish_latest(load_dir, tag)
+                except OSError as e:
+                    # read-only store: the fallback LOAD still proceeds
+                    logger.warning(f"could not heal '{LATEST_FILE}': {e}")
+    else:
+        ok, reason = validate_checkpoint_dir(os.path.join(load_dir, tag))
+        if not ok:
+            raise ValueError(
+                f"checkpoint tag '{tag}' in {load_dir} failed validation: "
+                f"{reason}")
     ckpt_dir = os.path.join(load_dir, tag)
     state, meta = load_state_tree(
         ckpt_dir, engine.state._replace(comm_state=()))

@@ -31,7 +31,7 @@ import numpy as np
 from jax import lax
 
 from ..utils.logging import log_dist, logger
-from .comms_logging import get_comms_logger
+from .comms_logging import get_comms_logger, note_collective
 
 ReduceOp = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin}
 
@@ -150,8 +150,9 @@ def configure(config=None, enabled=None, prof_all=None, prof_ops=None,
 # --------------------------------------------------------------------------- #
 
 def _axis_size(axis_name) -> int:
+    from ..utils.jax_compat import axis_size
     try:
-        return lax.axis_size(axis_name)
+        return axis_size(axis_name)
     except NameError:
         return 1
 
@@ -159,6 +160,11 @@ def _axis_size(axis_name) -> int:
 def _record(op: str, x, axis_name, log_name=None, scale: float = 1.0):
     n = _axis_size(axis_name)
     nbytes = int(np.prod(jnp.shape(x)) * jnp.result_type(x).itemsize * scale)
+    # unconditional: the resilience watchdog names this collective when a
+    # step stalls (docs/resilience.md); also the 'collective' fault site
+    note_collective(op, nbytes, n, log_name=log_name)
+    from ..resilience.fault_injection import get_fault_injector
+    get_fault_injector().maybe_fire("collective")
     get_comms_logger().append(op, nbytes, n, log_name=log_name)
 
 

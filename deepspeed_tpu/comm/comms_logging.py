@@ -131,3 +131,32 @@ _LOGGER = CommsLogger()
 
 def get_comms_logger() -> CommsLogger:
     return _LOGGER
+
+
+# --------------------------------------------------------------------------- #
+# last-collective tracking (resilience watchdog stall diagnosis)
+# --------------------------------------------------------------------------- #
+
+#: the most recent collective seen by comm._record, independent of the
+#: CommsLogger enable switch — the step watchdog names it when a step
+#: stalls. Collectives are recorded at TRACE time under jit, so this is
+#: "the last collective the program being (re)built contains", which for a
+#: hung first execution is exactly the right suspect list.
+_LAST_COLLECTIVE: Optional[Dict] = None
+
+
+def note_collective(op_name: str, size_bytes: int, n_participants: int,
+                    log_name: Optional[str] = None) -> None:
+    global _LAST_COLLECTIVE
+    import time
+    _LAST_COLLECTIVE = {
+        "op": op_name,
+        "log_name": log_name,
+        "size_bytes": int(size_bytes),
+        "n": int(n_participants),
+        "time": time.time(),
+    }
+
+
+def last_collective() -> Optional[Dict]:
+    return None if _LAST_COLLECTIVE is None else dict(_LAST_COLLECTIVE)

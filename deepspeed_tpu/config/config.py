@@ -205,6 +205,9 @@ class HybridEngineConfig(ConfigModel):
     release_inference_cache: bool = False
     pin_parameters: bool = True
     tp_gather_partition_size: int = 8
+    # cap on cached ragged rollout engines (each owns a device KV pool);
+    # LRU-evicted engines free their pool (docs/resilience.md satellite)
+    ragged_cache_size: int = 4
 
 
 @dataclass
@@ -318,6 +321,10 @@ class CheckpointConfig(ConfigModel):
     parallel_write: Dict[str, Any] = field(default_factory=dict)
     # TPU-native: async checkpointing via a background commit thread
     async_save: bool = False
+    # self-healing saves: transient I/O errors are retried with exponential
+    # backoff before the save is declared failed (resilience layer)
+    save_retries: int = 3
+    retry_backoff_s: float = 0.5
 
 
 @dataclass
@@ -341,6 +348,38 @@ class ElasticityConfig(ConfigModel):
     ignore_non_elastic_batch_info: bool = False
     num_gpus_per_node: int = 1
     model_parallel_size: int = 1
+
+
+# --------------------------------------------------------------------------- #
+# Resilience (fault tolerance) — see docs/resilience.md
+# --------------------------------------------------------------------------- #
+
+@dataclass
+class WatchdogConfig(ConfigModel):
+    """Step-stall watchdog: a heartbeat thread flags (or aborts) steps that
+    exceed ``stall_factor`` x the trailing-median step time."""
+    enabled: bool = False
+    stall_factor: float = 5.0
+    check_interval_s: float = 2.0
+    min_median_samples: int = 3
+    min_stall_s: float = 10.0         # never flag before this many seconds
+    action: str = "log"               # log | abort (exit for elastic restart)
+    heartbeat_file: Optional[str] = None
+
+
+@dataclass
+class PreemptionConfig(ConfigModel):
+    """SIGTERM/SIGINT grace: urgent checkpoint at the step boundary, then
+    exit with MEMBERSHIP_CHANGE_EXIT so the elastic agent restarts us."""
+    enabled: bool = False
+    save_dir: Optional[str] = None    # default: last save_checkpoint dir
+    signals: List[str] = field(default_factory=lambda: ["SIGTERM"])
+
+
+@dataclass
+class ResilienceConfig(ConfigModel):
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
+    preemption: PreemptionConfig = field(default_factory=PreemptionConfig)
 
 
 # --------------------------------------------------------------------------- #
@@ -395,6 +434,7 @@ class Config(ConfigModel):
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     aio: AioConfig = field(default_factory=AioConfig)
     elasticity: ElasticityConfig = field(default_factory=ElasticityConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     # compression_training keeps the reference's raw JSON schema (parsed by
     # deepspeed_tpu/compression/compress.py, not a typed sub-config)
     compression_training: Dict[str, Any] = field(default_factory=dict)

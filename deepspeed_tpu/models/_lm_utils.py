@@ -97,8 +97,8 @@ def lm_head_xent(hidden: jnp.ndarray, head: jnp.ndarray,
         from ..parallel import topology as _topo
         if head_layout == "cv":
             head = head.T
-        manual = getattr(jax.sharding.get_abstract_mesh(),
-                         "manual_axes", ())
+        from ..utils.jax_compat import manual_axes
+        manual = manual_axes()
         if manual:
             # already inside an engine manual seam (ZeRO++/1-bit
             # shard_map): hidden is per-rank local and the seam pmeans

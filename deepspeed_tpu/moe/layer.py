@@ -20,7 +20,7 @@ from typing import Callable, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..utils.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from . import sharded_moe

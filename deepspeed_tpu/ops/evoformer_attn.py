@@ -39,7 +39,15 @@ def _attend(q, k, v, biases, scale):
                         k.astype(jnp.float32)) * scale
     for b in biases:
         logits = logits + b
+    # fully-masked rows (every key at -inf) would make softmax emit NaN
+    # (max-subtraction yields -inf - -inf); substitute finite logits for
+    # those rows and zero their probabilities — matching the flash
+    # kernel's 0-output convention, with clean (zero) gradients
+    row_max = jnp.max(logits, axis=-1, keepdims=True)
+    fully_masked = row_max == -jnp.inf
+    logits = jnp.where(fully_masked, 0.0, logits)
     probs = jax.nn.softmax(logits, axis=-1)
+    probs = jnp.where(fully_masked, 0.0, probs)
     return jnp.einsum("bnhqk,bnkhd->bnqhd", probs, v.astype(jnp.float32))
 
 

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from ...utils.jax_compat import tpu_compiler_params as _compat_tpu_compiler_params
 
 _NEG_INF = float("-inf")
 
@@ -61,6 +62,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mb_ref, pb_ref, o_ref,
     m_prev, l_prev = m_scr[:], l_scr[:]
     m_cur = jnp.max(s, axis=1, keepdims=True)
     m_next = jnp.maximum(m_prev, m_cur)
+    # fully-masked rows (mask/pair bias -inf across every key) keep the
+    # running max at -inf; clamping to a finite floor stops alpha from
+    # becoming exp(-inf - -inf) = NaN while exp(-inf - floor) stays 0, so
+    # the l==0 guard below sees clean zeros and emits 0 output rows
+    m_next = jnp.maximum(m_next, -1e30)
     alpha = jnp.exp(m_prev - m_next)
     p = jnp.exp(s - m_next[:, :1])
     l_scr[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
@@ -136,7 +142,7 @@ def _evo_fwd_pallas(q4, k4, v4, mb2, pb4, *, n_rows, scale, block_q,
         scratch_shapes=[pltpu.VMEM((Tq, 128), jnp.float32),
                         pltpu.VMEM((Tq, 128), jnp.float32),
                         pltpu.VMEM((Tq, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from ...utils.jax_compat import tpu_compiler_params as _compat_tpu_compiler_params
 
 _NEG_INF = float("-inf")
 _LANES = 128
@@ -195,7 +196,7 @@ def _fwd_sparse(q, k, v, block_mask, sm_scale, block_q, block_k, kv_len,
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -290,7 +291,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len, causal_offset,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -441,7 +442,7 @@ def _bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset, interpret,
                                lambda b, h, i, j: (b, h, i, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -463,7 +464,7 @@ def _bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset, interpret,
                         pltpu.VMEM((block_k, d), jnp.float32)],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -616,7 +617,7 @@ def sharded_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     Falls back to fewer sharded dims when sizes don't divide. q/k/v are
     (B, T, H, D) for layout="BTHD" (flax convention) or (B, H, T, D).
     """
-    from jax import shard_map
+    from ...utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     if layout == "BTHD":

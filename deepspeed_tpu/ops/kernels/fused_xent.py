@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from ...utils.jax_compat import tpu_compiler_params as _compat_tpu_compiler_params
 
 _NEG_INF = float("-inf")
 _LANES = 128
@@ -129,7 +130,7 @@ def _fwd(h2, emb, tgt2, *, Tb, Vb, eps, interpret):
         ],
         out_shape=[jax.ShapeDtypeStruct((N2, 1), jnp.float32)] * 3,
         scratch_shapes=[pltpu.VMEM((Tb, _LANES), jnp.float32)] * 4,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat_tpu_compiler_params(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(h2, e, tgt2[:, None])
@@ -290,7 +291,7 @@ def _xent_bwd_rule(N, Tb, Vb, ignore, z, eps, interpret, res, g):
         out_specs=pl.BlockSpec((1, Tb, C), lambda i, j: (i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((Nt, Tb, C), h2.dtype),
         scratch_shapes=[pltpu.VMEM((Tb, C), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat_tpu_compiler_params(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(scale, h2, e, tgt2[:, None], lse[:, None]).reshape(N2, C)
@@ -309,7 +310,7 @@ def _xent_bwd_rule(N, Tb, Vb, ignore, z, eps, interpret, res, g):
         out_specs=pl.BlockSpec((1, Vb, C), lambda j, i: (j, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((Vt, Vb, C), jnp.float32),
         scratch_shapes=[pltpu.VMEM((Vb, C), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat_tpu_compiler_params(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(scale, h2, e, tgt2[:, None], lse[:, None]).reshape(Vt * Vb, C)[:V]
@@ -397,7 +398,7 @@ def sharded_fused_lm_xent(hidden: jnp.ndarray, embedding: jnp.ndarray,
     Falls back to the unsharded kernel when no batch axis divides the
     leading dim.
     """
-    from jax import shard_map
+    from ...utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     ignore = kwargs.get("ignore_index")

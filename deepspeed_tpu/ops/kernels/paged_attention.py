@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from ...utils.jax_compat import tpu_compiler_params as _compat_tpu_compiler_params
 
 _NEG_INF = float("-inf")
 _LANES = 128
@@ -563,7 +564,7 @@ def _flash_decode_grouped(qw, kp_flat, vp_flat, fetch, start_pos, seq_lens,
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, H, KVD), out_dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat_tpu_compiler_params(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(*prefetch, *operands)
@@ -886,7 +887,7 @@ def flash_paged_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(qw.shape, q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat_tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(*prefetch, *operands)

@@ -30,7 +30,7 @@ from typing import Any, Callable, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from ..utils.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import comm
@@ -449,13 +449,16 @@ class PipelineModule:
             return (buf_next, loss_acc), None
 
         buf0 = jnp.zeros(bshape, dtype)
-        carry0 = (buf0, jnp.zeros((), jnp.float32))
+        # (1,)-shaped accumulator — scalar scan carries break the legacy
+        # shard_map transpose (see _ring's carry0 note)
+        carry0 = (buf0, jnp.zeros((1,), jnp.float32))
         if self.boundary_windows is None:
             (_, loss_sum), _ = jax.lax.scan(step, carry0,
                                             jnp.arange(total_steps))
         else:
             (_, loss_sum) = _windowed_schedule(step, carry0, total_steps,
                                                self.boundary_windows)
+        loss_sum = loss_sum[0]
         # only the last stage accumulated loss; psum broadcasts it, and the
         # same psum over the data axes averages the data-parallel shards
         loss = jax.lax.psum(
@@ -829,14 +832,20 @@ class StackedPipelineModule:
                                      log_name="pipe_send_activations")
             return (buf_next, loss_acc, aux_acc), None
 
+        # (1,)-shaped accumulators, NOT scalars: a scalar scan carry inside
+        # a shard_map body trips the legacy (pre-0.5) shard_map transpose's
+        # residual naming ({0: axes} names on a rank-0 residual ->
+        # _SpecError); the singleton axis costs nothing and is squeezed
+        # right after the scan
         carry0 = (jnp.zeros(bshape, self.compute_dtype),
-                  jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+                  jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.float32))
         if self.boundary_windows is None:
             (_, loss_sum, aux_sum), _ = jax.lax.scan(step, carry0,
                                                      jnp.arange(total_steps))
         else:
             (_, loss_sum, aux_sum) = _windowed_schedule(
                 step, carry0, total_steps, self.boundary_windows)
+        loss_sum, aux_sum = loss_sum[0], aux_sum[0]
 
         loss = loss_sum / m     # already identical on every pipe rank
         if self.aux_weight:

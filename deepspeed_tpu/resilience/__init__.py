@@ -1,0 +1,25 @@
+"""Resilience layer: fault injection, step watchdog, preemption grace,
+restart ledger. See docs/resilience.md for the failure model and the
+recovery guarantees each piece provides."""
+
+from .fault_injection import (
+    FAULT_SITES,
+    FaultInjector,
+    InjectedFault,
+    get_fault_injector,
+    set_fault_injector,
+)
+from .ledger import RestartLedger
+from .preemption import PreemptionHandler
+from .watchdog import StepWatchdog
+
+__all__ = [
+    "FAULT_SITES",
+    "FaultInjector",
+    "InjectedFault",
+    "get_fault_injector",
+    "set_fault_injector",
+    "RestartLedger",
+    "PreemptionHandler",
+    "StepWatchdog",
+]

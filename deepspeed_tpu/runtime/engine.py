@@ -263,6 +263,30 @@ class Engine:
         self.global_samples = 0
         self.skipped_steps = 0
 
+        # resilience: step watchdog + preemption grace (docs/resilience.md)
+        self._last_save_dir: Optional[str] = None
+        rcfg = config.resilience
+        self._watchdog = None
+        if rcfg.watchdog.enabled:
+            import weakref
+
+            from ..resilience.watchdog import StepWatchdog
+            w = rcfg.watchdog
+            self._watchdog = StepWatchdog(
+                stall_factor=w.stall_factor,
+                check_interval_s=w.check_interval_s,
+                min_median_samples=w.min_median_samples,
+                min_stall_s=w.min_stall_s, action=w.action,
+                heartbeat_file=w.heartbeat_file)
+            # the polling thread must not outlive the engine (a stale dog
+            # would keep rewriting heartbeat_file and, with action=abort,
+            # could kill a process whose engine is long gone)
+            weakref.finalize(self, self._watchdog.stop)
+        self._preemption = None
+        if rcfg.preemption.enabled:
+            from ..resilience.preemption import PreemptionHandler
+            self._preemption = PreemptionHandler(rcfg.preemption.signals)
+
     # ------------------------------------------------------------------ #
     # construction helpers
     # ------------------------------------------------------------------ #
@@ -771,11 +795,26 @@ class Engine:
             raise ConfigError(
                 f"train_batch expects leading dim == train_batch_size ({expected}), got {lead}")
 
+        from ..resilience.fault_injection import get_fault_injector
+        get_fault_injector().maybe_fire("step", step=self.global_steps)
+        if self._watchdog is not None:
+            self._watchdog.step_start(self.global_steps)
+
         if self.flops_profiler is not None:
             self.flops_profiler.maybe_start(self.global_steps, batch)
         self._ensure_opt_state_resident()
         self._ensure_params_resident()
-        self.state, metrics = self._train_step(self.state, batch)
+        if self._watchdog is not None:
+            self._watchdog.phase("compiled_step")
+        try:
+            self.state, metrics = self._train_step(self.state, batch)
+        except BaseException:
+            # a dead step must not read as an eternal stall (with
+            # action='abort' a stale in-flight marker would kill the
+            # process after the caller recovered)
+            if self._watchdog is not None:
+                self._watchdog.step_abort()
+            raise
         if self._stream_params:
             # re-park streamed leaves in pinned_host (inferred out
             # placements land them on device after the update)
@@ -802,6 +841,17 @@ class Engine:
             # before param eviction: the profiler counts param elements
             self.flops_profiler.maybe_stop(self.global_steps, metrics)
         self._evict_params()
+        if self._watchdog is not None:
+            # step_end blocks on the loss so the recorded duration is the
+            # TRUE step time, not async dispatch time (and a hung step
+            # parks us here — exactly where the watchdog is watching)
+            try:
+                jax.block_until_ready(metrics.loss)
+            except BaseException:
+                self._watchdog.step_abort()   # deferred XLA error
+                raise
+            self._watchdog.step_end(self.global_steps)
+        self._maybe_handle_preemption()
         return metrics.loss
 
     def eval_batch(self, batch: Any, rng: Optional[jax.Array] = None):
@@ -839,6 +889,39 @@ class Engine:
             *self._micro_queue)
         self._micro_queue = []
         return self.train_batch(batch)
+
+    # --- resilience ---------------------------------------------------- #
+
+    @property
+    def preemption(self):
+        """The PreemptionHandler (None unless resilience.preemption is
+        enabled). External schedulers can call ``.request()`` on it."""
+        return self._preemption
+
+    def _maybe_handle_preemption(self):
+        """At the step boundary (the only consistent point): urgent save,
+        then exit with MEMBERSHIP_CHANGE_EXIT so the elastic agent
+        restarts us against the surviving device set."""
+        if self._preemption is None or not self._preemption.preempted:
+            return
+        from ..elasticity.elastic_agent import MEMBERSHIP_CHANGE_EXIT
+        save_dir = (self.config.resilience.preemption.save_dir
+                    or self._last_save_dir)
+        if save_dir:
+            logger.warning(
+                f"preemption: urgent checkpoint at step {self.global_steps} "
+                f"-> {save_dir}")
+            self.save_checkpoint(save_dir)
+            # async engines: the write MUST be durable before we exit
+            from ..checkpoint.checkpoint_engine import flush_all_pending
+            flush_all_pending()
+        else:
+            logger.error(
+                "preemption: no save_dir configured and no prior "
+                "save_checkpoint dir — exiting WITHOUT a final checkpoint")
+        logger.warning(f"preemption: exiting {MEMBERSHIP_CHANGE_EXIT} "
+                       f"for elastic restart")
+        raise SystemExit(MEMBERSHIP_CHANGE_EXIT)
 
     # --- telemetry ----------------------------------------------------- #
 

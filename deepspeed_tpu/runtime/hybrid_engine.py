@@ -25,12 +25,13 @@ functions of one param pytree:
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
-from ..utils.logging import log_dist
+from ..utils.logging import log_dist, logger
 from .engine import Engine
 
 
@@ -54,8 +55,12 @@ class HybridEngine(Engine):
         self._lora_fuse = lora_fuse_fn
         self._lora_unfuse = lora_unfuse_fn
         self._gen_cache = {}
-        self._ragged_cache = {}
+        # LRU of InferenceEngineV2 rollout engines: each owns a device KV
+        # pool, so an unbounded dict leaks HBM across varying prompt
+        # lengths (RLHF rollouts); see _ragged_generate's bucketing
+        self._ragged_cache: OrderedDict = OrderedDict()
         hcfg = self.config.hybrid_engine
+        self._ragged_cache_cap = max(1, int(hcfg.ragged_cache_size))
         self.max_out_tokens = int(hcfg.max_out_tokens)
         self._latency = []
         self._gen_rng = jax.random.PRNGKey(self.config.seed ^ 0x9E3779B9)
@@ -172,21 +177,33 @@ class HybridEngine(Engine):
 
         pt = np.asarray(prompt_tokens)
         B, P = pt.shape
-        total = P + max_new
-        # key on the full (B, P, max_new) split: chunk_size and the fused
-        # decode loop length are sized from P/max_new, so a same-total
+        # prompt lengths BUCKET to the next power of two: RLHF rollouts
+        # with organically-varying prompt lengths would otherwise mint one
+        # engine (and one device KV pool) per distinct length; the engine
+        # is sized for the bucket, shorter prompts just underfill it
+        bucket_p = 8
+        while bucket_p < P:
+            bucket_p *= 2
+        total = bucket_p + max_new
+        # key on (B, bucket, max_new): chunk_size and the fused decode
+        # loop length are sized from bucket/max_new, so a same-total
         # different-split call must not reuse a mis-sized engine
-        key = (B, P, max_new)
+        key = (B, bucket_p, max_new)
         eng = self._ragged_cache.get(key)
-        if eng is None:
+        if eng is not None:
+            self._ragged_cache.move_to_end(key)
+        else:
             eng = InferenceEngineV2(
                 self.model_cfg, None, RaggedInferenceConfig(
-                    max_seqs=B, chunk_size=max(P, 8), block_size=total,
+                    max_seqs=B, chunk_size=bucket_p, block_size=total,
                     num_blocks=B + 2, max_blocks_per_seq=1,
                     decode_loop_steps=min(max_new, 32),
                     dtype=jnp.dtype(self.compute_dtype).name,
                     attention_impl="auto"))
             self._ragged_cache[key] = eng
+            while len(self._ragged_cache) > self._ragged_cache_cap:
+                old_key, old_eng = self._ragged_cache.popitem(last=False)
+                self._free_ragged_engine(old_key, old_eng)
         p = cast_floating(params, self.compute_dtype)
         if self._compression is not None:
             p = self._compression.apply(p, self.state.step)
@@ -202,6 +219,22 @@ class HybridEngine(Engine):
         ctx = np.concatenate([pt, new], axis=1)
         return jnp.asarray(ctx, prompt_tokens.dtype), jnp.asarray(
             new, jnp.int32)
+
+    def _free_ragged_engine(self, key, eng) -> None:
+        """Release an LRU-evicted rollout engine's device KV pool NOW —
+        dropping the reference alone leaves the buffers alive until GC,
+        which on a tight HBM budget is too late."""
+        freed = 0
+        for leaf in jax.tree_util.tree_leaves(getattr(eng, "_kv_data", None)):
+            try:
+                freed += leaf.nbytes
+                leaf.delete()
+            except Exception:
+                pass
+        eng._kv_data = None
+        eng.params = None
+        log_dist(f"ragged rollout cache: evicted engine {key} "
+                 f"(freed ~{freed / 2**20:.1f} MiB KV pool)")
 
     # RLHF helpers mirroring the reference's bookkeeping ----------------- #
 

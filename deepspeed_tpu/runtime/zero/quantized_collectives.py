@@ -43,11 +43,9 @@ from ...ops.kernels.quantization import (
 
 def shard_map(f, mesh, in_specs, out_specs, axis_names=None):
     """Version-tolerant shard_map with partial-manual axes."""
-    kwargs = {}
-    if axis_names is not None:
-        kwargs["axis_names"] = set(axis_names)
-    return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False, **kwargs)
+    from ...utils.jax_compat import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_vma=False, axis_names=axis_names)
 
 
 # --------------------------------------------------------------------------- #

@@ -24,7 +24,9 @@ import jax
 if os.environ["JAX_PLATFORMS"] == "cpu":
     # must precede the first backend touch (tests/conftest.py pattern)
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from deepspeed_tpu.utils.jax_compat import request_cpu_devices
+    request_cpu_devices(8)
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 

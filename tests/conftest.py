@@ -17,7 +17,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+
+from deepspeed_tpu.utils.jax_compat import request_cpu_devices  # noqa: E402
+
+request_cpu_devices(8)
 
 import pytest  # noqa: E402
 
@@ -168,6 +171,13 @@ def pytest_collection_modifyitems(config, items):
         if base in _FULL_TESTS:
             item.add_marker(pytest.mark.full)
             matched.add(base)
+        # tier-1 CI selects -m 'not slow' under a hard wall-clock budget;
+        # the full tier (listed above OR marked in-source) must not push
+        # it past the timeout (a mid-suite kill covers LESS than the
+        # curated fast tier)
+        if item.get_closest_marker("full") and \
+                not item.get_closest_marker("slow"):
+            item.add_marker(pytest.mark.slow)
     # a renamed/deleted test must not SILENTLY fall out of the full tier
     # (it would land in the fast tier and break its timing guarantee) —
     # only meaningful when the whole suite was collected

@@ -40,7 +40,8 @@ class TestCheckpoint:
         g_ckpt = jax.grad(lambda p, x_: ac.checkpoint(_mlp, p, x_))(params, x)
         for a, b in zip(jax.tree_util.tree_leaves(g_ref),
                         jax.tree_util.tree_leaves(g_ckpt)):
-            np.testing.assert_allclose(a, b, rtol=1e-6)
+            # remat reorders fusion; tolerance covers XLA-version jitter
+            np.testing.assert_allclose(a, b, rtol=5e-6)
 
     def test_policies_resolve(self):
         for name in ("nothing_saveable", "dots_saveable", "checkpoint_dots"):
@@ -58,10 +59,13 @@ class TestCheckpoint:
         x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
         g_ref = jax.grad(_mlp)(params, x)
         ac.configure(cpu_checkpointing=True)
-        g = jax.grad(lambda p, x_: ac.checkpoint(_mlp, p, x_))(params, x)
+        # host-offload policies move saved residuals with device_put-to-
+        # memory-kind, an in-jit-only feature — jit like the engine does
+        g = jax.jit(jax.grad(
+            lambda p, x_: ac.checkpoint(_mlp, p, x_)))(params, x)
         for a, b in zip(jax.tree_util.tree_leaves(g_ref),
                         jax.tree_util.tree_leaves(g)):
-            np.testing.assert_allclose(a, b, rtol=1e-6)
+            np.testing.assert_allclose(a, b, rtol=5e-6)
 
     def test_configure_kwargs(self):
         cfg = ac.configure(policy="dots_saveable")

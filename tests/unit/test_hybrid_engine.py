@@ -137,3 +137,46 @@ class TestCachedRollout:
         engine.generate(prompt, max_new_tokens=256)
         uncached_s = _t.perf_counter() - t0
         assert cached_s * 3 < uncached_s, (cached_s, uncached_s)
+
+
+class TestRaggedCacheBounds:
+    """The rollout-engine cache must stay bounded: each entry owns a device
+    KV pool, and RLHF prompts have organically varying lengths (ADVICE r5:
+    unbounded _ragged_cache exhausts HBM)."""
+
+    def test_cache_capped_with_lru_eviction(self, devices8):
+        engine = _setup(devices8, cached=True)
+        cap = engine._ragged_cache_cap
+        # distinct (B, bucket, max_new) keys well beyond the cap: vary
+        # max_new so bucketing cannot collapse them
+        for max_new in range(1, cap + 4):
+            prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+            ctx, new = engine.generate(prompt, max_new_tokens=max_new)
+            assert new.shape == (1, max_new)
+            assert len(engine._ragged_cache) <= cap
+        assert len(engine._ragged_cache) == cap
+
+    def test_prompt_lengths_bucket_to_pow2(self, devices8):
+        engine = _setup(devices8, cached=True)
+        # lengths 3..8 share the bucket-8 engine: ONE cache entry
+        for plen in range(3, 9):
+            prompt = jnp.asarray([list(range(1, plen + 1))], jnp.int32)
+            ctx, new = engine.generate(prompt, max_new_tokens=4)
+            assert new.shape == (1, 4)
+        assert len(engine._ragged_cache) == 1
+        ((_, bucket, _),) = engine._ragged_cache.keys()
+        assert bucket == 8
+
+    def test_evicted_engine_pool_freed(self, devices8):
+        engine = _setup(devices8, cached=True)
+        engine._ragged_cache_cap = 1
+        engine.generate(jnp.asarray([[1, 2, 3]], jnp.int32),
+                        max_new_tokens=2)
+        (first,) = engine._ragged_cache.values()
+        kv_leaves = jax.tree_util.tree_leaves(first._kv_data)
+        assert kv_leaves
+        engine.generate(jnp.asarray([[1, 2, 3]], jnp.int32),
+                        max_new_tokens=3)       # different key -> evicts
+        assert len(engine._ragged_cache) == 1
+        assert first._kv_data is None
+        assert all(leaf.is_deleted() for leaf in kv_leaves)

@@ -471,6 +471,11 @@ def test_pipeline_stacked_engine_trains(devices8):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="GSPMD TP inside the manual pipeline seam needs modern "
+           "jax.shard_map partial-auto; the legacy lowering emits a "
+           "PartitionId instruction XLA's SPMD partitioner rejects")
 def test_pipeline_stacked_tp_no_user_psum(devices8):
     """VERDICT r3 #9: TP inside the pipeline with NO psum in layer code.
     block_fn is plain matmuls; the model axis stays AUTOMATIC in the

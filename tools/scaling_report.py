@@ -78,10 +78,10 @@ def parse_collectives(hlo: str):
 def run_one(n_dev: int, micro: int):
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n_dev)
-    import jax.numpy as jnp
-
     sys.path.insert(0, REPO)
+    from deepspeed_tpu.utils.jax_compat import request_cpu_devices
+    request_cpu_devices(n_dev)
+    import jax.numpy as jnp
     import deepspeed_tpu as dstpu
     from deepspeed_tpu.models.gpt2 import GPT2Config, make_model
 
