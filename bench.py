@@ -172,6 +172,15 @@ def bench_train(model_kind: str = "gpt124"):
         "micro_batch": micro,
         "n_params": n_params,
         "last_loss": last_loss,
+        # active knob set (DSTPU_TRAIN_* env flags, docs/serving.md
+        # "Bench flags") so BENCH rows are self-describing
+        "train_config": {
+            "xent_impl": cfg_model.xent_impl,
+            "attention_impl": cfg_model.attention_impl,
+            "remat": bool(cfg_model.remat),
+            "remat_policy": cfg_model.remat_policy,
+            "grad_accum_dtype": grad_accum_dtype,
+        },
     }
     if model_kind == "gpt1p3b":
         rec["optimizer"] = "AdamW(bf16 params, bf16 moments, fp32 math)"
@@ -246,6 +255,16 @@ def bench_serve():
     # reproduces the round-3 configuration.
     kv_dtype = os.environ.get("DSTPU_BENCH_KV", "int8")
     blocks_per_seq = (PROMPT + GEN + bs - 1) // bs
+    # tensor-parallel serving over the model axis (inference/v2/tp.py):
+    # DSTPU_BENCH_TP=4 is the FastGen-headline configuration class
+    # (Llama-2-70B at TP=4); per-chip KV bytes scale 1/tp
+    tp = int(os.environ.get("DSTPU_BENCH_TP", "1"))
+    # SplitFuse prefill chunk cap: S=256 x 512-token prompts fit in one
+    # prefill forward (the r3 40.5k configuration) so the cap is off
+    # there; bigger-slot configs cap at 256 (512-token chunks OOM prefill
+    # activations at S >= 384 — PROFILE.md serving levers)
+    chunk_cap = int(os.environ.get("DSTPU_BENCH_CHUNK_CAP",
+                                   "0" if S <= 256 else "256"))
     cfg = RaggedInferenceConfig(
         max_seqs=S, chunk_size=PROMPT, block_size=bs,
         num_blocks=S * blocks_per_seq + 4,
@@ -257,9 +276,7 @@ def bench_serve():
         decode_loop_steps=int(os.environ.get("DSTPU_BENCH_LOOP", "64")),
         dtype="bfloat16", attention_impl=impl,
         kv_cache_dtype="int8" if kv_dtype == "int8" else "auto",
-        # S=256 x 512-token prompts fit in one prefill forward (the r3
-        # 40.5k prefill configuration) so the default is uncapped there;
-        # bigger-slot configs keep the 32768 budget (S=384 OOMs uncapped)
+        tp_size=tp, prefill_chunk_cap=chunk_cap,
         max_batch_tokens=int(os.environ.get(
             "DSTPU_BENCH_BUDGET", "0" if S <= 256 else "32768")))
     eng = InferenceEngineV2(mcfg, params, cfg)
@@ -301,14 +318,17 @@ def bench_serve():
     prefill_tokens = S * PROMPT
     decode_tokens = S * GEN
     decode_tps = decode_tokens / (t2 - t1)
-    flop_per_token = 2.0 * n_params
+    flop_per_token = 2.0 * n_params / tp          # per-chip under TP
     # decode is bandwidth-bound: the honest roofline is HBM traffic
-    # (weights once per step + every live KV row), not FLOPs
+    # (weights once per step + every live KV row), not FLOPs. Under TP
+    # each chip streams ~1/tp of both (sharded weights + head-sharded KV;
+    # replicated embeddings make this slightly optimistic).
     avg_ctx = PROMPT + GEN / 2
-    bytes_per_step = weight_bytes + S * avg_ctx * _kv_row_bytes(
-        mcfg, kv_dtype)
+    bytes_per_step = (weight_bytes + S * avg_ctx * _kv_row_bytes(
+        mcfg, kv_dtype)) / tp
     steps_per_sec = decode_tps / S
     bw_util = bytes_per_step * steps_per_sec / HBM_BW
+    kv_rep = eng.state.kv_memory_report()
     print(json.dumps({
         "model": "llama-1.1B (TinyLlama shape, GQA 32/4)",
         "weight_quant": woq or "bf16",
@@ -317,6 +337,18 @@ def bench_serve():
         "batch_seqs": S,
         "prompt_len": PROMPT,
         "gen_len": GEN,
+        # full active knob set (DSTPU_BENCH_* env flags, docs/serving.md
+        # "Bench flags") so BENCH rows are self-describing
+        "serve_config": {
+            "woq": woq or "bf16", "kv_cache_dtype": kv_dtype,
+            "attention_impl": impl, "batch_seqs": S, "block_size": bs,
+            "decode_loop_steps": NL,
+            "max_batch_tokens": cfg.max_batch_tokens,
+            "prefill_chunk_cap": chunk_cap, "tp_size": tp,
+            "n_layers": mcfg.num_layers,
+        },
+        "tp_size": tp,
+        "kv_pool_bytes_per_chip": kv_rep["kv_pool_bytes_per_chip"],
         "prefill_tokens_per_sec": round(prefill_tokens / (t1 - t0), 1),
         "decode_tokens_per_sec": round(decode_tps, 1),
         "total_tokens_per_sec": round(
@@ -557,6 +589,9 @@ def bench_serve_fastgen():
         num_blocks=S + 4, max_blocks_per_seq=1,
         decode_loop_steps=N, dtype="bfloat16",
         attention_impl=os.environ.get("DSTPU_FG_IMPL", "paged_flash"),
+        # uncapped by default: keeps the measured r4/r5 TTFT series
+        # comparable (cap via env to probe the S>=384 lever)
+        prefill_chunk_cap=int(os.environ.get("DSTPU_FG_CHUNK_CAP", "0")),
         kv_cache_dtype="int8" if kv_dtype == "int8" else "auto")
     eng = InferenceEngineV2(mcfg, params, cfg)
 

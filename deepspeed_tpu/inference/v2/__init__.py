@@ -17,9 +17,10 @@ from .engine_v2 import InferenceEngineV2
 from .kv_cache import BlockedKVCache
 from .sequence import SequenceDescriptor, SequenceStatus
 from .state_manager import StateManager
+from .tp import TPContext, build_tp_context
 
 __all__ = [
     "BlockedAllocator", "BlockedKVCache", "InferenceEngineV2",
     "RaggedInferenceConfig", "SequenceDescriptor", "SequenceStatus",
-    "StateManager",
+    "StateManager", "TPContext", "build_hf_engine", "build_tp_context",
 ]

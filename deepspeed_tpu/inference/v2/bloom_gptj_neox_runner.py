@@ -16,14 +16,13 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from ...models._lm_utils import alibi_slopes
 from ...models.bloom import BloomConfig
 from ...models.gpt_neox import (GPTJConfig, GPTNeoXConfig,
                                 apply_partial_rope_interleaved)
 from ...models.phi import apply_partial_rope
 from .config import RaggedInferenceConfig
 from .model_runner import (RaggedBatch, RaggedRunnerBase, _layer_norm,
-                           _linear, paged_attention)
+                           _linear, paged_attention, tp_alibi_slopes)
 
 
 def _bloom_ragged_step(params, kv, batch: RaggedBatch, *,
@@ -33,7 +32,9 @@ def _bloom_ragged_step(params, kv, batch: RaggedBatch, *,
     S, C = batch.tokens.shape
     H, D = mc.num_heads, mc.head_dim
     scale = 1.0 / (D ** 0.5)
-    slopes = alibi_slopes(H)
+    # slope values follow the GLOBAL head index; under TP this slices the
+    # chip's head window out of the full vector
+    slopes = tp_alibi_slopes(H)
 
     pos = batch.start_pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
     valid_q = jnp.arange(C, dtype=jnp.int32)[None, :] < batch.n_tokens[:, None]
@@ -53,12 +54,13 @@ def _bloom_ragged_step(params, kv, batch: RaggedBatch, *,
         v = _linear(h, pa["v_proj"], dtype).reshape(S, C, H, D)
         kv, y = paged_attention(kv, li, q, k, v, batch, cfg, pos, valid_q,
                                 scale, dtype, alibi_slopes=slopes)
-        x = x + _linear(y, pa["dense"], dtype)
+        x = x + _linear(y, pa["dense"], dtype, row_parallel=True, cfg=cfg)
 
         h = _layer_norm(x.astype(jnp.float32), p["post_attention_layernorm"],
                         mc.layer_norm_eps).astype(dtype)
         m = jax.nn.gelu(_linear(h, p["dense_h_to_4h"], dtype))
-        x = x + _linear(m, p["dense_4h_to_h"], dtype)
+        x = x + _linear(m, p["dense_4h_to_h"], dtype, row_parallel=True,
+                        cfg=cfg)
 
     x = _layer_norm(x.astype(jnp.float32), params["ln_f"], mc.layer_norm_eps)
     last = jnp.maximum(batch.n_tokens - 1, 0)
@@ -93,7 +95,7 @@ def _neox_ragged_step(params, kv, batch: RaggedBatch, *,
         k = apply_partial_rope(k, pos, mc.rope_theta, mc.rotary_dim)
         kv, y = paged_attention(kv, li, q, k, v, batch, cfg, pos, valid_q,
                                 scale, dtype)
-        attn_out = _linear(y, p["dense"], dtype)
+        attn_out = _linear(y, p["dense"], dtype, row_parallel=True, cfg=cfg)
 
         if not mc.use_parallel_residual:
             x = x + attn_out        # sequential: norm AFTER attn residual
@@ -101,7 +103,8 @@ def _neox_ragged_step(params, kv, batch: RaggedBatch, *,
                              p["post_attention_layernorm"],
                              mc.layer_norm_eps).astype(dtype)
         m = jax.nn.gelu(_linear(mlp_in, p["dense_h_to_4h"], dtype))
-        m = _linear(m, p["dense_4h_to_h"], dtype)
+        m = _linear(m, p["dense_4h_to_h"], dtype, row_parallel=True,
+                    cfg=cfg)
         x = (x + attn_out + m) if mc.use_parallel_residual else (x + m)
 
     x = _layer_norm(x.astype(jnp.float32), params["final_layer_norm"],
@@ -139,9 +142,10 @@ def _gptj_ragged_step(params, kv, batch: RaggedBatch, *,
                                            mc.rotary_dim)
         kv, y = paged_attention(kv, li, q, k, v, batch, cfg, pos, valid_q,
                                 scale, dtype)
-        attn_out = _linear(y, p["out_proj"], dtype)
+        attn_out = _linear(y, p["out_proj"], dtype, row_parallel=True,
+                           cfg=cfg)
         m = _linear(jax.nn.gelu(_linear(h, p["fc_in"], dtype)),
-                    p["fc_out"], dtype)
+                    p["fc_out"], dtype, row_parallel=True, cfg=cfg)
         x = x + attn_out + m                    # parallel residual
 
     x = _layer_norm(x.astype(jnp.float32), params["ln_f"], mc.layer_norm_eps)

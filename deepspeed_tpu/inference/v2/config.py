@@ -32,6 +32,22 @@ class RaggedInferenceConfig(ConfigModel):
     # blocks only), dense gather elsewhere (interpret-mode Pallas would be a
     # Python-loop per layer per step off-TPU). "paged_flash"/"dense" force.
     attention_impl: str = "auto"
+    # Tensor-parallel serving over the 'model' mesh axis (inference/v2/
+    # tp.py): weights follow the tp_rules column/row classification, the
+    # KV pool + decode ring are head-sharded (per-chip KV bytes ∝ 1/tp),
+    # and each layer pays exactly two all-reduces plus one pre-sampling
+    # logits gather. num_heads and kv_heads must divide by tp_size.
+    tp_size: int = 1
+    # Route the TP all-reduces through int8 quantized comm (the ZeRO++
+    # helpers; EQuARX-class for bandwidth-bound decode). Greedy token
+    # parity across tp sizes is NOT guaranteed with this on.
+    tp_quantized_comm: bool = False
+    # Cap on the SplitFuse prefill chunk actually scheduled (and on the
+    # compiled prefill program's token dim): min(chunk_size, cap).
+    # 512-token chunks OOM prefill activations at max_seqs >= 384
+    # (PROFILE.md serving levers); 256 keeps the transient bounded.
+    # 0 disables the cap.
+    prefill_chunk_cap: int = 256
 
     # sampling defaults for the built-in generate loop
     greedy: bool = True
@@ -61,10 +77,24 @@ class RaggedInferenceConfig(ConfigModel):
             raise ValueError(
                 f"kv_cache_dtype must be 'auto' or 'int8', got "
                 f"{self.kv_cache_dtype!r}")
+        if self.tp_size < 1:
+            raise ValueError(f"tp_size must be >= 1, got {self.tp_size}")
+        if self.prefill_chunk_cap < 0:
+            raise ValueError(
+                f"prefill_chunk_cap must be >= 0 (0 = uncapped), got "
+                f"{self.prefill_chunk_cap}")
 
     @property
     def max_context(self) -> int:
         return self.max_blocks_per_seq * self.block_size
+
+    @property
+    def effective_chunk(self) -> int:
+        """Prefill chunk length the scheduler (and the compiled prefill
+        program's token dim) actually uses."""
+        if self.prefill_chunk_cap > 0:
+            return min(self.chunk_size, self.prefill_chunk_cap)
+        return self.chunk_size
 
     @property
     def token_budget(self) -> int:

@@ -28,11 +28,15 @@ def build_hf_engine(model_dir: str,
                     engine_config: Optional[RaggedInferenceConfig] = None,
                     dtype: Optional[str] = None,
                     quantization_mode: Optional[str] = None,
-                    strict: bool = True) -> InferenceEngineV2:
+                    strict: bool = True,
+                    tp_size: Optional[int] = None) -> InferenceEngineV2:
     """Build a ragged inference engine from a HuggingFace checkpoint dir.
 
     ``quantization_mode``: None | "wf8" (int8 WOQ) | "wf4" (int4 WOQ) —
     mirrors the reference's quantization-mode string.
+    ``tp_size``: tensor-parallel degree over the ``model`` mesh axis
+    (overrides ``engine_config.tp_size`` — the reference's AutoTP-style
+    one-knob entry; see docs/serving.md).
     """
     import json
     import os
@@ -59,8 +63,10 @@ def build_hf_engine(model_dir: str,
             "enabled": True, "num_bits": bits,
             "modules": ["proj", "fc", "attn", "mlp"],
             "excluded_modules": ["embed", "wte", "wpe", "norm", "ln"]}})
-    engine = InferenceEngineV2(model_cfg, params,
-                               engine_config or RaggedInferenceConfig())
+    cfg = engine_config or RaggedInferenceConfig()
+    if tp_size is not None:
+        cfg = dataclasses.replace(cfg, tp_size=int(tp_size))
+    engine = InferenceEngineV2(model_cfg, params, cfg)
     log_dist(f"build_hf_engine: {arch} from {model_dir} "
-             f"(quant={quantization_mode or 'off'})")
+             f"(quant={quantization_mode or 'off'}, tp={cfg.tp_size})")
     return engine

@@ -68,22 +68,37 @@ class InferenceEngineV2:
         (GPT2Config here; llama-family runners register the same interface).
         ``params``: the matching param pytree."""
         self.config = config or RaggedInferenceConfig()
-        self.params = params
         self.runner = runner or _runner_for(model_cfg, self.config)
+        tp = self.config.tp_size
+        if tp > 1:
+            # tensor-parallel serving (tp.py): params are re-laid/sharded
+            # over the 'model' mesh and every runner program rebuilds under
+            # shard_map — the host-side scheduler/allocator stay as-is
+            if not hasattr(self.runner, "init_tp"):
+                raise ValueError(
+                    f"runner {type(self.runner).__name__} does not support "
+                    f"tensor-parallel serving (no init_tp)")
+            from .tp import build_tp_context
+            tp_ctx, params = build_tp_context(self.config, self.runner,
+                                              params)
+            self.runner.init_tp(tp_ctx)
+        self.params = params
         if self.config.kv_cache_dtype == "int8" \
                 and self.config.attention_impl in ("auto", "paged_flash") \
                 and jax.default_backend() == "tpu":
             # surface the Mosaic DMA-tiling constraint of the int8 decode
             # kernel at engine construction, not deep inside a compile
             # (the dense fallback dequantizes per row and has no such
-            # constraint — it is exempt)
-            kvd = self.runner.kv_heads * self.runner.head_dim
+            # constraint — it is exempt). Under TP the kernel sees the
+            # PER-CHIP row width.
+            kvd = self.runner.kv_heads * self.runner.head_dim // tp
             if kvd % 128:
                 raise ValueError(
                     f"kv_cache_dtype='int8' with the paged-flash kernel "
-                    f"needs kv_heads*head_dim ({kvd}) to be a multiple of "
-                    f"128 (int8 DMA tiling); use attention_impl='dense' "
-                    f"or the bf16 pool for this head geometry")
+                    f"needs per-chip kv_heads*head_dim ({kvd}) to be a "
+                    f"multiple of 128 (int8 DMA tiling); use "
+                    f"attention_impl='dense' or the bf16 pool for this "
+                    f"head geometry")
             if self.config.block_size % 128:
                 raise ValueError(
                     f"kv_cache_dtype='int8' with the paged-flash kernel "
@@ -93,6 +108,10 @@ class InferenceEngineV2:
         self.kv_cache = BlockedKVCache(
             self.config, self.runner.num_layers, self.runner.kv_heads,
             self.runner.head_dim, dtype=resolve_dtype(self.config.dtype))
+        if tp > 1:
+            # head-shard the pool at rest: per-chip KV bytes ∝ 1/tp — the
+            # lever that lets a model's KV footprint span chips
+            self.kv_cache.shard(self.runner.tp.mesh)
         self.state = StateManager(self.config, self.kv_cache)
         self.scheduler = SplitFuseScheduler(self.config, self.state)
         self._kv_data = self.kv_cache.pool
@@ -100,8 +119,10 @@ class InferenceEngineV2:
         self._sample_key = jax.random.PRNGKey(0)
         log_dist(
             f"InferenceEngineV2 ready: {self.config.max_seqs} slots x "
-            f"{self.config.chunk_size} tokens, "
-            f"{self.config.num_blocks} KV blocks x {self.config.block_size}")
+            f"{self.config.chunk_size} tokens "
+            f"(prefill chunk cap {self.config.effective_chunk}), "
+            f"{self.config.num_blocks} KV blocks x {self.config.block_size}"
+            + (f", tp={tp}" if tp > 1 else ""))
 
     # ------------------------------------------------------------------ #
     # reference-parity surface
@@ -141,7 +162,7 @@ class InferenceEngineV2:
         resuming with less would just thrash (restore, fail to schedule,
         get evicted again)."""
         bs = self.config.block_size
-        n = min(seq.in_flight, self.config.chunk_size)
+        n = min(seq.in_flight, self.config.effective_chunk)
         total = -(-(seq.seen_tokens + n) // bs)
         return max(total, seq.paused_blocks)
 
@@ -362,7 +383,7 @@ class InferenceEngineV2:
         # flattening tokens into one ragged array (ragged_wrapper.py),
         # which XLA's static shapes forbid.
         C = 1 if all(len(item.tokens) == 1 for item in sched) \
-            else cfg.chunk_size
+            else cfg.effective_chunk
         S = cfg.max_seqs
         for b in (16, 32, 64, 128, 256, 512):
             if b >= len(sched) and b <= cfg.max_seqs:

@@ -21,7 +21,7 @@ from ...models.llama import apply_rope
 from ...models.phi import PhiConfig, apply_partial_rope
 from .config import RaggedInferenceConfig
 from .model_runner import (RaggedBatch, RaggedRunnerBase, _layer_norm,
-                           _linear, paged_attention)
+                           _linear, paged_attention, tp_alibi_slopes)
 
 
 class FalconRaggedRunner(RaggedRunnerBase):
@@ -39,8 +39,9 @@ def _falcon_ragged_step(params, kv, batch, *, model_cfg: FalconConfig,
 
     slopes = None
     if mc.alibi:
-        from ...models._lm_utils import alibi_slopes
-        slopes = alibi_slopes(H)
+        # slope values follow the GLOBAL head index; under TP this slices
+        # the chip's head window out of the full vector
+        slopes = tp_alibi_slopes(H)
 
     x = params["word_embeddings"]["embedding"][batch.tokens].astype(dtype)
     for li in range(mc.num_layers):
@@ -65,11 +66,13 @@ def _falcon_ragged_step(params, kv, batch, *, model_cfg: FalconConfig,
             k = apply_rope(k, pos, mc.rope_theta)
         kv, y = paged_attention(kv, li, q, k, v, batch, cfg, pos, valid_q,
                                 scale, dtype, alibi_slopes=slopes)
-        attn_out = _linear(y, pa["dense"], dtype)
+        attn_out = _linear(y, pa["dense"], dtype, row_parallel=True,
+                           cfg=cfg)
 
         def mlp(h):
             m = jax.nn.gelu(_linear(h, p["mlp"]["dense_h_to_4h"], dtype))
-            return _linear(m, p["mlp"]["dense_4h_to_h"], dtype)
+            return _linear(m, p["mlp"]["dense_4h_to_h"], dtype,
+                           row_parallel=True, cfg=cfg)
 
         if mc.parallel_attn or mc.new_decoder_architecture:
             x = x + attn_out + mlp(mlp_in)
@@ -115,9 +118,10 @@ def _phi_ragged_step(params, kv, batch, *, model_cfg: PhiConfig,
         k = apply_partial_rope(k, pos, mc.rope_theta, mc.rotary_dim)
         kv, y = paged_attention(kv, li, q, k, v, batch, cfg, pos, valid_q,
                                 scale, dtype)
-        attn_out = _linear(y, pa["dense"], dtype)
+        attn_out = _linear(y, pa["dense"], dtype, row_parallel=True,
+                           cfg=cfg)
         m = jax.nn.gelu(_linear(h, p["fc1"], dtype))
-        m = _linear(m, p["fc2"], dtype)
+        m = _linear(m, p["fc2"], dtype, row_parallel=True, cfg=cfg)
         x = x + attn_out + m                      # parallel residual
 
     x = _layer_norm(x.astype(jnp.float32), params["final_layernorm"],

@@ -70,10 +70,41 @@ class BlockedKVCache:
     def free(self, blocks) -> None:
         self.allocator.free(blocks)
 
+    def shard(self, mesh) -> None:
+        """Head-shard the pool at rest over the TP ``model`` mesh axis:
+        data rows chunk their flat [KV*D] lane dim (KV/tp heads per chip),
+        int8 scale planes chunk their KV dim. The block tables and the
+        allocator are untouched — TP is invisible to the host side."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        self.data = jax.device_put(
+            self.data, NamedSharding(mesh, P(None, None, None, "model")))
+        if self.scales is not None:
+            self.scales = jax.device_put(
+                self.scales, NamedSharding(mesh, P(None, None, "model",
+                                                   None)))
+
     def memory_bytes(self) -> int:
         n = self.data.size * self.data.dtype.itemsize
         if self.scales is not None:
             n += self.scales.size * self.scales.dtype.itemsize
+        return n
+
+    def memory_bytes_per_chip(self) -> int:
+        """Bytes one chip actually holds, read from the device sharding
+        (∝ 1/tp under head-sharded TP; equals :meth:`memory_bytes` on a
+        single device)."""
+        import numpy as np
+
+        def per_chip(a):
+            sh = getattr(a, "sharding", None)
+            if sh is None or not hasattr(sh, "shard_shape"):
+                return a.size * a.dtype.itemsize
+            return int(np.prod(sh.shard_shape(a.shape))) * a.dtype.itemsize
+
+        n = per_chip(self.data)
+        if self.scales is not None:
+            n += per_chip(self.scales)
         return n
 
     # ------------------- host offload / restore ----------------------- #

@@ -17,8 +17,8 @@ import jax.numpy as jnp
 from ...models.llama import LlamaConfig, apply_rope
 from ...models.mixtral import MixtralConfig
 from .config import RaggedInferenceConfig
-from .model_runner import (RaggedBatch, RaggedRunnerBase,
-                           paged_attention, woq_mm)
+from .model_runner import (RaggedBatch, RaggedRunnerBase, paged_attention,
+                           tp_all_reduce, woq_mm)
 
 
 def _rms(x, scale, eps):
@@ -101,6 +101,7 @@ def _llama_ragged_step(params, kv, batch: RaggedBatch, *,
                                 scale, dtype,
                                 sliding_window=model_cfg.sliding_window)
         y = woq_mm(y, pa["o_proj"]["kernel"], dtype)
+        y = tp_all_reduce(y, cfg)           # TP collective 1 (row-parallel)
         x = x + y
 
         h = _rms(x, p["post_attn_norm"]["scale"],
@@ -123,7 +124,8 @@ def _llama_ragged_step(params, kv, batch: RaggedBatch, *,
             gate = woq_mm(h, pm["gate_proj"]["kernel"], dtype)
             up = woq_mm(h, pm["up_proj"]["kernel"], dtype)
             m = jax.nn.silu(gate) * up
-            x = x + woq_mm(m, pm["down_proj"]["kernel"], dtype)
+            m = woq_mm(m, pm["down_proj"]["kernel"], dtype)
+            x = x + tp_all_reduce(m, cfg)   # TP collective 2 (row-parallel)
 
     x = _rms(x, params["final_norm"]["scale"], model_cfg.rms_eps)
     last = jnp.maximum(batch.n_tokens - 1, 0)

@@ -16,12 +16,16 @@ sequences' KV.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from ...models.gpt2 import GPT2Config
+from ...parallel.tp_rules import MODEL_AXIS
+from ...utils.jax_compat import manual_axes, shard_map
 from .config import RaggedInferenceConfig
 from .kv_quant import KVPool, RingKV, pool_parts, quantize_rows, repack
 
@@ -34,10 +38,67 @@ class RaggedBatch(NamedTuple):
     block_tables: jnp.ndarray  # [S, MAXB] int32 (padded with 0)
 
 
-def _linear(x, p, dtype):
+# --------------------------------------------------------------------- #
+# tensor-parallel seams (inference/v2/tp.py) — every helper is an exact
+# no-op outside the TP shard_map region, so single-device programs are
+# byte-identical to the pre-TP engine
+# --------------------------------------------------------------------- #
+
+
+def tp_all_reduce(y, cfg: "RaggedInferenceConfig" = None):
+    """One of the two canonical per-layer TP collectives: sum the
+    row-parallel partial products over the ``model`` axis. With
+    ``cfg.tp_quantized_comm`` the reduction rides int8 (symmetric per-row
+    scales via the ZeRO++ comm helpers — the EQuARX regime for
+    bandwidth-bound decode); otherwise a plain psum."""
+    if MODEL_AXIS not in manual_axes():
+        return y
+    if cfg is not None and getattr(cfg, "tp_quantized_comm", False):
+        from ...runtime.zero.quantized_collectives import (
+            _dequant_from_comm, _quant_for_comm)
+        q, scale, packed = _quant_for_comm(y, 8)
+        gq = jax.lax.all_gather(q, MODEL_AXIS)
+        gs = jax.lax.all_gather(scale, MODEL_AXIS)
+        return _dequant_from_comm(gq, gs, packed, jnp.float32) \
+            .sum(axis=0).astype(y.dtype)
+    return jax.lax.psum(y, MODEL_AXIS)
+
+
+def tp_gather_logits(logits, vocab_size: int):
+    """The single pre-sampling collective: all-gather vocab-sharded logits
+    to full width. Identity when the unembed was replicated (tied
+    embeddings) or outside the TP region."""
+    if MODEL_AXIS not in manual_axes() or logits.shape[-1] == vocab_size:
+        return logits
+    return jax.lax.all_gather(logits, MODEL_AXIS, axis=logits.ndim - 1,
+                              tiled=True)
+
+
+def tp_alibi_slopes(num_heads_local: int):
+    """ALiBi slopes for THIS chip's heads. Slope values depend on the
+    GLOBAL head index, so inside the TP region the full slope vector is
+    built and this chip's window sliced out; single-device this is plainly
+    ``alibi_slopes(H)``."""
+    from ...models._lm_utils import alibi_slopes
+    if MODEL_AXIS not in manual_axes():
+        return alibi_slopes(num_heads_local)
+    from ...utils.jax_compat import axis_size
+    tp = axis_size(MODEL_AXIS)
+    full = jnp.asarray(alibi_slopes(num_heads_local * tp), jnp.float32)
+    r = jax.lax.axis_index(MODEL_AXIS)
+    return jax.lax.dynamic_slice(full, (r * num_heads_local,),
+                                 (num_heads_local,))
+
+
+def _linear(x, p, dtype, row_parallel: bool = False,
+            cfg: "RaggedInferenceConfig" = None):
     """Dense apply over a flax {kernel[, bias]} param dict (shared by the
-    OPT/Falcon/Phi runners)."""
+    OPT/Falcon/Phi/Bloom/NeoX/GPT-J runners). ``row_parallel`` marks the
+    two per-layer TP reduction sites: the partial product is all-reduced
+    BEFORE the (replicated) bias is added once."""
     y = x @ p["kernel"].astype(dtype)
+    if row_parallel:
+        y = tp_all_reduce(y, cfg)
     if "bias" in p:
         y = y + p["bias"].astype(dtype)
     return y
@@ -276,11 +337,22 @@ class RaggedRunnerBase:
     """Shared runner plumbing: jitted step closing over the configs, with
     WOQ int8/int4 leaves dequantized INSIDE the jit (XLA fuses the dequant
     into each layer's matmul while HBM keeps the packed weights). Subclasses
-    set ``step_fn``; kv-cache geometry derives from the model config."""
+    set ``step_fn``; kv-cache geometry derives from the model config.
+
+    With ``cfg.tp_size > 1`` the engine calls :meth:`init_tp` and every
+    jitted program (step / greedy step / fused decode loop / ring flush)
+    is rebuilt under ONE ``shard_map`` over the ``model`` mesh axis:
+    weights enter as their TP shards, the KV pool and decode ring enter
+    head-sharded, and the only collectives are the step functions' two
+    per-layer ``tp_all_reduce`` sites plus the ``tp_gather_logits`` before
+    token selection (inference/v2/tp.py)."""
 
     step_fn = None   # staticmethod(params, kv, batch, *, model_cfg, cfg, dtype)
     #: the runner's matmuls dispatch via ``woq_mm`` (fused fp6 capable)
     supports_fused_woq = False
+    #: param-path regexes of FUSED [q|k|v] projections; their output dim is
+    #: re-laid chip-major at TP init so local jnp.split stays correct
+    tp_fused_qkv: tuple = ()
 
     def __init__(self, model_cfg: Any, cfg: RaggedInferenceConfig,
                  compute_dtype: Any = None):
@@ -293,15 +365,62 @@ class RaggedRunnerBase:
         self.head_dim = getattr(
             model_cfg, "head_dim",
             model_cfg.hidden_size // model_cfg.num_heads)
+        self.tp = None            # TPContext once init_tp runs
+        self._build_programs()
 
+    # ---------------------------- TP wiring --------------------------- #
+
+    def init_tp(self, tp_ctx) -> None:
+        """Adopt a ``tp.TPContext`` and rebuild every device program under
+        its ``model``-axis shard_map."""
+        self.tp = tp_ctx
+        self._build_programs()
+
+    @property
+    def local_kv_heads(self) -> int:
+        return self.kv_heads // (self.tp.tp_size if self.tp else 1)
+
+    def _wrap(self, fn, in_specs, out_specs):
+        """shard_map ``fn`` over the TP mesh (identity at tp_size 1)."""
+        if self.tp is None:
+            return fn
+        return shard_map(fn, mesh=self.tp.mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+    def _local_params(self, params):
+        """In-jit params view: QuantizedTensor static shapes localized to
+        this chip's shard, then the WOQ dequant pass."""
+        from ..quantization import dequantize_tree
+        if self.tp is not None:
+            params = self.tp.localize_quant_meta(params)
+        return dequantize_tree(params, keep_fused=self.supports_fused_woq)
+
+    # ------------------------- program builders ----------------------- #
+
+    def _build_programs(self) -> None:
+        model_cfg, cfg = self.model_cfg, self.cfg
         dtype = self.compute_dtype
+        tp = self.tp
+        mcfg_l = tp.localize_model_cfg(model_cfg) if tp else model_cfg
+        vocab = getattr(model_cfg, "vocab_size", -1)
+        quantized_pool = cfg.kv_cache_dtype == "int8"
+        if tp is not None:
+            pspecs = tp.param_specs
+            pool_spec = tp.pool_spec(quantized_pool)
+            ring_spec = tp.ring_spec
+            batch_spec = RaggedBatch(P(), P(), P(), P())
 
         def _step(params, kv_data, batch):
-            from ..quantization import dequantize_tree
-            return type(self).step_fn(
-                dequantize_tree(params, keep_fused=self.supports_fused_woq),
-                kv_data, batch, model_cfg=model_cfg, cfg=cfg, dtype=dtype)
+            logits, kv_out = type(self).step_fn(
+                self._local_params(params), kv_data, batch,
+                model_cfg=mcfg_l, cfg=cfg, dtype=dtype)
+            # vocab-sharded unembed -> ONE all-gather to full logits
+            # (identity for tied/replicated unembeds and at tp_size 1)
+            return tp_gather_logits(logits, vocab), kv_out
 
+        if tp is not None:
+            _step = self._wrap(_step, (pspecs, pool_spec, batch_spec),
+                               (P(), pool_spec))
         self._step = jax.jit(_step)
         # greedy decode variant: argmax fused into the jit so a decode step
         # returns [S] int32 token ids instead of shipping [S, V] f32 logits
@@ -345,22 +464,18 @@ class RaggedRunnerBase:
             return jnp.take_along_axis(
                 idxs, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
 
-        def _decode_loop_ring(params, kv_data, tok0, start, active, tables,
+        def _decode_loop_impl(params, kv_data, tok0, start, active, tables,
                               key, *, n, mode, top_k, cand, temp, top_p,
                               eos_id):
-            # temp/top_p/eos_id are STATIC: they change rarely (per
-            # tokenizer / per sampling profile) and passing them as device
-            # scalars cost tunnel round-trips on every fused-loop call
-            from ..quantization import dequantize_tree
-            params = dequantize_tree(params,
-                                     keep_fused=self.supports_fused_woq)
+            params = self._local_params(params)
             S = cfg.max_seqs
             pool_arr, pool_scales = pool_parts(kv_data)
             # over an int8 pool the ring stays in the compute dtype: its
             # rows are the loop's freshest tokens, rewritten every step,
-            # and are quantized once at flush time
+            # and are quantized once at flush time. Under TP the ring —
+            # like the pool — is head-sharded: local_kv_heads rows.
             ring = jnp.zeros((n, self.num_layers, 2, S,
-                              self.kv_heads * self.head_dim),
+                              self.local_kv_heads * self.head_dim),
                              pool_arr.dtype if pool_scales is None
                              else dtype)
             use_eos = eos_id >= 0
@@ -381,8 +496,11 @@ class RaggedRunnerBase:
                                     n_tokens=alive, block_tables=tables)
                 logits, kv_out = type(self).step_fn(
                     params, RingKV(kv_data, ring, t, t + 1), batch,
-                    model_cfg=model_cfg, cfg=cfg, dtype=dtype)
+                    model_cfg=mcfg_l, cfg=cfg, dtype=dtype)
                 ring = kv_out.ring
+                # the one pre-sampling collective: every chip then selects
+                # the SAME next token from identical full-width logits
+                logits = tp_gather_logits(logits, vocab)
                 if mode == "greedy":
                     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 else:
@@ -401,8 +519,25 @@ class RaggedRunnerBase:
             (ring, _, pos_f, _, _), toks = jax.lax.scan(
                 body, (ring, tok0, start, key, done0),
                 jnp.arange(n, dtype=jnp.int32))
-            consumed = (pos_f - start) if use_eos else None
-            return jnp.transpose(toks), ring, consumed
+            # consumed is shard_map-shape-stable: always an array; the
+            # decode_loop wrapper drops it when EOS is disabled
+            return jnp.transpose(toks), ring, pos_f - start
+
+        def _decode_loop_ring(params, kv_data, tok0, start, active, tables,
+                              key, *, n, mode, top_k, cand, temp, top_p,
+                              eos_id):
+            # temp/top_p/eos_id are STATIC: they change rarely (per
+            # tokenizer / per sampling profile) and passing them as device
+            # scalars cost tunnel round-trips on every fused-loop call
+            impl = functools.partial(
+                _decode_loop_impl, n=n, mode=mode, top_k=top_k, cand=cand,
+                temp=temp, top_p=top_p, eos_id=eos_id)
+            if tp is not None:
+                impl = self._wrap(
+                    impl,
+                    (pspecs, pool_spec, P(), P(), P(), P(), P()),
+                    (P(), ring_spec, P()))
+            return impl(params, kv_data, tok0, start, active, tables, key)
 
         self._decode_loop_ring = jax.jit(
             _decode_loop_ring,
@@ -464,6 +599,12 @@ class RaggedRunnerBase:
                     sc_t.reshape(L, 2, KV, S * R))
             return repack(kv_data, data, scales)
 
+        if tp is not None:
+            # all flush work is head-local (quantize_rows is per-kv-head,
+            # scatter indices live on the slots dim): zero collectives
+            _flush_ring = self._wrap(_flush_ring,
+                                     (pool_spec, ring_spec, P(), P(), P()),
+                                     pool_spec)
         self._flush_ring = jax.jit(_flush_ring, donate_argnums=(0,))
 
     def step(self, params, kv_data, batch: "RaggedBatch"):
@@ -503,12 +644,16 @@ class RaggedRunnerBase:
             eos_id=int(eos_id))
         kv_data = self._flush_ring(kv_data, ring, block_tables, start_pos,
                                    active)
-        return toks, kv_data, consumed
+        return toks, kv_data, (consumed if int(eos_id) >= 0 else None)
 
 
 class GPT2RaggedRunner(RaggedRunnerBase):
     """Paged-KV decode/prefill over the flax ``GPT2`` param tree
-    (``deepspeed_tpu/models/gpt2.py`` naming: wte/wpe/h_i/ln_f)."""
+    (``deepspeed_tpu/models/gpt2.py`` naming: wte/wpe/h_i/ln_f). The fused
+    ``c_attn`` qkv needs its output dim re-laid chip-major under TP so the
+    local ``jnp.split`` still yields (q, k, v) — see tp.py."""
+
+    tp_fused_qkv = (r"attn/c_attn",)
 
 
 def _gpt2_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: GPT2Config,
@@ -542,6 +687,7 @@ def _gpt2_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: GPT2Config,
                                 scale, dtype)
 
         y = y @ p["attn"]["c_proj"]["kernel"].astype(dtype)
+        y = tp_all_reduce(y, cfg)           # TP collective 1 (row-parallel)
         if "bias" in p["attn"]["c_proj"]:
             y = y + p["attn"]["c_proj"]["bias"].astype(dtype)
         x = x + y
@@ -552,6 +698,7 @@ def _gpt2_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: GPT2Config,
             m = m + p["mlp"]["c_fc"]["bias"].astype(dtype)
         m = jax.nn.gelu(m)
         m = m @ p["mlp"]["c_proj"]["kernel"].astype(dtype)
+        m = tp_all_reduce(m, cfg)           # TP collective 2 (row-parallel)
         if "bias" in p["mlp"]["c_proj"]:
             m = m + p["mlp"]["c_proj"]["bias"].astype(dtype)
         x = x + m

@@ -16,31 +16,14 @@ import jax.numpy as jnp
 
 from ...models.opt import OPTConfig
 from .config import RaggedInferenceConfig
-from .model_runner import (RaggedBatch, _layer_norm, _linear,
-                           paged_attention)
+from .model_runner import (RaggedBatch, RaggedRunnerBase, _layer_norm,
+                           _linear, paged_attention)
 
 
-class OPTRaggedRunner:
-    def __init__(self, model_cfg: OPTConfig, cfg: RaggedInferenceConfig,
-                 compute_dtype: Any = None):
-        self.model_cfg = model_cfg
-        self.cfg = cfg
-        self.compute_dtype = compute_dtype or model_cfg.dtype
-        self.num_layers = model_cfg.num_layers
-        self.kv_heads = model_cfg.num_heads
-        self.head_dim = model_cfg.head_dim
-
-        def _step(params, kv_data, batch):
-            from ..quantization import dequantize_tree
-            params = dequantize_tree(params)
-            return _opt_ragged_step(params, kv_data, batch,
-                                    model_cfg=model_cfg, cfg=cfg,
-                                    dtype=self.compute_dtype)
-
-        self._step = jax.jit(_step)
-
-    def step(self, params, kv_data, batch: RaggedBatch):
-        return self._step(params, kv_data, batch)
+class OPTRaggedRunner(RaggedRunnerBase):
+    """All plumbing (jitted step / greedy step / fused decode loop, WOQ
+    dequant-in-jit, TP shard_map) comes from RaggedRunnerBase — OPT was
+    the last family on a bespoke step-only runner."""
 
 
 def _opt_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: OPTConfig,
@@ -75,7 +58,7 @@ def _opt_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: OPTConfig,
 
         kv, y = paged_attention(kv, li, q, k, v, batch, cfg, pos, valid_q,
                                 scale, dtype)
-        y = _linear(y, pa["out_proj"], dtype)
+        y = _linear(y, pa["out_proj"], dtype, row_parallel=True, cfg=cfg)
         x = x + y
         if not pre_ln:
             x = _layer_norm(x.astype(jnp.float32), p["self_attn_layer_norm"],
@@ -85,7 +68,7 @@ def _opt_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: OPTConfig,
                               mc.layer_norm_eps).astype(dtype)
                   if pre_ln else x)
         m = jax.nn.relu(_linear(mlp_in, p["fc1"], dtype))
-        m = _linear(m, p["fc2"], dtype)
+        m = _linear(m, p["fc2"], dtype, row_parallel=True, cfg=cfg)
         x = x + m
         if not pre_ln:
             x = _layer_norm(x.astype(jnp.float32), p["final_layer_norm"],
@@ -103,3 +86,6 @@ def _opt_ragged_step(params, kv, batch: RaggedBatch, *, model_cfg: OPTConfig,
     if "lm_head" in params:
         return x_last @ params["lm_head"]["kernel"].astype(jnp.float32), kv
     return x_last @ wte.T.astype(jnp.float32), kv
+
+
+OPTRaggedRunner.step_fn = staticmethod(_opt_ragged_step)

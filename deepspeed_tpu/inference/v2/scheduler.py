@@ -59,7 +59,10 @@ class SplitFuseScheduler:
             if seq.in_flight == 1:
                 n = 1                          # decode rows are budget-EXEMPT
             else:
-                n = min(seq.in_flight, cfg.chunk_size,
+                # effective_chunk = min(chunk_size, prefill_chunk_cap):
+                # uncapped 512-token chunks OOM prefill activations at
+                # max_seqs >= 384 (PROFILE.md serving levers)
+                n = min(seq.in_flight, cfg.effective_chunk,
                         max(budget - used, 0))
                 if n <= 0:
                     break                      # prefill budget exhausted

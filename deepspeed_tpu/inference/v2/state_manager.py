@@ -71,6 +71,16 @@ class StateManager:
                     f"({self.cfg.max_blocks_per_seq})")
             seq.kv_blocks.extend(self.kv_cache.reserve(need))
 
+    def kv_memory_report(self) -> Dict[str, int]:
+        """Serving-memory self-description: total KV-pool bytes, the bytes
+        ONE chip holds (read from the live device sharding — ∝ 1/tp under
+        head-sharded tensor parallelism), and the TP degree."""
+        return {
+            "kv_pool_bytes_total": self.kv_cache.memory_bytes(),
+            "kv_pool_bytes_per_chip": self.kv_cache.memory_bytes_per_chip(),
+            "tp_size": max(1, int(getattr(self.cfg, "tp_size", 1))),
+        }
+
     def flush(self, uid: int) -> None:
         """Release a sequence and its KV blocks (reference ``flush``)."""
         seq = self._seqs.pop(uid, None)

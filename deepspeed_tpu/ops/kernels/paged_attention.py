@@ -205,7 +205,7 @@ def _paged_kernel(starts_ref, fetch_ref, lo_ref, hi_ref, slopes_ref, *rest,
 def _decode_grouped_kernel(starts_ref, fetch_ref, lens_ref, rcount_ref,
                            contig_ref, layer_ref, slopes_ref, q_ref,
                            kp_hbm, vp_hbm, rk_ref, rv_ref, *rest, G, bs,
-                           H, KV, D, sm_scale, use_alibi, window, R,
+                           ts, H, KV, D, sm_scale, use_alibi, window, R,
                            ring5d, use_pool_full, quant, sc_full):
     """Grouped decode: G sequences per grid step (VERDICT r3 #4 decode
     roofline work). The BlockSpec path pays one grid step per (sequence,
@@ -283,37 +283,77 @@ def _decode_grouped_kernel(starts_ref, fetch_ref, lens_ref, rcount_ref,
                                   ssem.at[1]).wait()
 
     @pl.when(contig_ref[i] == 0)
-    def _copy_scattered():
+    def _copy_tiled():
+        # seq_len-bounded block reads (PROFILE.md serving lever): the
+        # per-sequence copy is tiled at ``ts`` rows and HBM reads stop at
+        # the sequence's settled length — with the linear layout a
+        # 640-slot block holding a 130-token context streams 1 tile, not
+        # 5. Dead tiles are ZEROED instead of copied: masked scores drop
+        # them, but stale/uninitialized VMEM can hold NaN bit patterns and
+        # 0 * NaN would poison the p@v matmul.
+        nt = bs // ts
+
+        def tile_live(g, t):
+            return t * ts < lens_ref[i * G + g]
+
         for g in range(G):
             off = fetch_ref[i * G + g] * bs
-            pltpu.make_async_copy(
-                k_src(off, bs), k_scr.at[pl.ds(g * bs, bs)],
-                sems.at[2 * g]).start()
-            pltpu.make_async_copy(
-                v_src(off, bs), v_scr.at[pl.ds(g * bs, bs)],
-                sems.at[2 * g + 1]).start()
-            if quant:
-                pltpu.make_async_copy(
-                    ks_src(off, bs), ks_scr.at[:, pl.ds(g * bs, bs)],
-                    ssem.at[2 + 2 * g]).start()
-                pltpu.make_async_copy(
-                    vs_src(off, bs), vs_scr.at[:, pl.ds(g * bs, bs)],
-                    ssem.at[3 + 2 * g]).start()
+            for t in range(nt):
+                row = g * bs + t * ts
+
+                @pl.when(tile_live(g, t))
+                def _dma(off=off, t=t, row=row, g=g):
+                    pltpu.make_async_copy(
+                        k_src(off + t * ts, ts),
+                        k_scr.at[pl.ds(row, ts)], sems.at[2 * g]).start()
+                    pltpu.make_async_copy(
+                        v_src(off + t * ts, ts),
+                        v_scr.at[pl.ds(row, ts)],
+                        sems.at[2 * g + 1]).start()
+                    if quant:
+                        pltpu.make_async_copy(
+                            ks_src(off + t * ts, ts),
+                            ks_scr.at[:, pl.ds(row, ts)],
+                            ssem.at[2 + 2 * g]).start()
+                        pltpu.make_async_copy(
+                            vs_src(off + t * ts, ts),
+                            vs_scr.at[:, pl.ds(row, ts)],
+                            ssem.at[3 + 2 * g]).start()
+
+                @pl.when(jnp.logical_not(tile_live(g, t)))
+                def _zero(row=row):
+                    k_scr[pl.ds(row, ts)] = jnp.zeros((ts, k_scr.shape[1]),
+                                                      k_scr.dtype)
+                    v_scr[pl.ds(row, ts)] = jnp.zeros((ts, v_scr.shape[1]),
+                                                      v_scr.dtype)
+                    if quant:
+                        ks_scr[:, pl.ds(row, ts)] = jnp.zeros(
+                            (KV, ts), ks_scr.dtype)
+                        vs_scr[:, pl.ds(row, ts)] = jnp.zeros(
+                            (KV, ts), vs_scr.dtype)
         for g in range(G):
             off = fetch_ref[i * G + g] * bs
-            pltpu.make_async_copy(
-                k_src(off, bs), k_scr.at[pl.ds(g * bs, bs)],
-                sems.at[2 * g]).wait()
-            pltpu.make_async_copy(
-                v_src(off, bs), v_scr.at[pl.ds(g * bs, bs)],
-                sems.at[2 * g + 1]).wait()
-            if quant:
-                pltpu.make_async_copy(
-                    ks_src(off, bs), ks_scr.at[:, pl.ds(g * bs, bs)],
-                    ssem.at[2 + 2 * g]).wait()
-                pltpu.make_async_copy(
-                    vs_src(off, bs), vs_scr.at[:, pl.ds(g * bs, bs)],
-                    ssem.at[3 + 2 * g]).wait()
+            for t in range(nt):
+                row = g * bs + t * ts
+
+                @pl.when(tile_live(g, t))
+                def _wait(off=off, t=t, row=row, g=g):
+                    pltpu.make_async_copy(
+                        k_src(off + t * ts, ts),
+                        k_scr.at[pl.ds(row, ts)], sems.at[2 * g]).wait()
+                    pltpu.make_async_copy(
+                        v_src(off + t * ts, ts),
+                        v_scr.at[pl.ds(row, ts)],
+                        sems.at[2 * g + 1]).wait()
+                    if quant:
+                        pltpu.make_async_copy(
+                            ks_src(off + t * ts, ts),
+                            ks_scr.at[:, pl.ds(row, ts)],
+                            ssem.at[2 + 2 * g]).wait()
+                        pltpu.make_async_copy(
+                            vs_src(off + t * ts, ts),
+                            vs_scr.at[:, pl.ds(row, ts)],
+                            ssem.at[3 + 2 * g]).wait()
 
     # scores per sequence (the matmuls are irreducibly [H, ...] slivers),
     # but ONE batched softmax over the whole group's [G*H, bs(+R)] rows —
@@ -481,8 +521,12 @@ def _flash_decode_grouped(qw, kp_flat, vp_flat, fetch, start_pos, seq_lens,
             raise ValueError(
                 f"ring_full dtype {ring_full.dtype} != expected {expect} "
                 f"(the grouped kernel does not cast the full ring)")
+    # copy-tile rows for the seq_len-bounded path: the largest 128-multiple
+    # dividing bs (DMA offsets stay (int8: 32, else 8/16)x128-tile aligned);
+    # blocks under 128 rows stream whole (already small)
+    ts = next((d for d in (256, 128) if bs % d == 0), bs)
     kernel = functools.partial(
-        _decode_grouped_kernel, G=G, bs=bs, H=H, KV=KV, D=D,
+        _decode_grouped_kernel, G=G, bs=bs, ts=ts, H=H, KV=KV, D=D,
         sm_scale=float(sm_scale), use_alibi=use_alibi, window=window, R=R,
         ring5d=ring5d, use_pool_full=use_pool_full, quant=quant,
         sc_full=scales_full is not None)
@@ -529,12 +573,20 @@ def _flash_decode_grouped(qw, kp_flat, vp_flat, fetch, start_pos, seq_lens,
             operands += [k_scales.astype(jnp.float32),
                          v_scales.astype(jnp.float32)]
 
-    # host-side run check: a group whose G block ids are consecutive takes
-    # the single-DMA fast path in the kernel
+    # host-side run check: a group whose G block ids are consecutive AND
+    # whose sequences are all within ONE copy tile of full takes the
+    # single-DMA fast path (the tiled copy could save at most ts rows per
+    # sequence there — not worth G x nt DMA issues in the near-full
+    # steady state); shorter groups go through the tiled copy so HBM
+    # reads stop at each sequence's settled length (seq_len-bounded
+    # block reads)
     fg = fetch.astype(jnp.int32).reshape(S // G, G)
     contig = jnp.all(
         fg == fg[:, :1] + jnp.arange(G, dtype=jnp.int32)[None, :],
-        axis=1).astype(jnp.int32)
+        axis=1)
+    near_full = jnp.all(
+        seq_lens.astype(jnp.int32).reshape(S // G, G) > bs - ts, axis=1)
+    contig = jnp.logical_and(contig, near_full).astype(jnp.int32)
 
     scr_dtype = pool_full.dtype if use_pool_full else kp_flat.dtype
     grid_spec = pltpu.PrefetchScalarGridSpec(

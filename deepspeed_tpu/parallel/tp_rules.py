@@ -30,11 +30,14 @@ MODEL_AXIS = "model"
 
 #: default name patterns, mirroring the reference's policy vocabulary
 #: (module_inject/containers/*: qkv/dense/h_to_4h/4h_to_h, HF: c_attn/c_proj/c_fc)
+#: the T5-style wi/wo names are WORD-BOUNDED: a bare r"wo" also matched
+#: "word_embeddings" and silently vocab-sharded every embedding table the
+#: generic rules saw (first-match-wins put row before embed)
 COLUMN_PATTERNS = [r"c_attn", r"qkv", r"query", r"key", r"value", r"q_proj",
                    r"k_proj", r"v_proj", r"c_fc", r"up_proj", r"gate_proj",
-                   r"h_to_4h", r"fc1", r"wi"]
+                   r"h_to_4h", r"fc1", r"\bwi(_\w+)?\b"]
 ROW_PATTERNS = [r"c_proj", r"o_proj", r"out_proj", r"dense(?!_h)", r"4h_to_h",
-                r"fc2", r"wo", r"down_proj"]
+                r"fc2", r"\bwo\b", r"down_proj"]
 EMBED_PATTERNS = [r"wte", r"embed_tokens", r"word_embeddings", r"embedding\b",
                   r"lm_head"]
 

@@ -199,6 +199,10 @@ class HybridEngine(Engine):
                     num_blocks=B + 2, max_blocks_per_seq=1,
                     decode_loop_steps=min(max_new, 32),
                     dtype=jnp.dtype(self.compute_dtype).name,
+                    # rollout prompts prefill in ONE bucket-sized chunk
+                    # (the engines are bucket-keyed precisely for that);
+                    # the serving-side chunk cap stays out of RLHF rollouts
+                    prefill_chunk_cap=0,
                     attention_impl="auto"))
             self._ragged_cache[key] = eng
             while len(self._ragged_cache) > self._ragged_cache_cap:

@@ -989,6 +989,231 @@ class TestKVInt8:
         assert rel < 0.05
 
 
+def _tp_setup(num_heads=4, hidden=64, vocab=96, **cfg_kw):
+    """GPT-2 geometry whose heads divide by 4 (TP over the virtual 8-device
+    CPU mesh) + a dense-impl ragged config with the fused loop on."""
+    mcfg = GPT2Config(vocab_size=vocab, max_seq_len=128, num_layers=2,
+                      num_heads=num_heads, hidden_size=hidden,
+                      dtype=jnp.float32)
+    model = GPT2(mcfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    base = dict(max_seqs=4, chunk_size=8, block_size=4, num_blocks=64,
+                max_blocks_per_seq=16, dtype="float32",
+                attention_impl="dense", decode_loop_steps=4)
+    base.update(cfg_kw)
+    return mcfg, model, params, base
+
+
+class TestTensorParallelServing:
+    """ISSUE 2 tentpole: the v2 ragged engine sharded over the ``model``
+    axis (inference/v2/tp.py) — column/row weights, head-sharded KV pool +
+    decode ring, two per-layer psums + one logits gather. Greedy decode
+    must be TOKEN-IDENTICAL across tp sizes on the 8-device CPU mesh, and
+    per-chip KV-pool bytes must scale ~1/tp."""
+
+    def test_tp2_token_identical_and_kv_shards(self):
+        mcfg, model, params, base = _tp_setup()
+        rng = np.random.default_rng(21)
+        prompts = [rng.integers(1, 96, 9).tolist() for _ in range(2)]
+        ref = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base)).generate(prompts, max_new_tokens=6)
+        eng = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base, tp_size=2))
+        got = eng.generate(prompts, max_new_tokens=6)
+        assert got == ref
+        rep = eng.state.kv_memory_report()
+        assert rep["tp_size"] == 2
+        assert rep["kv_pool_bytes_per_chip"] * 2 == \
+            rep["kv_pool_bytes_total"]
+
+    @pytest.mark.full
+    def test_tp4_token_identical(self):
+        # tp4 exercises >2-way psums, the fused c_attn chip-major re-lay at
+        # its deepest split, and 1/4-pool sharding
+        mcfg, model, params, base = _tp_setup()
+        rng = np.random.default_rng(22)
+        prompts = [rng.integers(1, 96, 9).tolist() for _ in range(2)]
+        ref = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base)).generate(prompts, max_new_tokens=6)
+        eng = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base, tp_size=4))
+        assert eng.generate(prompts, max_new_tokens=6) == ref
+        rep = eng.state.kv_memory_report()
+        assert rep["kv_pool_bytes_per_chip"] * 4 == \
+            rep["kv_pool_bytes_total"]
+
+    @pytest.mark.full
+    def test_tp2_llama_gqa_kernel_and_lmhead_gather(self):
+        # GQA (kv heads split across chips), RoPE, untied lm_head (the
+        # vocab-sharded unembed -> logits all-gather path), paged-flash
+        # kernel running inside the shard_map region (interpret mode)
+        from deepspeed_tpu.models.llama import Llama, LlamaConfig
+        mcfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="xla")
+        model = Llama(mcfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        base = dict(max_seqs=2, chunk_size=8, block_size=4, num_blocks=64,
+                    max_blocks_per_seq=16, dtype="float32",
+                    attention_impl="paged_flash", decode_loop_steps=4)
+        prompts = [list(np.random.default_rng(23).integers(1, 500, 9))]
+        ref = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base)).generate(prompts, max_new_tokens=6)
+        got = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base, tp_size=2)).generate(prompts, max_new_tokens=6)
+        assert got == ref
+
+    @pytest.mark.full
+    def test_tp2_woq_scales_shard_with_weights(self):
+        # WOQ QuantizedTensor leaves shard their group rows (values AND
+        # scales) with the weight — numerics identical to unsharded WOQ,
+        # so greedy decode stays token-exact across tp
+        from deepspeed_tpu.inference.quantization import \
+            quantize_model_params
+        from deepspeed_tpu.models.llama import Llama, LlamaConfig
+        mcfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="xla")
+        model = Llama(mcfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        # group 16 divides the per-chip kv projection width (KV*D/tp = 16)
+        qparams = quantize_model_params(params, {"quantized_weights": {
+            "enabled": True, "num_bits": 8, "group_size": 16,
+            "modules": ["proj"]}})
+        base = dict(max_seqs=2, chunk_size=8, block_size=4, num_blocks=64,
+                    max_blocks_per_seq=16, dtype="float32",
+                    attention_impl="dense", decode_loop_steps=4)
+        prompts = [list(np.random.default_rng(24).integers(1, 500, 9))]
+        ref = InferenceEngineV2(mcfg, qparams, RaggedInferenceConfig(
+            **base)).generate(prompts, max_new_tokens=5)
+        got = InferenceEngineV2(mcfg, qparams, RaggedInferenceConfig(
+            **base, tp_size=2)).generate(prompts, max_new_tokens=5)
+        assert got == ref
+
+    @pytest.mark.full
+    def test_tp2_woq_fused_qkv_group_permutation(self):
+        # WOQ + fused c_attn: the chip-major qkv re-lay composes with the
+        # quantization groups when group_size | head_dim — token-exact
+        from deepspeed_tpu.inference.quantization import \
+            quantize_model_params
+        mcfg, model, params, base = _tp_setup()          # D = 16
+        qparams = quantize_model_params(params, {"quantized_weights": {
+            "enabled": True, "num_bits": 8, "group_size": 16,
+            "modules": ["attn", "mlp"],
+            "excluded_modules": ["wte", "wpe", "ln"]}})
+        prompts = [list(np.random.default_rng(26).integers(1, 96, 9))]
+        ref = InferenceEngineV2(mcfg, qparams, RaggedInferenceConfig(
+            **base)).generate(prompts, max_new_tokens=5)
+        got = InferenceEngineV2(mcfg, qparams, RaggedInferenceConfig(
+            **base, tp_size=2)).generate(prompts, max_new_tokens=5)
+        assert got == ref
+        # a group that straddles head blocks (gs does not divide D) must
+        # fail loudly at engine construction
+        qbad = quantize_model_params(params, {"quantized_weights": {
+            "enabled": True, "num_bits": 8, "group_size": 24,
+            "modules": ["attn"], "excluded_modules": ["wte", "wpe", "ln"]}})
+        with pytest.raises(ValueError, match="head_dim"):
+            InferenceEngineV2(mcfg, qbad,
+                              RaggedInferenceConfig(**base, tp_size=2))
+
+    @pytest.mark.full
+    def test_tp2_quantized_comm(self):
+        # config-gated int8 all-reduce (EQuARX-class): runs end-to-end and
+        # the first greedy token survives the comm quantization
+        mcfg, model, params, base = _tp_setup()
+        prompts = [list(np.random.default_rng(25).integers(1, 96, 9))]
+        ref = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base)).generate(prompts, max_new_tokens=3)
+        got = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base, tp_size=2, tp_quantized_comm=True)).generate(
+                prompts, max_new_tokens=3)
+        assert got[0][0] == ref[0][0]
+
+    def test_tp_rejects_indivisible_heads(self):
+        # 2 heads cannot split 4 ways — fail at engine construction with a
+        # geometry message, not deep inside a trace
+        mcfg, model, params, base = _tp_setup(num_heads=2, hidden=32)
+        with pytest.raises(ValueError, match="divide"):
+            InferenceEngineV2(mcfg, params,
+                              RaggedInferenceConfig(**base, tp_size=4))
+
+
+class TestPrefillChunkCap:
+    """Satellite: cap the SplitFuse prefill chunk (config key
+    ``prefill_chunk_cap``) so long-context prefill stops OOMing at
+    max_seqs >= 384 with 512-token chunks (PROFILE.md serving levers)."""
+
+    def test_capped_prefill_matches_uncapped(self):
+        cfg, mcfg, model, params = _tiny_setup(chunk=8)
+        rng = np.random.default_rng(31)
+        prompts = {0: rng.integers(1, 96, 21).tolist(),
+                   1: rng.integers(1, 96, 7).tolist()}
+        out_ref = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **{**cfg.__dict__, "prefill_chunk_cap": 0})).put(
+                list(prompts), list(prompts.values()))
+        cfg_cap = RaggedInferenceConfig(**{**cfg.__dict__,
+                                           "prefill_chunk_cap": 4})
+        assert cfg_cap.effective_chunk == 4
+        out_cap = InferenceEngineV2(mcfg, params, cfg_cap).put(
+            list(prompts), list(prompts.values()))
+        for uid in prompts:
+            np.testing.assert_allclose(out_cap[uid], out_ref[uid],
+                                       atol=2e-4, rtol=2e-4)
+
+    def test_scheduler_respects_cap(self):
+        cfg, mcfg, _, _ = _tiny_setup(chunk=8)
+        cfg = RaggedInferenceConfig(**{**cfg.__dict__,
+                                       "prefill_chunk_cap": 4})
+        kv = BlockedKVCache(cfg, mcfg.num_layers, 2, 16, jnp.float32)
+        sm = StateManager(cfg, kv)
+        sched = SplitFuseScheduler(cfg, sm)
+        sm.put_tokens(1, range(20))
+        items = sched.schedule()
+        assert max(len(it.tokens) for it in items) == 4
+
+
+class TestSeqLenBoundedGroupedReads:
+    """Satellite: the grouped decode kernel's per-sequence context copy is
+    tiled and stops at each sequence's settled length instead of streaming
+    the whole (linear-layout) block; dead tiles are zero-filled."""
+
+    def test_partial_lengths_match_reference(self):
+        from deepspeed_tpu.ops.kernels import flash_paged_attention
+        rng = np.random.default_rng(41)
+        S, H, KV, D = 4, 4, 2, 16
+        KVD = KV * D
+        bs = 512                          # ts=256 -> 2 copy tiles per seq
+        slots = (S + 1) * bs
+        kf = jnp.asarray(rng.normal(size=(slots, KVD)), jnp.float32)
+        vf = jnp.asarray(rng.normal(size=(slots, KVD)), jnp.float32)
+        tables = jnp.arange(S, dtype=jnp.int32)[:, None]
+        lens = jnp.asarray([130, 512, 1, 0], jnp.int32)  # partial/full/idle
+        start = jnp.maximum(lens - 1, 0)
+        q = jnp.asarray(rng.normal(size=(S, 1, H, D)), jnp.float32)
+        out = flash_paged_attention(q, kf, vf, tables, start, lens,
+                                    block_size=bs, num_kv_heads=KV,
+                                    interpret=True)
+        g = H // KV
+        for s in range(S):
+            L = int(lens[s])
+            if L == 0:
+                assert np.allclose(np.asarray(out[s]), 0)
+                continue
+            base = int(tables[s, 0]) * bs
+            kc = np.repeat(np.asarray(kf)[base:base + L]
+                           .reshape(L, KV, D), g, 1)
+            vc = np.repeat(np.asarray(vf)[base:base + L]
+                           .reshape(L, KV, D), g, 1)
+            sc = np.einsum("chd,khd->hck", np.asarray(q)[s], kc) \
+                / np.sqrt(D)
+            mask = np.arange(L)[None, None, :] <= int(start[s])
+            p = jax.nn.softmax(jnp.asarray(np.where(mask, sc, -np.inf)),
+                               -1)
+            ref = jnp.einsum("hck,khd->chd", p, jnp.asarray(vc))
+            np.testing.assert_allclose(np.asarray(out[s]),
+                                       np.asarray(ref),
+                                       atol=2e-5, rtol=1e-4)
+
+
 class TestEvoformerFullyMasked:
     """Rows whose mask bias is -inf across every key (padded MSA rows)
     must produce 0 output — not NaN — on BOTH the flash kernel and the

@@ -75,10 +75,22 @@ def walk(cls, prefix: str, rows: list) -> None:
         rows.append((key, _type_name(t), repr(default)))
 
 
-def main():
-    rows: list = []
-    walk(Config, "", rows)
-    out = [HEADER, "| key | type | default |", "|---|---|---|"]
+SERVING_HEADER = """
+
+## Ragged serving config (`RaggedInferenceConfig`)
+
+Keys of `deepspeed_tpu.inference.v2.RaggedInferenceConfig` — the v2
+ragged engine's constructor config (`InferenceEngineV2` /
+`build_hf_engine(engine_config=...)`), the analogue of the reference's
+`RaggedInferenceEngineConfig`. See docs/serving.md for the serving guide
+(tensor-parallel sharding map, comm accounting, per-chip KV formula,
+bench flags).
+
+"""
+
+
+def _table(rows: list) -> list:
+    out = ["| key | type | default |", "|---|---|---|"]
     for key, tname, default in rows:
         if tname == "section":
             out.append(f"| **`{key}`** | — | — |")
@@ -86,11 +98,21 @@ def main():
             d = default.replace("|", "\\|")
             t = tname.replace("|", "\\|")
             out.append(f"| `{key}` | {t} | `{d}` |")
+    return out
+
+
+def main():
+    from deepspeed_tpu.inference.v2.config import RaggedInferenceConfig
+    rows: list = []
+    walk(Config, "", rows)
+    srows: list = []
+    walk(RaggedInferenceConfig, "", srows)
+    out = [HEADER] + _table(rows) + [SERVING_HEADER] + _table(srows)
     os.makedirs(os.path.join(REPO, "docs"), exist_ok=True)
     path = os.path.join(REPO, "docs", "CONFIG.md")
     with open(path, "w") as f:
         f.write("\n".join(out) + "\n")
-    print(f"wrote {path} ({len(rows)} keys)")
+    print(f"wrote {path} ({len(rows)} + {len(srows)} keys)")
 
 
 if __name__ == "__main__":

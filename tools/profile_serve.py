@@ -40,6 +40,10 @@ def capture():
                                 num_blocks=S + 4, max_blocks_per_seq=1,
                                 decode_loop_steps=NL, dtype="bfloat16",
                                 attention_impl="paged_flash",
+                                # uncapped: keep the measured r4 single-
+                                # forward-prefill configuration comparable
+                                prefill_chunk_cap=int(os.environ.get(
+                                    "DSTPU_PROF_CHUNK_CAP", "0")),
                                 kv_cache_dtype=os.environ.get(
                                     "DSTPU_PROF_KV", "auto"))
     eng = InferenceEngineV2(mcfg, params, cfg)

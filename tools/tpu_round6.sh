@@ -1,0 +1,33 @@
+#!/bin/bash
+# Round-6 on-chip sequence: first TPU contact for the TP ragged serving
+# layer (ISSUE 2). Strictly sequential (one process owns the chip), no
+# timeouts around TPU clients (a killed client wedges the grant).
+cd /root/repo || exit 1
+LOG=profiles/r06_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round6 start $(date -u +%FT%TZ)"
+
+echo "--- [1/4] tpu_smoke (incl. tp_paged_decode parity row)"
+python tools/tpu_smoke.py | tee SMOKE_TPU_r06.txt
+
+echo "--- [2/4] serve bench, single chip control (int8 KV, NL=64)"
+python bench.py serve > BENCH_SERVE_TP1_r06.json
+tail -c 400 BENCH_SERVE_TP1_r06.json
+
+echo "--- [3/4] serve bench at tp=4 (the FastGen-headline configuration"
+echo "    class; captures on-chip tok/s + per-chip KV bytes at 1/4)"
+if python - <<'EOF'
+import jax, sys
+sys.exit(0 if len(jax.devices()) >= 4 else 1)
+EOF
+then
+  DSTPU_BENCH_TP=4 python bench.py serve > BENCH_SERVE_TP4_r06.json
+  tail -c 400 BENCH_SERVE_TP4_r06.json
+else
+  echo "SKIP tp=4 serve bench (fewer than 4 chips)"
+fi
+
+echo "--- [4/4] full bench (driver runs it again at round end)"
+python bench.py > BENCH_SELF_r06.json
+tail -c 600 BENCH_SELF_r06.json
+echo "=== tpu_round6 done $(date -u +%FT%TZ)"
