@@ -366,6 +366,167 @@ def bench_serve():
     }))
 
 
+def bench_serve_pipeline():
+    """Overlapped-serving-pipeline benchmark (ISSUE 3): per-step greedy
+    decode through the plan/dispatch/commit engine loop, synchronous
+    (depth 0) vs pipelined (depth ``DSTPU_SERVE_ASYNC``, default 2), with
+    a SYNTHETIC per-step host cost injected into the plan phase — the
+    stand-in for scheduler/admission/tokenizer/bookkeeping work that in
+    the sync loop sits in the device's idle gap and in the pipelined loop
+    overlaps the in-flight step. Reports both throughputs plus the
+    host-gap metric: ``host_gap_hidden_frac`` = (t_sync - t_pipe) /
+    (steps x host_cost), the fraction of injected host time the overlap
+    actually hid (1.0 = fully hidden, 0 = no overlap)."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceConfig)
+    from deepspeed_tpu.models.llama import Llama, LlamaConfig
+
+    # the env knob also steers engine construction — consume it here so
+    # the depth-0 control below stays a true synchronous oracle
+    depth = int(os.environ.pop("DSTPU_SERVE_ASYNC", "") or 2)
+    on_tpu = jax.default_backend() == "tpu"
+    if os.environ.get("DSTPU_PIPE_MODEL", "big" if on_tpu else "tiny") \
+            == "big":
+        # TinyLlama-1.1B shape — the serve-phase flagship model
+        mcfg = LlamaConfig(vocab_size=32000, max_seq_len=2048,
+                           num_layers=22, num_heads=32, num_kv_heads=4,
+                           hidden_size=2048, intermediate_size=5632,
+                           dtype=jnp.bfloat16)
+        S, PROMPT, GEN = 64, 128, 64
+        dtype = "bfloat16"
+    else:
+        # CPU-harness shape: small enough that a decode step is a few ms
+        mcfg = LlamaConfig(vocab_size=2048, max_seq_len=512, num_layers=4,
+                           num_heads=8, num_kv_heads=4, hidden_size=256,
+                           intermediate_size=512, dtype=jnp.float32)
+        S, PROMPT, GEN = 8, 32, 64
+        dtype = "float32"
+    S = int(os.environ.get("DSTPU_PIPE_SEQS", str(S)))
+    GEN = int(os.environ.get("DSTPU_PIPE_GEN", str(GEN)))
+    model = Llama(mcfg)
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32)))["params"]
+
+    # NON-degenerate deterministic params, filled on device: zeros (the
+    # serve-bench trick) would make every argmax constant and the
+    # token-parity self-check below vacuous; real random init of the big
+    # shape costs a 1.1B host init + transfer. A cheap iota hash per leaf
+    # keeps weights varied, small and centered so greedy tokens actually
+    # depend on the fed inputs.
+    leaf_i = [0]
+
+    def _pseudo(s):
+        leaf_i[0] += 1
+        n = int(np.prod(s.shape))
+        x = (jnp.arange(n, dtype=jnp.float32)
+             * (0.7548 + 0.0173 * (leaf_i[0] % 11))) % 1.0
+        return ((x - 0.5) * 0.05).reshape(s.shape).astype(mcfg.dtype)
+
+    params = jax.tree.map(_pseudo, shapes)
+
+    bs = PROMPT + GEN + 8          # +8: the warm-up decode tokens
+    base = dict(max_seqs=S, chunk_size=PROMPT, block_size=bs,
+                num_blocks=S + 4, max_blocks_per_seq=1, dtype=dtype,
+                attention_impl="paged_flash" if on_tpu else "dense",
+                decode_loop_steps=0)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, mcfg.vocab_size, size=PROMPT).tolist()
+               for _ in range(S)]
+    uids = list(range(S))
+
+    # Synthetic host cost flavors: "sleep" (default) models a host-side
+    # gap that does not contend for compute — the right model for a real
+    # accelerator, where the host cores are separate from the device; on
+    # the CPU harness the XLA "device" shares the host cores, so "spin"
+    # (a GIL-holding busy loop) additionally steals device cycles and
+    # understates the overlap a real TPU host would see.
+    host_kind = os.environ.get("DSTPU_PIPE_HOSTKIND", "sleep")
+
+    def host_work(seconds):
+        if host_kind == "spin":
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < seconds:
+                pass
+        else:
+            time.sleep(seconds)
+
+    def run(pipe_depth, host_cost):
+        eng = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base, serve_pipeline_depth=pipe_depth))
+        first = eng.put(uids, prompts, _greedy=True)
+        # warm the decode-step program (and the feedback variant) before
+        # the measurement
+        warm = eng.decode_pipelined(uids, [first[u] for u in uids], 3)
+        last = [warm[u][-1] for u in uids]
+        if host_cost > 0:
+            orig_plan = eng._plan_step
+
+            def costly_plan(*a, **kw):
+                host_work(host_cost)
+                return orig_plan(*a, **kw)
+            eng._plan_step = costly_plan
+        stats0 = dict(eng.pipeline_stats)
+        t0 = time.perf_counter()
+        outs = eng.decode_pipelined(uids, last, GEN)
+        dt = time.perf_counter() - t0
+        commit_block = eng.pipeline_stats["commit_block_s"] \
+            - stats0["commit_block_s"]
+        fed = eng.pipeline_stats["fed_steps"] - stats0["fed_steps"]
+        for u in uids:
+            eng.flush(u)
+        return outs, dt, commit_block, fed
+
+    # device-only step time calibrates the synthetic host cost: the
+    # default host gap equals one device step (the regime where overlap
+    # can reach 2x and a blocking loop pays full price)
+    _, dt_dev, _, _ = run(0, 0.0)
+    dev_step = dt_dev / GEN
+    host_ms = os.environ.get("DSTPU_PIPE_HOSTMS")
+    host_cost = float(host_ms) / 1e3 if host_ms else dev_step
+
+    sync_out, t_sync, sync_block, _ = run(0, host_cost)
+    pipe_out, t_pipe, pipe_block, pipe_fed = run(depth, host_cost)
+    parity = sync_out == pipe_out
+    # parity is only evidence if the streams actually vary — all-equal
+    # tokens (degenerate weights) would make the check vacuous
+    distinct = len({t for toks in sync_out.values() for t in toks})
+
+    hidden = max(0.0, t_sync - t_pipe)
+    print(json.dumps({
+        "model": f"llama {mcfg.num_layers}L hidden={mcfg.hidden_size}",
+        "pipeline_depth": depth,
+        "batch_seqs": S, "prompt_len": PROMPT, "gen_len": GEN,
+        "device_step_ms": round(dev_step * 1e3, 3),
+        "host_cost_ms_per_step": round(host_cost * 1e3, 3),
+        "host_cost_kind": host_kind,
+        "sync": {
+            "decode_steps_per_sec": round(GEN / t_sync, 2),
+            "decode_tokens_per_sec": round(S * GEN / t_sync, 1),
+            "commit_block_s": round(sync_block, 3),
+        },
+        "pipelined": {
+            "decode_steps_per_sec": round(GEN / t_pipe, 2),
+            "decode_tokens_per_sec": round(S * GEN / t_pipe, 1),
+            "commit_block_s": round(pipe_block, 3),
+            "device_fed_steps": pipe_fed,
+        },
+        "speedup": round(t_sync / t_pipe, 3),
+        "host_gap_hidden_frac": round(hidden / (GEN * host_cost), 3)
+        if host_cost > 0 else None,      # DSTPU_PIPE_HOSTMS=0: pure
+                                         # pipeline overhead, no gap to hide
+        "token_parity": parity,
+        "distinct_tokens": distinct,
+    }))
+    return 0 if parity and distinct > 1 else 1
+
+
 def _moe_param_counts(shapes, num_experts: int, top_k: int):
     """(total, active) param counts from a Mixtral param tree: expert
     leaves carry a leading E axis under a 'moe' subtree; only k/E of each
@@ -775,6 +936,8 @@ def main():
         return bench_train("gpt1p3b")
     if sys.argv[1:] == ["serve"]:
         return bench_serve()
+    if sys.argv[1:] == ["serve_pipeline"]:
+        return bench_serve_pipeline()
     if sys.argv[1:] == ["fastgen"]:
         return bench_serve_fastgen()
     if sys.argv[1:] == ["moe"]:
@@ -805,8 +968,8 @@ def main():
     phase_timeout = float(os.environ.get("DSTPU_PHASE_TIMEOUT", "2400"))
     out = {"probe": probe}
     dead = False
-    for phase in ("train", "train_xl", "train_1p3b", "serve", "fastgen",
-                  "moe", "moe_train"):
+    for phase in ("train", "train_xl", "train_1p3b", "serve",
+                  "serve_pipeline", "fastgen", "moe", "moe_train"):
         if dead:
             out[phase] = {"error": "skipped_backend_dead"}
             continue
@@ -856,17 +1019,23 @@ def main():
     train = out.get("train", {})
     train_xl = out.get("train_xl", {})
     ref_tflops = 64.0  # BERT-large, 1x V100 (BASELINE.md row 1)
-    best = max(train.get("tflops_per_chip", 0.0) or 0.0,
-               train_xl.get("tflops_per_chip", 0.0) or 0.0,
-               out.get("train_1p3b", {}).get("tflops_per_chip", 0.0) or 0.0)
+    # headline honesty (VERDICT #8): record WHICH phase won, not just the
+    # max, so round-over-round comparisons survive one flaky phase
+    candidates = {
+        phase: out.get(phase, {}).get("tflops_per_chip", 0.0) or 0.0
+        for phase in ("train", "train_xl", "train_1p3b")}
+    best_phase = max(candidates, key=candidates.get)
+    best = candidates[best_phase]
     print(json.dumps({
         "metric": "gpt2_train_tflops_per_chip",
         "value": best,
         "unit": "TFLOPS",
+        "best_phase": best_phase,
         "vs_baseline": round(best / ref_tflops, 3),
         "detail": {**train, "train_xl": train_xl,
                    "train_1p3b": out.get("train_1p3b", {}),
                    "serving": out.get("serve", {}),
+                   "serve_pipeline": out.get("serve_pipeline", {}),
                    "fastgen": out.get("fastgen", {}),
                    "moe_serve": out.get("moe", {}),
                    "moe_train": out.get("moe_train", {}),

@@ -48,6 +48,19 @@ class RaggedInferenceConfig(ConfigModel):
     # (PROFILE.md serving levers); 256 keeps the transient bounded.
     # 0 disables the cap.
     prefill_chunk_cap: int = 256
+    # Overlapped serving pipeline depth: how many scheduled steps may be
+    # in flight on the device at once. The serve loop splits into plan
+    # (host: scheduler + batch staging, runs ahead) / dispatch (enqueue
+    # the compiled step without blocking — JAX async dispatch keeps the
+    # result as an in-flight future) / commit (apply step k's readback
+    # while step k+1 executes), so host-side bookkeeping overlaps device
+    # compute instead of sitting in its idle gap. Greedy decode feeds the
+    # next step's token slots from a device-resident last-token buffer
+    # (no host round-trip in the steady pure-decode state); EOS is
+    # reconciled on the delayed readback with explicit rollback.
+    # 0 = fully synchronous (the parity oracle); the env knob
+    # DSTPU_SERVE_ASYNC overrides this at engine construction.
+    serve_pipeline_depth: int = 2
 
     # sampling defaults for the built-in generate loop
     greedy: bool = True
@@ -83,6 +96,10 @@ class RaggedInferenceConfig(ConfigModel):
             raise ValueError(
                 f"prefill_chunk_cap must be >= 0 (0 = uncapped), got "
                 f"{self.prefill_chunk_cap}")
+        if self.serve_pipeline_depth < 0:
+            raise ValueError(
+                f"serve_pipeline_depth must be >= 0 (0 = synchronous), "
+                f"got {self.serve_pipeline_depth}")
 
     @property
     def max_context(self) -> int:

@@ -7,10 +7,25 @@ over whatever the SplitFuse scheduler picked, and returns last-token logits
 for every sequence that completed its pending work this step. ``query`` /
 ``can_schedule`` expose KV-pressure hints; ``flush`` releases sequence state.
 A built-in ``generate`` drives the put-loop with sampling for convenience.
+
+The serving hot path is an overlapped pipeline (``serve_pipeline_depth``,
+docs/serving.md): every step splits into **plan** (host: scheduler +
+staged-buffer fill, runs ahead), **dispatch** (enqueue the compiled step —
+JAX async dispatch keeps the result as an in-flight future in a small
+ring) and **commit** (apply step k's readback while step k+1 executes).
+Greedy decode keeps the feedback token on device: each step returns a
+device-resident ``[S]`` last-token buffer that feeds the next step's token
+slots directly, so the steady pure-decode state never round-trips tokens
+through the host; EOS is reconciled on the delayed readback with explicit
+rollback (dead in-flight slots, retracted positions, freed KV blocks).
+Depth 0 is the fully synchronous path — the parity oracle.
 """
 
 from __future__ import annotations
 
+import os
+import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -26,6 +41,49 @@ from .model_runner import GPT2RaggedRunner, RaggedBatch
 from .scheduler import SplitFuseScheduler
 from .sequence import SequenceStatus
 from .state_manager import StateManager
+
+#: placeholder value a speculatively scheduled decode token carries in
+#: ``pending_tokens`` while its real value is still an in-flight device
+#: future (the step program substitutes the device value; the host value
+#: is patched in at commit if the placeholder is still queued)
+_SPEC_TOKEN = -1
+
+
+class _PlannedStep:
+    """Host half of one step (the plan phase): the schedule plus its
+    staged numpy arrays, ready to dispatch."""
+
+    __slots__ = ("sched", "tokens", "start", "ntok", "tables",
+                 "feed_mask", "feed_idx", "use_greedy")
+
+    def __init__(self, sched, tokens, start, ntok, tables, feed_mask,
+                 feed_idx, use_greedy):
+        self.sched = sched
+        self.tokens = tokens
+        self.start = start
+        self.ntok = ntok
+        self.tables = tables
+        self.feed_mask = feed_mask          # None when no slot is device-fed
+        self.feed_idx = feed_idx
+        self.use_greedy = use_greedy
+
+
+class _InFlightStep:
+    """A dispatched, uncommitted step: the device-side result future plus
+    the host bookkeeping needed to commit — or partially kill — it.
+    ``dead`` slots were invalidated by a late EOS (their readback is
+    discarded); ``rollbacks`` are (seq, n_tokens) retractions that must
+    wait until THIS step has executed (its KV writes still reference the
+    blocks being freed)."""
+
+    __slots__ = ("sched", "result", "use_greedy", "dead", "rollbacks")
+
+    def __init__(self, sched, result, use_greedy):
+        self.sched = sched
+        self.result = result
+        self.use_greedy = use_greedy
+        self.dead: set = set()
+        self.rollbacks: List[Tuple[Any, int]] = []
 
 
 def _runner_for(model_cfg: Any, cfg: RaggedInferenceConfig):
@@ -117,6 +175,21 @@ class InferenceEngineV2:
         self._kv_data = self.kv_cache.pool
         self._step_counter = 0
         self._sample_key = jax.random.PRNGKey(0)
+        # overlapped serving pipeline: max in-flight steps. The env knob
+        # DSTPU_SERVE_ASYNC overrides the config (0 = force synchronous —
+        # the operational kill-switch for parity debugging on live traffic)
+        env_depth = os.environ.get("DSTPU_SERVE_ASYNC")
+        self.pipeline_depth = int(env_depth) if env_depth not in (None, "") \
+            else self.config.serve_pipeline_depth
+        # reused per-(S, C) staging buffers (host alloc churn is on the
+        # overlap-critical path) — see _staging_bufs
+        self._staging: Dict[Tuple[int, int], Dict[str, Any]] = {}
+        # device feedback source: the latest dispatched greedy step's
+        # [S] last-token buffer and each uid's slot in it
+        self._feed_src = None
+        self._feed_slot: Dict[int, int] = {}
+        self.pipeline_stats = {"steps": 0, "fed_steps": 0, "plan_s": 0.0,
+                               "dispatch_s": 0.0, "commit_block_s": 0.0}
         log_dist(
             f"InferenceEngineV2 ready: {self.config.max_seqs} slots x "
             f"{self.config.chunk_size} tokens "
@@ -140,22 +213,58 @@ class InferenceEngineV2:
         engine pauses (host-offloads) least-recently-scheduled idle sequences
         to free blocks, and resumes paused sequences as room appears — the
         reference's state manager exists precisely to oversubscribe
-        (``inference/v2/ragged/kv_cache.py:166,176``)."""
+        (``inference/v2/ragged/kv_cache.py:166,176``).
+
+        Runs through the overlapped pipeline: up to ``pipeline_depth``
+        steps are planned and dispatched ahead of the oldest step's
+        commit (chunks of one sequence may span in-flight steps — the
+        device orders them through the KV-pool data dependence). Depth 0
+        plans, dispatches and commits each step synchronously."""
         for uid, toks in zip(batch_uids, batch_tokens):
             self.state.put_tokens(uid, toks)
         done: Dict[int, np.ndarray] = {}
-        while any(s.in_flight for s in self.state.sequences.values()):
-            self._try_resume()
-            n_scheduled, step_done = self._run_step(greedy=_greedy)
-            if n_scheduled == 0 and not self._relieve_kv_pressure():
+
+        def work_left():
+            return any(s.in_flight for s in self.state.sequences.values())
+
+        def commit_one(ring):
+            _, step_done = self._commit_step(ring.popleft())
+            done.update(step_done)
+
+        self._drive_pipeline(
+            work_left, lambda: self._plan_step(greedy=_greedy), commit_one)
+        return done
+
+    def _drive_pipeline(self, work_left, make_plan, commit_one,
+                        on_dispatch=None) -> None:
+        """The shared ring-drive loop behind put() and decode_pipelined:
+        fill the in-flight ring up to ``pipeline_depth`` (plan+dispatch),
+        then commit the oldest step; when nothing is schedulable and
+        nothing is in flight, relieve KV pressure or declare starvation.
+        ``commit_one(ring)`` pops and applies the oldest step;
+        ``on_dispatch(plan, fl)`` hooks post-dispatch bookkeeping."""
+        depth = max(1, self.pipeline_depth)
+        ring: deque = deque()
+        while ring or work_left():
+            while len(ring) < depth and work_left():
+                self._try_resume()
+                plan = make_plan()
+                if plan is None:
+                    break
+                fl = self._dispatch_step(plan)
+                ring.append(fl)
+                if on_dispatch is not None:
+                    on_dispatch(plan, fl)
+            if ring:
+                commit_one(ring)
+                continue
+            if not self._relieve_kv_pressure():
                 # nothing schedulable, nothing evictable or resumable ->
                 # a single sequence genuinely does not fit the pool
                 raise RuntimeError(
                     "scheduler starved: KV pool too small even after "
                     "pausing all idle sequences "
                     f"(free blocks={self.kv_cache.free_blocks})")
-            done.update(step_done)
-        return done
 
     def _resume_headroom(self, seq) -> int:
         """Blocks needed to restore ``seq`` AND schedule its next chunk —
@@ -361,16 +470,46 @@ class InferenceEngineV2:
         return out
 
     # ------------------------------------------------------------------ #
+    # the serving hot path: plan -> dispatch -> commit
+    # ------------------------------------------------------------------ #
 
-    def _run_step(self, greedy: bool = False) -> Tuple[int, Dict[int, Any]]:
-        sched = self.scheduler.schedule()
+    def _staging_bufs(self, S: int, C: int):
+        """Reused per-(S, C) numpy staging buffers — host-side allocation
+        churn sits on the overlap-critical path, so the step arrays
+        (tokens/start/ntok/tables + the feed mask/idx) are allocated once
+        per shape bucket. A rotation of ``pipeline_depth + 1`` sets keeps
+        an in-flight step's source buffers from being rewritten before
+        its host->device copy is done."""
+        pool = self._staging.get((S, C))
+        if pool is None:
+            MAXB = self.config.max_blocks_per_seq
+            pool = {"sets": [
+                (np.zeros((S, C), np.int32), np.zeros((S,), np.int32),
+                 np.zeros((S,), np.int32), np.zeros((S, MAXB), np.int32),
+                 np.zeros((S,), np.int32), np.zeros((S,), np.int32))
+                for _ in range(max(1, self.pipeline_depth) + 1)],
+                "next": 0}
+            self._staging[(S, C)] = pool
+        bufs = pool["sets"][pool["next"]]
+        pool["next"] = (pool["next"] + 1) % len(pool["sets"])
+        for b in bufs:
+            b.fill(0)
+        return bufs
+
+    def _plan_step(self, greedy: bool = False,
+                   eligible=None) -> Optional[_PlannedStep]:
+        """PLAN: run the scheduler and stage the step's host arrays.
+        Pure host work — runs ahead of the device in the pipelined loop."""
+        t0 = time.perf_counter()
+        sched = self.scheduler.schedule(eligible)
         if not sched:
-            return 0, {}
+            return None
         self._step_counter += 1
+        self.state.step += 1
         for item in sched:
             item.seq.last_step = self._step_counter
+            item.seq.last_sched = self.state.step
         cfg = self.config
-        MAXB = cfg.max_blocks_per_seq
         # shape bucketing: a pure-decode step (every scheduled slot carries
         # one token) runs the [S, 1] program instead of padding every slot
         # to chunk_size — chunk_size× fewer wasted positions in the steady
@@ -389,35 +528,230 @@ class InferenceEngineV2:
             if b >= len(sched) and b <= cfg.max_seqs:
                 S = b
                 break
-        tokens = np.zeros((S, C), np.int32)
-        start = np.zeros((S,), np.int32)
-        ntok = np.zeros((S,), np.int32)
-        tables = np.zeros((S, MAXB), np.int32)
+        tokens, start, ntok, tables, feed_mask, feed_idx = \
+            self._staging_bufs(S, C)
+        has_feed = False
         for i, item in enumerate(sched):
-            tokens[i, :len(item.tokens)] = item.tokens
+            seq = item.seq
+            if seq.spec_pending and item.tokens == [_SPEC_TOKEN]:
+                # speculative placeholder: its value is the in-flight
+                # latest step's device-side output for this sequence —
+                # the step program substitutes it (no host round-trip)
+                seq.spec_pending -= 1
+                feed_mask[i] = 1
+                feed_idx[i] = self._feed_slot[seq.uid]
+                has_feed = True
+            else:
+                tokens[i, :len(item.tokens)] = item.tokens
             start[i] = item.start_pos
             ntok[i] = len(item.tokens)
-            tables[i, :len(item.seq.kv_blocks)] = item.seq.kv_blocks
-        batch = RaggedBatch(
-            tokens=jax.numpy.asarray(tokens),
-            start_pos=jax.numpy.asarray(start),
-            n_tokens=jax.numpy.asarray(ntok),
-            block_tables=jax.numpy.asarray(tables))
+            tables[i, :len(seq.kv_blocks)] = seq.kv_blocks
         use_greedy = greedy and hasattr(self.runner, "step_greedy")
-        if use_greedy:
+        self.pipeline_stats["plan_s"] += time.perf_counter() - t0
+        return _PlannedStep(sched, tokens, start, ntok, tables,
+                            feed_mask if has_feed else None, feed_idx,
+                            use_greedy)
+
+    def _dispatch_step(self, plan: _PlannedStep) -> _InFlightStep:
+        """DISPATCH: enqueue the compiled step without blocking — the
+        result stays an in-flight device future (JAX async dispatch).
+        A greedy step's [S] token output becomes the device feedback
+        source for the next plan's speculative slots."""
+        t0 = time.perf_counter()
+        jnp = jax.numpy
+        batch = RaggedBatch(
+            tokens=jnp.asarray(plan.tokens),
+            start_pos=jnp.asarray(plan.start),
+            n_tokens=jnp.asarray(plan.ntok),
+            block_tables=jnp.asarray(plan.tables))
+        if plan.feed_mask is not None:
+            result, self._kv_data = self.runner.step_greedy_fb(
+                self.params, self._kv_data, batch, self._feed_src,
+                jnp.asarray(plan.feed_mask), jnp.asarray(plan.feed_idx))
+            self.pipeline_stats["fed_steps"] += 1
+        elif plan.use_greedy:
             result, self._kv_data = self.runner.step_greedy(
                 self.params, self._kv_data, batch)
         else:
             result, self._kv_data = self.runner.step(self.params,
                                                      self._kv_data, batch)
-        result = np.asarray(result)
+        if plan.use_greedy:
+            self._feed_src = result
+            self._feed_slot = {item.seq.uid: i
+                               for i, item in enumerate(plan.sched)}
+        self.pipeline_stats["steps"] += 1
+        self.pipeline_stats["dispatch_s"] += time.perf_counter() - t0
+        return _InFlightStep(plan.sched, result, plan.use_greedy)
+
+    def _commit_step(self, fl: _InFlightStep) -> Tuple[int, Dict[int, Any]]:
+        """COMMIT: apply a step's host readback — in the pipelined loop
+        this runs one (or more) steps behind dispatch, while the next
+        step executes on the device. Used by the put() path only: its
+        steps carry no speculation, so dead slots / rollbacks (the
+        decode_pipelined commit's concern) cannot occur here."""
+        t0 = time.perf_counter()
+        result = np.asarray(fl.result)
+        self.pipeline_stats["commit_block_s"] += time.perf_counter() - t0
         out: Dict[int, Any] = {}
-        for i, item in enumerate(sched):
+        for i, item in enumerate(fl.sched):
             if item.is_last_chunk:
-                out[item.seq.uid] = int(result[i]) if use_greedy \
+                out[item.seq.uid] = int(result[i]) if fl.use_greedy \
                     else result[i]
                 item.seq.status = SequenceStatus.WAITING
-        return len(sched), out
+        return len(fl.sched), out
+
+    def decode_pipelined(self, batch_uids: Sequence[int],
+                         first_tokens: Sequence[int], n,
+                         eos_token_id: Optional[int] = None,
+                         ) -> Dict[int, List[int]]:
+        """Greedy-decode up to ``n`` tokens per uid (int, or a per-uid
+        sequence of budgets) through the overlapped pipeline: host-side
+        planning and token bookkeeping run ``pipeline_depth`` steps ahead
+        of the delayed commit, and each step's input tokens come straight
+        from the previous step's device-resident last-token buffer — the
+        steady decode state pays ZERO host round-trips on its critical
+        path (vs one blocking readback per token in the synchronous loop).
+
+        Scheduling past the newest committed token is SPECULATIVE: when
+        the delayed readback reveals a sequence emitted ``eos_token_id``
+        at step k, its already-dispatched steps k+1.. are killed (their
+        readback discarded, no post-EOS tokens emitted) and the
+        speculation rolled back — token positions retracted and
+        over-allocated KV blocks freed via ``StateManager.trim_blocks``
+        once the last dead step has executed.
+
+        Sequences must have no pending tokens (drain with put() first);
+        returns {uid: emitted tokens}, ending with eos when it fired.
+        The token stream is identical to the synchronous per-step path."""
+        cfg = self.config
+        if len(batch_uids) != len(first_tokens):
+            raise ValueError(
+                f"{len(batch_uids)} uids but {len(first_tokens)} "
+                f"first_tokens")
+        if isinstance(n, (list, tuple)):
+            budgets = {u: int(b) for u, b in zip(batch_uids, n)}
+        else:
+            budgets = {u: int(n) for u in batch_uids}
+        seqs: Dict[int, Any] = {}
+        for uid in batch_uids:
+            seq = self.state.get(uid)
+            if seq is None:
+                raise ValueError(f"unknown sequence {uid}")
+            if seq.in_flight:
+                raise ValueError(f"sequence {uid} has pending tokens; "
+                                 f"drain with put() first")
+            seqs[uid] = seq
+        for uid, seq in self.state.sequences.items():
+            if uid not in budgets and seq.in_flight:
+                raise ValueError(
+                    f"sequence {uid} has pending tokens but is not in "
+                    f"this decode batch")
+        out: Dict[int, List[int]] = {u: [] for u in batch_uids}
+        finished = {u for u in batch_uids if budgets[u] <= 0}
+        inflight_n = {u: 0 for u in batch_uids}
+        spec_src: Dict[int, _InFlightStep] = {}   # uid -> producer step
+        for uid, t in zip(batch_uids, first_tokens):
+            if uid not in finished:
+                self.state.put_tokens(uid, [int(t)])
+        self._feed_src, self._feed_slot = None, {}
+
+        def eligible(seq):
+            # a speculative placeholder may only be scheduled while its
+            # producing step is the latest dispatched one (that step's
+            # output buffer is the feed source); otherwise wait for the
+            # producer's commit to patch in the host value
+            if seq.spec_pending and seq.pending_tokens \
+                    and seq.pending_tokens[0] == _SPEC_TOKEN:
+                return seq.uid in self._feed_slot
+            return True
+
+        def work_left():
+            return any(seqs[u].in_flight for u in budgets
+                       if u not in finished)
+
+        def commit_one(ring):
+            fl = ring.popleft()
+            t0 = time.perf_counter()
+            toks = np.asarray(fl.result)
+            self.pipeline_stats["commit_block_s"] += \
+                time.perf_counter() - t0
+            for i, item in enumerate(fl.sched):
+                seq = item.seq
+                u = seq.uid
+                inflight_n[u] -= 1
+                if spec_src.get(u) is fl:
+                    del spec_src[u]
+                    patch = True
+                else:
+                    patch = False
+                if i in fl.dead:
+                    continue
+                tok = int(toks[i])
+                seq.status = SequenceStatus.WAITING
+                out[u].append(tok)
+                if patch and seq.spec_pending and seq.pending_tokens \
+                        and seq.pending_tokens[0] == _SPEC_TOKEN:
+                    # this step produced the queued placeholder and its
+                    # value is now host-known: feed it by value instead
+                    seq.pending_tokens[0] = tok
+                    seq.spec_pending -= 1
+                if len(out[u]) < budgets[u] and \
+                        (eos_token_id is None or tok != eos_token_id):
+                    continue
+                # stop condition reached on the DELAYED readback: kill
+                # everything that ran (or is queued) speculatively past
+                # it. The queued next-input token — whether still a
+                # placeholder or just patched by value above — exists
+                # only because of speculation: drop it, or the sequence
+                # ends with a stale pending token the sync path never
+                # leaves behind
+                finished.add(u)
+                if seq.pending_tokens:
+                    seq.pending_tokens.pop()
+                    if seq.spec_pending:
+                        seq.spec_pending -= 1
+                    spec_src.pop(u, None)
+                retract, last_fl = 0, None
+                for fl2 in ring:
+                    for j, item2 in enumerate(fl2.sched):
+                        if item2.seq.uid == u and j not in fl2.dead:
+                            fl2.dead.add(j)
+                            retract += 1
+                            last_fl = fl2
+                if retract:
+                    # the dead steps' KV appends still target the blocks
+                    # being retracted — free them only once the last such
+                    # step has executed (its commit)
+                    last_fl.rollbacks.append((seq, retract))
+            for seq, retract in fl.rollbacks:
+                seq.seen_tokens -= retract
+                self.state.trim_blocks(seq)
+
+        def speculate(plan, fl):
+            # speculate the next step: every live sequence scheduled in
+            # this step gets a placeholder token whose value is this
+            # step's (still in-flight) device output. Never past the
+            # sequence's block capacity: the call then returns what fits
+            # and the NEXT call's put_tokens raises the same
+            # 'exceeds max_context' the synchronous path raises
+            for item in plan.sched:
+                seq = item.seq
+                u = seq.uid
+                if u not in budgets or u in finished:
+                    continue
+                inflight_n[u] += 1
+                if len(out[u]) + inflight_n[u] < budgets[u] and \
+                        seq.seen_tokens + seq.in_flight < cfg.max_context:
+                    seq.pending_tokens.append(_SPEC_TOKEN)
+                    seq.spec_pending += 1
+                    spec_src[u] = fl
+
+        self._drive_pipeline(
+            work_left, lambda: self._plan_step(greedy=True,
+                                               eligible=eligible),
+            commit_one, on_dispatch=speculate)
+        self._feed_src, self._feed_slot = None, {}
+        return out
 
     # ------------------------------------------------------------------ #
     # convenience generate loop
@@ -501,6 +835,18 @@ class InferenceEngineV2:
                     for u in list(outs):
                         finish_chunk(u, outs[u])
                     continue
+            if greedy and self.pipeline_depth > 0 \
+                    and hasattr(self.runner, "step_greedy_fb"):
+                # overlapped pipeline tail: per-step decode with device
+                # token feedback — plan/dispatch run ahead, commits (and
+                # EOS detection + rollback) lag by pipeline_depth steps
+                outs = self.decode_pipelined(
+                    lu, [last_tok[u] for u in lu],
+                    [max_new_tokens - len(outputs[u]) for u in lu],
+                    eos_token_id=eos_token_id)
+                for u in lu:
+                    finish_chunk(u, outs[u])
+                continue
             # tails / tiny budgets / truly starved pools: token-at-a-time
             results = self.put(lu, [[last_tok[u]] for u in lu],
                                _greedy=greedy)

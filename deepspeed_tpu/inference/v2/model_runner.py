@@ -432,6 +432,29 @@ class RaggedRunnerBase:
 
         self._step_greedy = jax.jit(_step_greedy)
 
+        # pipelined greedy step with DEVICE token feedback (the overlapped
+        # serving pipeline, engine_v2): fed slots take their input token
+        # from ``prev_tok`` — the previous in-flight step's [S_prev]
+        # last-token output, which never round-trips through the host —
+        # gathered through ``feed_idx`` (this sequence's slot in that
+        # step); unfed slots keep their host-staged token. The
+        # substitution runs on replicated arrays before the (possibly
+        # shard_map-wrapped) step, so TP programs are untouched.
+        # ``kv_data`` is donated on TPU (each step consumes the previous
+        # pool functionally; donation keeps one pool resident instead of
+        # depth+1). prev_tok is NOT donated: the commit phase still reads
+        # its values after the next step dispatches.
+        def _step_greedy_fb(params, kv_data, batch, prev_tok, feed_mask,
+                            feed_idx):
+            fed = prev_tok[jnp.clip(feed_idx, 0, prev_tok.shape[0] - 1)]
+            tok0 = jnp.where(feed_mask > 0, fed, batch.tokens[:, 0])
+            batch = batch._replace(tokens=batch.tokens.at[:, 0].set(tok0))
+            return _step_greedy(params, kv_data, batch)
+
+        donate = (1,) if jax.default_backend() == "tpu" else ()
+        self._step_greedy_fb = jax.jit(_step_greedy_fb,
+                                       donate_argnums=donate)
+
         # fused multi-step greedy decode: n forward+argmax+KV-append steps
         # in ONE device program (lax.scan), feeding each step's token to
         # the next. Per-token host round-trips — the decode wall when the
@@ -614,6 +637,16 @@ class RaggedRunnerBase:
     def step_greedy(self, params, kv_data, batch: "RaggedBatch"):
         """Returns (argmax token ids [S] int32, new kv_data)."""
         return self._step_greedy(params, kv_data, batch)
+
+    def step_greedy_fb(self, params, kv_data, batch: "RaggedBatch",
+                       prev_tok, feed_mask, feed_idx):
+        """Greedy step with device token feedback: slot i's input token is
+        ``prev_tok[feed_idx[i]]`` where ``feed_mask[i]`` is set (the
+        previous step's device-resident last-token buffer), else
+        ``batch.tokens[i, 0]``. Returns (token ids [S] int32, new
+        kv_data)."""
+        return self._step_greedy_fb(params, kv_data, batch, prev_tok,
+                                    feed_mask, feed_idx)
 
     def decode_loop(self, params, kv_data, tok0, start_pos, active,
                     block_tables, n: int, *, key=None, temperature=1.0,

@@ -15,11 +15,18 @@ per-token latency; remaining slots are filled with prefill chunks.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List
+from typing import Callable, List, Optional
 
 from .config import RaggedInferenceConfig
 from .sequence import SequenceDescriptor, SequenceStatus
 from .state_manager import StateManager
+
+#: steps a prefill may wait before it jumps the longest-first queue.
+#: Longest-prefill-first alone starves short prompts under sustained load
+#: (a stream of fresh long prompts always outranks a waiting short one);
+#: once a prefill has waited this many steps it is ordered oldest-first
+#: ahead of the fresh pool, so no waiting prefill is deferred unboundedly.
+PREFILL_AGING_STEPS = 8
 
 
 @dataclass
@@ -35,16 +42,32 @@ class SplitFuseScheduler:
         self.cfg = cfg
         self.state = state
 
-    def schedule(self) -> List[ScheduledSeq]:
-        """Pick up to ``max_seqs`` sequences with pending tokens."""
+    def schedule(self, eligible: Optional[
+            Callable[[SequenceDescriptor], bool]] = None
+            ) -> List[ScheduledSeq]:
+        """Pick up to ``max_seqs`` sequences with pending tokens.
+        ``eligible`` lets the engine veto sequences for this step (the
+        pipelined decode path defers a sequence whose next token is a
+        device-side speculative placeholder that cannot be fed yet)."""
         cfg = self.cfg
         pending = [s for s in self.state.sequences.values()
                    if s.in_flight > 0 and s.status is not SequenceStatus.FINISHED]
-        # decode (1 token) first: latency-bound; then longest prefills first
-        # (they need the most chunks, start them early)
+        if eligible is not None:
+            pending = [s for s in pending if eligible(s)]
+        # decode (1 token) first: latency-bound; then prefills — starved
+        # ones (waited >= PREFILL_AGING_STEPS) oldest-first ahead of the
+        # fresh pool, which stays longest-first (they need the most
+        # chunks, start them early)
+        now = self.state.step
         decode = [s for s in pending if s.in_flight == 1]
+
+        def prefill_key(s):
+            if now - s.last_sched >= PREFILL_AGING_STEPS:
+                return (0, s.last_sched, -s.in_flight)
+            return (1, -s.in_flight, s.last_sched)
+
         prefill = sorted((s for s in pending if s.in_flight > 1),
-                         key=lambda s: -s.in_flight)
+                         key=prefill_key)
         out: List[ScheduledSeq] = []
         # Dynamic-SplitFuse forward budget: decode rows always fit (1 token
         # each, latency-bound); prefill chunks fill — and SPLIT mid-chunk —

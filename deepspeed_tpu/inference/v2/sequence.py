@@ -31,6 +31,17 @@ class SequenceDescriptor:
     host_kv: object = None                # offloaded KV (engine.pause)
     paused_blocks: int = 0                # block count captured at pause()
     last_step: int = 0                    # engine step last scheduled (LRU)
+    # scheduler-clock stamp (one tick per scheduler invocation — unlike
+    # last_step, whose engine-step clock jumps by n per fused decode_batch
+    # call): what prefill AGING measures waiting time against
+    last_sched: int = 0
+    # pipelined serving (engine serve_pipeline_depth > 0): number of
+    # SPECULATIVE placeholder tokens in pending_tokens whose value is
+    # still on the device (a prior step's in-flight last-token buffer).
+    # The scheduler may only pop one while its producing step is the
+    # latest dispatched step (device feedback); otherwise the commit of
+    # the producing step patches the placeholder with the real value.
+    spec_pending: int = 0
 
     @property
     def in_flight(self) -> int:

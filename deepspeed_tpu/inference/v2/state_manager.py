@@ -20,12 +20,19 @@ class StateManager:
         self.cfg = cfg
         self.kv_cache = kv_cache
         self._seqs: Dict[int, SequenceDescriptor] = {}
+        # scheduler clock: ONE tick per scheduler invocation (bumped by
+        # the engine's plan phase — deliberately NOT the engine step
+        # counter, which decode_batch advances by n per fused call and
+        # would instantly "age" every waiting prefill). New sequences
+        # stamp their arrival here so aging measures real waiting time.
+        self.step: int = 0
 
     # ------------------------------------------------------------------ #
 
     def get_or_create(self, uid: int) -> SequenceDescriptor:
         if uid not in self._seqs:
-            self._seqs[uid] = SequenceDescriptor(uid=uid)
+            self._seqs[uid] = SequenceDescriptor(uid=uid,
+                                                 last_sched=self.step)
         return self._seqs[uid]
 
     def get(self, uid: int) -> Optional[SequenceDescriptor]:
@@ -70,6 +77,19 @@ class StateManager:
                     f"sequence {seq.uid} exceeds max_blocks_per_seq "
                     f"({self.cfg.max_blocks_per_seq})")
             seq.kv_blocks.extend(self.kv_cache.reserve(need))
+
+    def trim_blocks(self, seq: SequenceDescriptor) -> int:
+        """Free KV blocks beyond what ``seq.seen_tokens`` needs — the
+        rollback half of speculative pipelined decode: when the delayed
+        host readback reveals a sequence finished (EOS) at step k, the
+        blocks its speculatively scheduled steps k+1.. over-allocated are
+        returned to the pool. Returns the number of blocks freed."""
+        needed = -(-seq.seen_tokens // self.cfg.block_size)
+        extra = seq.kv_blocks[needed:]
+        if extra:
+            del seq.kv_blocks[needed:]
+            self.kv_cache.free(extra)
+        return len(extra)
 
     def kv_memory_report(self) -> Dict[str, int]:
         """Serving-memory self-description: total KV-pool bytes, the bytes

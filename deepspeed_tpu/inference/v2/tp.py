@@ -70,6 +70,13 @@ TP_LMHEAD_PATTERNS = (r"lm_head", r"embed_out")
 POOL_DATA_SPEC = P(None, None, None, MODEL_AXIS)
 POOL_SCALE_SPEC = P(None, None, MODEL_AXIS, None)
 RING_SPEC = P(None, None, None, None, MODEL_AXIS)
+# The overlapped pipeline's feedback operands (prev-step [S] last-token
+# buffer + feed mask/idx) carry NO spec here: every chip computed
+# identical full-width logits before argmax (tp_gather_logits), so the
+# fed token is already chip-consistent and the substitution runs as
+# plain replicated ops OUTSIDE the shard_map region
+# (model_runner._step_greedy_fb) — the pipelined path adds ZERO
+# collectives over the sync TP step.
 
 
 def _quant_leaf_types():

@@ -1214,6 +1214,286 @@ class TestSeqLenBoundedGroupedReads:
                                        atol=2e-5, rtol=1e-4)
 
 
+class TestServePipeline:
+    """ISSUE 3 tentpole: the overlapped plan/dispatch/commit serving
+    pipeline (``serve_pipeline_depth``). Greedy decode through the
+    pipelined loop — host planning running ahead, device token feedback
+    (``step_greedy_fb``), commits one step behind — must be
+    TOKEN-IDENTICAL to the synchronous depth-0 oracle, and a late EOS
+    must kill the speculative steps (no post-EOS tokens, retracted
+    positions, freed KV blocks)."""
+
+    @staticmethod
+    def _depth(cfg, depth, **kw):
+        return RaggedInferenceConfig(**{**cfg.__dict__,
+                                        "serve_pipeline_depth": depth,
+                                        **kw})
+
+    def test_put_prefill_logits_match_sync(self):
+        # chunked prefill with chunks of ONE sequence spanning in-flight
+        # steps (device-ordered through the KV-pool data dependence)
+        cfg, mcfg, model, params = _tiny_setup(chunk=8)
+        rng = np.random.default_rng(51)
+        prompts = {0: rng.integers(1, 96, 21).tolist(),
+                   1: rng.integers(1, 96, 7).tolist(),
+                   2: rng.integers(1, 96, 16).tolist()}
+        ref = InferenceEngineV2(mcfg, params, self._depth(cfg, 0)).put(
+            list(prompts), list(prompts.values()))
+        got = InferenceEngineV2(mcfg, params, self._depth(cfg, 2)).put(
+            list(prompts), list(prompts.values()))
+        for uid in prompts:
+            np.testing.assert_allclose(got[uid], ref[uid],
+                                       atol=2e-4, rtol=2e-4)
+
+    @pytest.mark.parametrize(
+        "depth", [2, pytest.param(3, marks=pytest.mark.slow)])
+    def test_generate_token_identical_gpt2(self, depth):
+        cfg, mcfg, model, params = _tiny_setup()
+        rng = np.random.default_rng(52)
+        prompts = [rng.integers(1, 96, 9).tolist() for _ in range(3)]
+        ref = InferenceEngineV2(
+            mcfg, params,
+            self._depth(cfg, 0, decode_loop_steps=0)).generate(
+                prompts, max_new_tokens=8)
+        eng = InferenceEngineV2(
+            mcfg, params, self._depth(cfg, depth, decode_loop_steps=0))
+        got = eng.generate(prompts, max_new_tokens=8)
+        assert got == ref
+        # the steady decode state really fed tokens device-side
+        assert eng.pipeline_stats["fed_steps"] > 0
+        # and with EOS forced mid-stream (late detection + rollback path)
+        eos = ref[0][3]
+        ref_eos = InferenceEngineV2(
+            mcfg, params,
+            self._depth(cfg, 0, decode_loop_steps=0)).generate(
+                prompts, max_new_tokens=8, eos_token_id=eos)
+        eng2 = InferenceEngineV2(
+            mcfg, params, self._depth(cfg, depth, decode_loop_steps=0))
+        got_eos = eng2.generate(prompts, max_new_tokens=8,
+                                eos_token_id=eos)
+        assert got_eos == ref_eos
+        assert eng2.free_blocks == cfg.num_blocks   # rollback + flush clean
+
+    @pytest.mark.slow
+    def test_generate_token_identical_llama(self):
+        from deepspeed_tpu.models.llama import Llama, LlamaConfig
+        mcfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="xla")
+        model = Llama(mcfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        cfg = RaggedInferenceConfig(max_seqs=2, chunk_size=8, block_size=4,
+                                    num_blocks=64, max_blocks_per_seq=16,
+                                    dtype="float32", decode_loop_steps=0)
+        prompts = [list(np.random.default_rng(53).integers(1, 500, 9))]
+        ref = InferenceEngineV2(mcfg, params, self._depth(cfg, 0)).generate(
+            prompts, max_new_tokens=6)
+        got = InferenceEngineV2(mcfg, params, self._depth(cfg, 2)).generate(
+            prompts, max_new_tokens=6)
+        assert got == ref
+
+    @pytest.mark.slow
+    def test_generate_token_identical_woq(self):
+        # WOQ int8 weights: the SAME quantized params through both paths
+        # must stay token-exact (dequant-in-jit is shared)
+        from deepspeed_tpu.inference.quantization import \
+            quantize_model_params
+        from deepspeed_tpu.models.llama import Llama, LlamaConfig
+        mcfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="xla")
+        model = Llama(mcfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        qparams = quantize_model_params(params, {"quantized_weights": {
+            "enabled": True, "num_bits": 8, "group_size": 64,
+            "modules": ["proj"]}})
+        cfg = RaggedInferenceConfig(max_seqs=2, chunk_size=8, block_size=4,
+                                    num_blocks=64, max_blocks_per_seq=16,
+                                    dtype="float32", decode_loop_steps=0)
+        prompts = [list(np.random.default_rng(54).integers(1, 500, 9))]
+        ref = InferenceEngineV2(mcfg, qparams,
+                                self._depth(cfg, 0)).generate(
+            prompts, max_new_tokens=5)
+        got = InferenceEngineV2(mcfg, qparams,
+                                self._depth(cfg, 2)).generate(
+            prompts, max_new_tokens=5)
+        assert got == ref
+
+    @pytest.mark.slow
+    def test_tp2_pipelined_token_identical(self):
+        # the pipelined path under the PR 2 shard_map programs: the fb
+        # step's replicated feed buffers + head-sharded pool, tp=2 on the
+        # CPU mesh, token-identical to the single-chip sync oracle
+        mcfg, model, params, base = _tp_setup()
+        base = {**base, "decode_loop_steps": 0}
+        rng = np.random.default_rng(61)
+        prompts = [rng.integers(1, 96, 9).tolist() for _ in range(2)]
+        ref = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base, serve_pipeline_depth=0)).generate(
+                prompts, max_new_tokens=6)
+        eng = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base, serve_pipeline_depth=2, tp_size=2))
+        got = eng.generate(prompts, max_new_tokens=6)
+        assert got == ref
+        assert eng.pipeline_stats["fed_steps"] > 0
+
+    def test_eos_step_boundary_rollback(self):
+        # EOS lands while speculative steps are in flight: the delayed
+        # readback must kill them — no post-EOS tokens, seen_tokens
+        # retracted, and the over-allocated KV block(s) freed back to the
+        # pool via StateManager.trim_blocks (block_size=1 makes every
+        # speculative token allocate — and rollback free — a real block)
+        cfg, mcfg, model, params = _tiny_setup(
+            block_size=1, num_blocks=64, max_blocks_per_seq=32)
+        cfg = RaggedInferenceConfig(**{**cfg.__dict__,
+                                       "attention_impl": "dense",
+                                       "decode_loop_steps": 0})
+        prompt = list(np.random.default_rng(55).integers(1, 96, 10))
+        eng0 = InferenceEngineV2(mcfg, params, self._depth(cfg, 0))
+        f0 = eng0.put([0], [prompt], _greedy=True)
+        chain = eng0.decode_pipelined([0], [f0[0]], 8)[0]
+        eos = chain[2]
+        k = chain.index(eos)                 # first occurrence
+        eng = InferenceEngineV2(mcfg, params, self._depth(cfg, 2))
+        first = eng.put([0], [prompt], _greedy=True)
+        trims = {"n": 0, "freed": 0}
+        orig_trim = eng.state.trim_blocks
+
+        def counting_trim(seq):
+            freed = orig_trim(seq)
+            trims["n"] += 1
+            trims["freed"] += freed
+            return freed
+        eng.state.trim_blocks = counting_trim
+        out = eng.decode_pipelined([0], [first[0]], 8, eos_token_id=eos)[0]
+        assert out == chain[:k + 1]          # truncated AT eos, nothing after
+        seq = eng.state.sequences[0]
+        # fed tokens: first + out[:-1] -> prompt + k + 1 settled positions
+        assert seq.seen_tokens == len(prompt) + k + 1
+        assert len(seq.kv_blocks) == seq.seen_tokens   # block_size=1
+        # speculative blocks went BACK to the pool before flush
+        assert eng.free_blocks == cfg.num_blocks - len(seq.kv_blocks)
+        assert trims["n"] >= 1 and trims["freed"] >= 1
+
+    @pytest.mark.parametrize("depth", [1, 2])
+    def test_eos_leaves_no_pending_tokens(self, depth):
+        # at depth 1 every placeholder is PATCHED by value at its
+        # producer's commit before EOS is seen — the finish path must
+        # drop the patched token too, or the sequence ends with a stale
+        # in_flight token the sync path never leaves (and the next
+        # decode_pipelined call on the engine rejects the batch)
+        cfg, mcfg, model, params = _tiny_setup()
+        cfg = self._depth(cfg, depth, decode_loop_steps=0)
+        eng = InferenceEngineV2(mcfg, params, cfg)
+        prompt = list(np.random.default_rng(56).integers(1, 96, 9))
+        first = eng.put([0], [prompt], _greedy=True)
+        chain = eng.decode_pipelined([0], [first[0]], 6)[0]
+        eng.flush(0)
+        eos = chain[1]
+        k = chain.index(eos)
+        first = eng.put([0], [prompt], _greedy=True)
+        out = eng.decode_pipelined([0], [first[0]], 6, eos_token_id=eos)
+        assert out[0] == chain[:k + 1]
+        seq = eng.state.sequences[0]
+        assert seq.in_flight == 0 and seq.spec_pending == 0
+        # the engine is immediately reusable for the same uid
+        out2 = eng.decode_pipelined([0], [out[0][-1]], 2)
+        assert len(out2[0]) == 2
+
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_context_overflow_raises_like_sync(self, depth):
+        # speculation must stop at the sequence's block capacity: decode
+        # past max_context surfaces the same ValueError the synchronous
+        # path raises (not a pause/resume livelock or a misleading
+        # pool-too-small error)
+        cfg, mcfg, model, params = _tiny_setup(
+            block_size=4, max_blocks_per_seq=4)       # max_context = 16
+        cfg = self._depth(cfg, depth, decode_loop_steps=0)
+        eng = InferenceEngineV2(mcfg, params, cfg)
+        prompt = list(np.random.default_rng(58).integers(1, 96, 9))
+        with pytest.raises(ValueError, match="max_context"):
+            eng.generate([prompt], max_new_tokens=20)
+
+    def test_staging_buffers_reused(self):
+        # satellite: per-(S, C) staging arrays are allocated once and
+        # rotated, not re-created every step
+        cfg, mcfg, model, params = _tiny_setup()
+        eng = InferenceEngineV2(mcfg, params, self._depth(cfg, 2))
+        eng.put([0], [[1, 2, 3]], _greedy=True)
+        eng.put([0], [[4]], _greedy=True)
+        eng.put([0], [[5]], _greedy=True)
+        key = next(k for k in eng._staging if k[1] == 1)   # decode bucket
+        sets = eng._staging[key]["sets"]
+        assert len(sets) == 3                # depth 2 -> depth + 1 sets
+        ids = [id(a) for s in sets for a in s]
+        eng.put([0], [[6]], _greedy=True)
+        eng.put([0], [[7]], _greedy=True)
+        sets2 = eng._staging[key]["sets"]
+        assert [id(a) for s in sets2 for a in s] == ids
+
+
+class TestSchedulerAging:
+    """Satellite: longest-prefill-first starves short prompts under
+    sustained load — the ``seq.last_step`` aging tie-break bounds how
+    long any waiting prefill can be deferred."""
+
+    def test_short_prefill_not_starved(self):
+        from deepspeed_tpu.inference.v2.scheduler import PREFILL_AGING_STEPS
+        cfg = RaggedInferenceConfig(
+            max_seqs=2, chunk_size=8, block_size=4, num_blocks=512,
+            max_blocks_per_seq=64, dtype="float32", max_batch_tokens=8)
+        kv = BlockedKVCache(cfg, 2, 2, 16, jnp.float32)
+        sm = StateManager(cfg, kv)
+        sched = SplitFuseScheduler(cfg, sm)
+        sm.put_tokens(1000, range(4))        # the short prompt, waiting
+        scheduled_at = None
+        for step in range(1, 4 * PREFILL_AGING_STEPS):
+            # sustained load: a fresh LONG prompt arrives every step and
+            # always outranks the short one on pure longest-first
+            sm.put_tokens(step, range(16))
+            sm.step = step
+            items = sched.schedule()
+            for it in items:
+                it.seq.last_sched = step
+            if any(it.seq.uid == 1000 for it in items):
+                scheduled_at = step
+                break
+        assert scheduled_at is not None, "short prefill starved forever"
+        assert scheduled_at <= PREFILL_AGING_STEPS + 2
+
+    def test_fused_decode_batch_does_not_fake_age_prefills(self):
+        # decode_batch advances the ENGINE step clock by n per fused
+        # call; the scheduler's aging clock must tick once per schedule()
+        # or a single 64-token fused call would instantly "age" every
+        # waiting prefill and longest-first would never apply
+        cfg, mcfg, model, params = _tiny_setup(max_seqs=4, chunk=8)
+        cfg = RaggedInferenceConfig(**{**cfg.__dict__,
+                                       "decode_loop_steps": 16})
+        eng = InferenceEngineV2(mcfg, params, cfg)
+        rng = np.random.default_rng(57)
+        first = eng.put([0], [rng.integers(1, 96, 5).tolist()],
+                        _greedy=True)
+        eng.decode_batch([0], [first[0]], 16)     # jumps _step_counter
+        assert eng.state.step < 16                # scheduler clock did not
+        # two fresh prefills after the fused call: still longest-first
+        eng.state.put_tokens(10, range(6))
+        eng.state.put_tokens(11, range(20))
+        items = eng.scheduler.schedule()
+        pre = [it.seq.uid for it in items if it.seq.uid in (10, 11)]
+        assert pre == [11, 10]
+
+    def test_fresh_prefills_stay_longest_first(self):
+        cfg = RaggedInferenceConfig(
+            max_seqs=4, chunk_size=8, block_size=4, num_blocks=64,
+            max_blocks_per_seq=16, dtype="float32")
+        kv = BlockedKVCache(cfg, 2, 2, 16, jnp.float32)
+        sm = StateManager(cfg, kv)
+        sched = SplitFuseScheduler(cfg, sm)
+        sm.put_tokens(1, range(5))
+        sm.put_tokens(2, range(20))
+        sm.put_tokens(3, range(11))
+        items = sched.schedule()
+        assert [it.seq.uid for it in items] == [2, 3, 1]
+
+
 class TestEvoformerFullyMasked:
     """Rows whose mask bias is -inf across every key (padded MSA rows)
     must produce 0 output — not NaN — on BOTH the flash kernel and the

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Round-7 on-chip sequence: first TPU contact for the overlapped serving
+# pipeline (ISSUE 3). Strictly sequential (one process owns the chip), no
+# timeouts around TPU clients (a killed client wedges the grant).
+cd /root/repo || exit 1
+LOG=profiles/r07_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round7 start $(date -u +%FT%TZ)"
+
+echo "--- [1/5] tpu_smoke (incl. async_parity: depth-2 pipeline vs sync"
+echo "    oracle, on-chip token match through step_greedy_fb + donation)"
+python tools/tpu_smoke.py | tee SMOKE_TPU_r07.txt
+
+echo "--- [2/5] serve_pipeline bench: sync vs pipelined steps/s + the"
+echo "    host-gap/overlap metric, on the 1.1B llama shape"
+python bench.py serve_pipeline > BENCH_PIPE_r07.json
+tail -c 600 BENCH_PIPE_r07.json
+
+echo "--- [3/5] serve_pipeline at depth 4 (does deeper overlap still"
+echo "    help once the host gap is hidden?)"
+DSTPU_SERVE_ASYNC=4 python bench.py serve_pipeline > BENCH_PIPE_D4_r07.json
+tail -c 600 BENCH_PIPE_D4_r07.json
+
+echo "--- [4/5] serve bench control (pipelined engine default, int8 KV)"
+python bench.py serve > BENCH_SERVE_r07.json
+tail -c 400 BENCH_SERVE_r07.json
+
+echo "--- [5/5] full bench (driver runs it again at round end)"
+python bench.py > BENCH_SELF_r07.json
+tail -c 700 BENCH_SELF_r07.json
+echo "=== tpu_round7 done $(date -u +%FT%TZ)"

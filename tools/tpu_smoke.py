@@ -244,6 +244,39 @@ def main():
     else:
         print("SKIP tp_paged_decode (single chip)", flush=True)
 
+    # async parity (ISSUE 3): the overlapped serving pipeline — depth-2
+    # plan/dispatch/commit with device token feedback (step_greedy_fb
+    # COMPILED on chip, KV-pool donation active on TPU) — must be
+    # token-identical to the synchronous depth-0 oracle, on chip.
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceConfig)
+    from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+    mcfg_a = GPT2Config(vocab_size=512, max_seq_len=512, num_layers=2,
+                        num_heads=8, hidden_size=512, dtype=jnp.bfloat16)
+    params_a = GPT2(mcfg_a).init(jax.random.PRNGKey(11),
+                                 jnp.zeros((1, 8), jnp.int32))["params"]
+    base_a = dict(max_seqs=4, chunk_size=32, block_size=128, num_blocks=8,
+                  max_blocks_per_seq=2, dtype="bfloat16",
+                  attention_impl="paged_flash", decode_loop_steps=0)
+    rng_a = np.random.RandomState(13)   # one RNG: DISTINCT prompts per
+    prompts_a = [rng_a.randint(1, 512, size=17).tolist()  # slot, so a
+                 for _ in range(4)]     # feed_idx permutation bug cannot
+                                        # hide behind identical sequences
+    ref_a = InferenceEngineV2(
+        mcfg_a, params_a,
+        RaggedInferenceConfig(**base_a, serve_pipeline_depth=0)).generate(
+            prompts_a, max_new_tokens=16)
+    eng_a = InferenceEngineV2(
+        mcfg_a, params_a,
+        RaggedInferenceConfig(**base_a, serve_pipeline_depth=2))
+    got_a = eng_a.generate(prompts_a, max_new_tokens=16)
+    par_a = got_a == ref_a
+    fed_a = eng_a.pipeline_stats["fed_steps"]
+    ok &= par_a and fed_a > 0
+    print(f"{'OK ' if par_a and fed_a > 0 else 'FAIL'} async_parity: "
+          f"depth2 token_parity={par_a} device_fed_steps={fed_a}",
+          flush=True)
+
     print("TPU_SMOKE " + ("PASS" if ok else "FAIL"), flush=True)
     return 0 if ok else 1
 
