@@ -473,26 +473,36 @@ def bench_serve_pipeline():
                 return orig_plan(*a, **kw)
             eng._plan_step = costly_plan
         stats0 = dict(eng.pipeline_stats)
+        # recompile tripwire (analysis/program_audit.py): a jit cache
+        # miss inside the measured warm run is a silent latency cliff —
+        # surface it in the row instead of averaging it away
+        from deepspeed_tpu.analysis import RecompileTripwire
+        tw = RecompileTripwire()
         t0 = time.perf_counter()
-        outs = eng.decode_pipelined(uids, last, GEN)
+        with tw:
+            outs = eng.decode_pipelined(uids, last, GEN)
         dt = time.perf_counter() - t0
         commit_block = eng.pipeline_stats["commit_block_s"] \
             - stats0["commit_block_s"]
         fed = eng.pipeline_stats["fed_steps"] - stats0["fed_steps"]
         for u in uids:
             eng.flush(u)
-        return outs, dt, commit_block, fed
+        # None (not 0) when this jax build cannot count compiles — an
+        # unverified run must not read as a verified zero-recompile run
+        return outs, dt, commit_block, fed, \
+            tw.fresh_compiles if tw.available else None
 
     # device-only step time calibrates the synthetic host cost: the
     # default host gap equals one device step (the regime where overlap
     # can reach 2x and a blocking loop pays full price)
-    _, dt_dev, _, _ = run(0, 0.0)
+    _, dt_dev, _, _, _ = run(0, 0.0)
     dev_step = dt_dev / GEN
     host_ms = os.environ.get("DSTPU_PIPE_HOSTMS")
     host_cost = float(host_ms) / 1e3 if host_ms else dev_step
 
-    sync_out, t_sync, sync_block, _ = run(0, host_cost)
-    pipe_out, t_pipe, pipe_block, pipe_fed = run(depth, host_cost)
+    sync_out, t_sync, sync_block, _, sync_compiles = run(0, host_cost)
+    pipe_out, t_pipe, pipe_block, pipe_fed, pipe_compiles = \
+        run(depth, host_cost)
     parity = sync_out == pipe_out
     # parity is only evidence if the streams actually vary — all-equal
     # tokens (degenerate weights) would make the check vacuous
@@ -510,12 +520,14 @@ def bench_serve_pipeline():
             "decode_steps_per_sec": round(GEN / t_sync, 2),
             "decode_tokens_per_sec": round(S * GEN / t_sync, 1),
             "commit_block_s": round(sync_block, 3),
+            "fresh_compiles_measured": sync_compiles,
         },
         "pipelined": {
             "decode_steps_per_sec": round(GEN / t_pipe, 2),
             "decode_tokens_per_sec": round(S * GEN / t_pipe, 1),
             "commit_block_s": round(pipe_block, 3),
             "device_fed_steps": pipe_fed,
+            "fresh_compiles_measured": pipe_compiles,
         },
         "speedup": round(t_sync / t_pipe, 3),
         "host_gap_hidden_frac": round(hidden / (GEN * host_cost), 3)

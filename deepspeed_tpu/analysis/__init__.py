@@ -1,0 +1,18 @@
+"""Static analysis of compiled programs (docs/analysis.md).
+
+:mod:`.program_audit` lowers jitted / shard_mapped programs and verifies
+their collective structure, donation and host-sync hygiene against
+declarative budgets; the companion repo linter is ``tools/dslint.py``
+(``bin/dstpu_lint``).
+"""
+
+from .program_audit import (CollectiveBudget, CollectiveSite, ProgramReport,
+                            RecompileTripwire, assert_budget,
+                            audit_fn, audit_serve_programs,
+                            donated_arg_indices)
+
+__all__ = [
+    "CollectiveBudget", "CollectiveSite", "ProgramReport",
+    "RecompileTripwire", "assert_budget", "audit_fn",
+    "audit_serve_programs", "donated_arg_indices",
+]

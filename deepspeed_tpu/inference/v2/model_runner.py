@@ -421,7 +421,13 @@ class RaggedRunnerBase:
         if tp is not None:
             _step = self._wrap(_step, (pspecs, pool_spec, batch_spec),
                                (P(), pool_spec))
-        self._step = jax.jit(_step)
+        # every step program consumes the previous KV pool functionally
+        # and the engine rebinds its handle to the output, so on TPU the
+        # pool argument is donated (aliased in place — one pool resident
+        # instead of two). CPU XLA implements no donation: an empty tuple
+        # keeps the test mesh free of donation-unimplemented warnings.
+        donate = (1,) if jax.default_backend() == "tpu" else ()
+        self._step = jax.jit(_step, donate_argnums=donate)
         # greedy decode variant: argmax fused into the jit so a decode step
         # returns [S] int32 token ids instead of shipping [S, V] f32 logits
         # to the host (the reference's host-side sampler reads full logits;
@@ -430,7 +436,7 @@ class RaggedRunnerBase:
             logits, kv_out = _step(params, kv_data, batch)
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv_out
 
-        self._step_greedy = jax.jit(_step_greedy)
+        self._step_greedy = jax.jit(_step_greedy, donate_argnums=donate)
 
         # pipelined greedy step with DEVICE token feedback (the overlapped
         # serving pipeline, engine_v2): fed slots take their input token
@@ -440,10 +446,9 @@ class RaggedRunnerBase:
         # step); unfed slots keep their host-staged token. The
         # substitution runs on replicated arrays before the (possibly
         # shard_map-wrapped) step, so TP programs are untouched.
-        # ``kv_data`` is donated on TPU (each step consumes the previous
-        # pool functionally; donation keeps one pool resident instead of
-        # depth+1). prev_tok is NOT donated: the commit phase still reads
-        # its values after the next step dispatches.
+        # ``kv_data`` is donated on TPU like the other step programs;
+        # prev_tok is NOT donated: the commit phase still reads its
+        # values after the next step dispatches.
         def _step_greedy_fb(params, kv_data, batch, prev_tok, feed_mask,
                             feed_idx):
             fed = prev_tok[jnp.clip(feed_idx, 0, prev_tok.shape[0] - 1)]
@@ -451,7 +456,6 @@ class RaggedRunnerBase:
             batch = batch._replace(tokens=batch.tokens.at[:, 0].set(tok0))
             return _step_greedy(params, kv_data, batch)
 
-        donate = (1,) if jax.default_backend() == "tpu" else ()
         self._step_greedy_fb = jax.jit(_step_greedy_fb,
                                        donate_argnums=donate)
 
@@ -562,6 +566,9 @@ class RaggedRunnerBase:
                     (P(), ring_spec, P()))
             return impl(params, kv_data, tok0, start, active, tables, key)
 
+        # dslint: allow(DSL002): the pool is strictly READ-ONLY inside
+        # the fused loop (fresh K/V rides the small ring carry);
+        # _flush_ring consumes — and donates — the pool right after
         self._decode_loop_ring = jax.jit(
             _decode_loop_ring,
             static_argnames=("n", "mode", "top_k", "cand", "temp", "top_p",

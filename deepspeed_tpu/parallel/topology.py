@@ -37,6 +37,18 @@ from ..utils.logging import log_dist
 
 AXIS_NAMES = ("pipe", "data", "expert", "seq", "model")
 
+#: role of each mesh axis — the program auditor
+#: (``analysis/program_audit.py``) labels collectives with these so a
+#: budget-violation diff names what the unexpected comm was for
+AXIS_ROLES = {
+    "pipe": "pipeline-stage neighbor comm",
+    "data": "data-parallel / ZeRO grad+param comm",
+    "data_inner": "ZeRO++ hpZ / MiCS shard-group comm",
+    "expert": "MoE expert-parallel dispatch",
+    "seq": "Ulysses/ring sequence-parallel comm",
+    "model": "tensor-parallel partial-sum comm",
+}
+
 #: canonical name of the batch-sharded mesh axes (ZeRO shards over these)
 DATA_AXES = ("data",)
 

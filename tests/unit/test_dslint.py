@@ -1,0 +1,254 @@
+"""dslint (ISSUE 4): the DSTPU-specific repo linter (tools/dslint.py,
+bin/dstpu_lint) — rule unit tests on synthetic trees plus the tier-1
+enforcement point: the real repo must lint clean, including the
+docs/CONFIG.md env-knob table (DSL004/DSL005 knob drift)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import dslint  # noqa: E402
+
+
+def _write(root, rel, text):
+    path = os.path.join(root, rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(textwrap.dedent(text))
+    return path
+
+
+class TestRepoClean:
+    """The enforcement point: every future PR runs this in tier-1."""
+
+    def test_deepspeed_tpu_lints_clean(self):
+        findings = dslint.lint(["deepspeed_tpu"], repo_root=REPO)
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+    def test_config_md_knob_table_current(self):
+        # DSL004/DSL005 both directions: the generated env-knob table in
+        # docs/CONFIG.md matches the scanned DSTPU_* read sites exactly
+        with open(os.path.join(REPO, "docs", "CONFIG.md")) as f:
+            documented = {k for k, _ in dslint.documented_knobs(f.read())}
+        read = {r.name for r in dslint.scan_env_knobs(REPO)}
+        assert documented == read, (
+            f"docs/CONFIG.md knob table drifted — run "
+            f"tools/gen_config_doc.py (undocumented: "
+            f"{sorted(read - documented)}, stale: "
+            f"{sorted(documented - read)})")
+
+    def test_knob_scan_finds_known_knobs(self):
+        names = {r.name for r in dslint.scan_env_knobs(REPO)}
+        # spot-check knobs of three different subsystems
+        assert "DSTPU_SERVE_ASYNC" in names
+        assert "DSTPU_FAULT_SITE" in names
+        assert "DSTPU_BENCH_TP" in names
+        assert len(names) >= 60
+
+
+class TestCLI:
+    def test_exit_zero_and_clean_on_repo(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "dstpu_lint"),
+             "deepspeed_tpu"], capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "clean" in proc.stdout
+
+    def test_exit_nonzero_with_rule_id_file_line_format(self, tmp_path):
+        bad = _write(str(tmp_path), "deepspeed_tpu/inference/v2/x.py", """
+            import jax
+            f = jax.jit(lambda x: x)
+        """)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "dstpu_lint"),
+             bad, "--no-knob-rules", "--root", str(tmp_path)],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 1
+        # `rule-id file:line message` findings format
+        first = proc.stdout.splitlines()[0]
+        assert first.startswith("DSL002 ")
+        assert ":3 " in first
+
+    def test_list_rules(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "dstpu_lint"),
+             "--list-rules"], capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0
+        for rid in ("DSL001", "DSL002", "DSL003", "DSL004", "DSL005"):
+            assert rid in proc.stdout
+
+
+class TestHostSyncRule:
+    HOT = {"hot.py": ("plan", "_build")}
+
+    def _lint(self, root):
+        return dslint.lint(["hot.py"], repo_root=root,
+                           hot_paths=self.HOT, knob_rules=False)
+
+    def test_flags_all_sync_forms_in_hot_path_only(self, tmp_path):
+        _write(str(tmp_path), "hot.py", """
+            import numpy as np
+            import jax
+            import jax.numpy as jnp
+
+            def plan(x, res):
+                a = np.asarray(res)              # DSL001
+                b = res.block_until_ready()      # DSL001
+                c = jax.device_get(res)          # DSL001
+                d = int(res[0])                  # DSL001 (scalar coerce)
+                e = res.item()                   # DSL001
+                ok = jnp.asarray(x)              # host->device: fine
+                n = int("7")                     # literal: fine
+                return a, b, c, d, e, ok, n
+
+            def commit(res):
+                return np.asarray(res)           # not registered: fine
+        """)
+        findings = self._lint(str(tmp_path))
+        assert [f.rule for f in findings] == ["DSL001"] * 5
+        assert all("plan" in f.message for f in findings)
+
+    def test_nested_defs_covered(self, tmp_path):
+        _write(str(tmp_path), "hot.py", """
+            import numpy as np
+
+            def _build(self):
+                def inner(res):
+                    return np.asarray(res)
+                return inner
+        """)
+        assert [f.rule for f in self._lint(str(tmp_path))] == ["DSL001"]
+
+    def test_allow_comment_on_any_statement_line(self, tmp_path):
+        # the suppression contract: an allow-comment on ANY line of the
+        # flagged (multi-line) call works, not just the first
+        _write(str(tmp_path), "hot.py", """
+            import numpy as np
+
+            def plan(res):
+                return np.asarray(
+                    res)  # dslint: allow(DSL001): commit-side readback
+        """)
+        assert self._lint(str(tmp_path)) == []
+
+
+class TestDonationRule:
+    def _lint(self, root):
+        return dslint.lint(["deepspeed_tpu/inference/v2"], repo_root=root,
+                           knob_rules=False)
+
+    def test_flags_undonated_jit_only_in_v2(self, tmp_path):
+        _write(str(tmp_path), "deepspeed_tpu/inference/v2/r.py", """
+            import jax
+            good = jax.jit(lambda kv: kv, donate_argnums=(0,))
+            named = jax.jit(lambda kv: kv, donate_argnames=("kv",))
+            empty = jax.jit(lambda kv: kv, donate_argnums=())  # explicit
+            bad = jax.jit(lambda kv: kv)
+        """)
+        _write(str(tmp_path), "deepspeed_tpu/runtime/t.py", """
+            import jax
+            outside_v2 = jax.jit(lambda x: x)
+        """)
+        findings = dslint.lint(["deepspeed_tpu"], repo_root=str(tmp_path),
+                               knob_rules=False)
+        assert len(findings) == 1
+        assert findings[0].rule == "DSL002"
+        assert findings[0].line == 6
+
+    def test_allow_comment_suppresses_with_justification(self, tmp_path):
+        _write(str(tmp_path), "deepspeed_tpu/inference/v2/r.py", """
+            import jax
+            # dslint: allow(DSL002): pool is read-only inside the scan
+            a = jax.jit(lambda kv: kv)
+            b = jax.jit(  # dslint: allow(DSL002): result cached
+                lambda kv: kv)
+            c = jax.jit(lambda kv: kv)   # unjustified -> flagged
+        """)
+        findings = self._lint(str(tmp_path))
+        assert [(f.rule, f.line) for f in findings] == [("DSL002", 7)]
+
+
+class TestShardMapImportRule:
+    def test_flags_every_import_form_except_jax_compat(self, tmp_path):
+        _write(str(tmp_path), "deepspeed_tpu/a.py", """
+            from jax.experimental.shard_map import shard_map
+        """)
+        _write(str(tmp_path), "deepspeed_tpu/b.py", """
+            import jax.experimental.shard_map as sm
+        """)
+        _write(str(tmp_path), "deepspeed_tpu/c.py", """
+            from jax.experimental import shard_map
+        """)
+        _write(str(tmp_path), "deepspeed_tpu/utils/jax_compat.py", """
+            from jax.experimental.shard_map import shard_map as _legacy
+        """)
+        _write(str(tmp_path), "deepspeed_tpu/ok.py", """
+            from deepspeed_tpu.utils.jax_compat import shard_map
+        """)
+        findings = dslint.lint(["deepspeed_tpu"], repo_root=str(tmp_path),
+                               knob_rules=False)
+        assert sorted(f.path for f in findings) == [
+            "deepspeed_tpu/a.py", "deepspeed_tpu/b.py",
+            "deepspeed_tpu/c.py"]
+        assert {f.rule for f in findings} == {"DSL003"}
+
+
+class TestKnobDriftRules:
+    def _root(self, tmp_path, code, doc_rows):
+        _write(str(tmp_path), "deepspeed_tpu/m.py", code)
+        _write(str(tmp_path), "docs/CONFIG.md",
+               "# cfg\n\n## Environment knobs (`DSTPU_*`)\n\n"
+               "| knob | default | read at |\n|---|---|---|\n"
+               + "".join(f"| `{k}` | — | `x` |\n" for k in doc_rows))
+        return str(tmp_path)
+
+    def test_undocumented_knob_flagged_at_read_site(self, tmp_path):
+        root = self._root(tmp_path, """
+            import os
+            d = os.environ.get("DSTPU_NEW_KNOB", "1")
+        """, ["DSTPU_DOCUMENTED"])
+        findings = dslint.lint([], repo_root=root)
+        assert ("DSL004", "deepspeed_tpu/m.py") in \
+            [(f.rule, f.path) for f in findings]
+        assert any("DSTPU_NEW_KNOB" in f.message for f in findings)
+        # the documented-but-unread knob is the mirror finding
+        assert any(f.rule == "DSL005" and "DSTPU_DOCUMENTED" in f.message
+                   for f in findings)
+
+    def test_all_read_idioms_covered(self, tmp_path):
+        root = self._root(tmp_path, """
+            import os
+            import os as _os
+            a = os.environ.get("DSTPU_A")
+            b = os.environ["DSTPU_B"]
+            c = os.getenv("DSTPU_C", "x")
+            d = os.environ.pop("DSTPU_D", "")
+            e = "DSTPU_E" in os.environ
+            f = _os.environ.get("DSTPU_F")
+        """, ["DSTPU_A", "DSTPU_B", "DSTPU_C", "DSTPU_D", "DSTPU_E",
+              "DSTPU_F"])
+        assert dslint.lint([], repo_root=root) == []
+        names = {r.name for r in dslint.scan_env_knobs(root)}
+        assert names == {"DSTPU_A", "DSTPU_B", "DSTPU_C", "DSTPU_D",
+                         "DSTPU_E", "DSTPU_F"}
+
+    def test_defaults_recorded(self, tmp_path):
+        root = self._root(tmp_path, """
+            import os
+            c = os.environ.get("DSTPU_C", "256")
+            b = os.environ["DSTPU_B"]
+            d = os.environ.get("DSTPU_D", str(4 + 4))
+        """, ["DSTPU_B", "DSTPU_C", "DSTPU_D"])
+        reads = {r.name: r.default for r in dslint.scan_env_knobs(root)}
+        # literal default kept verbatim; computed default is "(dynamic)"
+        # (NOT None — only a truly default-less read documents as
+        # required); no-default subscript is None
+        assert reads == {"DSTPU_C": "'256'", "DSTPU_B": None,
+                         "DSTPU_D": "(dynamic)"}

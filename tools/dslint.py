@@ -1,0 +1,436 @@
+"""dslint — DSTPU-specific static lint rules (``bin/dstpu_lint``).
+
+AST-based checks for invariants generic linters cannot see (docs/
+analysis.md has the full catalog with examples):
+
+  DSL001 hot-path-host-sync   blocking host sync (``np.asarray`` /
+         ``np.array``, ``jax.device_get``, ``.block_until_ready()``,
+         ``.item()``, ``int()``/``float()`` coercion of non-trivial
+         expressions) inside a registered overlap-critical function —
+         the plan/dispatch phases of the serve pipeline and the runner
+         program builders must never block on the device.
+  DSL002 undonated-jit        ``jax.jit`` without ``donate_argnums`` /
+         ``donate_argnames`` under ``deepspeed_tpu/inference/v2/``
+         (serving pools are large; an undonated jit silently doubles
+         peak HBM). Suppress per-site with a justification.
+  DSL003 raw-shard-map-import direct ``jax.experimental.shard_map``
+         import anywhere but ``utils/jax_compat.py`` (the one place the
+         legacy/modern API translation lives).
+  DSL004 undocumented-knob    a ``DSTPU_*`` env knob read in code but
+         absent from docs/CONFIG.md's generated knob table.
+  DSL005 stale-knob-doc       a knob documented in docs/CONFIG.md that
+         no code reads any more.
+
+Suppression: ``# dslint: allow(DSL002): <justification>`` on any line of
+the flagged statement (or the line directly above it).
+
+Usage: ``bin/dstpu_lint [paths...]`` — prints ``rule-id file:line
+message`` per finding and exits non-zero if any survive.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import os
+import re
+import sys
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RULES: Mapping[str, str] = {
+    "DSL001": "blocking host sync inside a registered hot-path function",
+    "DSL002": "jax.jit without donate_argnums/donate_argnames in "
+              "inference/v2 (justify with # dslint: allow(DSL002): why)",
+    "DSL003": "direct jax.experimental.shard_map import outside "
+              "utils/jax_compat.py",
+    "DSL004": "DSTPU_* env knob read in code but not documented in "
+              "docs/CONFIG.md (re-run tools/gen_config_doc.py)",
+    "DSL005": "DSTPU_* knob documented in docs/CONFIG.md but read "
+              "nowhere (re-run tools/gen_config_doc.py)",
+}
+
+#: overlap-critical functions (relative path suffix -> function names):
+#: host work here runs AHEAD of the device — one blocking readback
+#: serializes the whole serve pipeline. Nested defs are covered.
+HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
+    "deepspeed_tpu/inference/v2/engine_v2.py":
+        ("_drive_pipeline", "_plan_step", "_dispatch_step",
+         "_staging_bufs"),
+    "deepspeed_tpu/inference/v2/model_runner.py": ("_build_programs",),
+}
+
+#: roots scanned for DSTPU_* env reads (knob rules + gen_config_doc) —
+#: everything an operator can set, test-only knobs excluded
+ENV_SCAN_ROOTS = ("deepspeed_tpu", "bench.py", "tools", "bin", "examples")
+
+_ALLOW_RE = re.compile(r"#\s*dslint:\s*allow\(([A-Z0-9_,\s]+)\)")
+_KNOB_DOC_ROW_RE = re.compile(r"^\|\s*`(DSTPU_[A-Z0-9_]+)`")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str       # repo-relative
+    line: int
+    message: str
+
+    def __str__(self):
+        return f"{self.rule} {self.path}:{self.line} {self.message}"
+
+
+# ------------------------------------------------------------------ #
+# shared AST helpers
+# ------------------------------------------------------------------ #
+
+
+def _import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Local name -> dotted module it refers to (``import numpy as np``
+    => {np: numpy}; ``from jax import numpy as jnp`` => {jnp:
+    jax.numpy})."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _dotted(node: ast.AST, aliases: Mapping[str, str]) -> Optional[str]:
+    """Resolve an attribute chain to a dotted name with the root import
+    alias expanded; None for non-name expressions."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    root = aliases.get(node.id, node.id)
+    return ".".join([root] + list(reversed(parts)))
+
+
+def _suppressed(finding_lines: Iterable[int], rule: str,
+                src_lines: Sequence[str]) -> bool:
+    """True when an allow-comment for ``rule`` sits on any of the
+    statement's lines or in the contiguous comment block directly above
+    it (multi-line justifications)."""
+    lines = sorted(set(finding_lines))
+    ln = lines[0] - 1 if lines else 0
+    while ln >= 1 and src_lines[ln - 1].strip().startswith("#"):
+        lines.append(ln)
+        ln -= 1
+    for ln in lines:
+        if 1 <= ln <= len(src_lines):
+            m = _ALLOW_RE.search(src_lines[ln - 1])
+            if m and rule in [r.strip() for r in m.group(1).split(",")]:
+                return True
+    return False
+
+
+def _node_lines(node: ast.AST) -> range:
+    end = getattr(node, "end_lineno", None) or node.lineno
+    return range(node.lineno, end + 1)
+
+
+# ------------------------------------------------------------------ #
+# per-file rules (DSL001-003)
+# ------------------------------------------------------------------ #
+
+_SYNC_ATTRS = ("block_until_ready", "item")
+_NUMPY_SYNC_FNS = ("asarray", "array")
+
+
+def _check_hot_fn(fn: ast.AST, aliases: Mapping[str, str], relpath: str,
+                  findings: List[Tuple[Finding, range]]) -> None:
+    hot = fn.name
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        msg = None
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _SYNC_ATTRS:
+            msg = f".{node.func.attr}() blocks on the device"
+        dotted = _dotted(node.func, aliases)
+        if dotted == "jax.device_get":
+            msg = "jax.device_get blocks on the device"
+        elif dotted and dotted.split(".")[0] == "numpy" \
+                and dotted.split(".")[-1] in _NUMPY_SYNC_FNS:
+            msg = (f"{dotted} on a device array is a blocking host "
+                   f"readback (use jnp.asarray for host->device)")
+        elif isinstance(node.func, ast.Name) \
+                and node.func.id in ("int", "float") and node.args \
+                and isinstance(node.args[0],
+                               (ast.Call, ast.Subscript, ast.Attribute)):
+            msg = (f"{node.func.id}(...) scalar coercion of a "
+                   f"non-trivial expression may force a device sync")
+        if msg:
+            findings.append((Finding(
+                "DSL001", relpath, node.lineno,
+                f"in hot path '{hot}': {msg}"), _node_lines(node)))
+
+
+def _lint_file(path: str, relpath: str,
+               hot_paths: Mapping[str, Tuple[str, ...]]) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding("DSL000", relpath, e.lineno or 0,
+                        f"syntax error: {e.msg}")]
+    src_lines = src.splitlines()
+    aliases = _import_aliases(tree)
+    raw: List[Tuple[Finding, range]] = []
+
+    # DSL001 — hot-path host-sync hygiene
+    hot_fns: Tuple[str, ...] = ()
+    for suffix, names in hot_paths.items():
+        if relpath.endswith(suffix):
+            hot_fns = names
+            break
+    if hot_fns:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in hot_fns:
+                _check_hot_fn(node, aliases, relpath, raw)
+
+    # DSL002 — undonated jax.jit in inference/v2
+    if "deepspeed_tpu/inference/v2/" in relpath.replace(os.sep, "/"):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and _dotted(node.func, aliases) == "jax.jit":
+                kw = {k.arg for k in node.keywords}
+                if not kw & {"donate_argnums", "donate_argnames"}:
+                    raw.append((Finding(
+                        "DSL002", relpath, node.lineno,
+                        "jax.jit without donate_argnums/donate_argnames "
+                        "(serving buffers are large — donate, or justify "
+                        "with # dslint: allow(DSL002): why)"),
+                        _node_lines(node)))
+
+    # DSL003 — raw shard_map imports
+    if not relpath.replace(os.sep, "/").endswith("utils/jax_compat.py"):
+        for node in ast.walk(tree):
+            hit = None
+            if isinstance(node, ast.Import):
+                if any(a.name.startswith("jax.experimental.shard_map")
+                       for a in node.names):
+                    hit = "import jax.experimental.shard_map"
+            elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                if node.module \
+                        and node.module.startswith(
+                            "jax.experimental.shard_map"):
+                    hit = f"from {node.module} import ..."
+                elif node.module == "jax.experimental" \
+                        and any(a.name == "shard_map" for a in node.names):
+                    hit = "from jax.experimental import shard_map"
+            if hit:
+                raw.append((Finding(
+                    "DSL003", relpath, node.lineno,
+                    f"{hit} bypasses utils/jax_compat (the one place the "
+                    f"legacy/modern shard_map translation lives)"),
+                    _node_lines(node)))
+
+    return [f for f, lines in raw
+            if not _suppressed(lines, f.rule, src_lines)]
+
+
+# ------------------------------------------------------------------ #
+# env-knob scan (DSL004/DSL005 + tools/gen_config_doc.py)
+# ------------------------------------------------------------------ #
+
+_ENV_METHODS = ("get", "pop", "setdefault")
+
+
+@dataclasses.dataclass
+class KnobRead:
+    name: str
+    path: str       # repo-relative
+    line: int
+    #: repr of the literal default; "(dynamic)" for a computed default
+    #: expression; None when the read has NO default (required)
+    default: Optional[str]
+
+
+def _default_repr(call: ast.Call) -> str:
+    if len(call.args) < 2:
+        return "None"      # .get/.pop/getenv with implicit None default
+    dflt = call.args[1]
+    return repr(dflt.value) if isinstance(dflt, ast.Constant) \
+        else "(dynamic)"
+
+
+def _env_read(node: ast.AST, aliases: Mapping[str, str]
+              ) -> Optional[Tuple[str, Optional[str]]]:
+    """(knob name, default repr) when ``node`` reads an env var with a
+    literal name; None otherwise. Covers os.environ.get/pop/setdefault,
+    os.environ[...], os.getenv(...) and ``"X" in os.environ``."""
+    def lit(n):
+        return n.value if isinstance(n, ast.Constant) \
+            and isinstance(n.value, str) else None
+
+    if isinstance(node, ast.Call):
+        dotted = _dotted(node.func, aliases)
+        if dotted == "os.getenv" and node.args:
+            name = lit(node.args[0])
+            if name:
+                return name, _default_repr(node)
+        if dotted and dotted.startswith("os.environ.") \
+                and dotted.rsplit(".", 1)[1] in _ENV_METHODS and node.args:
+            name = lit(node.args[0])
+            if name:
+                return name, _default_repr(node)
+    elif isinstance(node, ast.Subscript):
+        if _dotted(node.value, aliases) == "os.environ":
+            name = lit(node.slice)
+            if name:
+                return name, None
+    elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+            and isinstance(node.ops[0], (ast.In, ast.NotIn)):
+        if _dotted(node.comparators[0], aliases) == "os.environ":
+            name = lit(node.left)
+            if name:
+                return name, None
+    return None
+
+
+def _py_files(root: str) -> Iterable[str]:
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            path = os.path.join(dirpath, fn)
+            if fn.endswith(".py") or os.sep + "bin" + os.sep in path:
+                yield path
+
+
+def scan_env_knobs(repo_root: str = REPO,
+                   prefix: str = "DSTPU_") -> List[KnobRead]:
+    """Every literal ``<prefix>*`` env read under ENV_SCAN_ROOTS — shared
+    by the knob-drift rules and tools/gen_config_doc.py (which generates
+    the docs/CONFIG.md table DSL004/DSL005 check against)."""
+    reads: List[KnobRead] = []
+    for root in ENV_SCAN_ROOTS:
+        full = os.path.join(repo_root, root)
+        if not os.path.exists(full):
+            continue
+        for path in _py_files(full):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except (SyntaxError, UnicodeDecodeError):
+                continue
+            aliases = _import_aliases(tree)
+            for node in ast.walk(tree):
+                hit = _env_read(node, aliases)
+                if hit and hit[0].startswith(prefix):
+                    reads.append(KnobRead(
+                        hit[0], os.path.relpath(path, repo_root),
+                        node.lineno, hit[1]))
+    return reads
+
+
+def documented_knobs(config_md: str) -> List[Tuple[str, int]]:
+    """(knob, line) rows of the generated env-knob table in CONFIG.md."""
+    out: List[Tuple[str, int]] = []
+    in_section = False
+    for i, line in enumerate(config_md.splitlines(), 1):
+        if line.startswith("## "):
+            in_section = "Environment knobs" in line
+        if in_section:
+            m = _KNOB_DOC_ROW_RE.match(line)
+            if m:
+                out.append((m.group(1), i))
+    return out
+
+
+def _knob_findings(repo_root: str) -> List[Finding]:
+    cfg_path = os.path.join(repo_root, "docs", "CONFIG.md")
+    if not os.path.exists(cfg_path):
+        return [Finding("DSL004", "docs/CONFIG.md", 0,
+                        "missing — run tools/gen_config_doc.py to "
+                        "generate the env-knob table")]
+    with open(cfg_path, encoding="utf-8") as f:
+        doc_rows = documented_knobs(f.read())
+    documented = {k for k, _ in doc_rows}
+    reads = scan_env_knobs(repo_root)
+    findings: List[Finding] = []
+    seen = set()
+    for r in reads:
+        if r.name not in documented and r.name not in seen:
+            seen.add(r.name)
+            findings.append(Finding(
+                "DSL004", r.path, r.line,
+                f"env knob {r.name} is read here but undocumented in "
+                f"docs/CONFIG.md — run tools/gen_config_doc.py"))
+    read_names = {r.name for r in reads}
+    for name, line in doc_rows:
+        if name not in read_names:
+            findings.append(Finding(
+                "DSL005", "docs/CONFIG.md", line,
+                f"documented env knob {name} is read nowhere — run "
+                f"tools/gen_config_doc.py"))
+    return findings
+
+
+# ------------------------------------------------------------------ #
+# driver
+# ------------------------------------------------------------------ #
+
+
+def lint(paths: Sequence[str], repo_root: str = REPO,
+         hot_paths: Optional[Mapping[str, Tuple[str, ...]]] = None,
+         knob_rules: bool = True) -> List[Finding]:
+    """Lint ``paths`` (files or directories). The knob-drift rules
+    (DSL004/DSL005) are repo-level — they scan ENV_SCAN_ROOTS under
+    ``repo_root`` regardless of ``paths``."""
+    hot_paths = HOT_PATHS if hot_paths is None else hot_paths
+    findings: List[Finding] = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(repo_root, p)
+        for path in _py_files(full):
+            findings.extend(_lint_file(
+                path, os.path.relpath(path, repo_root), hot_paths))
+    if knob_rules:
+        findings.extend(_knob_findings(repo_root))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dstpu_lint",
+        description="DSTPU-specific static lint (see docs/analysis.md)")
+    ap.add_argument("paths", nargs="*", default=["deepspeed_tpu"],
+                    help="files/directories to lint (default: "
+                         "deepspeed_tpu)")
+    ap.add_argument("--root", default=REPO,
+                    help="repo root (docs/CONFIG.md + knob scan anchor)")
+    ap.add_argument("--no-knob-rules", action="store_true",
+                    help="skip the repo-level DSL004/DSL005 knob scan")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for rid, desc in RULES.items():
+            print(f"{rid}  {desc}")
+        return 0
+    findings = lint(args.paths or ["deepspeed_tpu"], repo_root=args.root,
+                    knob_rules=not args.no_knob_rules)
+    for f in findings:
+        print(f)
+    n = len(findings)
+    print(f"dslint: {n} finding{'s' if n != 1 else ''}"
+          + ("" if n else " — clean"))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,6 +89,50 @@ bench flags).
 """
 
 
+ENV_HEADER = """
+
+## Environment knobs (`DSTPU_*`)
+
+Every `DSTPU_*` environment variable the code reads — name, default and
+reading site — generated from an AST scan of `deepspeed_tpu/`,
+`bench.py`, `tools/`, `bin/` and `examples/`
+(`tools/dslint.py scan_env_knobs`). `bin/dstpu_lint`'s DSL004/DSL005
+rules fail CI when this table and the code drift, so re-run
+`python tools/gen_config_doc.py` after adding or removing a knob.
+"(required)" means the knob is read with no default
+(`os.environ[...]` or a presence test); "(dynamic)" means the default
+is computed at the read site. Bench/profiling knobs are further
+described in [serving.md](serving.md#bench-flags).
+
+"""
+
+
+def _env_table(reads) -> list:
+    by_name: dict = {}
+    for r in reads:
+        by_name.setdefault(r.name, []).append(r)
+    out = ["| knob | default | read at |", "|---|---|---|"]
+    for name in sorted(by_name):
+        sites = by_name[name]
+        defaults = []
+        for r in sites:
+            d = r.default if r.default is not None else "(required)"
+            if d not in defaults:
+                defaults.append(d)
+        dcol = " / ".join(defaults).replace("|", "\\|")
+        # file-level sites only: line numbers rot on every unrelated
+        # edit and the drift rules compare names, not lines
+        files = []
+        for r in sites:
+            if r.path not in files:
+                files.append(r.path)
+        scol = ", ".join(f"`{p}`" for p in files[:3])
+        if len(files) > 3:
+            scol += f" (+{len(files) - 3} more)"
+        out.append(f"| `{name}` | {dcol} | {scol} |")
+    return out
+
+
 def _table(rows: list) -> list:
     out = ["| key | type | default |", "|---|---|---|"]
     for key, tname, default in rows:
@@ -103,16 +147,21 @@ def _table(rows: list) -> list:
 
 def main():
     from deepspeed_tpu.inference.v2.config import RaggedInferenceConfig
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from dslint import scan_env_knobs
     rows: list = []
     walk(Config, "", rows)
     srows: list = []
     walk(RaggedInferenceConfig, "", srows)
-    out = [HEADER] + _table(rows) + [SERVING_HEADER] + _table(srows)
+    knobs = scan_env_knobs(REPO)
+    out = [HEADER] + _table(rows) + [SERVING_HEADER] + _table(srows) \
+        + [ENV_HEADER] + _env_table(knobs)
     os.makedirs(os.path.join(REPO, "docs"), exist_ok=True)
     path = os.path.join(REPO, "docs", "CONFIG.md")
     with open(path, "w") as f:
         f.write("\n".join(out) + "\n")
-    print(f"wrote {path} ({len(rows)} + {len(srows)} keys)")
+    print(f"wrote {path} ({len(rows)} + {len(srows)} keys, "
+          f"{len({k.name for k in knobs})} env knobs)")
 
 
 if __name__ == "__main__":

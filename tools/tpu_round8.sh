@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-8 on-chip sequence: static-analysis round (ISSUE 4). Captures the
+# program-audit evidence on real hardware — donation is only implemented
+# on TPU, so the smoke's program_audit row is the first on-chip proof the
+# KV pool actually aliases in place — plus the lint gate and a bench
+# control whose serve_pipeline row now carries the recompile tripwire.
+# Strictly sequential (one process owns the chip), no timeouts around TPU
+# clients (a killed client wedges the grant).
+cd /root/repo || exit 1
+LOG=profiles/r08_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round8 start $(date -u +%FT%TZ)"
+
+echo "--- [1/4] tpu_smoke (incl. program_audit: on-chip donation +"
+echo "    collective budgets for step/greedy/fb/decode-loop/ring-flush)"
+python tools/tpu_smoke.py | tee SMOKE_TPU_r08.txt
+
+echo "--- [2/4] dstpu_lint (host-sync hygiene, donation, shard_map"
+echo "    imports, knob/doc drift — must be clean on chip too)"
+python bin/dstpu_lint deepspeed_tpu
+
+echo "--- [3/4] serve_pipeline bench (row now reports"
+echo "    fresh_compiles_measured — the recompile tripwire on a warm run)"
+python bench.py serve_pipeline > BENCH_PIPE_r08.json
+tail -c 700 BENCH_PIPE_r08.json
+
+echo "--- [4/4] full bench (driver runs it again at round end)"
+python bench.py > BENCH_SELF_r08.json
+tail -c 700 BENCH_SELF_r08.json
+echo "=== tpu_round8 done $(date -u +%FT%TZ)"
