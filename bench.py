@@ -366,6 +366,53 @@ def bench_serve():
     }))
 
 
+def _serve_llama(big):
+    """The serve-phase model pair shared by the pipeline and prefix
+    benches: TinyLlama-1.1B shape (the serve-phase flagship) on TPU, or
+    a CPU-harness shape small enough that a decode step is a few ms.
+    One definition — the phases' numbers stay cross-comparable."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.llama import Llama, LlamaConfig
+
+    if big:
+        mcfg = LlamaConfig(vocab_size=32000, max_seq_len=2048,
+                           num_layers=22, num_heads=32, num_kv_heads=4,
+                           hidden_size=2048, intermediate_size=5632,
+                           dtype=jnp.bfloat16)
+    else:
+        mcfg = LlamaConfig(vocab_size=2048, max_seq_len=512, num_layers=4,
+                           num_heads=8, num_kv_heads=4, hidden_size=256,
+                           intermediate_size=512, dtype=jnp.float32)
+    return Llama(mcfg), mcfg
+
+
+def _pseudo_params(model, mcfg):
+    """NON-degenerate deterministic params, filled on device: zeros (the
+    serve-bench trick) would make every argmax constant and the serve
+    phases' token-parity self-checks vacuous; real random init of the big
+    shape costs a 1.1B host init + transfer. A cheap iota hash per leaf
+    keeps weights varied, small and centered so greedy tokens actually
+    depend on the fed inputs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32)))["params"]
+    leaf_i = [0]
+
+    def _pseudo(s):
+        leaf_i[0] += 1
+        n = int(np.prod(s.shape))
+        x = (jnp.arange(n, dtype=jnp.float32)
+             * (0.7548 + 0.0173 * (leaf_i[0] % 11))) % 1.0
+        return ((x - 0.5) * 0.05).reshape(s.shape).astype(mcfg.dtype)
+
+    return jax.tree.map(_pseudo, shapes)
+
+
 def bench_serve_pipeline():
     """Overlapped-serving-pipeline benchmark (ISSUE 3): per-step greedy
     decode through the plan/dispatch/commit engine loop, synchronous
@@ -380,56 +427,25 @@ def bench_serve_pipeline():
     import os
 
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
                                             RaggedInferenceConfig)
-    from deepspeed_tpu.models.llama import Llama, LlamaConfig
 
     # the env knob also steers engine construction — consume it here so
     # the depth-0 control below stays a true synchronous oracle
     depth = int(os.environ.pop("DSTPU_SERVE_ASYNC", "") or 2)
     on_tpu = jax.default_backend() == "tpu"
-    if os.environ.get("DSTPU_PIPE_MODEL", "big" if on_tpu else "tiny") \
-            == "big":
-        # TinyLlama-1.1B shape — the serve-phase flagship model
-        mcfg = LlamaConfig(vocab_size=32000, max_seq_len=2048,
-                           num_layers=22, num_heads=32, num_kv_heads=4,
-                           hidden_size=2048, intermediate_size=5632,
-                           dtype=jnp.bfloat16)
-        S, PROMPT, GEN = 64, 128, 64
-        dtype = "bfloat16"
+    big = os.environ.get("DSTPU_PIPE_MODEL",
+                         "big" if on_tpu else "tiny") == "big"
+    model, mcfg = _serve_llama(big)
+    if big:
+        S, PROMPT, GEN, dtype = 64, 128, 64, "bfloat16"
     else:
-        # CPU-harness shape: small enough that a decode step is a few ms
-        mcfg = LlamaConfig(vocab_size=2048, max_seq_len=512, num_layers=4,
-                           num_heads=8, num_kv_heads=4, hidden_size=256,
-                           intermediate_size=512, dtype=jnp.float32)
-        S, PROMPT, GEN = 8, 32, 64
-        dtype = "float32"
+        S, PROMPT, GEN, dtype = 8, 32, 64, "float32"
     S = int(os.environ.get("DSTPU_PIPE_SEQS", str(S)))
     GEN = int(os.environ.get("DSTPU_PIPE_GEN", str(GEN)))
-    model = Llama(mcfg)
-    shapes = jax.eval_shape(
-        lambda: model.init(jax.random.PRNGKey(0),
-                           jnp.zeros((1, 8), jnp.int32)))["params"]
-
-    # NON-degenerate deterministic params, filled on device: zeros (the
-    # serve-bench trick) would make every argmax constant and the
-    # token-parity self-check below vacuous; real random init of the big
-    # shape costs a 1.1B host init + transfer. A cheap iota hash per leaf
-    # keeps weights varied, small and centered so greedy tokens actually
-    # depend on the fed inputs.
-    leaf_i = [0]
-
-    def _pseudo(s):
-        leaf_i[0] += 1
-        n = int(np.prod(s.shape))
-        x = (jnp.arange(n, dtype=jnp.float32)
-             * (0.7548 + 0.0173 * (leaf_i[0] % 11))) % 1.0
-        return ((x - 0.5) * 0.05).reshape(s.shape).astype(mcfg.dtype)
-
-    params = jax.tree.map(_pseudo, shapes)
+    params = _pseudo_params(model, mcfg)
 
     bs = PROMPT + GEN + 8          # +8: the warm-up decode tokens
     base = dict(max_seqs=S, chunk_size=PROMPT, block_size=bs,
@@ -533,6 +549,125 @@ def bench_serve_pipeline():
         "host_gap_hidden_frac": round(hidden / (GEN * host_cost), 3)
         if host_cost > 0 else None,      # DSTPU_PIPE_HOSTMS=0: pure
                                          # pipeline overhead, no gap to hide
+        "token_parity": parity,
+        "distinct_tokens": distinct,
+    }))
+    return 0 if parity and distinct > 1 else 1
+
+
+def bench_serve_prefix():
+    """Prefix-cached serving benchmark (ISSUE 5): a shared-prefix
+    workload — N sequential requests that share a common system prompt,
+    each with a unique user suffix — through the v2 engine with
+    ``prefix_cache`` on vs off. Reports ``prefill_chunks_skipped_frac``
+    (matched tokens never ran a prefill chunk), prefill tokens/s, decode
+    steps/s and end-to-end request steps/s for both runs, plus a
+    token-parity self-check (cache hits must not change a single greedy
+    token) and the recompile tripwire over the measured window."""
+    import os
+
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceConfig)
+
+    on_tpu = jax.default_backend() == "tpu"
+    big = os.environ.get("DSTPU_PREFIX_MODEL",
+                         "big" if on_tpu else "tiny") == "big"
+    model, mcfg = _serve_llama(big)
+    if big:
+        SYS, TAIL, GEN, bs, CHUNK, dtype = 1360, 128, 32, 256, 256, \
+            "bfloat16"
+    else:
+        SYS, TAIL, GEN, bs, CHUNK, dtype = 144, 16, 16, 32, 32, "float32"
+    N = int(os.environ.get("DSTPU_PREFIX_REQS", "8"))
+    GEN = int(os.environ.get("DSTPU_PREFIX_GEN", str(GEN)))
+    params = _pseudo_params(model, mcfg)
+
+    rng = np.random.RandomState(0)
+    sys_prompt = rng.randint(1, mcfg.vocab_size, size=SYS).tolist()
+    prompts = [sys_prompt + rng.randint(1, mcfg.vocab_size,
+                                        size=TAIL).tolist()
+               for _ in range(N)]
+    prompt_len = SYS + TAIL
+    blocks_per_seq = (prompt_len + GEN + bs - 1) // bs
+    # chunk_size < prompt_len on purpose: a prompt spans SEVERAL SplitFuse
+    # chunk steps, so a prefix hit skips whole compiled prefill steps (the
+    # step program's shape is fixed — skipping tokens inside one chunk
+    # would save nothing)
+    base = dict(
+        max_seqs=8, chunk_size=CHUNK, block_size=bs,
+        # room for every request's private tail AND the retained shared
+        # chain (cache-on holds refcount-0 blocks until pressure)
+        num_blocks=(N + 4) * blocks_per_seq,
+        max_blocks_per_seq=blocks_per_seq,
+        dtype=dtype, attention_impl="paged_flash" if on_tpu else "dense",
+        decode_loop_steps=0)
+
+    def run(enable):
+        eng = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base, prefix_cache=enable))
+        # warm every program the measured loop hits — incl. the CoW copy
+        # (dispatched on the second warm request's partial-tail hit).
+        # Warm-ONLY tails: replaying measured prompts here would leave
+        # their full chains (unique tail included) cached and inflate
+        # the measured skipped fraction past the workload's shared span
+        wrng = np.random.RandomState(10_000)
+        warm = [sys_prompt + wrng.randint(1, mcfg.vocab_size,
+                                          size=TAIL).tolist()
+                for _ in range(2)]
+        for wuid, wp in ((99001, warm[0]), (99002, warm[1])):
+            w = eng.put([wuid], [wp], _greedy=True)
+            eng.decode_pipelined([wuid], [w[wuid]], GEN)
+            eng.flush(wuid)
+        stats0 = dict(eng.prefix_stats)
+        from deepspeed_tpu.analysis import RecompileTripwire
+        tw = RecompileTripwire()
+        outs = {}
+        t_prefill = t_decode = 0.0
+        t0 = time.perf_counter()
+        with tw:
+            for i, p in enumerate(prompts):
+                ts = time.perf_counter()
+                first = eng.put([i], [p], _greedy=True)
+                tm = time.perf_counter()
+                toks = eng.decode_pipelined([i], [first[i]], GEN)
+                t_prefill += tm - ts
+                t_decode += time.perf_counter() - tm
+                outs[i] = [first[i]] + toks[i]
+                eng.flush(i)
+        wall = time.perf_counter() - t0
+        st = eng.prefix_stats
+        skipped = st["matched_tokens"] - stats0["matched_tokens"]
+        ran = st["prefill_tokens"] - stats0["prefill_tokens"]
+        return {
+            "prefill_chunks_skipped_frac": round(
+                skipped / (skipped + ran), 3) if skipped + ran else 0.0,
+            "prefill_tokens_per_sec": round(ran / t_prefill, 1),
+            "decode_steps_per_sec": round(N * GEN / t_decode, 2),
+            "request_steps_per_sec": round(N / wall, 3),
+            "wall_s": round(wall, 3),
+            "matched_tokens": skipped,
+            "cow_copies": st["cow_copies"] - stats0["cow_copies"],
+            "cached_blocks": st.get("cached_blocks", 0),
+            "fresh_compiles_measured":
+                tw.fresh_compiles if tw.available else None,
+        }, outs
+
+    off, off_out = run(False)
+    on, on_out = run(True)
+    parity = on_out == off_out
+    distinct = len({t for toks in off_out.values() for t in toks})
+    print(json.dumps({
+        "model": f"llama {mcfg.num_layers}L hidden={mcfg.hidden_size}",
+        "workload": {"requests": N, "system_prompt_tokens": SYS,
+                     "unique_tail_tokens": TAIL, "gen_tokens": GEN,
+                     "block_size": bs},
+        "cache_off": off,
+        "cache_on": on,
+        "prefill_chunks_skipped_frac": on["prefill_chunks_skipped_frac"],
+        "e2e_speedup": round(off["wall_s"] / on["wall_s"], 3),
         "token_parity": parity,
         "distinct_tokens": distinct,
     }))
@@ -950,6 +1085,8 @@ def main():
         return bench_serve()
     if sys.argv[1:] == ["serve_pipeline"]:
         return bench_serve_pipeline()
+    if sys.argv[1:] == ["serve_prefix"]:
+        return bench_serve_prefix()
     if sys.argv[1:] == ["fastgen"]:
         return bench_serve_fastgen()
     if sys.argv[1:] == ["moe"]:
@@ -958,11 +1095,18 @@ def main():
         return bench_moe_train()
 
     # orchestrator: NO jax import here — each phase gets the TPU alone.
-    probe = _probe_backend(float(os.environ.get("DSTPU_PROBE_TIMEOUT",
-                                                "300")))
+    # DSTPU_BENCH_PROBE_S bounds the initial device probe (BENCH_r05
+    # lesson: the hard-coded 300 s burned the whole window on a dead
+    # tunnel — the driver can now choose a fail-fast budget; the legacy
+    # DSTPU_PROBE_TIMEOUT name is honored as a fallback)
+    probe = _probe_backend(float(
+        os.environ.get("DSTPU_BENCH_PROBE_S",
+                       os.environ.get("DSTPU_PROBE_TIMEOUT", "300"))))
     if not probe["ok"]:
         # structured, immediate, machine-readable — the driver records
-        # WHY there is no number instead of a timeout traceback
+        # WHY there is no number (e.g. error=backend_unreachable) the
+        # moment the probe fails, instead of a timeout traceback at the
+        # end of the window
         print(json.dumps({
             "metric": "gpt2_train_tflops_per_chip", "value": 0.0,
             "unit": "TFLOPS", "vs_baseline": 0.0,
@@ -981,7 +1125,8 @@ def main():
     out = {"probe": probe}
     dead = False
     for phase in ("train", "train_xl", "train_1p3b", "serve",
-                  "serve_pipeline", "fastgen", "moe", "moe_train"):
+                  "serve_pipeline", "serve_prefix", "fastgen", "moe",
+                  "moe_train"):
         if dead:
             out[phase] = {"error": "skipped_backend_dead"}
             continue
@@ -1048,6 +1193,7 @@ def main():
                    "train_1p3b": out.get("train_1p3b", {}),
                    "serving": out.get("serve", {}),
                    "serve_pipeline": out.get("serve_pipeline", {}),
+                   "serve_prefix": out.get("serve_prefix", {}),
                    "fastgen": out.get("fastgen", {}),
                    "moe_serve": out.get("moe", {}),
                    "moe_train": out.get("moe_train", {}),

@@ -15,12 +15,14 @@ from .config import RaggedInferenceConfig
 from .engine_factory import build_hf_engine
 from .engine_v2 import InferenceEngineV2
 from .kv_cache import BlockedKVCache
+from .prefix_cache import PrefixCache
 from .sequence import SequenceDescriptor, SequenceStatus
 from .state_manager import StateManager
 from .tp import TPContext, build_tp_context
 
 __all__ = [
     "BlockedAllocator", "BlockedKVCache", "InferenceEngineV2",
-    "RaggedInferenceConfig", "SequenceDescriptor", "SequenceStatus",
-    "StateManager", "TPContext", "build_hf_engine", "build_tp_context",
+    "PrefixCache", "RaggedInferenceConfig", "SequenceDescriptor",
+    "SequenceStatus", "StateManager", "TPContext", "build_hf_engine",
+    "build_tp_context",
 ]

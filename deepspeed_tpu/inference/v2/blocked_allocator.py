@@ -4,11 +4,17 @@ Analogue of the reference's ``BlockedAllocator``
 (``inference/v2/ragged/blocked_allocator.py``): a free-list over a fixed pool
 of KV blocks. Host-side only — block ids flow into device block tables; the
 cache itself never moves.
+
+With prefix caching (``prefix_cache.py``) a block can be co-owned by the
+cache and several sequences; the allocator stays refcount-oblivious — shared
+blocks are simply *allocated* until the cache evicts them — but it now
+detects a double free exactly (set membership, not just list overflow),
+which is what the refcounting stress tests assert against.
 """
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import List, Sequence, Set
 
 
 class OutOfBlocksError(RuntimeError):
@@ -21,6 +27,7 @@ class BlockedAllocator:
             raise ValueError(f"num_blocks must be positive, got {num_blocks}")
         self._num_blocks = num_blocks
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._free_set: Set[int] = set(self._free)
 
     @property
     def num_blocks(self) -> int:
@@ -30,17 +37,24 @@ class BlockedAllocator:
     def free_blocks(self) -> int:
         return len(self._free)
 
+    def is_free(self, block: int) -> bool:
+        return block in self._free_set
+
     def allocate(self, n: int) -> List[int]:
         if n > len(self._free):
             raise OutOfBlocksError(
                 f"requested {n} blocks, only {len(self._free)} free")
         out = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(out)
         return out
 
     def free(self, blocks: Sequence[int]) -> None:
+        incoming: Set[int] = set()
         for b in blocks:
             if not 0 <= b < self._num_blocks:
                 raise ValueError(f"block id {b} out of range")
+            if b in self._free_set or b in incoming:
+                raise RuntimeError(f"double free of block {b}")
+            incoming.add(b)
         self._free.extend(blocks)
-        if len(self._free) > self._num_blocks:
-            raise RuntimeError("double free detected")
+        self._free_set.update(incoming)

@@ -48,6 +48,24 @@ class RaggedInferenceConfig(ConfigModel):
     # (PROFILE.md serving levers); 256 keeps the transient bounded.
     # 0 disables the cap.
     prefill_chunk_cap: int = 256
+    # Automatic prefix caching (prefix_cache.py): a content-addressed,
+    # parent-linked index over full KV blocks with per-block refcounts.
+    # put() matches each fresh prompt's longest cached block chain and
+    # skips those prefill chunks entirely (the sequence's table points at
+    # the shared device blocks); a partial-tail match is served by one
+    # copy-on-write block copy. Refcount-0 blocks STAY cached and are
+    # LRU-evicted only under allocator pressure. Greedy decode is
+    # token-identical with this on or off (the cached rows are exactly
+    # what a fresh prefill would write — positions start at 0 and KV
+    # content is deterministic, int8 pool payloads and scales included).
+    prefix_cache: bool = False
+    # Cap on cached blocks (0 = bounded by the pool only): at the cap an
+    # insert evicts one cold block, or is skipped when everything cached
+    # is still referenced.
+    prefix_cache_max_blocks: int = 0
+    # Eviction order among refcount-0 cached blocks: "lru" (least
+    # recently released, default) or "fifo" (oldest insertion).
+    prefix_cache_policy: str = "lru"
     # Overlapped serving pipeline depth: how many scheduled steps may be
     # in flight on the device at once. The serve loop splits into plan
     # (host: scheduler + batch staging, runs ahead) / dispatch (enqueue
@@ -96,6 +114,14 @@ class RaggedInferenceConfig(ConfigModel):
             raise ValueError(
                 f"prefill_chunk_cap must be >= 0 (0 = uncapped), got "
                 f"{self.prefill_chunk_cap}")
+        if self.prefix_cache_policy not in ("lru", "fifo"):
+            raise ValueError(
+                f"prefix_cache_policy must be 'lru' or 'fifo', got "
+                f"{self.prefix_cache_policy!r}")
+        if self.prefix_cache_max_blocks < 0:
+            raise ValueError(
+                f"prefix_cache_max_blocks must be >= 0 (0 = pool-bounded), "
+                f"got {self.prefix_cache_max_blocks}")
         if self.serve_pipeline_depth < 0:
             raise ValueError(
                 f"serve_pipeline_depth must be >= 0 (0 = synchronous), "

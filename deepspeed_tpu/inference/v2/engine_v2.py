@@ -171,6 +171,19 @@ class InferenceEngineV2:
             # lever that lets a model's KV footprint span chips
             self.kv_cache.shard(self.runner.tp.mesh)
         self.state = StateManager(self.config, self.kv_cache)
+        self._prefix = None
+        if self.config.prefix_cache:
+            # automatic prefix caching (prefix_cache.py): the index layers
+            # on the allocator via the kv cache (evictable-block capacity,
+            # pressure-driven eviction inside reserve) and on the state
+            # manager (match/register/decref); put() drives it below
+            from .prefix_cache import PrefixCache
+            self._prefix = PrefixCache(
+                self.config.block_size,
+                max_blocks=self.config.prefix_cache_max_blocks,
+                policy=self.config.prefix_cache_policy)
+            self.kv_cache.attach_prefix_cache(self._prefix)
+            self.state.prefix = self._prefix
         self.scheduler = SplitFuseScheduler(self.config, self.state)
         self._kv_data = self.kv_cache.pool
         self._step_counter = 0
@@ -195,7 +208,8 @@ class InferenceEngineV2:
             f"{self.config.chunk_size} tokens "
             f"(prefill chunk cap {self.config.effective_chunk}), "
             f"{self.config.num_blocks} KV blocks x {self.config.block_size}"
-            + (f", tp={tp}" if tp > 1 else ""))
+            + (f", tp={tp}" if tp > 1 else "")
+            + (", prefix_cache=on" if self._prefix is not None else ""))
 
     # ------------------------------------------------------------------ #
     # reference-parity surface
@@ -221,7 +235,9 @@ class InferenceEngineV2:
         device orders them through the KV-pool data dependence). Depth 0
         plans, dispatches and commits each step synchronously."""
         for uid, toks in zip(batch_uids, batch_tokens):
-            self.state.put_tokens(uid, toks)
+            seq = self.state.put_tokens(uid, toks)
+            if self._prefix is not None:
+                self._match_prefix(seq)
         done: Dict[int, np.ndarray] = {}
 
         def work_left():
@@ -233,7 +249,45 @@ class InferenceEngineV2:
 
         self._drive_pipeline(
             work_left, lambda: self._plan_step(greedy=_greedy), commit_one)
+        if self._prefix is not None:
+            self._register_prefix(batch_uids)
         return done
+
+    def _match_prefix(self, seq) -> None:
+        """Prefix-cache hit path: point a fresh prompt's table at the
+        longest cached block chain and dispatch the CoW row copies a
+        partial-tail match requests — non-blocking enqueue on the
+        functional pool thread, so later steps (and later matchers'
+        reads) order after it on device. A DSL001-registered hot path:
+        matching must never block on the device."""
+        for src, dst in self.state.match_prefix(seq):
+            self._kv_data = self.kv_cache.copy_block(self._kv_data, src,
+                                                     dst)
+
+    def _register_prefix(self, batch_uids) -> None:
+        """Insert this put() call's fully-prefilled prompt blocks into
+        the cache (their KV writes are dispatched; device ordering makes
+        them safe to share). DSL001-registered with ``_match_prefix``."""
+        for uid in batch_uids:
+            seq = self.state.get(uid)
+            if seq is not None:
+                self.state.register_prefix(seq)
+
+    @property
+    def prefix_stats(self) -> Dict[str, Any]:
+        """Merged host-side prefix-cache counters plus the skipped-chunk
+        fraction: matched tokens never ran a prefill chunk; the fraction
+        is matched / (matched + prefilled prompt tokens)."""
+        st = dict(self.state.prefix_stats)
+        if self._prefix is not None:
+            st.update(self._prefix.stats)
+            st["cached_blocks"] = self._prefix.cached_blocks
+            st["evictable_blocks"] = self._prefix.evictable_blocks
+        ran = st["prefill_tokens"]
+        hit = st["matched_tokens"]
+        st["prefill_chunks_skipped_frac"] = (
+            hit / (hit + ran) if hit + ran else 0.0)
+        return st
 
     def _drive_pipeline(self, work_left, make_plan, commit_one,
                         on_dispatch=None) -> None:
@@ -343,7 +397,11 @@ class InferenceEngineV2:
         # what was saved, not re-derive it from seen_tokens (the two could
         # diverge under future allocate-ahead policies)
         seq.paused_blocks = len(seq.kv_blocks)
-        self.kv_cache.free(seq.kv_blocks)
+        # cache-shared leading blocks are DECREF'd, not freed (the cache —
+        # or another sequence — still owns them); resume() restores the
+        # offloaded copy into all-private blocks, so the resumed sequence
+        # simply stops sharing
+        self.state.release_blocks(seq, seq.kv_blocks)
         seq.kv_blocks = []
         seq.status = SequenceStatus.PAUSED
 

@@ -12,12 +12,13 @@ exists only in the allocator and the block tables. Rows are lane-aligned
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax.numpy as jnp
 
 from .blocked_allocator import BlockedAllocator
 from .config import RaggedInferenceConfig
+from .prefix_cache import PrefixCache
 
 
 class BlockedKVCache:
@@ -29,6 +30,9 @@ class BlockedKVCache:
         self.head_dim = head_dim
         self.dtype = dtype or jnp.bfloat16
         self.allocator = BlockedAllocator(cfg.num_blocks)
+        self.prefix: Optional[PrefixCache] = None   # attach_prefix_cache
+        self._mesh = None                           # set by shard()
+        self._copy_jit = None                       # built on first CoW
         # +1 trash BLOCK at the end: padded query positions scatter into its
         # last slot, so they can never corrupt a live sequence's KV (see
         # model_runner) — and the pool stays an exact multiple of block_size,
@@ -60,15 +64,92 @@ class BlockedKVCache:
             return KVPool(self.data, self.scales)
         return self.data
 
+    def attach_prefix_cache(self, prefix: PrefixCache) -> None:
+        """Layer the content-addressed block index over the allocator:
+        refcount-0 cached blocks count as reclaimable capacity and are
+        LRU-evicted by :meth:`reserve` only under actual pressure. Also
+        builds AND compiles the CoW copy program here, off the serve
+        loop — the first partial-tail hit must not pay a trace+compile
+        inside the pipeline's plan-ahead window (DSL001 discipline)."""
+        self.prefix = prefix
+        self._warm_copy()
+
+    def _warm_copy(self) -> None:
+        """Compile the CoW row copy with a trash-block self-copy (writes
+        only the trash block, whose content is never read) and thread the
+        result back — on TPU the program donates the pool buffers."""
+        from .kv_quant import pool_parts
+        warmed = self.copy_block(self.pool, self.cfg.num_blocks,
+                                 self.cfg.num_blocks)
+        self.data, scales = pool_parts(warmed)
+        if scales is not None:
+            self.scales = scales
+
     @property
     def free_blocks(self) -> int:
-        return self.allocator.free_blocks
+        """Blocks a caller can still reserve: the allocator's free list
+        plus refcount-0 prefix-cached blocks (evictable on demand)."""
+        n = self.allocator.free_blocks
+        if self.prefix is not None:
+            n += self.prefix.evictable_blocks
+        return n
+
+    def collect_prefix_evictions(self) -> None:
+        if self.prefix is not None:
+            freed = self.prefix.collect_pending_free()
+            if freed:
+                self.allocator.free(freed)
 
     def reserve(self, n: int):
+        self.collect_prefix_evictions()
+        short = n - self.allocator.free_blocks
+        if short > 0 and self.prefix is not None:
+            self.allocator.free(self.prefix.evict(short))
         return self.allocator.allocate(n)
 
     def free(self, blocks) -> None:
         self.allocator.free(blocks)
+
+    # --------------------- prefix-cache CoW copy ---------------------- #
+
+    def copy_block(self, kv_data, src: int, dst: int):
+        """Copy one block's rows (and int8 scales) ``src`` -> ``dst`` —
+        the copy-on-write step behind a partial-tail prefix match. A
+        single compiled row copy on the functional pool thread; under TP
+        the pool's lane (head) dim is untouched, so the program is
+        head-local with ZERO collectives (audited:
+        test_program_audit.py::TestPrefixCacheBudgets)."""
+        if self._copy_jit is None:
+            self._copy_jit = self._build_copy()
+        return self._copy_jit(kv_data, jnp.int32(src), jnp.int32(dst))
+
+    def _build_copy(self):
+        import jax
+        from .kv_quant import pool_parts, repack
+        bs = self.cfg.block_size
+
+        def _copy(kv_data, src, dst):
+            data, scales = pool_parts(kv_data)
+            rows = jnp.arange(bs, dtype=jnp.int32)
+            si = src * bs + rows
+            di = dst * bs + rows
+            data = data.at[:, :, di].set(data[:, :, si])
+            if scales is not None:
+                scales = scales.at[:, :, :, di].set(scales[:, :, :, si])
+            return repack(kv_data, data, scales)
+
+        if self._mesh is not None:
+            from jax.sharding import PartitionSpec as P
+            from ...utils.jax_compat import shard_map
+            from .tp import pool_specs
+            spec = pool_specs(self.quantized)
+            _copy = shard_map(_copy, mesh=self._mesh,
+                              in_specs=(spec, P(), P()), out_specs=spec,
+                              check_vma=False)
+        # pool donated on TPU like every other pool-threading program
+        # (CPU XLA implements no donation; () avoids the warning spam)
+        donate = (0,) if jax.default_backend() == "tpu" else ()
+        return jax.jit(_copy, donate_argnums=donate)
 
     def shard(self, mesh) -> None:
         """Head-shard the pool at rest over the TP ``model`` mesh axis:
@@ -77,12 +158,16 @@ class BlockedKVCache:
         allocator are untouched — TP is invisible to the host side."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
+        self._mesh = mesh
+        self._copy_jit = None       # rebuild under the mesh
         self.data = jax.device_put(
             self.data, NamedSharding(mesh, P(None, None, None, "model")))
         if self.scales is not None:
             self.scales = jax.device_put(
                 self.scales, NamedSharding(mesh, P(None, None, "model",
                                                    None)))
+        if self.prefix is not None:
+            self._warm_copy()       # recompile eagerly, off the serve loop
 
     def memory_bytes(self) -> int:
         n = self.data.size * self.data.dtype.itemsize

@@ -1,0 +1,312 @@
+"""Content-addressed prefix cache over the paged KV pool.
+
+Automatic prefix caching for the v2 ragged engine (the optimization the
+reference's blocked KV layout exists to enable — fixed blocks are what
+make KV state shareable and remappable): fleets of requests that share a
+system prompt / few-shot preamble re-prefill identical tokens from
+position 0, so both the prefill FLOPs and the KV HBM writes for those
+tokens are redundant. This module indexes FULL KV blocks by the token
+chain that produced them so a later sequence can point its block table at
+the already-written device blocks and skip those prefill chunks entirely.
+
+Design (docs/serving.md "Automatic prefix caching"):
+
+  * **Block identity is the whole prefix**, not the block's own tokens:
+    entries are parent-linked (a trie over ``block_size``-token groups),
+    so two blocks holding the same 64 tokens at different positions — or
+    after different histories — never alias. KV content is a
+    deterministic function of (params, config, token chain, absolute
+    positions), and a chain always starts at position 0, which is what
+    makes reuse exact: the cached rows are bit-identical to what a fresh
+    prefill would write (including int8 ``kv_quant`` payloads + scales
+    and WOQ-weight-produced values — determinism covers the quantized
+    content too).
+  * **Refcounts, never frees**: a cached block is co-owned by the cache
+    and every live sequence whose table references it. Release paths
+    (flush, EOS rollback ``trim_blocks``, pause) *decref*; the block only
+    returns to the allocator when the cache itself evicts it.
+  * **Refcount-0 blocks stay cached** (that is the whole point) and are
+    reclaimed ONLY under allocator pressure: ``BlockedKVCache.reserve``
+    asks the cache to evict just enough refcount-0 blocks, leaf-first in
+    LRU (or FIFO) order. A parent is never evicted before its cached
+    children — an orphaned child could no longer be reached by a match
+    walk and would leak its block until drain.
+  * **Copy-on-write tail**: when a match ends mid-block (the shared
+    preamble is rarely block-aligned) the cached child block whose tokens
+    extend the match is COPIED into a freshly allocated private block
+    (one on-device row copy, zero collectives) and the sequence skips the
+    agreeing token span; its own continuation then writes into the
+    private copy — never into the shared block.
+
+Everything here is host-side metadata (dicts over ints); the one device
+interaction — the CoW row copy — is dispatched by the engine through
+``BlockedKVCache.copy_block``. ``match``/``insert``/``evict`` are
+registered DSL001 hot paths: they run inside the serve loop's plan-ahead
+window and must never block on the device.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, Optional, Tuple
+
+TokenKey = Tuple[int, ...]
+
+
+class _Entry:
+    """One cached full block: ``tokens`` (its block_size-token group),
+    its parent link (identity = the whole chain), the device block id it
+    owns, and the live-sequence refcount."""
+
+    __slots__ = ("tokens", "block", "parent", "children", "refs", "stamp",
+                 "born")
+
+    def __init__(self, tokens: TokenKey, block: int,
+                 parent: Optional["_Entry"], stamp: int):
+        self.tokens = tokens
+        self.block = block
+        self.parent = parent
+        self.children: Dict[TokenKey, _Entry] = {}
+        self.refs = 0            # live sequences referencing this block
+        self.stamp = stamp       # LRU clock: last time refs dropped to 0
+        self.born = stamp        # FIFO clock: insertion order
+
+
+class PrefixCache:
+    """Host-side index of cached KV blocks, layered on the allocator:
+    blocks it holds are *allocated* as far as ``BlockedAllocator`` is
+    concerned and are returned via :meth:`evict` only."""
+
+    def __init__(self, block_size: int, max_blocks: int = 0,
+                 policy: str = "lru"):
+        if policy not in ("lru", "fifo"):
+            raise ValueError(
+                f"prefix_cache_policy must be 'lru' or 'fifo', got "
+                f"{policy!r}")
+        self.block_size = block_size
+        self.max_blocks = max_blocks          # 0 = bounded by the pool only
+        self.policy = policy
+        self._roots: Dict[TokenKey, _Entry] = {}
+        self._by_block: Dict[int, _Entry] = {}
+        # blocks evicted as a side effect of a capped insert, awaiting
+        # collection by BlockedKVCache (the allocator's owner is the only
+        # place that frees)
+        self._pending_free: List[int] = []
+        self._evictable = 0      # running count of refs==0 entries
+        # lazy-deletion min-heap of (rank, block) eviction candidates:
+        # leaves are pushed when their refcount drops to 0 (and parents
+        # when their last cached child leaves), stale tuples are skipped
+        # at pop time by re-validating against the live entry — so evict()
+        # under steady pool pressure never rescans the whole index
+        self._heap: List[Tuple[int, int]] = []
+        self._clock = 0
+        self.stats = {"hit_blocks": 0, "cow_hits": 0, "inserted": 0,
+                      "evicted": 0}
+
+    # ------------------------------------------------------------------ #
+    # introspection
+    # ------------------------------------------------------------------ #
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._by_block)
+
+    @property
+    def evictable_blocks(self) -> int:
+        """Blocks reclaimable under pressure. refs(parent) >= refs(child)
+        (a matching sequence acquires every entry on its path), so a
+        refcount-0 entry's whole subtree is refcount-0 and the count of
+        refs==0 entries IS the reclaimable total. Maintained as a running
+        counter — this is read via ``BlockedKVCache.free_blocks`` on every
+        ``can_schedule`` call, a scan here would scale with cache size."""
+        return self._evictable
+
+    def entry_of(self, block: int) -> Optional[_Entry]:
+        return self._by_block.get(block)
+
+    # ------------------------------------------------------------------ #
+    # match / acquire / release — the serve-loop hot path
+    # ------------------------------------------------------------------ #
+
+    def match(self, tokens) -> Tuple[List[_Entry], Optional[_Entry], int]:
+        """Longest cached prefix of ``tokens``.
+
+        Returns ``(entries, cow, cow_len)``: ``entries`` are the matched
+        full-block chain (NOT yet acquired — the caller increfs via
+        :meth:`acquire` once it commits to using them); ``cow`` is the
+        child entry whose block agrees with the next ``cow_len`` tokens
+        after the full-block match (copy-on-write candidate), or None.
+        At least ONE trailing token is always left unmatched so the
+        engine still runs a final chunk and returns last-token logits."""
+        bs = self.block_size
+        n = len(tokens)
+        out: List[_Entry] = []
+        node: Optional[_Entry] = None
+        pos = 0
+        while pos + bs <= n - 1:
+            key = tuple(tokens[pos:pos + bs])
+            child = (self._roots if node is None else node.children).get(key)
+            if child is None:
+                break
+            out.append(child)
+            node = child
+            pos += bs
+        # copy-on-write tail: the longest agreeing span of any cached
+        # child of the matched node (capped one short of the remainder)
+        cow, cow_len = None, 0
+        cap = n - pos - 1
+        if cap > 0:
+            children = self._roots if node is None else node.children
+            limit = min(cap, bs)
+            first = tokens[pos]
+            for child in children.values():
+                ctoks = child.tokens
+                if ctoks[0] != first:
+                    continue   # span would be 0 — a node with many
+                    #            children (one per unique tail) reduces
+                    #            to one int compare per sibling
+                span = 1
+                while span < limit and ctoks[span] == tokens[pos + span]:
+                    span += 1
+                if span > cow_len:
+                    cow, cow_len = child, span
+        if cow_len == 0:
+            cow = None
+        return out, cow, cow_len
+
+    def acquire(self, entry: _Entry) -> None:
+        if entry.refs == 0:
+            self._evictable -= 1
+        entry.refs += 1
+
+    def release_block(self, block: int) -> bool:
+        """Decref the entry owning ``block``; True when it was cached
+        (False = not a cache block, the caller frees it normally)."""
+        entry = self._by_block.get(block)
+        if entry is None:
+            return False
+        if entry.refs <= 0:
+            raise RuntimeError(
+                f"prefix-cache refcount underflow on block {block}")
+        entry.refs -= 1
+        if entry.refs == 0:
+            self._evictable += 1
+            self._clock += 1
+            entry.stamp = self._clock
+            if not entry.children:
+                self._push_candidate(entry)
+        return True
+
+    def _rank(self, entry: _Entry) -> int:
+        return entry.stamp if self.policy == "lru" else entry.born
+
+    def _push_candidate(self, entry: _Entry) -> None:
+        # stale tuples (re-acquired entries, evicted-and-reused block
+        # ids) are skipped at pop time by a rank mismatch: stamps are
+        # unique per release and born per insert, so a matching rank
+        # identifies the same incarnation in the same state. Compact
+        # when stale tuples dominate, keeping the heap O(cached).
+        heapq.heappush(self._heap, (self._rank(entry), entry.block))
+        if len(self._heap) > 2 * len(self._by_block) + 64:
+            self._heap = [(self._rank(e), e.block)
+                          for e in self._by_block.values()
+                          if not e.refs and not e.children]
+            heapq.heapify(self._heap)
+
+    # ------------------------------------------------------------------ #
+    # insert / evict
+    # ------------------------------------------------------------------ #
+
+    def lookup_child(self, parent: Optional[_Entry],
+                     tokens: TokenKey) -> Optional[_Entry]:
+        return (self._roots if parent is None else parent.children) \
+            .get(tokens)
+
+    def insert(self, parent: Optional[_Entry], tokens: TokenKey,
+               block: int) -> Optional[_Entry]:
+        """Adopt ``block`` (already written with ``tokens``' KV under
+        ``parent``'s chain) into the index with refs=1 held by the
+        registering sequence. Returns None — and adopts nothing — when
+        the key already exists (the first writer won; the caller's block
+        stays private) or the ``max_blocks`` cap is reached and nothing
+        is evictable."""
+        if len(tokens) != self.block_size:
+            raise ValueError(
+                f"only full {self.block_size}-token blocks are cacheable, "
+                f"got {len(tokens)}")
+        siblings = self._roots if parent is None else parent.children
+        if tokens in siblings:
+            return None
+        if self.max_blocks and len(self._by_block) >= self.max_blocks:
+            # stay under the cap by evicting one cold block; if nothing
+            # is evictable the insert is skipped (block stays private)
+            victims = self.evict(1)
+            if not victims:
+                return None
+            # the victim's block goes back to the ALLOCATOR through the
+            # caller-visible path: stash it for collection
+            self._pending_free.extend(victims)
+        self._clock += 1
+        entry = _Entry(tokens, block, parent, self._clock)
+        entry.refs = 1
+        siblings[tokens] = entry
+        self._by_block[block] = entry
+        self.stats["inserted"] += 1
+        return entry
+
+    def collect_pending_free(self) -> List[int]:
+        out = self._pending_free
+        self._pending_free = []
+        return out
+
+    def evict(self, n: int) -> List[int]:
+        """Reclaim up to ``n`` refcount-0 blocks, leaf-first in policy
+        order (lru: least-recently-released; fifo: oldest insertion).
+        Returns the freed device block ids (the caller hands them back to
+        the allocator). Pops the persistent candidate heap (fed by
+        ``release_block`` and by parents whose last cached child leaves),
+        skipping stale tuples — eviction under steady pool pressure is
+        O(log cached) per victim, never a rescan of the index; this runs
+        inside ``reserve`` on the scheduling hot path."""
+        freed: List[int] = []
+        while self._heap and len(freed) < n:
+            rank, blk = heapq.heappop(self._heap)
+            e = self._by_block.get(blk)
+            if e is None or e.refs or e.children or self._rank(e) != rank:
+                continue               # stale: superseded or reused id
+            siblings = self._roots if e.parent is None \
+                else e.parent.children
+            del siblings[e.tokens]
+            del self._by_block[blk]
+            self._evictable -= 1
+            freed.append(blk)
+            self.stats["evicted"] += 1
+            p = e.parent
+            if p is not None and not p.refs and not p.children:
+                self._push_candidate(p)
+        return freed
+
+    def check_invariants(self) -> None:
+        """Model-checker hook (tests): structural consistency of the
+        index — every entry reachable from a root, block map exact,
+        refs(parent) >= refs(child)."""
+        seen = {}
+        stack = [(None, e) for e in self._roots.values()]
+        while stack:
+            parent, e = stack.pop()
+            assert e.parent is parent, "parent link broken"
+            assert e.block not in seen, "block owned by two entries"
+            if parent is not None:
+                assert parent.refs >= e.refs, \
+                    "child outlives parent refcount"
+            seen[e.block] = e
+            stack.extend((e, c) for c in e.children.values())
+        assert seen.keys() == self._by_block.keys(), \
+            "block index out of sync with the trie"
+        assert self._evictable == sum(
+            1 for e in self._by_block.values() if e.refs == 0), \
+            "evictable counter out of sync with refcounts"
+        live = {(self._rank(e), e.block) for e in self._by_block.values()
+                if not e.refs and not e.children}
+        assert live <= set(self._heap), \
+            "evictable leaf missing from the candidate heap"

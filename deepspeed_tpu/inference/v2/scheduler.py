@@ -92,6 +92,13 @@ class SplitFuseScheduler:
             if not self.state.can_schedule(seq.uid, n):
                 continue                       # KV pressure: leave waiting
             self.state.ensure_blocks(seq, n)
+            if seq.seen_tokens < seq.prompt_len:
+                # prefill work that actually RAN — the denominator of the
+                # prefix cache's skipped-chunk fraction (matched tokens
+                # never reach this point: they moved pending->seen at
+                # match time and no chunk is ever scheduled for them)
+                self.state.prefix_stats["prefill_tokens"] += \
+                    min(n, seq.prompt_len - seq.seen_tokens)
             tokens = seq.pending_tokens[:n]
             del seq.pending_tokens[:n]
             out.append(ScheduledSeq(

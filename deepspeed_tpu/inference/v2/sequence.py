@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from typing import List
+from typing import List, Optional, Set
 
 
 class SequenceStatus(enum.Enum):
@@ -35,6 +35,19 @@ class SequenceDescriptor:
     # last_step, whose engine-step clock jumps by n per fused decode_batch
     # call): what prefill AGING measures waiting time against
     last_sched: int = 0
+    # prefix caching (engine prefix_cache=True): block ids in kv_blocks
+    # that are CACHE-SHARED — co-owned by the prefix cache (and possibly
+    # other sequences). Release paths (flush / trim_blocks rollback /
+    # pause) must DECREF these through the cache, never free them to the
+    # allocator; only cache eviction frees a shared block.
+    shared: Set[int] = field(default_factory=set)
+    # the sequence's initial prompt (set at first put) while its full
+    # blocks still await registration into the prefix cache; None once
+    # registered (or when caching is off)
+    prefix_tokens: Optional[List[int]] = None
+    # prompt length incl. any cache-matched span — scheduler positions
+    # below this count as PREFILL work for the skipped-chunk accounting
+    prompt_len: int = 0
     # pipelined serving (engine serve_pipeline_depth > 0): number of
     # SPECULATIVE placeholder tokens in pending_tokens whose value is
     # still on the device (a prior step's in-flight last-token buffer).

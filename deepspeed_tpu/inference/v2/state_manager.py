@@ -3,15 +3,25 @@
 Analogue of the reference's ``DSStateManager``
 (``inference/v2/ragged/ragged_manager.py:19``): tracks live sequences,
 grows their KV block allocations as tokens arrive, and frees state on flush.
+
+With prefix caching enabled (``prefix_cache.py``) the manager is also the
+refcount boundary: a sequence's leading blocks may be CACHE-SHARED
+(``seq.shared``), and every release path here — flush, the pipelined EOS
+rollback's ``trim_blocks``, the engine's pause offload — *decrefs* shared
+blocks through the cache instead of freeing them to the allocator. Matching
+(``match_prefix``) and registration (``register_prefix``) are the two
+host-side halves of automatic prefix reuse; the engine dispatches the
+device-side CoW copies that matching requests.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from .blocked_allocator import OutOfBlocksError
 from .config import RaggedInferenceConfig
 from .kv_cache import BlockedKVCache
+from .prefix_cache import PrefixCache
 from .sequence import SequenceDescriptor, SequenceStatus
 
 
@@ -26,6 +36,15 @@ class StateManager:
         # would instantly "age" every waiting prefill). New sequences
         # stamp their arrival here so aging measures real waiting time.
         self.step: int = 0
+        #: the content-addressed block index (None = prefix caching off);
+        #: set by the engine, which also attaches it to the kv cache
+        self.prefix: Optional[PrefixCache] = None
+        #: skipped-vs-run prefill accounting for the serve_prefix bench /
+        #: smoke rows: matched_tokens never ran a prefill chunk,
+        #: prefill_tokens did (scheduler-counted, prompt positions only)
+        self.prefix_stats = {"matched_tokens": 0, "matched_blocks": 0,
+                             "cow_tokens": 0, "cow_copies": 0,
+                             "prefill_tokens": 0, "match_queries": 0}
 
     # ------------------------------------------------------------------ #
 
@@ -45,6 +64,11 @@ class StateManager:
     def put_tokens(self, uid: int, tokens: Iterable[int]) -> SequenceDescriptor:
         seq = self.get_or_create(uid)
         seq.pending_tokens.extend(int(t) for t in tokens)
+        if seq.seen_tokens == 0 and not seq.kv_blocks:
+            # still a fresh prompt (nothing prefilled yet): everything
+            # pending is prompt — the span the prefix tracker hashes and
+            # the scheduler counts as prefill work
+            seq.prompt_len = seq.in_flight
         # PAUSED sequences keep their status: the scheduler skips them and
         # the engine auto-resumes as blocks free up (engine_v2._try_resume).
         if seq.status not in (SequenceStatus.RUNNING, SequenceStatus.PAUSED):
@@ -55,6 +79,124 @@ class StateManager:
                 f"sequence {uid}: {total} tokens exceeds max_context "
                 f"{self.cfg.max_context} (raise max_blocks_per_seq)")
         return seq
+
+    # ------------------------------------------------------------------ #
+    # prefix caching: match (longest cached prefix) + register (insert
+    # this sequence's full prompt blocks)
+    # ------------------------------------------------------------------ #
+
+    def match_prefix(self, seq: SequenceDescriptor
+                     ) -> List[Tuple[int, int]]:
+        """Point a FRESH sequence's block table at the longest cached
+        chain of its prompt and skip those tokens' prefill entirely
+        (pending -> seen with no scheduled chunk). Returns the
+        ``(src_block, dst_block)`` copy-on-write row copies the engine
+        must dispatch (partial-tail match into a private copy). At least
+        one trailing token is always left to prefill so the last chunk
+        still produces this sequence's logits. Pure host work plus
+        non-blocking device dispatch — a DSL001 hot path."""
+        copies: List[Tuple[int, int]] = []
+        pc = self.prefix
+        if pc is None or seq.seen_tokens or seq.kv_blocks \
+                or seq.in_flight < 2:
+            return copies
+        toks = seq.pending_tokens
+        seq.prefix_tokens = list(toks)
+        self.prefix_stats["match_queries"] += 1
+        entries, cow, cow_len = pc.match(toks)
+        bs = self.cfg.block_size
+        maxb = self.cfg.max_blocks_per_seq
+        # no table-width truncation needed here: put_tokens caps the
+        # prompt at max_context = maxb * bs, and match leaves >= 1 token,
+        # so at most maxb - 1 full blocks can match; the cow append below
+        # carries its own < maxb guard
+        matched = 0
+        for e in entries:
+            pc.acquire(e)
+            seq.kv_blocks.append(e.block)
+            seq.shared.add(e.block)
+            matched += bs
+        pc.stats["hit_blocks"] += len(entries)
+        self.prefix_stats["matched_blocks"] += len(entries)
+        if cow is not None and len(seq.kv_blocks) < maxb:
+            # pin the source entry across the reserve — with refcount 0
+            # it would itself be an eviction candidate for the block we
+            # are about to allocate as the copy destination
+            pc.acquire(cow)
+            try:
+                dst = self.kv_cache.reserve(1)[0]
+            except OutOfBlocksError:
+                dst = None
+            finally:
+                pc.release_block(cow.block)
+            if dst is not None:
+                copies.append((cow.block, dst))
+                seq.kv_blocks.append(dst)        # private: CoW, not shared
+                matched += cow_len
+                pc.stats["cow_hits"] += 1
+                self.prefix_stats["cow_copies"] += 1
+                self.prefix_stats["cow_tokens"] += cow_len
+        if matched:
+            seq.seen_tokens += matched
+            del seq.pending_tokens[:matched]
+            self.prefix_stats["matched_tokens"] += matched
+        return copies
+
+    def register_prefix(self, seq: SequenceDescriptor) -> None:
+        """Insert this sequence's fully-prefilled full prompt blocks into
+        the cache (first writer wins; duplicates stay private). Called by
+        the engine once a put() call has drained — every registered
+        block's KV writes are already dispatched, and any later matcher
+        dispatches after, so the device orders reads after writes through
+        the pool data dependence."""
+        pc = self.prefix
+        toks = seq.prefix_tokens
+        if pc is None or toks is None:
+            return
+        if seq.status is SequenceStatus.PAUSED or not seq.kv_blocks:
+            # defensive only — unreachable via put(), which drains before
+            # registering; guards a future out-of-drain caller against
+            # caching a paused sequence's released block ids
+            return
+        bs = self.cfg.block_size
+        usable = min(seq.seen_tokens, len(toks), len(seq.kv_blocks) * bs)
+        node = None
+        for i in range(usable // bs):
+            grp = tuple(toks[i * bs:(i + 1) * bs])
+            child = pc.lookup_child(node, grp)
+            if child is not None:
+                if child.block != seq.kv_blocks[i]:
+                    # another sequence won the race with a DIFFERENT device
+                    # block: our copy stays private, and grafting our NEXT
+                    # blocks under the foreign chain would break
+                    # refs(parent) >= refs(child) — we hold no refs along
+                    # it, so its ancestors could hit 0 while our child is
+                    # still referenced, stranding "evictable" capacity
+                    break
+                node = child       # ours (matched or registered earlier)
+                continue
+            entry = pc.insert(node, grp, seq.kv_blocks[i])
+            if entry is None:
+                break              # cap reached and nothing evictable
+            seq.shared.add(seq.kv_blocks[i])
+            node = entry
+        if seq.seen_tokens >= len(toks):
+            seq.prefix_tokens = None        # prompt fully processed
+        self.kv_cache.collect_prefix_evictions()
+
+    def release_blocks(self, seq: SequenceDescriptor, blocks) -> None:
+        """The one release path: cache-shared blocks are DECREF'd (they
+        stay cached, evictable once cold), private blocks go back to the
+        allocator."""
+        private: List[int] = []
+        for b in blocks:
+            if b in seq.shared:
+                seq.shared.discard(b)
+                self.prefix.release_block(b)
+            else:
+                private.append(b)
+        if private:
+            self.kv_cache.free(private)
 
     # ------------------------------------------------------------------ #
 
@@ -83,12 +225,14 @@ class StateManager:
         rollback half of speculative pipelined decode: when the delayed
         host readback reveals a sequence finished (EOS) at step k, the
         blocks its speculatively scheduled steps k+1.. over-allocated are
-        returned to the pool. Returns the number of blocks freed."""
+        returned to the pool. Cache-shared blocks are decref'd, never
+        freed (another sequence — or the cache — may still own them).
+        Returns the number of blocks released."""
         needed = -(-seq.seen_tokens // self.cfg.block_size)
         extra = seq.kv_blocks[needed:]
         if extra:
             del seq.kv_blocks[needed:]
-            self.kv_cache.free(extra)
+            self.release_blocks(seq, extra)
         return len(extra)
 
     def kv_memory_report(self) -> Dict[str, int]:
@@ -105,7 +249,7 @@ class StateManager:
         """Release a sequence and its KV blocks (reference ``flush``)."""
         seq = self._seqs.pop(uid, None)
         if seq is not None and seq.kv_blocks:
-            self.kv_cache.free(seq.kv_blocks)
+            self.release_blocks(seq, seq.kv_blocks)
 
     def flush_all(self) -> None:
         for uid in list(self._seqs):

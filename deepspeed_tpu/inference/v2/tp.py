@@ -70,6 +70,20 @@ TP_LMHEAD_PATTERNS = (r"lm_head", r"embed_out")
 POOL_DATA_SPEC = P(None, None, None, MODEL_AXIS)
 POOL_SCALE_SPEC = P(None, None, MODEL_AXIS, None)
 RING_SPEC = P(None, None, None, None, MODEL_AXIS)
+
+
+def pool_specs(quantized: bool):
+    """The KV pool's shard_map spec pytree — shared by every runner
+    program and by the prefix-cache CoW block copy
+    (``BlockedKVCache.copy_block``), which under TP must stay head-local:
+    the copy touches only the slots dim, so each chip copies its own
+    KV/tp head columns and the program carries zero collectives. Prefix
+    sharing itself is invisible to TP — block tables are host metadata,
+    and a shared block id simply appears in several tables while its rows
+    stay sharded exactly like private blocks."""
+    if quantized:
+        return KVPool(POOL_DATA_SPEC, POOL_SCALE_SPEC)
+    return POOL_DATA_SPEC
 # The overlapped pipeline's feedback operands (prev-step [S] last-token
 # buffer + feed mask/idx) carry NO spec here: every chip computed
 # identical full-width logits before argmax (tp_gather_logits), so the
@@ -224,9 +238,7 @@ class TPContext:
     quantized_comm: bool = False
 
     def pool_spec(self, quantized: bool):
-        if quantized:
-            return KVPool(POOL_DATA_SPEC, POOL_SCALE_SPEC)
-        return POOL_DATA_SPEC
+        return pool_specs(quantized)
 
     @property
     def ring_spec(self):

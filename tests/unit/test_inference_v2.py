@@ -1494,6 +1494,214 @@ class TestSchedulerAging:
         assert [it.seq.uid for it in items] == [2, 3, 1]
 
 
+class TestPrefixCachedServing:
+    """ISSUE 5 tentpole: automatic prefix caching — refcounted KV-block
+    reuse across sequences (``inference/v2/prefix_cache.py``). Greedy
+    decode must be TOKEN-IDENTICAL with ``prefix_cache`` on vs off while
+    matched sequences skip their shared prefill chunks entirely, and
+    every release path (flush, pipelined EOS rollback, pause) must
+    decref shared blocks, never free them."""
+
+    @staticmethod
+    def _with(cfg, **kw):
+        return RaggedInferenceConfig(**{**cfg.__dict__, **kw})
+
+    def _shared_prompts(self, n, shared_len=10, tail=5, seed=71, vocab=96):
+        rng = np.random.default_rng(seed)
+        shared = rng.integers(1, vocab, shared_len).tolist()
+        return [shared + rng.integers(1, vocab, tail).tolist()
+                for _ in range(n)]
+
+    @pytest.mark.parametrize(
+        "depth", [pytest.param(0, marks=pytest.mark.slow), 2])
+    def test_generate_token_identical_gpt2(self, depth):
+        cfg, mcfg, model, params = _tiny_setup()
+        prompts = self._shared_prompts(3)
+        base = self._with(cfg, serve_pipeline_depth=depth,
+                          decode_loop_steps=0)
+        ref = InferenceEngineV2(mcfg, params, base)
+        refs = [ref.generate([p], max_new_tokens=6)[0] for p in prompts]
+        eng = InferenceEngineV2(mcfg, params,
+                                self._with(base, prefix_cache=True))
+        got = [eng.generate([p], max_new_tokens=6)[0] for p in prompts]
+        assert got == refs
+        st = eng.prefix_stats
+        # requests 2 and 3 shared the 10-token preamble: 2 full blocks
+        # each plus a CoW tail — most of their prefill never ran
+        assert st["matched_blocks"] >= 4 and st["cow_copies"] >= 1
+        assert st["prefill_chunks_skipped_frac"] > 0.3
+        # hit sequences keep decoding over SHARED device blocks
+        assert st["hit_blocks"] > 0
+
+    def test_whole_prompt_cached_still_returns_logits(self):
+        # an identical repeated prompt: everything except the final token
+        # is served from cache, and put() still returns the last-token
+        # result (at least one token always prefills)
+        cfg, mcfg, model, params = _tiny_setup()
+        eng = InferenceEngineV2(mcfg, params,
+                                self._with(cfg, prefix_cache=True))
+        prompt = list(np.random.default_rng(72).integers(1, 96, 16))
+        r1 = eng.put([0], [prompt], _greedy=True)
+        r2 = eng.put([1], [prompt], _greedy=True)
+        assert r1[0] == r2[1]
+        seq = eng.state.sequences[1]
+        assert seq.seen_tokens == 16
+        # 3 full-block hits (block 4 would swallow the last token) + CoW
+        assert len(seq.shared) == 3
+        assert eng.prefix_stats["matched_tokens"] == 15
+
+    def test_eos_rollback_decrefs_shared_blocks(self):
+        # late EOS with speculative steps in flight (PR 3's deferred
+        # trim_blocks) while the sequence's leading blocks are SHARED:
+        # rollback must decref them — a free would corrupt the cache
+        cfg, mcfg, model, params = _tiny_setup(
+            block_size=1, num_blocks=64, max_blocks_per_seq=32)
+        cfg = self._with(cfg, attention_impl="dense", decode_loop_steps=0,
+                         prefix_cache=True)
+        prompt = list(np.random.default_rng(73).integers(1, 96, 10))
+        eng = InferenceEngineV2(mcfg, params, cfg)
+        f = eng.put([0], [prompt], _greedy=True)
+        chain = eng.decode_pipelined([0], [f[0]], 8)[0]
+        eng.flush(0)
+        eos = chain[2]
+        k = chain.index(eos)
+        cached0 = eng._prefix.cached_blocks
+        assert cached0 > 0
+        f = eng.put([1], [prompt], _greedy=True)       # cache hit
+        seq = eng.state.sequences[1]
+        assert seq.shared
+        out = eng.decode_pipelined([1], [f[1]], 8, eos_token_id=eos)[1]
+        assert out == chain[:k + 1]
+        # rollback trimmed the speculative blocks; the shared prefix is
+        # still intact in the cache (nothing was double-freed)
+        assert eng._prefix.cached_blocks >= cached0
+        eng.flush(1)
+        # capacity conservation: allocator free + cached == pool, and the
+        # engine-visible availability counts evictable cached blocks
+        assert eng.kv_cache.allocator.free_blocks \
+            + eng._prefix.cached_blocks == cfg.num_blocks
+        assert eng.free_blocks == cfg.num_blocks
+
+    @pytest.mark.full
+    def test_eviction_under_pressure_recovers_capacity(self):
+        cfg, mcfg, model, params = _tiny_setup(
+            num_blocks=8, max_blocks_per_seq=8)
+        eng = InferenceEngineV2(mcfg, params,
+                                self._with(cfg, prefix_cache=True))
+        rng = np.random.default_rng(74)
+        # distinct prompts fill the cache past the pool; reserve() must
+        # LRU-evict cold refcount-0 blocks instead of starving
+        for i in range(6):
+            p = rng.integers(1, 96, 9).tolist()
+            eng.generate([p], max_new_tokens=3)
+        st = eng.prefix_stats
+        assert st["evicted"] > 0
+        assert eng.free_blocks == cfg.num_blocks           # all flushed
+
+    @pytest.mark.full
+    def test_pause_resume_with_shared_blocks(self):
+        # pausing a sequence that references cache-shared blocks offloads
+        # its KV and DECREFS the shared run; resume restores into private
+        # blocks — tokens stay identical to the never-paused engine
+        cfg, mcfg, model, params = _tiny_setup()
+        prompts = self._shared_prompts(2, seed=75)
+        ref = InferenceEngineV2(mcfg, params, cfg)
+        r0 = ref.put([0], [prompts[0]], _greedy=True)
+        r1 = ref.put([1], [prompts[1]], _greedy=True)
+        rd = ref.decode_pipelined([1], [r1[1]], 4)[1]
+        eng = InferenceEngineV2(mcfg, params,
+                                self._with(cfg, prefix_cache=True))
+        g0 = eng.put([0], [prompts[0]], _greedy=True)
+        g1 = eng.put([1], [prompts[1]], _greedy=True)
+        assert (g0[0], g1[1]) == (r0[0], r1[1])
+        seq = eng.state.sequences[1]
+        assert seq.shared                      # riding the cached prefix
+        entry_blocks = set(seq.shared)
+        eng.pause(1)
+        assert not seq.shared and not seq.kv_blocks
+        # the cache still owns the blocks the paused sequence let go of
+        for b in entry_blocks:
+            assert eng._prefix.entry_of(b) is not None
+        eng.resume(1)
+        assert not seq.shared                  # resumed blocks are private
+        assert len(seq.kv_blocks) == -(-seq.seen_tokens // cfg.block_size)
+        gd = eng.decode_pipelined([1], [g1[1]], 4)[1]
+        assert gd == rd
+
+    @pytest.mark.full
+    def test_int8_kv_prefix_parity(self):
+        # int8 pool: the shared blocks hold QUANTIZED rows + scales; a
+        # hit must reproduce the exact quantized content a fresh prefill
+        # would write (CoW copies rows AND the transposed scale planes)
+        cfg, mcfg, model, params = _tiny_setup(
+            block_size=128, num_blocks=8, max_blocks_per_seq=3)
+        cfg = self._with(cfg, kv_cache_dtype="int8",
+                         attention_impl="dense")
+        # 130 shared + 126 unique = two FULL blocks per prompt: block 0
+        # is a clean hit, block 1 diverges after 2 tokens -> CoW copy
+        prompts = self._shared_prompts(2, shared_len=130, tail=126,
+                                       seed=76)
+        ref = InferenceEngineV2(mcfg, params, cfg)
+        refs = [ref.generate([p], max_new_tokens=4)[0] for p in prompts]
+        eng = InferenceEngineV2(mcfg, params,
+                                self._with(cfg, prefix_cache=True))
+        got = [eng.generate([p], max_new_tokens=4)[0] for p in prompts]
+        assert got == refs
+        st = eng.prefix_stats
+        assert st["matched_blocks"] >= 1 and st["cow_copies"] >= 1
+
+    @pytest.mark.slow
+    def test_llama_and_woq_prefix_parity(self):
+        from deepspeed_tpu.inference.quantization import \
+            quantize_model_params
+        from deepspeed_tpu.models.llama import Llama, LlamaConfig
+        mcfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="xla")
+        model = Llama(mcfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        qparams = quantize_model_params(params, {"quantized_weights": {
+            "enabled": True, "num_bits": 8, "group_size": 64,
+            "modules": ["proj"]}})
+        cfg = RaggedInferenceConfig(max_seqs=2, chunk_size=8, block_size=4,
+                                    num_blocks=64, max_blocks_per_seq=16,
+                                    dtype="float32", decode_loop_steps=0)
+        prompts = self._shared_prompts(2, seed=77, vocab=500)
+        for ps in (params, qparams):
+            ref = InferenceEngineV2(mcfg, ps, cfg)
+            refs = [ref.generate([p], max_new_tokens=5)[0]
+                    for p in prompts]
+            eng = InferenceEngineV2(mcfg, ps,
+                                    self._with(cfg, prefix_cache=True))
+            got = [eng.generate([p], max_new_tokens=5)[0]
+                   for p in prompts]
+            assert got == refs
+            assert eng.prefix_stats["matched_blocks"] > 0
+
+    @pytest.mark.slow
+    def test_tp2_prefix_parity(self):
+        # shared blocks in a HEAD-SHARDED pool: block tables are host
+        # metadata, so per-chip sharing needs no new collectives — the
+        # hit path's programs are the same audited step programs
+        mcfg, model, params, base = _tp_setup()
+        prompts = self._shared_prompts(2, seed=78)
+        ref = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base))
+        refs = [ref.generate([p], max_new_tokens=6)[0] for p in prompts]
+        eng = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base, tp_size=2, prefix_cache=True))
+        got = [eng.generate([p], max_new_tokens=6)[0] for p in prompts]
+        assert got == refs
+        assert eng.prefix_stats["matched_blocks"] > 0
+
+    def test_off_by_default_zero_overhead_path(self):
+        cfg, mcfg, model, params = _tiny_setup()
+        eng = InferenceEngineV2(mcfg, params, cfg)
+        assert eng._prefix is None
+        eng.put([0], [[1, 2, 3, 4, 5]], _greedy=True)
+        assert eng.prefix_stats["matched_tokens"] == 0
+        assert eng.prefix_stats["prefill_chunks_skipped_frac"] == 0.0
+
+
 class TestEvoformerFullyMasked:
     """Rows whose mask bias is -inf across every key (padded MSA rows)
     must produce 0 output — not NaN — on BOTH the flash kernel and the

@@ -1,0 +1,310 @@
+"""Prefix cache (ISSUE 5): the content-addressed refcounted block index
+(`inference/v2/prefix_cache.py`) and its allocator/state-manager seams.
+
+The centerpiece is the randomized stress test: interleaved
+alloc/match/share/decref/evict/trim against a reference-counting model
+checker — no double free (the allocator now detects it exactly), no freed
+block aliasing into a live block table, and full capacity recovery at
+drain. This covers the PR 3 interplay where the pipelined EOS rollback's
+deferred ``trim_blocks`` must decref shared blocks instead of freeing
+them."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (
+    BlockedAllocator,
+    BlockedKVCache,
+    PrefixCache,
+    RaggedInferenceConfig,
+    StateManager,
+)
+from deepspeed_tpu.inference.v2.blocked_allocator import OutOfBlocksError
+
+
+class TestAllocatorGuards:
+    def test_double_free_detected_exactly(self):
+        a = BlockedAllocator(8)
+        blocks = a.allocate(3)
+        a.free(blocks[:1])
+        with pytest.raises(RuntimeError, match="double free of block"):
+            a.free(blocks[:1])
+        # the failed free must not have corrupted the free list
+        assert a.free_blocks == 6
+
+    def test_partial_double_free_rolls_nothing_in(self):
+        a = BlockedAllocator(4)
+        b = a.allocate(2)
+        a.free([b[0]])
+        with pytest.raises(RuntimeError):
+            a.free([b[0], b[1]])       # first id already free
+        assert a.free_blocks == 3      # b[1] NOT silently freed
+
+    def test_same_call_duplicate_detected(self):
+        a = BlockedAllocator(8)
+        b = a.allocate(1)[0]
+        # the duplicate is WITHIN one call: neither copy is in the free
+        # set when checked, so only a same-call guard catches it (a miss
+        # would hand block b to two later allocate() calls)
+        with pytest.raises(RuntimeError, match="double free"):
+            a.free([b, b])
+        assert a.free_blocks == 7      # nothing rolled in
+
+
+class TestPrefixCacheIndex:
+    def _pc(self, bs=4, **kw):
+        return PrefixCache(bs, **kw)
+
+    def test_identity_includes_parent_chain(self):
+        pc = self._pc()
+        a = pc.insert(None, (1, 2, 3, 4), 0)
+        b = pc.insert(a, (9, 9, 9, 9), 1)
+        # the SAME tokens under a different prefix are a different block
+        c = pc.insert(None, (9, 9, 9, 9), 2)
+        assert b is not None and c is not None and b is not c
+        ents, cow, n = pc.match([1, 2, 3, 4, 9, 9, 9, 9, 5])
+        assert [e.block for e in ents] == [0, 1]
+        ents2, _, _ = pc.match([9, 9, 9, 9, 5])
+        assert [e.block for e in ents2] == [2]
+
+    def test_match_leaves_last_token(self):
+        pc = self._pc()
+        a = pc.insert(None, (1, 2, 3, 4), 0)
+        pc.insert(a, (5, 6, 7, 8), 1)
+        # the whole query is cached — the match must still leave >= 1
+        # token for the engine's final chunk (last-token logits)
+        ents, cow, n = pc.match([1, 2, 3, 4, 5, 6, 7, 8])
+        assert [e.block for e in ents] == [0]
+        assert cow is not None and cow.block == 1 and n == 3
+
+    def test_cow_longest_agreeing_child(self):
+        pc = self._pc()
+        root = pc.insert(None, (1, 2, 3, 4), 0)
+        pc.insert(root, (5, 6, 0, 0), 1)
+        pc.insert(root, (5, 6, 7, 0), 2)
+        ents, cow, n = pc.match([1, 2, 3, 4, 5, 6, 7, 9, 9])
+        assert [e.block for e in ents] == [0]
+        assert cow.block == 2 and n == 3
+
+    def test_eviction_leaf_first_lru(self):
+        pc = self._pc()
+        a = pc.insert(None, (1,) * 4, 0)
+        b = pc.insert(a, (2,) * 4, 1)
+        c = pc.insert(None, (3,) * 4, 2)
+        for e in (a, b, c):
+            pc.release_block(e.block)      # refs 1 -> 0, in insert order
+        # a has a cached child: only b and c are leaf-evictable; b was
+        # released before c -> LRU takes b; that makes a a leaf, and a
+        # (released before c) goes next, then c
+        assert pc.evict(1) == [1]
+        assert pc.evict(2) == [0, 2]
+        assert pc.cached_blocks == 0
+
+    def test_refcounted_blocks_not_evictable(self):
+        pc = self._pc()
+        a = pc.insert(None, (1,) * 4, 0)
+        pc.acquire(a)                      # a matcher holds it
+        pc.release_block(0)                # registering seq lets go
+        assert pc.evictable_blocks == 0 and pc.evict(4) == []
+        pc.release_block(0)
+        assert pc.evictable_blocks == 1
+
+    def test_refcount_underflow_raises(self):
+        pc = self._pc()
+        pc.insert(None, (1,) * 4, 0)
+        pc.release_block(0)
+        with pytest.raises(RuntimeError, match="underflow"):
+            pc.release_block(0)
+
+    def test_insert_duplicate_not_adopted(self):
+        pc = self._pc()
+        assert pc.insert(None, (1,) * 4, 0) is not None
+        assert pc.insert(None, (1,) * 4, 5) is None
+        assert pc.cached_blocks == 1
+
+    def test_max_blocks_cap_evicts_or_skips(self):
+        pc = self._pc(max_blocks=2)
+        a = pc.insert(None, (1,) * 4, 0)
+        b = pc.insert(None, (2,) * 4, 1)
+        # everything referenced: cap reached, insert skipped
+        assert pc.insert(None, (3,) * 4, 2) is None
+        pc.release_block(0)
+        # a is cold now: the capped insert evicts it and adopts
+        e = pc.insert(None, (4,) * 4, 3)
+        assert e is not None
+        assert pc.collect_pending_free() == [0]
+        assert pc.cached_blocks == 2
+
+    def test_fifo_policy_orders_by_insertion(self):
+        pc = self._pc(policy="fifo")
+        pc.insert(None, (1,) * 4, 0)
+        pc.insert(None, (2,) * 4, 1)
+        pc.release_block(1)                # released FIRST
+        pc.release_block(0)
+        assert pc.evict(1) == [0]          # but 0 was inserted first
+
+
+class TestBatchedPutRegistration:
+    def test_no_graft_under_foreign_chain(self):
+        """Batched put() race: two fresh prompts sharing a prefix both
+        match (empty cache) BEFORE either registers. The first writer
+        owns the chain; the second's copies stay private — it must NOT
+        graft its extra full block under the foreign chain, which would
+        let the chain's ancestors hit refcount 0 while a referenced
+        child stays cached (breaking refs(parent) >= refs(child) and
+        overcounting evictable capacity)."""
+        import jax.numpy as jnp
+        bs = 4
+        cfg = RaggedInferenceConfig(
+            max_seqs=4, chunk_size=8, block_size=bs, num_blocks=16,
+            max_blocks_per_seq=8, dtype="float32", prefix_cache=True)
+        kv = BlockedKVCache(cfg, 1, 1, 4, jnp.float32)
+        pc = PrefixCache(bs)
+        kv.attach_prefix_cache(pc)
+        sm = StateManager(cfg, kv)
+        sm.prefix = pc
+        shared = [1, 2, 3, 4, 5, 6, 7, 8]
+        s0 = sm.put_tokens(0, shared + [9])                    # 2 full blocks
+        s1 = sm.put_tokens(1, shared + [10, 11, 12, 13, 14])   # 3 full blocks
+        sm.match_prefix(s0)
+        sm.match_prefix(s1)            # nothing cached yet: both miss
+        for s in (s0, s1):
+            n = s.in_flight
+            sm.ensure_blocks(s, n)
+            del s.pending_tokens[:n]
+            s.seen_tokens += n
+        sm.register_prefix(s0)         # first writer wins the shared chain
+        sm.register_prefix(s1)
+        pc.check_invariants()
+        sm.flush(0)                    # chain goes cold; must ALL be
+        pc.check_invariants()          # evictable — no stranded child
+        assert pc.evictable_blocks == pc.cached_blocks == 2
+        sm.flush(1)
+        kv.allocator.free(pc.evict(16))
+        assert pc.cached_blocks == 0
+        assert kv.allocator.free_blocks == 16
+
+
+class TestRandomizedRefcountModel:
+    """The satellite model checker: random interleavings of the full
+    block lifecycle against a shadow ownership model."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_stress_no_double_free_no_aliasing_full_drain(self, seed):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(seed)
+        bs, num_blocks = 4, 48
+        cfg = RaggedInferenceConfig(
+            max_seqs=4, chunk_size=8, block_size=bs, num_blocks=num_blocks,
+            max_blocks_per_seq=8, dtype="float32", prefix_cache=True)
+        kv = BlockedKVCache(cfg, 1, 1, 4, jnp.float32)
+        pc = PrefixCache(bs, policy=rng.choice(["lru", "fifo"]))
+        kv.attach_prefix_cache(pc)
+        sm = StateManager(cfg, kv)
+        sm.prefix = pc
+
+        # a small prompt alphabet so random prompts actually collide
+        vocab, next_uid = 3, [0]
+        live = {}
+
+        def new_seq():
+            uid = next_uid[0]
+            next_uid[0] += 1
+            n = int(rng.integers(2, 29))
+            toks = rng.integers(0, vocab, n).tolist()
+            try:
+                seq = sm.put_tokens(uid, toks)
+            except ValueError:
+                return
+            sm.match_prefix(seq)       # copies would be device work: the
+            #                            stress checks bookkeeping only
+            # prefill the rest in random chunk sizes
+            while seq.in_flight:
+                c = int(rng.integers(1, 9))
+                c = min(c, seq.in_flight)
+                try:
+                    sm.ensure_blocks(seq, c)
+                except OutOfBlocksError:
+                    if not live:        # nothing to victimize: drop it
+                        sm.flush(uid)
+                        return
+                    # evict pressure path exercised; give up on this seq
+                    sm.flush(uid)
+                    return
+                del seq.pending_tokens[:c]
+                seq.seen_tokens += c
+            sm.register_prefix(seq)
+            live[uid] = seq
+
+        def decode_some(uid):
+            seq = live[uid]
+            n = int(rng.integers(1, 9))
+            try:
+                sm.ensure_blocks(seq, n)
+            except OutOfBlocksError:
+                return
+            seq.seen_tokens += n
+
+        def trim(uid):
+            seq = live[uid]
+            # retract a random speculative overrun (never into the prompt)
+            prompt = seq.prompt_len
+            if seq.seen_tokens > prompt:
+                seq.seen_tokens -= int(
+                    rng.integers(0, seq.seen_tokens - prompt + 1))
+            sm.trim_blocks(seq)
+
+        def check():
+            alloc = kv.allocator
+            free = set(alloc._free)
+            assert len(free) == alloc.free_blocks          # list == set
+            pc.check_invariants()
+            cached = set(pc._by_block)
+            assert not free & cached, "freed block still cached"
+            refs = {}
+            for seq in live.values():
+                tabs = set(seq.kv_blocks)
+                assert len(tabs) == len(seq.kv_blocks), \
+                    "block repeated in one table"
+                assert not any(alloc.is_free(b) for b in tabs), \
+                    "freed block aliased into a live block table"
+                for b in seq.kv_blocks:
+                    if b in seq.shared:
+                        assert b in cached, "shared block not cached"
+                        refs[b] = refs.get(b, 0) + 1
+                    else:
+                        # a private block is owned by exactly one table
+                        assert refs.setdefault(b, "private") == "private"
+            for b, n in refs.items():
+                if n != "private":
+                    assert pc.entry_of(b).refs == n, \
+                        f"refcount drift on block {b}"
+            # conservation: every block is free, cached, or exactly one
+            # sequence's private block
+            private = {b for s in live.values() for b in s.kv_blocks
+                       if b not in s.shared}
+            assert len(free) + len(cached) + len(private) == num_blocks
+
+        for _ in range(300):
+            op = rng.integers(0, 4)
+            if op == 0 or not live:
+                new_seq()
+            elif op == 1:
+                decode_some(int(rng.choice(list(live))))
+            elif op == 2:
+                trim(int(rng.choice(list(live))))
+            else:
+                uid = int(rng.choice(list(live)))
+                sm.flush(uid)
+                del live[uid]
+            check()
+
+        # drain: flush everything, then evict the whole cache — the
+        # allocator must recover FULL capacity
+        for uid in list(live):
+            sm.flush(uid)
+        live.clear()
+        check()
+        kv.allocator.free(pc.evict(num_blocks))
+        assert pc.cached_blocks == 0
+        assert kv.allocator.free_blocks == num_blocks

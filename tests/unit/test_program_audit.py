@@ -129,6 +129,50 @@ class TestCollectiveBudgets:
         assert "all_reduce[model]" in msg
 
 
+def _warm_hit_engine(tp):
+    eng = _gpt2_engine(tp=tp, prefix_cache=True)
+    # block_size=8: 10 shared + 8 unique = 2 FULL blocks per prompt —
+    # block 0 is a clean hit, block 1 agrees for 2 tokens (CoW)
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, 96, 10).tolist()
+    eng.put([0], [shared + rng.integers(1, 96, 8).tolist()],
+            _greedy=True)
+    eng.put([1], [shared + rng.integers(1, 96, 8).tolist()],
+            _greedy=True)
+    st = eng.prefix_stats
+    assert st["matched_blocks"] > 0 and st["cow_copies"] > 0, st
+    return eng
+
+
+@pytest.fixture(scope="module", params=[1, 2], ids=["tp1", "tp2"])
+def prefix_hit_engine(request):
+    return request.param, _warm_hit_engine(request.param)
+
+
+class TestPrefixCacheBudgets:
+    """ISSUE 5 satellite: a prefix-cache HIT serves fewer chunks through
+    the SAME compiled step programs — the hit path's collective counts
+    must equal the miss path's (zero at tp=1, the canonical 2-per-layer
+    all-reduces at tp=2), and the one new device program (the CoW block
+    copy) is head-local: zero collectives, zero host callbacks."""
+
+    def test_hit_prefill_budget_equals_miss_path(self, prefix_hit_engine):
+        tp, eng = prefix_hit_engine
+        per_layer = {} if tp == 1 else {"all_reduce": 2}
+        reps = audit_serve_programs(eng, programs=("step", "step_greedy"))
+        for name in ("step", "step_greedy"):
+            assert_budget(reps[name], CollectiveBudget(
+                f"tp{tp}-prefix-{name}", num_layers=L,
+                per_layer=per_layer))
+
+    def test_cow_copy_program_head_local(self, prefix_hit_engine):
+        tp, eng = prefix_hit_engine
+        rep = audit_fn(eng.kv_cache._copy_jit, eng._kv_data,
+                       jnp.int32(0), jnp.int32(1), name=f"cow-copy-tp{tp}")
+        assert rep.total_collectives == 0, rep.summary()
+        assert rep.host_callbacks == 0, rep.summary()
+
+
 class TestHostSyncHygiene:
     """PR 3's 'zero host round-trips on the steady decode path': the
     compiled programs must contain no host callbacks/infeed."""

@@ -58,8 +58,16 @@ RULES: Mapping[str, str] = {
 HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
     "deepspeed_tpu/inference/v2/engine_v2.py":
         ("_drive_pipeline", "_plan_step", "_dispatch_step",
-         "_staging_bufs"),
+         "_staging_bufs", "_match_prefix", "_register_prefix"),
     "deepspeed_tpu/inference/v2/model_runner.py": ("_build_programs",),
+    # the prefix-cache match/hash path runs inside put()'s plan-ahead
+    # window (before and between _drive_pipeline fills): pure host dict
+    # walks plus non-blocking CoW dispatch — a blocking readback here
+    # would serialize the pipeline exactly like one in _plan_step
+    "deepspeed_tpu/inference/v2/prefix_cache.py":
+        ("match", "acquire", "release_block", "insert", "evict"),
+    "deepspeed_tpu/inference/v2/state_manager.py":
+        ("match_prefix", "register_prefix", "release_blocks"),
 }
 
 #: roots scanned for DSTPU_* env reads (knob rules + gen_config_doc) —

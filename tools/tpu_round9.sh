@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-9 on-chip sequence: prefix-cached ragged serving (ISSUE 5).
+# Captures the first on-chip evidence that refcounted KV-block reuse is
+# token-exact against the compiled paged-flash kernel (smoke prefix_cache
+# row), that the hit path keeps the audited collective budgets (lint +
+# program-audit tier already passed on CPU; the smoke's program_audit row
+# re-proves donation on real hardware), and the serve_prefix bench's
+# shared-prefix workload numbers: prefill_chunks_skipped_frac, cache
+# on/off throughputs and the recompile tripwire over the measured window.
+# Strictly sequential (one process owns the chip), no timeouts around TPU
+# clients (a killed client wedges the grant).
+cd /root/repo || exit 1
+LOG=profiles/r09_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round9 start $(date -u +%FT%TZ)"
+
+echo "--- [1/5] tpu_smoke (incl. prefix_cache: on-chip cache-on vs"
+echo "    cache-off token parity + measured skipped-chunk fraction)"
+python tools/tpu_smoke.py | tee SMOKE_TPU_r09.txt
+
+echo "--- [2/5] dstpu_lint (now also covers the prefix-match hot path"
+echo "    and the prefix_cache knob rows in docs/CONFIG.md)"
+python bin/dstpu_lint deepspeed_tpu
+
+echo "--- [3/5] serve_prefix bench (shared-prefix workload: skipped"
+echo "    fraction, cache on/off steps/s, token parity, tripwire)"
+python bench.py serve_prefix > BENCH_PREFIX_r09.json
+tail -c 900 BENCH_PREFIX_r09.json
+
+echo "--- [4/5] serve control (cache-off flagship numbers, unchanged"
+echo "    hot path: program-audit budgets must hold)"
+python bench.py serve > BENCH_SERVE_r09.json
+tail -c 700 BENCH_SERVE_r09.json
+
+echo "--- [5/5] full bench (driver runs it again at round end)"
+python bench.py > BENCH_SELF_r09.json
+tail -c 700 BENCH_SELF_r09.json
+echo "=== tpu_round9 done $(date -u +%FT%TZ)"

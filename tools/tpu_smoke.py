@@ -309,6 +309,38 @@ def main():
     print(f"{'OK ' if aud_ok else 'FAIL'} program_audit: on-chip "
           f"donation+collective budgets (tp={tp})", flush=True)
 
+    # prefix cache (ISSUE 5): refcounted KV-block reuse ON CHIP — three
+    # sequential requests sharing a 130-token preamble; cache-on must be
+    # token-identical to cache-off while skipping most prefill chunks
+    # (the matched blocks are read by the compiled paged-flash kernel,
+    # and the CoW block copy gets its first Mosaic-adjacent compile here)
+    mcfg_p = GPT2Config(vocab_size=512, max_seq_len=512, num_layers=2,
+                        num_heads=8, hidden_size=512, dtype=jnp.bfloat16)
+    params_p = GPT2(mcfg_p).init(jax.random.PRNGKey(17),
+                                 jnp.zeros((1, 8), jnp.int32))["params"]
+    base_p = dict(max_seqs=4, chunk_size=32, block_size=128, num_blocks=16,
+                  max_blocks_per_seq=3, dtype="bfloat16",
+                  attention_impl="paged_flash", decode_loop_steps=0)
+    rng_p = np.random.RandomState(19)
+    shared_p = rng_p.randint(1, 512, size=130).tolist()
+    prompts_p = [shared_p + rng_p.randint(1, 512, size=30).tolist()
+                 for _ in range(3)]
+    ref_eng = InferenceEngineV2(mcfg_p, params_p,
+                                RaggedInferenceConfig(**base_p))
+    ref_p = [ref_eng.generate([p], max_new_tokens=8)[0] for p in prompts_p]
+    eng_p = InferenceEngineV2(
+        mcfg_p, params_p,
+        RaggedInferenceConfig(**base_p, prefix_cache=True))
+    got_p = [eng_p.generate([p], max_new_tokens=8)[0] for p in prompts_p]
+    par_p = got_p == ref_p
+    frac_p = eng_p.prefix_stats["prefill_chunks_skipped_frac"]
+    hit_p = eng_p.prefix_stats["matched_blocks"] > 0
+    ok &= par_p and hit_p
+    print(f"{'OK ' if par_p and hit_p else 'FAIL'} prefix_cache: "
+          f"token_parity={par_p} skipped_chunk_frac={frac_p:.3f} "
+          f"matched_blocks={eng_p.prefix_stats['matched_blocks']} "
+          f"cow_copies={eng_p.prefix_stats['cow_copies']}", flush=True)
+
     print("TPU_SMOKE " + ("PASS" if ok else "FAIL"), flush=True)
     return 0 if ok else 1
 
