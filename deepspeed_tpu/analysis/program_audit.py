@@ -101,30 +101,60 @@ class CollectiveSite:
         return f"{self.kind}[{','.join(self.axes)}]({self.dtype})"
 
 
+#: inner-jit (pjit eqn) name fragments that canonicalize the ppermute
+#: hops traced inside them: the decomposed TP collectives
+#: (``comm.ring_reduce_scatter`` / ``comm.ring_all_gather``) are built
+#: from ppermute rings, and counting those hops as raw ppermutes would
+#: make a reduce-scatter indistinguishable from pipeline p2p traffic.
+#: Any ppermute inside a region whose pjit name carries one of these
+#: fragments reports as the canonical decomposed kind — so a planted
+#: extra ring hop trips a reduce_scatter/all_gather budget diff.
+RING_REGION_KINDS: Mapping[str, str] = {
+    "ring_reduce_scatter": "reduce_scatter",
+    "ring_all_gather": "all_gather",
+}
+
+
+def _ring_kind_for(name: Optional[str]) -> Optional[str]:
+    if not name:
+        return None
+    for frag, kind in RING_REGION_KINDS.items():
+        if frag in name:
+            return kind
+    return None
+
+
 def _walk(jaxpr, counts: Dict[CollectiveSite, int], state: Dict[str, Any],
-          mult: int) -> None:
+          mult: int, ring_kind: Optional[str] = None) -> None:
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
         kind = COLLECTIVE_PRIMS.get(prim)
         if kind is not None:
+            if kind == "ppermute" and ring_kind is not None:
+                # a hop of a decomposed ring: canonicalize to the
+                # reduce-scatter / all-gather family it implements
+                kind = ring_kind
             site = CollectiveSite(
                 kind=kind, axes=_axis_names(eqn.params),
                 dtype=str(eqn.invars[0].aval.dtype))
             counts[site] = counts.get(site, 0) + mult
         if prim in HOST_CALLBACK_PRIMS:
             state["host_callbacks"] += mult
+        sub_ring = ring_kind
+        if prim == "pjit":
+            sub_ring = _ring_kind_for(eqn.params.get("name")) or ring_kind
         if prim == "scan":
             # a scan body executes `length` times: weight its collectives
             # so an n-step fused decode loop reports n x its per-step comm
             inner_mult = mult * int(eqn.params.get("length", 1))
             for sub in _subjaxprs(eqn.params):
-                _walk(sub, counts, state, inner_mult)
+                _walk(sub, counts, state, inner_mult, ring_kind)
             continue
         if prim == "while":
             # trip count is dynamic: counts stay per-iteration, flagged
             state["dynamic_loops"] += 1
         for sub in _subjaxprs(eqn.params):
-            _walk(sub, counts, state, mult)
+            _walk(sub, counts, state, mult, sub_ring)
 
 
 # ------------------------------------------------------------------ #
@@ -254,6 +284,15 @@ def audit_fn(fn: Callable, *args, name: Optional[str] = None,
 # ------------------------------------------------------------------ #
 
 
+def _budget_key(key: str) -> Tuple[str, Optional[str]]:
+    """Split a budget key into (kind, dtype): plain ``"reduce_scatter"``
+    covers every dtype; ``"reduce_scatter@int8"`` pins the comm dtype —
+    how the decomposed quantized schedule asserts its int8 value hops
+    separately from the f32 per-chunk scale hops."""
+    kind, sep, dt = key.partition("@")
+    return kind, (dt if sep else None)
+
+
 @dataclasses.dataclass
 class CollectiveBudget:
     """Expected collective structure of one program, as a regression spec.
@@ -261,11 +300,20 @@ class CollectiveBudget:
     ``per_layer`` maps canonical kind -> count per transformer layer per
     executed step; ``per_program`` maps kind -> count per executed step
     regardless of depth (e.g. the single pre-sampling logits gather).
-    ``steps`` is the scan trip count for fused loops (1 for plain steps).
-    Expected total per kind = ``steps * (num_layers * per_layer[kind]
-    + per_program[kind])``. Kinds absent from both maps must not appear
-    at all; collectives over axes other than ``axis`` are violations
-    unless ``allow_other_axes``.
+    A key may pin the comm dtype as ``"kind@dtype"`` (e.g.
+    ``"reduce_scatter@int8"``) — the decomposed quantized ring's int8
+    value hops and f32 scale hops are then budgeted separately; a plain
+    ``"kind"`` key aggregates over every dtype no sibling pinned key of
+    the same kind claims (so plain + pinned keys compose instead of
+    double-counting). ``steps`` is the scan trip
+    count for fused loops (1 for plain steps). Expected total per key =
+    ``steps * (num_layers * per_layer[key] + per_program[key])``. Kinds
+    absent from both maps must not appear at all; collectives over axes
+    other than ``axis`` are violations unless ``allow_other_axes``.
+    Ring-decomposed collectives (ppermute hops inside the
+    ``comm.ring_*`` regions) are already canonicalized to
+    reduce_scatter/all_gather by the walker — budget those kinds, not
+    ppermute.
     """
 
     name: str
@@ -287,17 +335,42 @@ class CollectiveBudget:
         """Violations of this budget in ``report`` (empty = conforming)."""
         out: List[str] = []
         expected = self.expected()
-        observed_kinds = {s.kind for s, n in report.collectives.items()
-                          if self.axis in s.axes and n}
-        for kind in sorted(set(expected) | observed_kinds):
-            want = expected.get(kind, 0)
-            got = report.count(kind=kind, axis=self.axis)
+        # (kind, dtype|None) -> budget key string; a plain-kind key
+        # absorbs every dtype of its kind EXCEPT dtypes a sibling pinned
+        # key already claims — so {"all_gather@int8": k, "all_gather": 1}
+        # budgets the ring's int8 hops and the f32 logits gather without
+        # double-counting the hops under the plain key
+        by_pair = {_budget_key(k): k for k in expected}
+        plain_kinds = {kind for kind, dt in by_pair if dt is None}
+        pinned: Dict[str, set] = {}
+        for kind, dt in by_pair:
+            if dt is not None:
+                pinned.setdefault(kind, set()).add(dt)
+        pairs = set(by_pair)
+        for site, n in report.collectives.items():
+            if self.axis in site.axes and n:
+                if site.dtype in pinned.get(site.kind, ()):
+                    pairs.add((site.kind, site.dtype))
+                elif site.kind in plain_kinds:
+                    pairs.add((site.kind, None))
+                else:
+                    pairs.add((site.kind, site.dtype))
+        for kind, dt in sorted(pairs, key=lambda t: (t[0], t[1] or "")):
+            key = by_pair.get((kind, dt), f"{kind}@{dt}" if dt else kind)
+            want = expected.get(key, 0)
+            got = report.count(kind=kind, axis=self.axis, dtype=dt)
+            if dt is None:
+                # subtract sites a sibling pinned key claims
+                got -= sum(report.count(kind=kind, axis=self.axis,
+                                        dtype=pdt)
+                           for pdt in pinned.get(kind, ()))
             if got != want:
+                label = kind if dt is None else f"{kind}@{dt}"
                 out.append(
-                    f"{kind}[{self.axis}]: expected {want} "
+                    f"{label}[{self.axis}]: expected {want} "
                     f"({self.steps} step(s) x ({self.num_layers} layers x "
-                    f"{self.per_layer.get(kind, 0)}/layer + "
-                    f"{self.per_program.get(kind, 0)}/program)), got {got}")
+                    f"{self.per_layer.get(key, 0)}/layer + "
+                    f"{self.per_program.get(key, 0)}/program)), got {got}")
         if not self.allow_other_axes:
             for site, n in sorted(report.collectives.items(), key=str):
                 if self.axis not in site.axes and n:

@@ -20,12 +20,14 @@ ICI/DCN. What this package keeps from the reference's design:
 """
 
 from .comm import (  # noqa: F401
+    TP_OVERLAP_MODES,
     all_gather,
     all_reduce,
     all_to_all_single,
     barrier,
     broadcast,
     configure,
+    decomposed_all_reduce,
     get_local_rank,
     get_rank,
     get_world_size,
@@ -34,7 +36,11 @@ from .comm import (  # noqa: F401
     is_initialized,
     log_summary,
     mpi_discovery,
+    overlap_all_reduce,
     ppermute,
     reduce_scatter,
+    resolve_tp_overlap,
+    ring_all_gather,
+    ring_reduce_scatter,
 )
 from .comms_logging import CommsLogger, get_comms_logger  # noqa: F401

@@ -38,10 +38,28 @@ class RaggedInferenceConfig(ConfigModel):
     # and each layer pays exactly two all-reduces plus one pre-sampling
     # logits gather. num_heads and kv_heads must divide by tp_size.
     tp_size: int = 1
-    # Route the TP all-reduces through int8 quantized comm (the ZeRO++
-    # helpers; EQuARX-class for bandwidth-bound decode). Greedy token
+    # Route the TP all-reduces through int8 quantized comm (EQuARX-class
+    # for bandwidth-bound decode). With tp_comm_overlap off this is the
+    # legacy monolithic int8 all-gather; with overlap on, quant/dequant
+    # fuses into every ring hop with per-chunk scales. Greedy token
     # parity across tp sizes is NOT guaranteed with this on.
     tp_quantized_comm: bool = False
+    # Decomposed, compute-overlappable TP collectives (comm/comm.py,
+    # docs/serving.md "Decomposed TP collectives"): replace each per-layer
+    # monolithic all-reduce with ring reduce-scatter + ring all-gather
+    # ppermute hops XLA can hide under adjacent GEMMs.
+    #   "off"           — one psum per site (the parity oracle);
+    #   "rs_ag"         — tp-1 RS hops + tp-1 AG hops per site;
+    #   "rs_ag_chunked" — additionally split the activation into
+    #                     tp_comm_chunks independent ring pipelines
+    #                     (k = chunks*(tp-1) hops per phase per site).
+    # The env knob DSTPU_TP_OVERLAP (off|rs_ag|rs_ag_chunked[:k])
+    # overrides at engine construction — the operational kill-switch.
+    tp_comm_overlap: str = "off"
+    # Chunk count for tp_comm_overlap="rs_ag_chunked" (k independent ring
+    # pipelines per all-reduce site; hidden_size must divide by
+    # tp_size * tp_comm_chunks). DSTPU_TP_OVERLAP_CHUNKS overrides.
+    tp_comm_chunks: int = 2
     # Cap on the SplitFuse prefill chunk actually scheduled (and on the
     # compiled prefill program's token dim): min(chunk_size, cap).
     # 512-token chunks OOM prefill activations at max_seqs >= 384
@@ -110,6 +128,14 @@ class RaggedInferenceConfig(ConfigModel):
                 f"{self.kv_cache_dtype!r}")
         if self.tp_size < 1:
             raise ValueError(f"tp_size must be >= 1, got {self.tp_size}")
+        from ...comm import TP_OVERLAP_MODES
+        if self.tp_comm_overlap not in TP_OVERLAP_MODES:
+            raise ValueError(
+                f"tp_comm_overlap must be one of {TP_OVERLAP_MODES}, "
+                f"got {self.tp_comm_overlap!r}")
+        if self.tp_comm_chunks < 1:
+            raise ValueError(
+                f"tp_comm_chunks must be >= 1, got {self.tp_comm_chunks}")
         if self.prefill_chunk_cap < 0:
             raise ValueError(
                 f"prefill_chunk_cap must be >= 0 (0 = uncapped), got "

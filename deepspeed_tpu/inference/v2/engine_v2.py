@@ -126,6 +126,25 @@ class InferenceEngineV2:
         (GPT2Config here; llama-family runners register the same interface).
         ``params``: the matching param pytree."""
         self.config = config or RaggedInferenceConfig()
+        # decomposed-collective env override (the operational kill-switch /
+        # force-on, like DSTPU_SERVE_ASYNC below): DSTPU_TP_OVERLAP =
+        # off|rs_ag|rs_ag_chunked[:k], DSTPU_TP_OVERLAP_CHUNKS = k.
+        # Applied BEFORE the runner builds so the traced step functions
+        # close over the final schedule.
+        if os.environ.get("DSTPU_TP_OVERLAP") \
+                or os.environ.get("DSTPU_TP_OVERLAP_CHUNKS"):
+            import dataclasses as _dc
+
+            from ... import comm
+            mode, chunks = comm.resolve_tp_overlap(
+                self.config.tp_comm_overlap, self.config.tp_comm_chunks)
+            # replace, never mutate: the caller's config object must not
+            # silently inherit the env schedule (an oracle engine built
+            # later from the same object would stop being the oracle)
+            self.config = _dc.replace(
+                self.config, tp_comm_overlap=mode,
+                **({"tp_comm_chunks": chunks}
+                   if mode == "rs_ag_chunked" else {}))
         self.runner = runner or _runner_for(model_cfg, self.config)
         tp = self.config.tp_size
         if tp > 1:

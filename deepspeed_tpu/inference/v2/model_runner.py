@@ -47,13 +47,33 @@ class RaggedBatch(NamedTuple):
 
 def tp_all_reduce(y, cfg: "RaggedInferenceConfig" = None):
     """One of the two canonical per-layer TP collectives: sum the
-    row-parallel partial products over the ``model`` axis. With
-    ``cfg.tp_quantized_comm`` the reduction rides int8 (symmetric per-row
-    scales via the ZeRO++ comm helpers — the EQuARX regime for
-    bandwidth-bound decode); otherwise a plain psum."""
+    row-parallel partial products over the ``model`` axis.
+
+    Schedule selected by ``cfg.tp_comm_overlap`` (docs/serving.md):
+
+      "off" — the monolithic parity oracle: a plain psum, or (with
+        ``cfg.tp_quantized_comm``) the legacy monolithic int8 all-gather
+        (symmetric per-row scales via the ZeRO++ comm helpers).
+      "rs_ag" / "rs_ag_chunked" — the decomposed schedule
+        (``comm.decomposed_all_reduce``): chunked ring reduce-scatter +
+        ring all-gather ppermute hops XLA can hide under adjacent GEMMs;
+        ``tp_quantized_comm`` then fuses int8 quant/dequant with
+        per-chunk scales into every hop (EQuARX-grade) instead of
+        quantizing once globally.
+    """
     if MODEL_AXIS not in manual_axes():
         return y
-    if cfg is not None and getattr(cfg, "tp_quantized_comm", False):
+    quant = cfg is not None and getattr(cfg, "tp_quantized_comm", False)
+    mode = getattr(cfg, "tp_comm_overlap", "off") if cfg is not None \
+        else "off"
+    if mode != "off":
+        from ... import comm
+        chunks = getattr(cfg, "tp_comm_chunks", 2) \
+            if mode == "rs_ag_chunked" else 1
+        return comm.decomposed_all_reduce(
+            y, axis_name=MODEL_AXIS, chunks=chunks,
+            quant_bits=8 if quant else None, log_name="tp_all_reduce")
+    if quant:
         from ...runtime.zero.quantized_collectives import (
             _dequant_from_comm, _quant_for_comm)
         q, scale, packed = _quant_for_comm(y, 8)

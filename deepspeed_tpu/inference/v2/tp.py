@@ -15,9 +15,14 @@ canonical per-layer all-reduces of Megatron-style TP (after the attention
 out-projection and after the MLP down-projection — the seam targeted by
 fused computation-collective work, arXiv:2305.06942) plus ONE logits
 all-gather before on-device sampling when the unembed is vocab-sharded.
-``tp_quantized_comm`` routes the all-reduces through the ZeRO++ int8 comm
-helpers (EQuARX-class quantized all-reduce for the bandwidth-bound decode
-regime, arXiv:2506.17615).
+With ``tp_comm_overlap`` != "off" each all-reduce site instead traces the
+decomposed schedule (``comm.decomposed_all_reduce``): k ring
+reduce-scatter + k ring all-gather ppermute hops (k = chunks*(tp-1))
+whose independent dataflow edges XLA can hide under adjacent GEMMs — the
+T3 regime (arXiv:2401.16677). ``tp_quantized_comm`` routes the comm
+through int8: monolithic ZeRO++ all-gathers when overlap is off, or
+per-hop/per-chunk-scale quantization fused into the ring when it is on
+(EQuARX-grade, arXiv:2506.17615).
 
 Host-side state (scheduler, blocked allocator, state manager) stays
 single-program: TP here is a sharding layer, not an engine rewrite.
@@ -236,6 +241,12 @@ class TPContext:
     param_specs: Any
     param_kinds: Any
     quantized_comm: bool = False
+    #: decomposed-collective schedule the runner programs trace with
+    #: ("off" | "rs_ag" | "rs_ag_chunked") and its ring chunk count —
+    #: carried for logging/introspection; the step functions read the
+    #: same values from the engine config at trace time
+    comm_overlap: str = "off"
+    comm_chunks: int = 1
 
     def pool_spec(self, quantized: bool):
         return pool_specs(quantized)
@@ -319,6 +330,22 @@ def build_tp_context(cfg, runner, params,
             f"tp_size={tp} must divide num_heads ({num_heads}) and "
             f"kv_heads ({runner.kv_heads}) — head-sharded KV needs whole "
             f"heads per chip")
+    # decomposed collectives: the ring scatters the all-reduce site's
+    # FULL-width activation (hidden_size) into tp shards, chunked into
+    # tp_comm_chunks independent pipelines — the geometry must divide, and
+    # failing at engine build keeps the audited hop counts deterministic
+    # (decomposed_all_reduce would otherwise silently degrade the chunk
+    # count and the budget tests would chase a moving schedule)
+    overlap_mode = getattr(cfg, "tp_comm_overlap", "off")
+    overlap_chunks = int(getattr(cfg, "tp_comm_chunks", 2)) \
+        if overlap_mode == "rs_ag_chunked" else 1
+    hidden = int(getattr(mcfg, "hidden_size", 0))
+    if overlap_mode != "off" and hidden and hidden % (tp * overlap_chunks):
+        raise ValueError(
+            f"tp_comm_overlap={overlap_mode!r} needs hidden_size "
+            f"({hidden}) divisible by tp_size*tp_comm_chunks "
+            f"({tp}*{overlap_chunks}); lower tp_comm_chunks or serve "
+            f"with tp_comm_overlap='off'")
 
     QuantizedTensor, FPQuantizedTensor, Fp6GemmWeight = _quant_leaf_types()
     quant_types = (QuantizedTensor, FPQuantizedTensor, Fp6GemmWeight)
@@ -375,9 +402,13 @@ def build_tp_context(cfg, runner, params,
     ctx = TPContext(mesh=mesh, tp_size=tp, param_specs=specs,
                     param_kinds=kinds,
                     quantized_comm=bool(getattr(cfg, "tp_quantized_comm",
-                                                False)))
+                                                False)),
+                    comm_overlap=overlap_mode,
+                    comm_chunks=overlap_chunks)
     new_params = ctx.device_put_params(new_params)
     log_dist(f"ragged TP: sharded {n_sharded[0]} param tensors over "
              f"'{MODEL_AXIS}' (tp={tp}, quantized_comm="
-             f"{ctx.quantized_comm})")
+             f"{ctx.quantized_comm}, comm_overlap={ctx.comm_overlap}"
+             + (f" x{ctx.comm_chunks}" if ctx.comm_overlap
+                == "rs_ag_chunked" else "") + ")")
     return ctx, new_params

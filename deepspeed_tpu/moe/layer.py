@@ -228,8 +228,14 @@ class MoE(nn.Module):
                     if tp:
                         # row-parallel wo: every model rank holds a partial
                         # sum over its hidden shard (reference
-                        # moe/mappings.py reduce on the TP region)
-                        eo = jax.lax.psum(eo, "model")
+                        # moe/mappings.py reduce on the TP region). The
+                        # training hot path shares the serve stack's
+                        # decomposed schedule: DSTPU_TP_OVERLAP swaps the
+                        # monolithic psum for the overlappable ring, and
+                        # either way the site is watchdog-named
+                        eo = comm.overlap_all_reduce(
+                            eo, axis_name="model",
+                            log_name="moe_wo_reduce")
                     # inverse a2a → [E, C, M]: results return to their tokens
                     return comm.all_to_all_single(eo, axis_name=EXPERT_AXIS,
                                                   split_axis=1, concat_axis=0,

@@ -355,8 +355,14 @@ def grouped_moe_ffn_ep(tokens: jnp.ndarray, logits: jnp.ndarray, k: int,
         h = activation(jax.lax.ragged_dot(xs, wi.astype(dtype), gs))
     ys = jax.lax.ragged_dot(h, wo.astype(dtype), gs)
     if tp_axis is not None:
-        # row-parallel wo: partial sums over the hidden shards
-        ys = jax.lax.psum(ys, tp_axis)
+        # row-parallel wo: partial sums over the hidden shards — routed
+        # through the shared comm facade so the DSTPU_TP_OVERLAP
+        # decomposed schedule (ring RS+AG instead of one psum) covers the
+        # grouped-GEMM training path too, and a stalled hop is
+        # watchdog-named like any serve-side collective
+        from .. import comm
+        ys = comm.overlap_all_reduce(ys, axis_name=tp_axis,
+                                     log_name="moe_grouped_wo")
     # rows past sum(gs) are unspecified — zero them before the return trip
     valid = jnp.arange(ep * Cs) < gs.sum()
     ys = jnp.where(valid[:, None], ys, jnp.zeros_like(ys))

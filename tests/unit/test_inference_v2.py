@@ -1137,6 +1137,123 @@ class TestTensorParallelServing:
                               RaggedInferenceConfig(**base, tp_size=4))
 
 
+class TestTPOverlapServing:
+    """ISSUE 6 tentpole: the decomposed, compute-overlappable TP
+    collectives (``tp_comm_overlap`` — chunked ring reduce-scatter +
+    all-gather built on ppermute instead of one monolithic psum per
+    site). Greedy decode must stay TOKEN-IDENTICAL to the psum oracle;
+    the audited schedule shape lives in test_program_audit.py."""
+
+    def test_tp2_rs_ag_chunked_token_identical(self):
+        mcfg, model, params, base = _tp_setup()
+        rng = np.random.default_rng(41)
+        prompts = [rng.integers(1, 96, 9).tolist() for _ in range(2)]
+        ref = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base)).generate(prompts, max_new_tokens=6)
+        eng = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base, tp_size=2, tp_comm_overlap="rs_ag_chunked",
+            tp_comm_chunks=2))
+        assert eng.generate(prompts, max_new_tokens=6) == ref
+
+    def test_env_override_selects_schedule(self, monkeypatch):
+        # DSTPU_TP_OVERLAP is the operational kill-switch/force-on; the
+        # :k suffix and DSTPU_TP_OVERLAP_CHUNKS both steer the chunking
+        mcfg, model, params, base = _tp_setup()
+        monkeypatch.setenv("DSTPU_TP_OVERLAP", "rs_ag_chunked:4")
+        eng = InferenceEngineV2(mcfg, params,
+                                RaggedInferenceConfig(**base))
+        assert eng.config.tp_comm_overlap == "rs_ag_chunked"
+        assert eng.config.tp_comm_chunks == 4
+        monkeypatch.setenv("DSTPU_TP_OVERLAP", "off")
+        eng = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base, tp_comm_overlap="rs_ag_chunked"))
+        assert eng.config.tp_comm_overlap == "off"
+
+    def test_indivisible_chunking_fails_at_build(self):
+        # hidden 64 at tp=2 cannot split into 5 chunks per shard — the
+        # engine must refuse loudly instead of silently degrading the
+        # audited hop count
+        mcfg, model, params, base = _tp_setup()
+        with pytest.raises(ValueError, match="tp_comm_chunks"):
+            InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+                **base, tp_size=2, tp_comm_overlap="rs_ag_chunked",
+                tp_comm_chunks=5))
+
+    @pytest.mark.full
+    def test_tp2_rs_ag_unchunked_token_identical(self):
+        mcfg, model, params, base = _tp_setup()
+        rng = np.random.default_rng(42)
+        prompts = [rng.integers(1, 96, 9).tolist() for _ in range(2)]
+        ref = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base)).generate(prompts, max_new_tokens=6)
+        got = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base, tp_size=2, tp_comm_overlap="rs_ag")).generate(
+                prompts, max_new_tokens=6)
+        assert got == ref
+
+    @pytest.mark.full
+    def test_tp4_chunked_token_identical(self):
+        # 4-chip ring: 3 hops per phase per chunk, deepest reassociation
+        mcfg, model, params, base = _tp_setup()
+        rng = np.random.default_rng(43)
+        prompts = [rng.integers(1, 96, 9).tolist() for _ in range(2)]
+        ref = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base)).generate(prompts, max_new_tokens=6)
+        got = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base, tp_size=4, tp_comm_overlap="rs_ag_chunked",
+            tp_comm_chunks=2)).generate(prompts, max_new_tokens=6)
+        assert got == ref
+
+    @pytest.mark.full
+    def test_tp2_llama_overlap_pipelined_prefix_cached(self):
+        # the acceptance stack composed: GQA llama (untied lm_head ->
+        # logits gather), overlap on, pipelined depth 2, prefix cache on
+        from deepspeed_tpu.models.llama import Llama, LlamaConfig
+        mcfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="xla")
+        params = Llama(mcfg).init(jax.random.PRNGKey(0),
+                                  jnp.zeros((1, 8), jnp.int32))["params"]
+        base = dict(max_seqs=2, chunk_size=8, block_size=4, num_blocks=64,
+                    max_blocks_per_seq=16, dtype="float32",
+                    attention_impl="dense", decode_loop_steps=0)
+        rng = np.random.default_rng(44)
+        shared = rng.integers(1, 500, 9).tolist()
+        prompts = [shared + rng.integers(1, 500, 3).tolist()
+                   for _ in range(2)]
+        ref_eng = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base, serve_pipeline_depth=0))
+        ref = [ref_eng.generate([p], max_new_tokens=5)[0] for p in prompts]
+        eng = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base, tp_size=2, tp_comm_overlap="rs_ag_chunked",
+            tp_comm_chunks=2, serve_pipeline_depth=2, prefix_cache=True))
+        got = [eng.generate([p], max_new_tokens=5)[0] for p in prompts]
+        assert got == ref
+        assert eng.prefix_stats["matched_blocks"] > 0
+
+    @pytest.mark.full
+    def test_tp2_woq_overlap_token_identical(self):
+        # WOQ int8 weights + decomposed comm: the group-sharded scales and
+        # the ring schedule compose without touching numerics
+        from deepspeed_tpu.inference.quantization import \
+            quantize_model_params
+        from deepspeed_tpu.models.llama import Llama, LlamaConfig
+        mcfg = LlamaConfig.tiny(dtype=jnp.float32, attention_impl="xla")
+        params = Llama(mcfg).init(jax.random.PRNGKey(0),
+                                  jnp.zeros((1, 8), jnp.int32))["params"]
+        qparams = quantize_model_params(params, {"quantized_weights": {
+            "enabled": True, "num_bits": 8, "group_size": 16,
+            "modules": ["proj"]}})
+        base = dict(max_seqs=2, chunk_size=8, block_size=4, num_blocks=64,
+                    max_blocks_per_seq=16, dtype="float32",
+                    attention_impl="dense", decode_loop_steps=4)
+        prompts = [list(np.random.default_rng(45).integers(1, 500, 9))]
+        ref = InferenceEngineV2(mcfg, qparams, RaggedInferenceConfig(
+            **base)).generate(prompts, max_new_tokens=5)
+        got = InferenceEngineV2(mcfg, qparams, RaggedInferenceConfig(
+            **base, tp_size=2, tp_comm_overlap="rs_ag_chunked",
+            tp_comm_chunks=2)).generate(prompts, max_new_tokens=5)
+        assert got == ref
+
+
 class TestPrefillChunkCap:
     """Satellite: cap the SplitFuse prefill chunk (config key
     ``prefill_chunk_cap``) so long-context prefill stops OOMing at

@@ -129,6 +129,131 @@ class TestCollectiveBudgets:
         assert "all_reduce[model]" in msg
 
 
+@pytest.fixture(scope="module")
+def gpt2_reports_tp2_overlap():
+    return audit_serve_programs(_gpt2_engine(
+        tp=2, tp_comm_overlap="rs_ag_chunked", tp_comm_chunks=2))
+
+
+class TestOverlapBudgets:
+    """ISSUE 6: with the decomposed schedule on, every per-layer
+    all-reduce site must audit as exactly k ring reduce-scatter + k ring
+    all-gather hops (k = chunks*(tp-1)) — NO residual psum, no stray
+    ppermutes (the walker canonicalizes ring hops, so any ppermute left
+    in the report is un-ringed traffic and fails the budget)."""
+
+    # tp=2, chunks=2 -> k = 2 hops per phase per site, 2 sites per layer
+    PER_LAYER = {"reduce_scatter": 4, "all_gather": 4}
+
+    def test_tp2_step_decomposed_schedule(self, gpt2_reports_tp2_overlap):
+        budget = CollectiveBudget("tp2-overlap-step", num_layers=L,
+                                  per_layer=self.PER_LAYER)
+        for name in ("step", "step_greedy", "step_greedy_fb"):
+            rep = gpt2_reports_tp2_overlap[name]
+            assert_budget(rep, budget)
+            # the decomposition is total: zero monolithic psums remain
+            assert rep.count(kind="all_reduce") == 0, rep.summary()
+
+    def test_tp2_decode_loop_scan_weighted(self, gpt2_reports_tp2_overlap):
+        assert_budget(gpt2_reports_tp2_overlap["decode_loop"],
+                      CollectiveBudget("tp2-overlap-loop", num_layers=L,
+                                       steps=4, per_layer=self.PER_LAYER))
+
+    def test_tp2_flush_still_head_local(self, gpt2_reports_tp2_overlap):
+        assert_budget(gpt2_reports_tp2_overlap["flush_ring"],
+                      CollectiveBudget("tp2-overlap-flush", num_layers=L))
+
+    def test_tp2_rs_ag_unchunked_schedule(self):
+        # rs_ag (chunks=1): tp-1 = 1 hop per phase per site
+        rep = audit_serve_programs(
+            _gpt2_engine(tp=2, tp_comm_overlap="rs_ag"),
+            programs=("step",))["step"]
+        assert_budget(rep, CollectiveBudget(
+            "tp2-rsag-step", num_layers=L,
+            per_layer={"reduce_scatter": 2, "all_gather": 2}))
+
+    def test_tp2_quantized_ring_dtype_split(self):
+        # EQuARX-grade: every hop carries int8 values + an f32 per-chunk
+        # scale plane — budgeted separately via the kind@dtype keys
+        rep = audit_serve_programs(
+            _gpt2_engine(tp=2, tp_comm_overlap="rs_ag_chunked",
+                         tp_comm_chunks=2, tp_quantized_comm=True),
+            programs=("step",))["step"]
+        assert rep.count(kind="all_reduce") == 0, rep.summary()
+        assert_budget(rep, CollectiveBudget(
+            "tp2-overlap-int8-step", num_layers=L,
+            per_layer={"reduce_scatter@int8": 4,
+                       "reduce_scatter@float32": 4,
+                       "all_gather@int8": 4,
+                       "all_gather@float32": 4}))
+
+    def test_tp2_llama_overlap_keeps_logits_gather(self):
+        # the one pre-sampling vocab gather stays a single real all_gather
+        # on top of the per-layer ring hops
+        reports = audit_serve_programs(
+            _llama_engine(tp=2, tp_comm_overlap="rs_ag_chunked",
+                          tp_comm_chunks=2), programs=("step",))
+        assert_budget(reports["step"], CollectiveBudget(
+            "tp2-llama-overlap-step", num_layers=L,
+            per_layer=self.PER_LAYER, per_program={"all_gather": 1}))
+
+    def test_quantized_llama_mixes_pinned_and_plain_keys(self):
+        # the full quantized-ring llama budget: pinned int8/f32 keys for
+        # the per-layer hops COMPOSE with the pre-sampling logits gather
+        # (same kind, f32) — the gather merges into the f32 pinned key's
+        # per_program count, and a plain sibling key only absorbs dtypes
+        # no pinned key claims (no double-counting)
+        rep = audit_serve_programs(
+            _llama_engine(tp=2, tp_comm_overlap="rs_ag_chunked",
+                          tp_comm_chunks=2, tp_quantized_comm=True),
+            programs=("step",))["step"]
+        assert_budget(rep, CollectiveBudget(
+            "tp2-llama-overlap-int8-step", num_layers=L,
+            per_layer={"reduce_scatter@int8": 4,
+                       "reduce_scatter@float32": 4,
+                       "all_gather@int8": 4,
+                       "all_gather@float32": 4},
+            per_program={"all_gather@float32": 1}))
+        # the pinned int8 key + a plain "all_gather" sibling must not
+        # re-absorb the pinned hops: with the int8 hops claimed, the
+        # plain key sees only the unpinned f32 sites (L*4 scale hops + 1
+        # logits gather) — under the old aggregate-everything semantics
+        # this mix was unsatisfiable (the plain key double-counted the
+        # int8 hops)
+        mixed = CollectiveBudget(
+            "mixed", num_layers=L,
+            per_layer={"all_gather@int8": 4, "reduce_scatter@int8": 4,
+                       "reduce_scatter@float32": 4},
+            per_program={"all_gather": L * 4 + 1})
+        assert mixed.check(rep) == [], "\n".join(mixed.check(rep))
+
+    def test_planted_ring_hop_fails_with_diff(self):
+        # acceptance tripwire: one extra hop planted inside a ring region
+        # must trip the decomposed budget with an expected/got diff
+        import deepspeed_tpu.comm as comm
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("model",))
+
+        def _sabotage_ring_reduce_scatter(x):
+            return jax.lax.ppermute(x, "model", [(0, 1), (1, 0)])
+
+        planted = jax.jit(_sabotage_ring_reduce_scatter)
+
+        def prog(x):
+            y = comm.decomposed_all_reduce(x, axis_name="model", chunks=2)
+            return y + planted(y)
+
+        f = shard_map(prog, mesh=mesh, in_specs=P(None), out_specs=P(None),
+                      check_vma=False)
+        rep = audit_fn(jax.jit(f), jnp.ones((8,), jnp.float32))
+        with pytest.raises(AssertionError) as e:
+            assert_budget(rep, CollectiveBudget(
+                "planted-hop", per_layer={"reduce_scatter": 2,
+                                          "all_gather": 2}))
+        msg = str(e.value)
+        assert "reduce_scatter[model]" in msg
+        assert "expected 2" in msg and "got 3" in msg
+
+
 def _warm_hit_engine(tp):
     eng = _gpt2_engine(tp=tp, prefix_cache=True)
     # block_size=8: 10 shared + 8 unique = 2 FULL blocks per prompt —

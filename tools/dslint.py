@@ -68,6 +68,16 @@ HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
         ("match", "acquire", "release_block", "insert", "evict"),
     "deepspeed_tpu/inference/v2/state_manager.py":
         ("match_prefix", "register_prefix", "release_blocks"),
+    # the decomposed TP collective builders trace inside every runner
+    # program build (and inside MoE training steps): a blocking host sync
+    # here would stall every retrace of the serve/train hot path — these
+    # must stay pure trace-time code (shard_map discipline: they are
+    # axis-level ops used inside jax_compat-built shard_map regions and
+    # import no shard_map themselves; DSL003 still covers the file)
+    "deepspeed_tpu/comm/comm.py":
+        ("overlap_all_reduce", "decomposed_all_reduce",
+         "ring_reduce_scatter", "ring_all_gather",
+         "_ring_reduce_scatter_impl", "_ring_all_gather_impl"),
 }
 
 #: roots scanned for DSTPU_* env reads (knob rules + gen_config_doc) —

@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-10 on-chip sequence: overlapped + quantized TP collectives
+# (ISSUE 6). Captures the first on-chip evidence that the decomposed
+# per-layer schedule (ring reduce-scatter + ring all-gather ppermute
+# hops instead of one monolithic psum) lowers through Mosaic/ICI,
+# stays token-identical to the psum oracle (smoke tp_overlap row), and
+# — the number the CPU harness cannot give — whether the hops actually
+# hide under adjacent GEMMs: bench serve_overlap's off/on/on+int8
+# decode steps/s and exposed-comm-fraction rows at tp=4 are the real
+# comm-hiding measurement (on the 2-core CPU harness those rows are a
+# schedule-shape check only; docs/serving.md "Measuring exposed comm").
+# Strictly sequential (one process owns the chip), no timeouts around
+# TPU clients (a killed client wedges the grant).
+cd /root/repo || exit 1
+LOG=profiles/r10_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round10 start $(date -u +%FT%TZ)"
+
+echo "--- [1/5] tpu_smoke (incl. tp_overlap: on-chip rs_ag_chunked vs"
+echo "    psum-oracle token parity + audited k-hop schedule)"
+python tools/tpu_smoke.py | tee SMOKE_TPU_r10.txt
+
+echo "--- [2/5] dstpu_lint (now also covers the ring comm builders in"
+echo "    the DSL001 hot-path registry and the DSTPU_TP_OVERLAP* rows"
+echo "    in docs/CONFIG.md)"
+python bin/dstpu_lint deepspeed_tpu
+
+echo "--- [3/5] serve_overlap bench at tp=4: off vs rs_ag_chunked vs"
+echo "    rs_ag_chunked+int8 decode steps/s, exposed-comm-fraction,"
+echo "    audited per-step schedule in every row"
+DSTPU_OVERLAP_TPS=2,4 python bench.py serve_overlap \
+    > BENCH_OVERLAP_r10.json
+tail -c 1200 BENCH_OVERLAP_r10.json
+
+echo "--- [4/5] serve control (overlap off: flagship numbers + the"
+echo "    program-audit budgets must hold unchanged)"
+python bench.py serve > BENCH_SERVE_r10.json
+tail -c 700 BENCH_SERVE_r10.json
+
+echo "--- [5/5] full bench (driver runs it again at round end)"
+python bench.py > BENCH_SELF_r10.json
+tail -c 700 BENCH_SELF_r10.json
+echo "=== tpu_round10 done $(date -u +%FT%TZ)"

@@ -341,6 +341,49 @@ def main():
           f"matched_blocks={eng_p.prefix_stats['matched_blocks']} "
           f"cow_copies={eng_p.prefix_stats['cow_copies']}", flush=True)
 
+    # TP overlap (ISSUE 6): the decomposed collective schedule ON CHIP —
+    # rs_ag_chunked must be token-identical to the psum oracle (got_tp
+    # above) with the audited per-layer schedule exactly k ring RS + k
+    # ring AG hops (k = chunks*(tp-1)) and zero residual psum; first
+    # evidence the ppermute rings lower through Mosaic/ICI and actually
+    # land next to the GEMMs they should hide under.
+    if tp > 1:
+        ov_chunks = 2
+        eng_ov = InferenceEngineV2(
+            mcfg_tp, params_tp,
+            RaggedInferenceConfig(**base_tp, tp_size=tp,
+                                  tp_comm_overlap="rs_ag_chunked",
+                                  tp_comm_chunks=ov_chunks))
+        t0 = _time.perf_counter()
+        got_ov = eng_ov.generate(prompts_tp, max_new_tokens=16)
+        dt_ov = _time.perf_counter() - t0
+        # the ring is BITWISE psum-equal only at tp=2 (one commutative
+        # add); beyond that it reassociates, so a within-ulp logit tie
+        # can legitimately flip an argmax — report parity at tp>2 but
+        # only hard-gate the unattended run on it at tp=2
+        par_ov = got_ov == got_tp
+        gate_par = par_ov or tp > 2
+        k_hops = 2 * ov_chunks * (tp - 1)   # 2 sites/layer, k hops each
+        sched_ov = True
+        try:
+            ov_reps = audit_serve_programs(eng_ov,
+                                           programs=("step_greedy",))
+            assert_budget(ov_reps["step_greedy"], CollectiveBudget(
+                "tp-overlap-step", num_layers=2,
+                per_layer={"reduce_scatter": k_hops,
+                           "all_gather": k_hops}))
+        except AssertionError as e:
+            sched_ov = False
+            print(str(e), flush=True)
+        ok &= gate_par and sched_ov
+        print(f"{'OK ' if gate_par and sched_ov else 'FAIL'} tp_overlap: "
+              f"tp={tp} rs_ag_chunked x{ov_chunks} token_parity={par_ov}"
+              f"{'' if tp == 2 else ' (informational at tp>2)'} "
+              f"audited_schedule_k={k_hops}/layer/phase ok={sched_ov} "
+              f"({4 * 16 / dt_ov:.0f} tok/s incl. compile)", flush=True)
+    else:
+        print("SKIP tp_overlap (single chip)", flush=True)
+
     print("TPU_SMOKE " + ("PASS" if ok else "FAIL"), flush=True)
     return 0 if ok else 1
 
