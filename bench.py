@@ -674,6 +674,156 @@ def bench_serve_prefix():
     return 0 if parity and distinct > 1 else 1
 
 
+def bench_serve_drill():
+    """Elastic-serving drill benchmark (ISSUE 7): preempt a serving
+    replica mid-stream and recover on a survivor. Measures what the
+    resilience layer costs and saves:
+
+      - ``drain_s`` / ``recovery_s``: SIGTERM-equivalent drain (pipeline
+        unwind + manifest) and drain->FIRST-replayed-token — how long
+        the preempted replica's requests are dark;
+      - ``replay_prefill_skipped_frac``: the fraction of the replayed
+        chains' re-prefill the survivor served from its prefix cache
+        (the ROADMAP's cheap-recovery claim, measured);
+      - ``goodput_frac``: committed tokens/s through the whole
+        drain/replay incident vs the steady-state decode rate;
+      - ``token_parity``: replayed streams must be identical to the
+        uninterrupted greedy run — the oracle for the whole layer.
+    """
+    import os
+
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceConfig)
+
+    on_tpu = jax.default_backend() == "tpu"
+    big = os.environ.get("DSTPU_DRILL_MODEL",
+                         "big" if on_tpu else "tiny") == "big"
+    model, mcfg = _serve_llama(big)
+    if big:
+        SYS, TAIL, GEN, bs, CHUNK, dtype = 1360, 128, 32, 256, 256, \
+            "bfloat16"
+    else:
+        SYS, TAIL, GEN, bs, CHUNK, dtype = 144, 16, 16, 32, 32, "float32"
+    N = int(os.environ.get("DSTPU_DRILL_REQS", "6"))
+    GEN = int(os.environ.get("DSTPU_DRILL_GEN", str(GEN)))
+    KILL_AT = GEN // 2
+    params = _pseudo_params(model, mcfg)
+
+    rng = np.random.RandomState(0)
+    sys_prompt = rng.randint(1, mcfg.vocab_size, size=SYS).tolist()
+    prompts = [sys_prompt + rng.randint(1, mcfg.vocab_size,
+                                        size=TAIL).tolist()
+               for _ in range(N)]
+    blocks_per_seq = (SYS + TAIL + GEN + bs - 1) // bs
+    cfg = RaggedInferenceConfig(
+        max_seqs=8, chunk_size=CHUNK, block_size=bs,
+        num_blocks=(N + 4) * blocks_per_seq,
+        max_blocks_per_seq=blocks_per_seq, dtype=dtype,
+        attention_impl="paged_flash" if on_tpu else "dense",
+        decode_loop_steps=0, prefix_cache=True, serve_pipeline_depth=2)
+
+    def warm(eng, n_warm=2):
+        # compile every program the cycle hits and seed the system
+        # prompt into the cache (warm-ONLY tails, the serve_prefix rule)
+        wrng = np.random.RandomState(10_000)
+        for k in range(n_warm):
+            wuid = 99001 + k
+            wp = sys_prompt + wrng.randint(1, mcfg.vocab_size,
+                                           size=TAIL).tolist()
+            w = eng.put([wuid], [wp], _greedy=True)
+            eng.decode_pipelined([wuid], [w[wuid]], 4)
+            eng.flush(wuid)
+
+    def serve_to(eng, uids, toks, budget):
+        while True:
+            live = [u for u in uids if len(toks[u]) < budget]
+            if not live:
+                return
+            outs = eng.decode_pipelined(
+                live, [toks[u][-1] for u in live],
+                [budget - len(toks[u]) for u in live])
+            for u in live:
+                toks[u].extend(outs[u][:budget - len(toks[u])])
+
+    # ---- replica A: oracle pass (uninterrupted, also warms A) -------- #
+    eng_a = InferenceEngineV2(mcfg, params, cfg)
+    warm(eng_a)
+    oracle = {}
+    for i, p in enumerate(prompts):
+        u = 90000 + i
+        first = eng_a.put([u], [p], _greedy=True)
+        oracle[i] = [int(first[u])]
+    otoks = {90000 + i: oracle[i] for i in range(N)}
+    serve_to(eng_a, list(otoks), otoks, GEN)
+    for u in list(otoks):
+        eng_a.flush(u)
+
+    # ---- survivor B: up and warm BEFORE the incident (a fleet's
+    # surviving replica is already serving; its build/compile time is
+    # not part of recovery) --------------------------------------------- #
+    eng_b = InferenceEngineV2(mcfg, params, cfg)
+    warm(eng_b)
+    st0 = dict(eng_b.prefix_stats)
+
+    # ---- the measured incident on replica A -------------------------- #
+    toks = {}
+    for i, p in enumerate(prompts):
+        first = eng_a.put([i], [p], _greedy=True)
+        toks[i] = [int(first[i])]
+    # steady-state decode rate over a DECODE-only window, so the
+    # goodput comparison below is decode-vs-incident, not decode-vs-
+    # (prefill+decode)
+    t_serve0 = time.perf_counter()
+    serve_to(eng_a, list(range(N)), toks, KILL_AT)
+    t_kill = time.perf_counter()
+    steady_tok_s = N * (KILL_AT - 1) / (t_kill - t_serve0)
+
+    eng_a.request_drain()              # the SIGTERM moment
+    manifest = eng_a.drain()
+    t_drained = time.perf_counter()
+
+    # ---- replay on the survivor -------------------------------------- #
+    t_replay0 = time.perf_counter()
+    out = eng_b.replay(manifest)
+    t_first = time.perf_counter()      # first replayed token committed
+    for i in range(N):
+        if i in out and len(toks[i]) < GEN:
+            toks[i].append(int(out[i]))
+    serve_to(eng_b, list(range(N)), toks, GEN)
+    t_done = time.perf_counter()
+    st = eng_b.prefix_stats
+    hit = st["matched_tokens"] - st0["matched_tokens"]
+    ran = st["prefill_tokens"] - st0["prefill_tokens"]
+
+    parity = all(toks[i] == oracle[i][:len(toks[i])]
+                 and len(toks[i]) == GEN for i in range(N))
+    # goodput: NEW tokens committed over the incident window (drain ->
+    # done; replayed history is recovered, not produced) vs steady rate
+    incident_s = t_done - t_kill
+    goodput = (N * (GEN - KILL_AT) / incident_s) / steady_tok_s
+    print(json.dumps({
+        "model": f"llama {mcfg.num_layers}L hidden={mcfg.hidden_size}",
+        "workload": {"requests": N, "system_prompt_tokens": SYS,
+                     "unique_tail_tokens": TAIL, "gen_tokens": GEN,
+                     "killed_after_tokens": KILL_AT,
+                     "block_size": bs},
+        "steady_decode_tokens_per_sec": round(steady_tok_s, 2),
+        "drain_s": round(t_drained - t_kill, 4),
+        "recovery_s": round(t_first - t_kill, 4),
+        "replay_to_first_token_s": round(t_first - t_replay0, 4),
+        "replay_prefill_skipped_frac": round(
+            hit / (hit + ran), 3) if hit + ran else 0.0,
+        "goodput_frac": round(goodput, 3),
+        "manifested_sequences": len(manifest["sequences"]),
+        "pool_fully_recovered": manifest["pool"]["fully_recovered"],
+        "token_parity": parity,
+    }))
+    return 0 if parity and manifest["pool"]["fully_recovered"] else 1
+
+
 def bench_serve_overlap():
     """Overlapped + quantized TP collectives benchmark (ISSUE 6): greedy
     decode through the v2 engine at tp in ``DSTPU_OVERLAP_TPS`` with the
@@ -1242,6 +1392,8 @@ def main():
         return bench_serve_pipeline()
     if sys.argv[1:] == ["serve_prefix"]:
         return bench_serve_prefix()
+    if sys.argv[1:] == ["serve_drill"]:
+        return bench_serve_drill()
     if sys.argv[1:] == ["serve_overlap"]:
         return bench_serve_overlap()
     if sys.argv[1:] == ["fastgen"]:
@@ -1282,8 +1434,8 @@ def main():
     out = {"probe": probe}
     dead = False
     for phase in ("train", "train_xl", "train_1p3b", "serve",
-                  "serve_pipeline", "serve_prefix", "serve_overlap",
-                  "fastgen", "moe", "moe_train"):
+                  "serve_pipeline", "serve_prefix", "serve_drill",
+                  "serve_overlap", "fastgen", "moe", "moe_train"):
         if dead:
             out[phase] = {"error": "skipped_backend_dead"}
             continue
@@ -1351,6 +1503,7 @@ def main():
                    "serving": out.get("serve", {}),
                    "serve_pipeline": out.get("serve_pipeline", {}),
                    "serve_prefix": out.get("serve_prefix", {}),
+                   "serve_drill": out.get("serve_drill", {}),
                    "serve_overlap": out.get("serve_overlap", {}),
                    "fastgen": out.get("fastgen", {}),
                    "moe_serve": out.get("moe", {}),

@@ -165,13 +165,22 @@ def run_elastic(
 
             if stop_signal["num"] is not None:
                 # drain: the worker already got the signal; give it the
-                # grace period to finish its final checkpoint, then stop
-                # supervising — a preempted host must NOT restart
+                # grace period to finish its final checkpoint (train) or
+                # publish its replay manifest (serve — the v2 engine's
+                # drain() writes DSTPU_SERVE_DRAIN_MANIFEST and the
+                # restarted/survivor replica re-admits from it), then
+                # stop supervising — a preempted host must NOT restart
                 ledger.record("signal", signum=int(stop_signal["num"]),
                               name=signal.Signals(stop_signal["num"]).name)
-                ledger.record("drained", rc=rc, runtime_s=round(runtime, 3))
+                manifest = child_env.get("DSTPU_SERVE_DRAIN_MANIFEST")
+                if manifest and not os.path.exists(manifest):
+                    manifest = None        # drain never published it
+                ledger.record("drained", rc=rc, runtime_s=round(runtime, 3),
+                              serve_manifest=manifest)
                 logger.warning(f"elastic agent: draining after signal; "
-                               f"worker exit {rc}")
+                               f"worker exit {rc}"
+                               + (f", replay manifest {manifest}"
+                                  if manifest else ""))
                 return 0 if rc in (0, MEMBERSHIP_CHANGE_EXIT) else rc
 
             if rc == 0:

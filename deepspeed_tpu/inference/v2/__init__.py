@@ -12,6 +12,15 @@ lengths), never in array shapes.
 
 from .blocked_allocator import BlockedAllocator
 from .config import RaggedInferenceConfig
+from .drain import (
+    EngineDrainingError,
+    ReplayJournal,
+    ServeDrainError,
+    ServeStepError,
+    load_manifest,
+    load_replay_state,
+    manifest_from_journal,
+)
 from .engine_factory import build_hf_engine
 from .engine_v2 import InferenceEngineV2
 from .kv_cache import BlockedKVCache
@@ -21,8 +30,10 @@ from .state_manager import StateManager
 from .tp import TPContext, build_tp_context
 
 __all__ = [
-    "BlockedAllocator", "BlockedKVCache", "InferenceEngineV2",
-    "PrefixCache", "RaggedInferenceConfig", "SequenceDescriptor",
-    "SequenceStatus", "StateManager", "TPContext", "build_hf_engine",
-    "build_tp_context",
+    "BlockedAllocator", "BlockedKVCache", "EngineDrainingError",
+    "InferenceEngineV2", "PrefixCache", "RaggedInferenceConfig",
+    "ReplayJournal", "SequenceDescriptor", "SequenceStatus",
+    "ServeDrainError", "ServeStepError", "StateManager", "TPContext",
+    "build_hf_engine", "build_tp_context", "load_manifest",
+    "load_replay_state", "manifest_from_journal",
 ]

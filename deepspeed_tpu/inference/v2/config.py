@@ -97,6 +97,36 @@ class RaggedInferenceConfig(ConfigModel):
     # 0 = fully synchronous (the parity oracle); the env knob
     # DSTPU_SERVE_ASYNC overrides this at engine construction.
     serve_pipeline_depth: int = 2
+    # ---- serve-side resilience (drain.py, docs/resilience.md) ---------
+    # Per-request wall-clock deadline in seconds, stamped at admission
+    # (0 = no deadlines). An expired request is ABORTED mid-pipeline with
+    # a structured rejection (engine.rejections) instead of being served
+    # late — its KV blocks and prefix-cache refcounts are released
+    # exactly, deferred past any in-flight step that still writes them.
+    # Env override at engine construction: DSTPU_SERVE_DEADLINE_S.
+    request_deadline_s: float = 0.0
+    # Bounded retry for a serve-step dispatch that fails with a
+    # TRANSIENT (I/O-class) error: retries with exponential backoff from
+    # serve_retry_backoff_s, then raises ServeStepError. The plan phase's
+    # host state is untouched by a failed dispatch, so redispatching the
+    # same planned step is always safe. Env: DSTPU_SERVE_RETRY /
+    # DSTPU_SERVE_RETRY_BACKOFF_S.
+    serve_step_retries: int = 2
+    serve_retry_backoff_s: float = 0.05
+    # Graceful load-shedding: when the scheduler starves with the KV pool
+    # exhausted even after prefix-cache eviction AND pausing every idle
+    # holder, abort the cheapest-to-redo victim (not-yet-started first,
+    # then largest demand) with a structured rejection instead of
+    # crashing the serve loop. False restores the hard RuntimeError.
+    # Env: DSTPU_SERVE_SHED=0|1.
+    serve_shed: bool = True
+    # Write-ahead replay journal path ("" = off): one JSONL record per
+    # admission / committed step / flush, flushed to the OS per record —
+    # a hard-crashed replica's committed token chains survive and
+    # manifest_from_journal() rebuilds the replay manifest. Env:
+    # DSTPU_SERVE_JOURNAL (+ DSTPU_SERVE_JOURNAL_FSYNC=1 for machine-loss
+    # durability).
+    serve_journal: str = ""
 
     # sampling defaults for the built-in generate loop
     greedy: bool = True
@@ -152,6 +182,18 @@ class RaggedInferenceConfig(ConfigModel):
             raise ValueError(
                 f"serve_pipeline_depth must be >= 0 (0 = synchronous), "
                 f"got {self.serve_pipeline_depth}")
+        if self.request_deadline_s < 0:
+            raise ValueError(
+                f"request_deadline_s must be >= 0 (0 = no deadlines), "
+                f"got {self.request_deadline_s}")
+        if self.serve_step_retries < 0:
+            raise ValueError(
+                f"serve_step_retries must be >= 0, got "
+                f"{self.serve_step_retries}")
+        if self.serve_retry_backoff_s < 0:
+            raise ValueError(
+                f"serve_retry_backoff_s must be >= 0, got "
+                f"{self.serve_retry_backoff_s}")
 
     @property
     def max_context(self) -> int:

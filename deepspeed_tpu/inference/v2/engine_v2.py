@@ -31,11 +31,14 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from ...resilience.fault_injection import get_fault_injector
 from ...utils.dtypes import resolve_dtype
-from ...utils.logging import log_dist
+from ...utils.logging import log_dist, logger
 from .blocked_allocator import OutOfBlocksError
 from ..config import InferenceConfig
 from .config import RaggedInferenceConfig
+from .drain import (EngineDrainingError, ReplayJournal, ServeDrainError,
+                    ServeStepError, build_manifest, write_manifest)
 from .kv_cache import BlockedKVCache
 from .model_runner import GPT2RaggedRunner, RaggedBatch
 from .scheduler import SplitFuseScheduler
@@ -72,11 +75,14 @@ class _InFlightStep:
     """A dispatched, uncommitted step: the device-side result future plus
     the host bookkeeping needed to commit — or partially kill — it.
     ``dead`` slots were invalidated by a late EOS (their readback is
-    discarded); ``rollbacks`` are (seq, n_tokens) retractions that must
-    wait until THIS step has executed (its KV writes still reference the
-    blocks being freed)."""
+    discarded) or an abort; ``rollbacks`` are (seq, n_tokens) retractions
+    that must wait until THIS step has executed (its KV writes still
+    reference the blocks being freed); ``aborts`` are sequences whose
+    flush is deferred to this step's commit for the same reason — it is
+    the last in-flight step whose KV writes target their blocks."""
 
-    __slots__ = ("sched", "result", "use_greedy", "dead", "rollbacks")
+    __slots__ = ("sched", "result", "use_greedy", "dead", "rollbacks",
+                 "aborts")
 
     def __init__(self, sched, result, use_greedy):
         self.sched = sched
@@ -84,6 +90,7 @@ class _InFlightStep:
         self.use_greedy = use_greedy
         self.dead: set = set()
         self.rollbacks: List[Tuple[Any, int]] = []
+        self.aborts: List[Any] = []
 
 
 def _runner_for(model_cfg: Any, cfg: RaggedInferenceConfig):
@@ -221,7 +228,43 @@ class InferenceEngineV2:
         self._feed_src = None
         self._feed_slot: Dict[int, int] = {}
         self.pipeline_stats = {"steps": 0, "fed_steps": 0, "plan_s": 0.0,
-                               "dispatch_s": 0.0, "commit_block_s": 0.0}
+                               "dispatch_s": 0.0, "commit_block_s": 0.0,
+                               "retries": 0}
+        # ---- serve-side resilience (drain.py, docs/resilience.md) ---- #
+        # env knobs are read with LITERAL names so the dslint knob scan
+        # (DSL004/5) and gen_config_doc keep seeing them
+        cfg = self.config
+        self.request_deadline_s = float(
+            os.environ.get("DSTPU_SERVE_DEADLINE_S")
+            or cfg.request_deadline_s)
+        self.serve_step_retries = int(
+            os.environ.get("DSTPU_SERVE_RETRY") or cfg.serve_step_retries)
+        self.serve_retry_backoff_s = float(
+            os.environ.get("DSTPU_SERVE_RETRY_BACKOFF_S")
+            or cfg.serve_retry_backoff_s)
+        shed = os.environ.get("DSTPU_SERVE_SHED")
+        self.serve_shed = cfg.serve_shed if shed in (None, "") \
+            else shed not in ("0", "false", "off")
+        jpath = os.environ.get("DSTPU_SERVE_JOURNAL") or cfg.serve_journal
+        self.journal = ReplayJournal(
+            jpath,
+            fsync=os.environ.get("DSTPU_SERVE_JOURNAL_FSYNC") == "1") \
+            if jpath else None
+        self._manifest_path = \
+            os.environ.get("DSTPU_SERVE_DRAIN_MANIFEST") or None
+        #: PreemptionHandler polled inside the pipeline (attach_preemption)
+        self.preemption = None
+        self._watchdog = None
+        if os.environ.get("DSTPU_SERVE_WATCHDOG") == "1":
+            from ...resilience.watchdog import StepWatchdog
+            self._watchdog = StepWatchdog(action="log")
+        self._drain_requested = False
+        self._drained = False
+        self._live_ring: Optional[deque] = None
+        #: structured rejections (load shedding, deadlines, drain-time
+        #: admission refusals): uid -> record. The serving layer above
+        #: turns these into 503-style responses; tests assert on them.
+        self.rejections: Dict[int, Dict[str, Any]] = {}
         log_dist(
             f"InferenceEngineV2 ready: {self.config.max_seqs} slots x "
             f"{self.config.chunk_size} tokens "
@@ -252,9 +295,58 @@ class InferenceEngineV2:
         steps are planned and dispatched ahead of the oldest step's
         commit (chunks of one sequence may span in-flight steps — the
         device orders them through the KV-pool data dependence). Depth 0
-        plans, dispatches and commits each step synchronously."""
+        plans, dispatches and commits each step synchronously.
+
+        Admission control (docs/resilience.md "Serving"): while the
+        engine is DRAINING, and for fresh prompts that could never fit
+        the KV pool even after eviction, the request is refused with a
+        structured record in :attr:`rejections` (never a crash) and its
+        uid is simply absent from the returned dict."""
+        admitted: List[int] = []
+        bs = self.config.block_size
         for uid, toks in zip(batch_uids, batch_tokens):
+            seq0 = self.state.get(uid)
+            fresh = seq0 is None or (seq0.seen_tokens == 0
+                                     and not seq0.kv_blocks)
+            if self._draining():
+                # a FRESH request is refused outright — the client must
+                # retry on another replica. A continuation of a LIVE
+                # sequence is simply not fed: that sequence rides the
+                # drain manifest (a rejection record here would
+                # double-route the same request — replayed by the
+                # survivor AND retried by the client)
+                if fresh:
+                    self._reject(uid, "draining",
+                                 detail="engine is draining for preemption")
+                continue
+            if fresh and self.serve_shed:
+                # load shedding at the door: a prompt whose KV (plus one
+                # generated token) exceeds the WHOLE pool can never be
+                # served, eviction or not — shed it before it poisons
+                # the scheduler (serve_shed=False keeps the legacy hard
+                # starvation RuntimeError instead)
+                need = -(-(len(toks) + 1) // bs)
+                if need > self.config.num_blocks:
+                    self._reject(
+                        uid, "kv_pool_exhausted",
+                        needed_blocks=need,
+                        num_blocks=self.config.num_blocks,
+                        detail="prompt exceeds the whole KV pool")
+                    continue
             seq = self.state.put_tokens(uid, toks)
+            admitted.append(uid)
+            # a reused uid sheds its STALE rejection record — generate()
+            # and the serving layer treat a present record as "this
+            # request failed", which must only ever mean THIS admission
+            self.rejections.pop(uid, None)
+            if fresh:
+                if self.request_deadline_s > 0 and seq.deadline_at is None:
+                    seq.deadline_at = time.monotonic() \
+                        + self.request_deadline_s
+                if self.journal is not None \
+                        and seq.seen_tokens == 0 and not seq.kv_blocks:
+                    # prompt still building: (re-)journal the full chain
+                    self.journal.admit(uid, seq.prompt_log)
             if self._prefix is not None:
                 self._match_prefix(seq)
         done: Dict[int, np.ndarray] = {}
@@ -269,7 +361,7 @@ class InferenceEngineV2:
         self._drive_pipeline(
             work_left, lambda: self._plan_step(greedy=_greedy), commit_one)
         if self._prefix is not None:
-            self._register_prefix(batch_uids)
+            self._register_prefix(admitted)
         return done
 
     def _match_prefix(self, seq) -> None:
@@ -279,7 +371,12 @@ class InferenceEngineV2:
         functional pool thread, so later steps (and later matchers'
         reads) order after it on device. A DSL001-registered hot path:
         matching must never block on the device."""
-        for src, dst in self.state.match_prefix(seq):
+        copies = self.state.match_prefix(seq)
+        if copies:
+            # serve fault site: a replica dying between the match (table
+            # already points at shared blocks) and the CoW dispatch
+            get_fault_injector().maybe_fire("during_cow_copy")
+        for src, dst in copies:
             self._kv_data = self.kv_cache.copy_block(self._kv_data, src,
                                                      dst)
 
@@ -313,31 +410,334 @@ class InferenceEngineV2:
         """The shared ring-drive loop behind put() and decode_pipelined:
         fill the in-flight ring up to ``pipeline_depth`` (plan+dispatch),
         then commit the oldest step; when nothing is schedulable and
-        nothing is in flight, relieve KV pressure or declare starvation.
-        ``commit_one(ring)`` pops and applies the oldest step;
-        ``on_dispatch(plan, fl)`` hooks post-dispatch bookkeeping."""
+        nothing is in flight, relieve KV pressure, shed the starved
+        request, or declare starvation. ``commit_one(ring)`` pops and
+        applies the oldest step; ``on_dispatch(plan, fl)`` hooks
+        post-dispatch bookkeeping.
+
+        Drain discipline (docs/resilience.md "Serving"): a preemption
+        signal (attached :class:`PreemptionHandler`) or an explicit
+        :meth:`request_drain` is polled at every fill/commit boundary —
+        once draining, no new step is planned, every already-dispatched
+        step is COMMITTED (its rollbacks and deferred aborts applied),
+        and the loop exits with host state token-consistent, ready for
+        :meth:`drain` to snapshot. The watchdog (attach_watchdog) brackets
+        each iteration so a stalled dispatch or commit is *named*."""
         depth = max(1, self.pipeline_depth)
         ring: deque = deque()
-        while ring or work_left():
-            while len(ring) < depth and work_left():
-                self._try_resume()
-                plan = make_plan()
-                if plan is None:
-                    break
-                fl = self._dispatch_step(plan)
-                ring.append(fl)
-                if on_dispatch is not None:
-                    on_dispatch(plan, fl)
-            if ring:
-                commit_one(ring)
+        wd = self._watchdog
+        self._live_ring = ring
+        try:
+            while ring or (work_left() and not self._draining()):
+                if wd is not None:
+                    wd.step_start(self._step_counter)
+                try:
+                    while len(ring) < depth and not self._draining() \
+                            and work_left():
+                        self._expire_deadlines()
+                        self._try_resume()
+                        if wd is not None:
+                            wd.phase("plan")
+                        plan = make_plan()
+                        if plan is None:
+                            break
+                        if wd is not None:
+                            wd.phase("dispatch")
+                        fl = self._dispatch_with_retry(plan)
+                        ring.append(fl)
+                        if on_dispatch is not None:
+                            on_dispatch(plan, fl)
+                    if ring:
+                        commit_one(ring)
+                        continue
+                    if self._draining():
+                        break
+                    if not self._relieve_kv_pressure() \
+                            and not self._shed_starved():
+                        # nothing schedulable, evictable, resumable or
+                        # sheddable -> a single sequence genuinely does
+                        # not fit the pool and shedding is off
+                        raise RuntimeError(
+                            "scheduler starved: KV pool too small even "
+                            "after pausing all idle sequences "
+                            f"(free blocks={self.kv_cache.free_blocks})")
+                except BaseException:
+                    if wd is not None:
+                        wd.step_abort()
+                    raise
+                finally:
+                    if wd is not None:
+                        wd.step_end(self._step_counter)
+        finally:
+            self._live_ring = None
+
+    # ------------------------------------------------------------------ #
+    # serve-side resilience: drain / replay / abort / shed / deadlines
+    # (docs/resilience.md "Serving"; drain.py has the manifest format)
+    # ------------------------------------------------------------------ #
+
+    def attach_preemption(self, handler) -> None:
+        """Wire a :class:`~...resilience.preemption.PreemptionHandler`
+        into the serve loop: once its flag is set (SIGTERM or a manual
+        request), the pipeline stops planning, commits everything in
+        flight and exits — the caller then runs :meth:`drain`."""
+        self.preemption = handler
+
+    def attach_watchdog(self, wd) -> None:
+        """Cover the serve loop with a
+        :class:`~...resilience.watchdog.StepWatchdog`: each pipeline
+        iteration is bracketed and the plan/dispatch/commit phases are
+        named, so a stalled step's diagnosis says WHERE it hung."""
+        self._watchdog = wd
+
+    def request_drain(self) -> None:
+        """Put the engine into draining mode (idempotent): no new
+        admissions, no new planned steps; in-flight steps still commit."""
+        self._drain_requested = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining()
+
+    def _draining(self) -> bool:
+        return self._drain_requested or (
+            self.preemption is not None and self.preemption.preempted)
+
+    def _reject(self, uid: int, reason: str, **fields) -> None:
+        """Record a structured rejection (load shed / deadline / drain
+        refusal) — the crash-free failure path the serving layer turns
+        into a retriable response. Pure host bookkeeping."""
+        rec = {"uid": uid, "reason": reason, "time": time.time(), **fields}
+        self.rejections[uid] = rec
+        logger.warning(f"serve rejection uid={uid}: {reason} "
+                       + (str(fields) if fields else ""))
+
+    def _expire_deadlines(self) -> None:
+        """Abort requests whose admission-stamped deadline has passed —
+        serving them late wastes pool and steps the on-time requests
+        need. Runs at every pipeline fill boundary; pure host checks."""
+        if self.request_deadline_s <= 0:
+            return
+        now = time.monotonic()
+        for seq in list(self.state.sequences.values()):
+            if not seq.in_flight:
+                # owes nothing right now: a request that completed its
+                # decode budget on time (awaiting caller flush) or one
+                # idle between decode rounds must NOT be reaped — expiry
+                # applies only to work actually being scheduled late
                 continue
-            if not self._relieve_kv_pressure():
-                # nothing schedulable, nothing evictable or resumable ->
-                # a single sequence genuinely does not fit the pool
-                raise RuntimeError(
-                    "scheduler starved: KV pool too small even after "
-                    "pausing all idle sequences "
-                    f"(free blocks={self.kv_cache.free_blocks})")
+            if seq.deadline_at is not None and now > seq.deadline_at \
+                    and seq.status is not SequenceStatus.FINISHED:
+                self._reject(seq.uid, "deadline_exceeded",
+                             deadline_s=self.request_deadline_s,
+                             seen_tokens=seq.seen_tokens,
+                             generated=len(seq.gen_log))
+                self.abort(seq.uid)
+
+    def _shed_starved(self) -> bool:
+        """Graceful load shedding: the scheduler starved with the pool
+        exhausted even after prefix-cache eviction and pausing — abort
+        the cheapest-to-redo victim (not-yet-started requests first,
+        then the largest demand, i.e. the request that can never fit)
+        with a structured rejection instead of crashing the loop."""
+        if not self.serve_shed:
+            return False
+        cands = [s for s in self.state.sequences.values()
+                 if s.in_flight and s.status is not SequenceStatus.FINISHED]
+        if not cands:
+            return False
+        victim = min(cands, key=lambda s: (s.seen_tokens != 0,
+                                           -(s.seen_tokens + s.in_flight)))
+        self._reject(
+            victim.uid, "kv_pool_exhausted",
+            needed_blocks=victim.blocks_needed(victim.in_flight,
+                                               self.config.block_size),
+            free_blocks=self.kv_cache.free_blocks,
+            seen_tokens=victim.seen_tokens)
+        self.abort(victim.uid)
+        return True
+
+    def abort(self, uid: int) -> bool:
+        """Cancel a sequence mid-pipeline, exactly releasing its state:
+        pending work is dropped, its slots in every in-flight step are
+        killed (their readback discarded), and the flush — KV blocks to
+        the allocator, prefix-cache refcounts decref'd — is DEFERRED to
+        the commit of the last in-flight step that still writes its
+        blocks (the same discipline as the EOS rollback's
+        ``trim_blocks``). Safe from inside or outside the pipeline;
+        returns False for an unknown uid. ``flush`` only reconciles at
+        commit — this is the any-time cancellation path."""
+        seq = self.state.get(uid)
+        if seq is None:
+            return False
+        seq.pending_tokens.clear()
+        seq.spec_pending = 0
+        seq.status = SequenceStatus.FINISHED   # scheduler skips it
+        last_fl = None
+        if self._live_ring:
+            for fl in self._live_ring:
+                touched = False
+                for j, item in enumerate(fl.sched):
+                    if item.seq.uid == uid:
+                        # ALREADY-dead slots (a late EOS killed them)
+                        # count too: the step's KV writes — and any
+                        # rollback it carries for this sequence — still
+                        # reference the blocks, so the flush must wait
+                        # for it regardless
+                        fl.dead.add(j)
+                        touched = True
+                if touched or any(s is seq for s, _ in fl.rollbacks):
+                    last_fl = fl
+        if last_fl is not None:
+            last_fl.aborts.append(seq)
+        else:
+            self._flush_uid(uid)
+        return True
+
+    def _flush_uid(self, uid: int) -> None:
+        """The one engine-level release path (flush / deferred abort /
+        drain): journal the finish so a replayed journal drops the
+        sequence, then free through the state manager (shared blocks
+        decref'd, private blocks to the allocator)."""
+        if self.journal is not None \
+                and self.state.get(uid) is not None:
+            self.journal.finish(uid)
+        self.state.flush(uid)
+
+    def drain(self, path: Optional[str] = None,
+              ledger: Any = None) -> Dict[str, Any]:
+        """Cooperative preemption drain: stop admitting, snapshot every
+        live sequence into a replay manifest (uid, prompt, tokens
+        generated so far, scheduler state), release ALL engine state —
+        prefix-cache refcounts decref'd exactly, every block back to the
+        allocator or the cache's evictable set — and atomically publish
+        the manifest (``path``, or DSTPU_SERVE_DRAIN_MANIFEST). Appends a
+        ``serve_drain`` entry to ``ledger`` (or a RestartLedger at
+        DSTPU_RESTART_LEDGER). Call with no steps in flight — i.e. after
+        the interrupted engine call returned; the pipeline itself unwinds
+        on the drain flag. Returns the manifest dict (``pool`` carries
+        the full-recovery verdict the drills assert on)."""
+        if self._live_ring is not None:
+            raise ServeDrainError(
+                "drain() called with steps in flight — request_drain() "
+                "and let the interrupted engine call return first")
+        self.request_drain()
+        manifest = build_manifest(self)
+        if self.journal is not None:
+            # retire the journal BEFORE flushing: the flush loop must not
+            # append 'finish' records for sequences this manifest still
+            # owes to a survivor — if the drain itself is killed before
+            # write_manifest lands, the intact journal is the recovery
+            # channel (finished-by-drain entries would erase it)
+            self.journal.close()
+            self.journal = None
+        for uid in list(self.state.sequences):
+            self._flush_uid(uid)
+        free = self.kv_cache.free_blocks
+        manifest["pool"] = {
+            "num_blocks": self.config.num_blocks,
+            "free_blocks_after_drain": free,
+            # evictable refcount-0 cached blocks count as free capacity
+            "fully_recovered": free == self.config.num_blocks,
+        }
+        manifest["rejections"] = list(self.rejections.values())
+        path = path or self._manifest_path
+        if path:
+            write_manifest(manifest, path)
+            manifest["path"] = path
+        if ledger is None and os.environ.get("DSTPU_RESTART_LEDGER"):
+            from ...resilience.ledger import RestartLedger
+            ledger = RestartLedger(os.environ["DSTPU_RESTART_LEDGER"])
+        if ledger is not None:
+            ledger.record("serve_drain",
+                          sequences=len(manifest["sequences"]),
+                          manifest=path,
+                          fully_recovered=manifest["pool"]["fully_recovered"])
+        self._drained = True
+        log_dist(f"serve drain: {len(manifest['sequences'])} sequences "
+                 f"manifested, pool fully_recovered="
+                 f"{manifest['pool']['fully_recovered']}")
+        return manifest
+
+    def replay(self, manifest: Dict[str, Any]) -> Dict[int, Any]:
+        """Re-admit a drained replica's sequences on THIS engine (a
+        restarted process or a live survivor): each sequence re-enters
+        the queue as ``prompt + generated`` and is prefilled — on a
+        survivor sharing the workload's prefix, mostly as prefix-cache
+        block hits — and the returned ``{uid: next greedy token}`` is
+        token-identical to what the uninterrupted run would have emitted
+        next. The sequences stay live for continued decoding, with
+        prompt/generated split restored so a LATER drain of this engine
+        emits cumulative manifests."""
+        if self._draining():
+            raise EngineDrainingError(
+                "replay() on a draining engine — replay belongs on the "
+                "restarted or survivor replica")
+        recs = manifest.get("sequences", [])
+        uids = [int(r["uid"]) for r in recs]
+        chains = [list(r["prompt"]) + list(r["generated"]) for r in recs]
+        out = self.put(uids, chains, _greedy=True)
+        for r in recs:
+            seq = self.state.get(int(r["uid"]))
+            if seq is not None:
+                # put() saw the whole chain as prompt; restore the true
+                # request identity (original prompt, generated history +
+                # whatever the replay prefill just emitted)
+                seq.prompt_log = list(r["prompt"])
+                seq.gen_log = list(r["generated"]) + seq.gen_log
+        return out
+
+    def _dispatch_with_retry(self, plan: _PlannedStep) -> _InFlightStep:
+        """Bounded retry-with-backoff around one step dispatch: a
+        TRANSIENT (I/O-class) failure re-dispatches the SAME planned step
+        — a failed dispatch mutated no host or pool state, so this is
+        always safe; persistent failure surfaces as ServeStepError (the
+        serve loop's cue to drain). Registered DSL001 hot path: the
+        backoff sleep only runs on the already-failed path."""
+        delay = self.serve_retry_backoff_s
+        attempt = 0
+        while True:
+            try:
+                return self._dispatch_step(plan)
+            except (OSError, ConnectionError) as e:
+                attempt += 1
+                self.pipeline_stats["retries"] += 1
+                if attempt > self.serve_step_retries:
+                    raise ServeStepError(
+                        f"serve step dispatch failed {attempt} times; "
+                        f"last error: {e}") from e
+                logger.warning(
+                    f"serve step dispatch transient failure ({e}); "
+                    f"retry {attempt}/{self.serve_step_retries} in "
+                    f"{delay:.3f}s")
+                if delay > 0:
+                    time.sleep(delay)
+                delay *= 2
+
+    def _pre_commit(self, fl: _InFlightStep) -> None:
+        """Shared entry of both commit paths, ahead of the blocking
+        readback: names the watchdog phase and carries the ``mid_commit``
+        fault site. Registered DSL001 hot path — pure host work."""
+        if self._watchdog is not None:
+            self._watchdog.phase("commit")
+        get_fault_injector().maybe_fire("mid_commit")
+
+    def _finish_commit(self, fl: _InFlightStep) -> None:
+        """Shared exit of both commit paths: apply the EOS rollbacks that
+        had to wait for this step's execution, then the deferred abort
+        flushes (same reason — their blocks took this step's writes).
+        A rollback whose sequence was flushed in the meantime (an abort
+        raced the queued retraction, or the step itself was popped from
+        the ring before the abort scan could see it) is a no-op — its
+        blocks went back wholesale with the flush, and trimming the
+        stale descriptor again would double-free them."""
+        for seq, retract in fl.rollbacks:
+            if self.state.get(seq.uid) is not seq:
+                continue                       # flushed: blocks already back
+            seq.seen_tokens -= retract
+            self.state.trim_blocks(seq)
+        for seq in fl.aborts:
+            self._flush_uid(seq.uid)
 
     def _resume_headroom(self, seq) -> int:
         """Blocks needed to restore ``seq`` AND schedule its next chunk —
@@ -397,7 +797,7 @@ class InferenceEngineV2:
         return self.state.can_schedule(uid, n_tokens)
 
     def flush(self, uid: int) -> None:
-        self.state.flush(uid)
+        self._flush_uid(uid)
 
     def pause(self, uid: int) -> None:
         """Evict a sequence's KV blocks to host memory and free them — the
@@ -537,13 +937,29 @@ class InferenceEngineV2:
         consumed = np.asarray(consumed) if consumed is not None else None
         self._step_counter += n
         out: Dict[int, List[int]] = {}
+        journal_toks: Dict[int, List[int]] = {}
         for i, (uid, seq) in enumerate(zip(batch_uids, seqs)):
+            used = int(consumed[i]) if consumed is not None else n
+            if greedy:
+                # replay history (drain.py): the fed first token joins
+                # gen_log unless it is one of our own committed outputs
+                # being fed back, then the outputs the loop actually
+                # consumed/emitted (post-EOS repeats never committed)
+                hist = []
+                if len(seq.prompt_log) + len(seq.gen_log) \
+                        <= seq.seen_tokens:
+                    hist.append(int(first_tokens[i]))
+                hist.extend(int(t) for t in toks[i][:used])
+                seq.gen_log.extend(hist)
+                if self.journal is not None:
+                    journal_toks[uid] = hist
             # fed first_tokens + generated until eos (or all n)
-            seq.seen_tokens += int(consumed[i]) if consumed is not None \
-                else n
+            seq.seen_tokens += used
             seq.last_step = self._step_counter
             seq.status = SequenceStatus.WAITING
             out[uid] = toks[i].tolist()
+        if self.journal is not None:
+            self.journal.tokens(journal_toks)
         return out
 
     # ------------------------------------------------------------------ #
@@ -624,6 +1040,11 @@ class InferenceEngineV2:
             ntok[i] = len(item.tokens)
             tables[i, :len(seq.kv_blocks)] = seq.kv_blocks
         use_greedy = greedy and hasattr(self.runner, "step_greedy")
+        if any(n > 1 for n in ntok[:len(sched)]):
+            # serve fault site: a replica dying with a freshly planned
+            # multi-token prefill chunk (tokens consumed host-side, step
+            # never dispatched)
+            get_fault_injector().maybe_fire("during_prefill_chunk")
         self.pipeline_stats["plan_s"] += time.perf_counter() - t0
         return _PlannedStep(sched, tokens, start, ntok, tables,
                             feed_mask if has_feed else None, feed_idx,
@@ -634,6 +1055,9 @@ class InferenceEngineV2:
         result stays an in-flight device future (JAX async dispatch).
         A greedy step's [S] token output becomes the device feedback
         source for the next plan's speculative slots."""
+        # serve fault site: planned but not yet enqueued — with mode
+        # 'ioerror' this is the transient _dispatch_with_retry absorbs
+        get_fault_injector().maybe_fire("pre_dispatch")
         t0 = time.perf_counter()
         jnp = jax.numpy
         batch = RaggedBatch(
@@ -663,18 +1087,34 @@ class InferenceEngineV2:
     def _commit_step(self, fl: _InFlightStep) -> Tuple[int, Dict[int, Any]]:
         """COMMIT: apply a step's host readback — in the pipelined loop
         this runs one (or more) steps behind dispatch, while the next
-        step executes on the device. Used by the put() path only: its
-        steps carry no speculation, so dead slots / rollbacks (the
-        decode_pipelined commit's concern) cannot occur here."""
+        step executes on the device. Used by the put() path: its steps
+        carry no speculation, so EOS rollbacks cannot occur here, but
+        abort() may have killed slots (``fl.dead``) and deferred flushes
+        (``fl.aborts``) to this commit. Greedy last-chunk tokens are the
+        committed stream: they extend each sequence's replay ``gen_log``
+        and land in the write-ahead journal."""
+        self._pre_commit(fl)
         t0 = time.perf_counter()
         result = np.asarray(fl.result)
         self.pipeline_stats["commit_block_s"] += time.perf_counter() - t0
         out: Dict[int, Any] = {}
+        journal_toks: Dict[int, List[int]] = {}
         for i, item in enumerate(fl.sched):
+            if i in fl.dead:
+                continue
             if item.is_last_chunk:
-                out[item.seq.uid] = int(result[i]) if fl.use_greedy \
-                    else result[i]
+                if fl.use_greedy:
+                    tok = int(result[i])
+                    out[item.seq.uid] = tok
+                    item.seq.gen_log.append(tok)
+                    if self.journal is not None:
+                        journal_toks[item.seq.uid] = [tok]
+                else:
+                    out[item.seq.uid] = result[i]
                 item.seq.status = SequenceStatus.WAITING
+        if self.journal is not None:
+            self.journal.tokens(journal_toks)
+        self._finish_commit(fl)
         return len(fl.sched), out
 
     def decode_pipelined(self, batch_uids: Sequence[int],
@@ -748,10 +1188,12 @@ class InferenceEngineV2:
 
         def commit_one(ring):
             fl = ring.popleft()
+            self._pre_commit(fl)
             t0 = time.perf_counter()
             toks = np.asarray(fl.result)
             self.pipeline_stats["commit_block_s"] += \
                 time.perf_counter() - t0
+            journal_toks: Dict[int, List[int]] = {}
             for i, item in enumerate(fl.sched):
                 seq = item.seq
                 u = seq.uid
@@ -766,6 +1208,9 @@ class InferenceEngineV2:
                 tok = int(toks[i])
                 seq.status = SequenceStatus.WAITING
                 out[u].append(tok)
+                seq.gen_log.append(tok)       # committed replay history
+                if self.journal is not None:
+                    journal_toks.setdefault(u, []).append(tok)
                 if patch and seq.spec_pending and seq.pending_tokens \
                         and seq.pending_tokens[0] == _SPEC_TOKEN:
                     # this step produced the queued placeholder and its
@@ -800,9 +1245,9 @@ class InferenceEngineV2:
                     # being retracted — free them only once the last such
                     # step has executed (its commit)
                     last_fl.rollbacks.append((seq, retract))
-            for seq, retract in fl.rollbacks:
-                seq.seen_tokens -= retract
-                self.state.trim_blocks(seq)
+            if self.journal is not None:
+                self.journal.tokens(journal_toks)
+            self._finish_commit(fl)
 
         def speculate(plan, fl):
             # speculate the next step: every live sequence scheduled in
@@ -854,8 +1299,21 @@ class InferenceEngineV2:
         live = set(uids)
         outputs: Dict[int, List[int]] = {u: [] for u in uids}
         last_tok: Dict[int, int] = {}
+
+        def drop_rejected():
+            # load-shed / deadline-aborted requests leave the loop with
+            # whatever they got — their structured record stays in
+            # self.rejections for the caller (no crash, no livelock)
+            for u in list(live):
+                if u in self.rejections:
+                    live.discard(u)
+
         results = self.put(uids, [list(p) for p in prompts], _greedy=greedy)
+        drop_rejected()
         for u in uids:
+            if u not in results:
+                live.discard(u)
+                continue
             nxt = self._sample(results[u], sampling, rng)
             outputs[u].append(nxt)
             if (eos_token_id is not None and nxt == eos_token_id) or \
@@ -870,6 +1328,8 @@ class InferenceEngineV2:
 
         def finish_chunk(u, toks):
             toks = toks[:max_new_tokens - len(outputs[u])]
+            if not toks:
+                return
             if eos_token_id is not None and eos_token_id in toks:
                 cut = toks.index(eos_token_id)
                 outputs[u].extend(toks[:cut + 1])
@@ -923,11 +1383,16 @@ class InferenceEngineV2:
                     eos_token_id=eos_token_id)
                 for u in lu:
                     finish_chunk(u, outs[u])
+                drop_rejected()
                 continue
             # tails / tiny budgets / truly starved pools: token-at-a-time
             results = self.put(lu, [[last_tok[u]] for u in lu],
                                _greedy=greedy)
+            drop_rejected()
             for u in lu:
+                if u not in results:
+                    live.discard(u)
+                    continue
                 nxt = self._sample(results[u], sampling, rng)
                 outputs[u].append(nxt)
                 if (eos_token_id is not None and nxt == eos_token_id) or \

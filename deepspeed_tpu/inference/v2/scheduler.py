@@ -42,6 +42,23 @@ class SplitFuseScheduler:
         self.cfg = cfg
         self.state = state
 
+    def describe(self, seq: SequenceDescriptor) -> dict:
+        """Scheduler-state snapshot for one sequence — the diagnostics
+        half of a drain manifest (drain.py): where the request stood in
+        the SplitFuse queue when the replica died. Pure host reads."""
+        waited = self.state.step - seq.last_sched
+        return {
+            "status": seq.status.value,
+            "seen_tokens": seq.seen_tokens,
+            "pending_tokens": seq.in_flight,
+            "prompt_len": seq.prompt_len,
+            "kv_blocks": len(seq.kv_blocks),
+            "shared_blocks": len(seq.shared),
+            "last_sched": seq.last_sched,
+            "waited_steps": waited,
+            "aged": seq.in_flight > 1 and waited >= PREFILL_AGING_STEPS,
+        }
+
     def schedule(self, eligible: Optional[
             Callable[[SequenceDescriptor], bool]] = None
             ) -> List[ScheduledSeq]:

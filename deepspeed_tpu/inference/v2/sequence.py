@@ -55,6 +55,20 @@ class SequenceDescriptor:
     # latest dispatched step (device feedback); otherwise the commit of
     # the producing step patches the placeholder with the real value.
     spec_pending: int = 0
+    # drain/replay (drain.py): the durable identity of the request. The
+    # replay chain is prompt_log + gen_log — re-put()ting it on a fresh
+    # or survivor engine reproduces this sequence's KV (and therefore its
+    # greedy continuation) exactly. prompt_log is every token fed while
+    # the sequence was still a fresh prompt; gen_log is every COMMITTED
+    # output of the greedy serve paths plus any caller-fed continuation
+    # token not already accounted (see StateManager.put_tokens) — dead
+    # (rolled-back) pipeline slots never reach it by construction.
+    prompt_log: List[int] = field(default_factory=list)
+    gen_log: List[int] = field(default_factory=list)
+    # absolute time.monotonic() deadline for this request (0/None = no
+    # deadline); the engine aborts expired sequences with a structured
+    # rejection instead of serving them late
+    deadline_at: Optional[float] = None
 
     @property
     def in_flight(self) -> int:

@@ -63,7 +63,22 @@ class StateManager:
 
     def put_tokens(self, uid: int, tokens: Iterable[int]) -> SequenceDescriptor:
         seq = self.get_or_create(uid)
-        seq.pending_tokens.extend(int(t) for t in tokens)
+        toks = [int(t) for t in tokens]
+        if seq.seen_tokens == 0 and not seq.kv_blocks:
+            # still a fresh prompt: the fed tokens are prompt — they join
+            # the replay chain's prompt half (drain.py)
+            seq.prompt_log.extend(toks)
+        else:
+            # continuation feed: a token is new replay history UNLESS it
+            # is one of our own committed outputs being fed back (the
+            # greedy loops append outputs to gen_log at commit — feeding
+            # them again must not double-count). The number of chain
+            # tokens not yet consumed-or-queued as inputs is exactly how
+            # many of the fed tokens are already accounted for.
+            unfed = len(seq.prompt_log) + len(seq.gen_log) \
+                - seq.seen_tokens - len(seq.pending_tokens)
+            seq.gen_log.extend(toks[max(0, unfed):])
+        seq.pending_tokens.extend(toks)
         if seq.seen_tokens == 0 and not seq.kv_blocks:
             # still a fresh prompt (nothing prefilled yet): everything
             # pending is prompt — the span the prefix tracker hashes and

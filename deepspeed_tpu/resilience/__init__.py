@@ -4,6 +4,8 @@ recovery guarantees each piece provides."""
 
 from .fault_injection import (
     FAULT_SITES,
+    SERVE_FAULT_SITES,
+    TRAIN_FAULT_SITES,
     FaultInjector,
     InjectedFault,
     get_fault_injector,
@@ -15,6 +17,8 @@ from .watchdog import StepWatchdog
 
 __all__ = [
     "FAULT_SITES",
+    "SERVE_FAULT_SITES",
+    "TRAIN_FAULT_SITES",
     "FaultInjector",
     "InjectedFault",
     "get_fault_injector",

@@ -23,6 +23,20 @@ Sites (see docs/resilience.md):
     ``step``                 at the top of ``Engine.train_batch`` once
                              ``global_steps >= at_step``
 
+Serve sites (the v2 ragged engine's pipeline, docs/resilience.md
+"Serving"): each models a replica dying at a different point of the
+plan/dispatch/commit overlap window — the serve drill
+(``bin/dstpu_faultdrill --mode serve``) crashes at every one and proves
+journal/manifest replay is token-identical:
+
+    ``pre_dispatch``         a planned step exists, nothing enqueued yet
+    ``mid_commit``           ahead of a commit's blocking readback —
+                             tokens journaled so far are durable, the
+                             in-flight ring is lost
+    ``during_prefill_chunk`` a multi-token prefill chunk was just planned
+    ``during_cow_copy``      between a partial-tail prefix match and its
+                             copy-on-write block-copy dispatch
+
 Env protocol (read lazily on first :func:`get_fault_injector` call):
 
     DSTPU_FAULT_SITE       one of the names above (unset = disabled)
@@ -47,7 +61,15 @@ from ..utils.logging import logger
 
 #: the canonical site names (docs + faultdrill iterate over these)
 FAULT_SITES = ("pre_save", "mid_save", "post_save_pre_latest",
-               "collective", "step")
+               "collective", "step",
+               # serve-side sites (InferenceEngineV2's pipeline)
+               "pre_dispatch", "mid_commit", "during_prefill_chunk",
+               "during_cow_copy")
+
+#: the serve-loop subset (bin/dstpu_faultdrill --mode serve drills these;
+#: the train drill keeps its original five)
+TRAIN_FAULT_SITES = FAULT_SITES[:5]
+SERVE_FAULT_SITES = FAULT_SITES[5:]
 
 
 class InjectedFault(RuntimeError):

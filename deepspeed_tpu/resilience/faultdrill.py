@@ -1,7 +1,8 @@
-"""Fault drill — crash a short train loop at every injection site, then
-prove it recovers.
+"""Fault drill — crash a short train or serve loop at every injection
+site, then prove it recovers.
 
-For each site in :data:`~.fault_injection.FAULT_SITES`:
+``--mode train`` (the PR 1 drill), for each site in
+:data:`~.fault_injection.TRAIN_FAULT_SITES`:
 
   1. run a tiny CPU train-loop worker with ``DSTPU_FAULT_SITE=<site>``
      armed (hard ``os._exit`` crash) and a once-marker file;
@@ -11,10 +12,30 @@ For each site in :data:`~.fault_injection.FAULT_SITES`:
      newest valid checkpoint, and that ``latest`` points at a
      validating tag.
 
+``--mode serve`` (ISSUE 7), for each site in
+:data:`~.fault_injection.SERVE_FAULT_SITES` plus the cooperative
+``sigterm`` drain:
+
+  1. run a serve worker (v2 ragged engine, prefix cache on, pipelined
+     depth 2, write-ahead replay journal armed) over a shared-prefix
+     workload once with NO fault to record the uninterrupted greedy
+     oracle;
+  2. crash it — a hard ``os._exit`` at the armed serve site (the journal
+     alone carries the committed state), or for ``sigterm`` a real
+     SIGTERM the worker sends itself mid-decode (the engine drains and
+     atomically publishes a replay manifest, exiting
+     ``MEMBERSHIP_CHANGE_EXIT`` like a preempted replica);
+  3. re-run in recovery: ``load_replay_state`` (manifest preferred,
+     journal fallback), ``engine.replay`` on a fresh engine, decode
+     every sequence to the full budget, and assert the streams are
+     TOKEN-IDENTICAL to the oracle with the block pool fully recovered.
+
 Exit 0 only when every site both crashed and recovered. This is the CI
 guard (``bin/dstpu_faultdrill``) that keeps the recovery paths in
-``checkpoint/`` and ``runtime/engine.py`` honest; tier-1 runs it over a
-subset via ``tests/unit/test_resilience.py``.
+``checkpoint/``, ``runtime/engine.py`` and ``inference/v2/drain.py``
+honest; tier-1 runs subsets via ``tests/unit/test_resilience.py`` and
+``tests/unit/test_serve_drain.py``; ``tools/tpu_round11.sh`` runs both
+modes in CI.
 """
 
 from __future__ import annotations
@@ -27,11 +48,18 @@ import sys
 import tempfile
 from typing import List, Optional
 
-from .fault_injection import FAULT_SITES
+from .fault_injection import (FAULT_SITES, SERVE_FAULT_SITES,
+                              TRAIN_FAULT_SITES)
 
 #: steps the drill worker trains for; the fault fires at DRILL_FAULT_STEP
 DRILL_STEPS = 5
 DRILL_FAULT_STEP = 3
+
+#: serve drill shape: requests sharing a prefix, tokens served per uid
+SERVE_DRILL_REQS = 3
+SERVE_DRILL_TOKENS = 8
+#: the cooperative-drain pseudo-site (a real SIGTERM, not an injector)
+SIGTERM_SITE = "sigterm"
 
 
 def _worker() -> int:
@@ -87,14 +115,138 @@ def _worker() -> int:
     return 0
 
 
-def _run_worker(env: dict) -> int:
+def _serve_worker() -> int:
+    """The serve drill's worker (subprocess; configured by env). Serves
+    SERVE_DRILL_REQS shared-prefix requests for SERVE_DRILL_TOKENS greedy
+    tokens each through a tiny pipelined v2 engine.
+
+    ``DRILL_SERVE_PHASE``:
+      - ``oracle``  — uninterrupted run; writes {uid: tokens} to
+        ``DRILL_ORACLE_FILE``.
+      - ``serve``   — journal armed (``DSTPU_SERVE_JOURNAL`` is set by
+        the drill); an armed fault site ``os._exit``s mid-serve, or
+        (``DRILL_SIGTERM_AFTER_ROUND``) the worker SIGTERMs itself and
+        the PreemptionHandler->drain path publishes the manifest and
+        exits ``MEMBERSHIP_CHANGE_EXIT``.
+      - ``recover`` — load_replay_state(manifest, journal), replay on a
+        fresh engine, decode every sequence to the full budget, write
+        {uid: tokens} + pool verdict to ``DRILL_RESULT_FILE``.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import signal
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..elasticity.elastic_agent import MEMBERSHIP_CHANGE_EXIT
+    from ..inference.v2 import (InferenceEngineV2, RaggedInferenceConfig,
+                                load_replay_state)
+    from ..models.gpt2 import GPT2, GPT2Config
+    from .preemption import PreemptionHandler
+
+    phase = os.environ["DRILL_SERVE_PHASE"]
+    n_tok = SERVE_DRILL_TOKENS
+
+    mcfg = GPT2Config(vocab_size=96, max_seq_len=128, num_layers=2,
+                      num_heads=2, hidden_size=32, dtype=jnp.float32)
+    params = GPT2(mcfg).init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, 8), jnp.int32))["params"]
+    cfg = RaggedInferenceConfig(
+        max_seqs=4, chunk_size=8, block_size=4, num_blocks=64,
+        max_blocks_per_seq=16, dtype="float32", attention_impl="dense",
+        decode_loop_steps=0, serve_pipeline_depth=2, prefix_cache=True)
+    eng = InferenceEngineV2(mcfg, params, cfg)
+
+    # shared 10-token preamble, block_size 4: two full shared blocks per
+    # later request plus a partial-tail CoW copy — every serve fault
+    # site is on this workload's path
+    rng = np.random.default_rng(55)
+    shared = rng.integers(1, 96, 10).tolist()
+    prompts = [shared + rng.integers(1, 96, 5).tolist()
+               for _ in range(SERVE_DRILL_REQS)]
+    uids = list(range(SERVE_DRILL_REQS))
+
+    if phase == "recover":
+        state = load_replay_state(os.environ.get("DRILL_MANIFEST"),
+                                  os.environ.get("DRILL_JOURNAL"))
+        if state is None:
+            print("faultdrill serve: no manifest or journal to recover "
+                  "from", file=sys.stderr)
+            return 2
+        out = eng.replay(state)
+        toks = {int(s["uid"]): list(s["generated"])
+                for s in state["sequences"]}
+        for u in list(toks):
+            if u in out and len(toks[u]) < n_tok:
+                toks[u].append(int(out[u]))
+        while True:
+            short = [u for u in toks if len(toks[u]) < n_tok]
+            if not short:
+                break
+            outs = eng.decode_pipelined(
+                short, [toks[u][-1] for u in short],
+                [n_tok - len(toks[u]) for u in short])
+            for u in short:
+                toks[u].extend(outs[u][:n_tok - len(toks[u])])
+        for u in list(toks):
+            eng.flush(u)
+        with open(os.environ["DRILL_RESULT_FILE"], "w") as f:
+            json.dump({"tokens": {str(u): t for u, t in toks.items()},
+                       "replayed": len(toks),
+                       "pool_recovered":
+                           eng.free_blocks == cfg.num_blocks,
+                       "prefix_stats": {
+                           k: v for k, v in eng.prefix_stats.items()
+                           if isinstance(v, (int, float))}}, f)
+        return 0
+
+    handler = PreemptionHandler() if phase == "serve" else None
+    if handler is not None:
+        eng.attach_preemption(handler)
+    sigterm_round = int(os.environ.get("DRILL_SIGTERM_AFTER_ROUND", "-1"))
+
+    toks = {}
+    for u, p in zip(uids, prompts):
+        r = eng.put([u], [list(p)], _greedy=True)
+        if u in r:
+            toks[u] = [int(r[u])]
+    rounds = 0
+    while True:
+        live = [u for u in toks if len(toks[u]) < n_tok
+                and u in eng.state.sequences]
+        if not live:
+            break
+        if rounds == sigterm_round:
+            # a REAL preemption signal, delivered with the next decode
+            # call's pipeline live: the drive loop polls the handler's
+            # flag, commits what's in flight and unwinds
+            os.kill(os.getpid(), signal.SIGTERM)
+        outs = eng.decode_pipelined(live, [toks[u][-1] for u in live], 2)
+        for u in live:
+            toks[u].extend(outs[u][:n_tok - len(toks[u])])
+        rounds += 1
+        if handler is not None and handler.preempted:
+            manifest = eng.drain(os.environ.get("DRILL_MANIFEST"))
+            print(f"faultdrill serve: drained "
+                  f"{len(manifest['sequences'])} sequences after "
+                  f"SIGTERM", file=sys.stderr)
+            return MEMBERSHIP_CHANGE_EXIT
+
+    if phase == "oracle":
+        with open(os.environ["DRILL_ORACLE_FILE"], "w") as f:
+            json.dump({str(u): t for u, t in toks.items()}, f)
+    return 0
+
+
+def _run_worker(env: dict, fn: str = "_worker") -> int:
     env = dict(env)
     repo_root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     cmd = [sys.executable, "-c",
            "import sys; from deepspeed_tpu.resilience.faultdrill import "
-           "_worker; sys.exit(_worker())"]
+           f"{fn}; sys.exit({fn}())"]
     return subprocess.run(cmd, env=env).returncode
 
 
@@ -163,24 +315,151 @@ def drill_site(site: str, workdir: str, verbose: bool = True) -> dict:
     return result
 
 
+def _serve_env(workdir: str, phase: str, **extra) -> dict:
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)            # single CPU device: fastest drill
+    for k in ("DSTPU_FAULT_SITE", "DSTPU_SERVE_JOURNAL",
+              "DSTPU_SERVE_DRAIN_MANIFEST"):
+        env.pop(k, None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "DRILL_SERVE_PHASE": phase,
+        "DRILL_ORACLE_FILE": os.path.join(workdir, "oracle.json"),
+    })
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _serve_oracle(workdir: str) -> Optional[dict]:
+    """The uninterrupted greedy streams, computed once per drill workdir
+    and shared by every serve site (greedy decode is deterministic, so
+    one oracle serves them all)."""
+    path = os.path.join(workdir, "oracle.json")
+    if not os.path.exists(path):
+        rc = _run_worker(_serve_env(workdir, "oracle"), fn="_serve_worker")
+        if rc != 0 or not os.path.exists(path):
+            return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def drill_serve_site(site: str, workdir: str, verbose: bool = True) -> dict:
+    """Crash-then-replay drill for one serve site (or ``sigterm``):
+    kill a serving replica mid-stream, recover on a fresh engine from
+    the manifest/journal, assert token parity with the uninterrupted
+    run and full block-pool recovery."""
+    site_dir = os.path.join(workdir, f"serve_{site}")
+    os.makedirs(site_dir, exist_ok=True)
+    journal = os.path.join(site_dir, "replay.jsonl")
+    manifest = os.path.join(site_dir, "manifest.json")
+    result_file = os.path.join(site_dir, "result.json")
+    marker = os.path.join(site_dir, "fired.marker")
+
+    result = {"site": site, "mode": "serve"}
+    oracle = _serve_oracle(workdir)
+    if oracle is None:
+        result.update(recovered=False, error="oracle run failed")
+        return result
+
+    env = _serve_env(workdir, "serve",
+                     DRILL_JOURNAL=journal, DRILL_MANIFEST=manifest,
+                     DSTPU_SERVE_JOURNAL=journal)
+    if site == SIGTERM_SITE:
+        # a REAL preemption signal mid-decode: PreemptionHandler ->
+        # pipeline unwind -> drain() -> atomic manifest publish
+        env["DRILL_SIGTERM_AFTER_ROUND"] = "1"
+    else:
+        # a hard os._exit at the armed site: no drain ran, the
+        # write-ahead journal alone carries the committed chains. The
+        # skips land the crash mid-stream with state worth replaying.
+        env.update({
+            "DSTPU_FAULT_SITE": site,
+            "DSTPU_FAULT_MODE": "exit",
+            "DSTPU_FAULT_ONCE_FILE": marker,
+            "DSTPU_FAULT_SKIP": {"pre_dispatch": "4", "mid_commit": "3",
+                                 "during_prefill_chunk": "2",
+                                 "during_cow_copy": "1"}.get(site, "0"),
+        })
+    rc_crash = _run_worker(env, fn="_serve_worker")
+    result["crash_rc"] = rc_crash
+    # 99 = MEMBERSHIP_CHANGE_EXIT: the cooperative drain's exit code
+    fired = os.path.exists(marker) if site != SIGTERM_SITE \
+        else rc_crash == 99
+    result["fault_fired"] = fired
+    if rc_crash == 0 or not fired:
+        result.update(recovered=False,
+                      error="worker did not crash — injection site never "
+                            "reached")
+        return result
+    if site == SIGTERM_SITE and not os.path.exists(manifest):
+        result.update(recovered=False,
+                      error="drain published no manifest")
+        return result
+
+    rc_rec = _run_worker(
+        _serve_env(workdir, "recover", DRILL_JOURNAL=journal,
+                   DRILL_MANIFEST=manifest, DRILL_RESULT_FILE=result_file),
+        fn="_serve_worker")
+    result["recover_rc"] = rc_rec
+    replayed = {}
+    if os.path.exists(result_file):
+        with open(result_file) as f:
+            replayed = json.load(f)
+    toks = replayed.get("tokens", {})
+    result["replayed_sequences"] = replayed.get("replayed")
+    result["pool_recovered"] = replayed.get("pool_recovered")
+    # every sequence the dead replica owed tokens to must finish with a
+    # stream identical to the uninterrupted run (a request admitted
+    # AFTER the kill point never entered the journal — the client
+    # retries it; everything admitted must replay exactly)
+    parity = bool(toks) and all(toks[u] == oracle[u] for u in toks)
+    result["token_parity"] = parity
+    result["recovered"] = (rc_rec == 0 and parity
+                           and replayed.get("pool_recovered") is True)
+    if verbose:
+        print(f"[faultdrill:serve:{site}] crash_rc={rc_crash} "
+              f"recover_rc={rc_rec} replayed={result['replayed_sequences']} "
+              f"parity={parity} recovered={result['recovered']}",
+              file=sys.stderr)
+    return result
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
-        description="crash a short CPU train loop at each fault-injection "
-                    "site and verify recovery (exit non-zero on any "
-                    "unrecovered failure)")
-    ap.add_argument("--sites", default=",".join(FAULT_SITES),
-                    help="comma-separated site subset (default: all)")
+        description="crash a short CPU train or serve loop at each "
+                    "fault-injection site and verify recovery (exit "
+                    "non-zero on any unrecovered failure)")
+    ap.add_argument("--mode", default="train",
+                    choices=("train", "serve", "all"),
+                    help="train: checkpoint-recovery drill (PR 1 sites); "
+                         "serve: drain/replay drill (serve sites + "
+                         "sigterm); all: both")
+    ap.add_argument("--sites", default=None,
+                    help="comma-separated site subset (default: every "
+                         "site of the selected mode)")
     ap.add_argument("--workdir", default=None,
                     help="scratch dir (default: a fresh tempdir)")
     args = ap.parse_args(argv)
 
-    sites = [s for s in args.sites.split(",") if s]
-    unknown = set(sites) - set(FAULT_SITES)
-    if unknown:
-        ap.error(f"unknown sites {sorted(unknown)}; valid: {FAULT_SITES}")
+    serve_sites = list(SERVE_FAULT_SITES) + [SIGTERM_SITE]
+    if args.sites:
+        sites = [s for s in args.sites.split(",") if s]
+        valid = set(FAULT_SITES) | {SIGTERM_SITE}
+        unknown = set(sites) - valid
+        if unknown:
+            ap.error(f"unknown sites {sorted(unknown)}; valid: "
+                     f"{sorted(valid)}")
+    elif args.mode == "train":
+        sites = list(TRAIN_FAULT_SITES)
+    elif args.mode == "serve":
+        sites = serve_sites
+    else:
+        sites = list(TRAIN_FAULT_SITES) + serve_sites
     workdir = args.workdir or tempfile.mkdtemp(prefix="dstpu_faultdrill_")
 
-    results = [drill_site(site, workdir) for site in sites]
+    results = [drill_serve_site(site, workdir)
+               if site in serve_sites else drill_site(site, workdir)
+               for site in sites]
     ok = all(r["recovered"] for r in results)
     print(json.dumps({"ok": ok, "results": results}, indent=2))
     return 0 if ok else 1

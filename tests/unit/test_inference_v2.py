@@ -165,14 +165,31 @@ class TestRaggedEngineParity:
         assert seen == 6
         assert headroom > 0
 
-    def test_scheduler_starvation_raises_when_one_seq_cannot_fit(self):
+    def test_scheduler_starvation_sheds_or_raises(self):
         # auto-pause can oversubscribe the pool across sequences, but a
-        # SINGLE sequence larger than the whole pool is a real deadlock
+        # SINGLE sequence larger than the whole pool can never be served.
+        # Default (serve_shed=True): graceful load shedding — a
+        # STRUCTURED rejection in engine.rejections, no crash, and the
+        # engine keeps serving other traffic. serve_shed=False restores
+        # the hard RuntimeError for callers that want the crash.
         cfg, mcfg, model, params = _tiny_setup(num_blocks=2, block_size=4,
                                                max_blocks_per_seq=8)
         eng = InferenceEngineV2(mcfg, params, cfg)
+        done = eng.put([0], [[1] * 16])           # needs 5 blocks, pool has 2
+        assert 0 not in done
+        assert eng.rejections[0]["reason"] == "kv_pool_exhausted"
+        assert 0 not in eng.state.sequences       # state fully released
+        assert eng.free_blocks == 2
+        # a small prompt still serves after the shed — no poisoned state
+        ok = eng.put([1], [[1, 2, 3, 4, 5]])
+        assert 1 in ok
+        # the hard-failure mode is still available
+        cfg_hard = RaggedInferenceConfig(**{**cfg.__dict__,
+                                            "serve_shed": False})
+        eng2 = InferenceEngineV2(mcfg, params, cfg_hard)
         with pytest.raises((RuntimeError, ValueError)):
-            eng.put([0], [[1] * 16])              # needs 4 blocks, pool has 2
+            eng2.put([0], [[1] * 12])             # needs 4 > 2, under the
+            #                                       whole-pool door check
 
     def test_fused_decode_loop_matches_per_step(self):
         # decode_greedy (on-device scan, one host call per N tokens) must be

@@ -573,6 +573,23 @@ class TestFaultDrill:
                    "--workdir", str(tmp_path)])
         assert rc == 0
 
+    @pytest.mark.slow
+    def test_serve_drill_hard_crash_and_sigterm(self, tmp_path):
+        # one hard-crash site (journal recovery) + the cooperative
+        # SIGTERM drain (manifest recovery); bin/dstpu_faultdrill
+        # --mode serve runs every serve site in CI (tools/tpu_round11.sh)
+        from deepspeed_tpu.resilience.faultdrill import main
+        rc = main(["--mode", "serve", "--sites", "mid_commit,sigterm",
+                   "--workdir", str(tmp_path)])
+        assert rc == 0
+
     def test_sites_cover_the_documented_set(self):
-        assert FAULT_SITES == ("pre_save", "mid_save",
-                               "post_save_pre_latest", "collective", "step")
+        from deepspeed_tpu.resilience import (SERVE_FAULT_SITES,
+                                              TRAIN_FAULT_SITES)
+        assert TRAIN_FAULT_SITES == (
+            "pre_save", "mid_save", "post_save_pre_latest", "collective",
+            "step")
+        assert SERVE_FAULT_SITES == (
+            "pre_dispatch", "mid_commit", "during_prefill_chunk",
+            "during_cow_copy")
+        assert FAULT_SITES == TRAIN_FAULT_SITES + SERVE_FAULT_SITES

@@ -56,9 +56,21 @@ RULES: Mapping[str, str] = {
 #: host work here runs AHEAD of the device — one blocking readback
 #: serializes the whole serve pipeline. Nested defs are covered.
 HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
+    # the serve-resilience hooks (_pre_commit .. abort) run INSIDE the
+    # plan-ahead window on every pipeline iteration: deadline sweeps,
+    # retry wrappers, shed/abort bookkeeping and the commit-side fault
+    # hook must stay pure host work — one readback there re-serializes
+    # the pipeline the drain layer is supposed to leave untouched
     "deepspeed_tpu/inference/v2/engine_v2.py":
         ("_drive_pipeline", "_plan_step", "_dispatch_step",
-         "_staging_bufs", "_match_prefix", "_register_prefix"),
+         "_staging_bufs", "_match_prefix", "_register_prefix",
+         "_pre_commit", "_dispatch_with_retry", "_expire_deadlines",
+         "abort", "_shed_starved"),
+    # the write-ahead replay journal appends on the COMMIT path of every
+    # serve step: buffered file writes over host ints only — a device
+    # sync here would gate every committed token on the journal
+    "deepspeed_tpu/inference/v2/drain.py":
+        ("_write", "admit", "tokens", "finish"),
     "deepspeed_tpu/inference/v2/model_runner.py": ("_build_programs",),
     # the prefix-cache match/hash path runs inside put()'s plan-ahead
     # window (before and between _drive_pipeline fills): pure host dict

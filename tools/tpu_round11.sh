@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-11 on-chip sequence: elastic serving — preemption-safe
+# drain/replay for the v2 ragged engine (ISSUE 7). The CPU-side story
+# is already proven (kill-point model tests, bin/dstpu_faultdrill
+# --mode serve); on-chip this captures (a) the drill's token-parity +
+# pool-recovery verdicts with the real paged/TP programs in the loop,
+# (b) bench serve_drill's recovery-time and goodput numbers — how long
+# a preempted replica's requests are dark before the first replayed
+# token, and what fraction of the re-prefill the prefix cache absorbs —
+# and (c) that the drain/replay hot paths stay lint- and budget-clean.
+# Strictly sequential (one process owns the chip), no timeouts around
+# TPU clients (a killed client wedges the grant).
+cd /root/repo || exit 1
+LOG=profiles/r11_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round11 start $(date -u +%FT%TZ)"
+
+echo "--- [1/5] serve fault drill: crash at every serve site (hard"
+echo "    os._exit -> journal replay) + cooperative SIGTERM drain"
+echo "    (-> manifest replay); token parity + full pool recovery"
+python bin/dstpu_faultdrill --mode serve | tee FAULTDRILL_SERVE_r11.json
+
+echo "--- [2/5] train drill control (the PR 1 checkpoint-recovery"
+echo "    sites must still pass untouched)"
+python bin/dstpu_faultdrill --mode train | tail -c 700
+
+echo "--- [3/5] dstpu_lint (DSL001 registry now covers the"
+echo "    drain/replay hot paths: journal writes, commit hooks,"
+echo "    abort/deadline/shed bookkeeping; DSTPU_SERVE_* knobs in"
+echo "    docs/CONFIG.md)"
+python bin/dstpu_lint deepspeed_tpu
+
+echo "--- [4/5] serve_drill bench: drain->first-replayed-token"
+echo "    recovery time, re-prefill chunks skipped on the survivor,"
+echo "    goodput through a drain/replay cycle"
+python bench.py serve_drill > BENCH_DRILL_r11.json
+tail -c 1200 BENCH_DRILL_r11.json
+
+echo "--- [5/5] serve control (flagship serve numbers + audited"
+echo "    budgets must hold with the resilience layer wired in)"
+python bench.py serve > BENCH_SERVE_r11.json
+tail -c 700 BENCH_SERVE_r11.json
+echo "=== tpu_round11 done $(date -u +%FT%TZ)"
