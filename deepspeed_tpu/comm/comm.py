@@ -166,6 +166,10 @@ def _record(op: str, x, axis_name, log_name=None, scale: float = 1.0):
     from ..resilience.fault_injection import get_fault_injector
     get_fault_injector().maybe_fire("collective")
     get_comms_logger().append(op, nbytes, n, log_name=log_name)
+    # telemetry: traced-site counters keyed by the program auditor's
+    # canonical kinds (docs/observability.md) — no-op with telemetry off
+    from ..telemetry.registry import comm_counter
+    comm_counter(op)
 
 
 def all_reduce(x, op: str = "sum", axis_name="data", log_name=None):

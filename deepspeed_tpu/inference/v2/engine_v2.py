@@ -32,6 +32,7 @@ import jax
 import numpy as np
 
 from ...resilience.fault_injection import get_fault_injector
+from ...telemetry.serve import serve_observer
 from ...utils.dtypes import resolve_dtype
 from ...utils.logging import log_dist, logger
 from .blocked_allocator import OutOfBlocksError
@@ -265,6 +266,11 @@ class InferenceEngineV2:
         #: admission refusals): uid -> record. The serving layer above
         #: turns these into 503-style responses; tests assert on them.
         self.rejections: Dict[int, Dict[str, Any]] = {}
+        #: telemetry observer (telemetry/serve.py; None when
+        #: DSTPU_TELEMETRY=0 — the zero-overhead path): per-request SLO
+        #: metrics + the phase flight recorder, recorded only at the
+        #: host-side plan/commit boundaries this loop already owns
+        self._obs = serve_observer(self)
         log_dist(
             f"InferenceEngineV2 ready: {self.config.max_seqs} slots x "
             f"{self.config.chunk_size} tokens "
@@ -340,6 +346,8 @@ class InferenceEngineV2:
             # request failed", which must only ever mean THIS admission
             self.rejections.pop(uid, None)
             if fresh:
+                if self._obs is not None:
+                    self._obs.on_admit(seq, time.monotonic())
                 if self.request_deadline_s > 0 and seq.deadline_at is None:
                     seq.deadline_at = time.monotonic() \
                         + self.request_deadline_s
@@ -438,11 +446,15 @@ class InferenceEngineV2:
                         self._try_resume()
                         if wd is not None:
                             wd.phase("plan")
+                        if self._obs is not None:
+                            self._obs.phase("plan", self._step_counter)
                         plan = make_plan()
                         if plan is None:
                             break
                         if wd is not None:
                             wd.phase("dispatch")
+                        if self._obs is not None:
+                            self._obs.phase("dispatch", self._step_counter)
                         fl = self._dispatch_with_retry(plan)
                         ring.append(fl)
                         if on_dispatch is not None:
@@ -468,6 +480,10 @@ class InferenceEngineV2:
                 finally:
                     if wd is not None:
                         wd.step_end(self._step_counter)
+                    if self._obs is not None:
+                        # close the open flight-recorder span; the ring
+                        # then cleanly ends at the iteration boundary
+                        self._obs.phase("idle")
         finally:
             self._live_ring = None
 
@@ -509,6 +525,8 @@ class InferenceEngineV2:
         into a retriable response. Pure host bookkeeping."""
         rec = {"uid": uid, "reason": reason, "time": time.time(), **fields}
         self.rejections[uid] = rec
+        if self._obs is not None:
+            self._obs.on_reject(reason)
         logger.warning(f"serve rejection uid={uid}: {reason} "
                        + (str(fields) if fields else ""))
 
@@ -570,6 +588,13 @@ class InferenceEngineV2:
         seq = self.state.get(uid)
         if seq is None:
             return False
+        if seq.status is SequenceStatus.FINISHED:
+            # already cancelled, deferred flush pending: idempotent
+            # (a re-scan would also re-queue the flush, and the abort
+            # outcome must be counted once per request)
+            return True
+        if self._obs is not None:
+            self._obs.on_abort(uid in self.rejections)
         seq.pending_tokens.clear()
         seq.spec_pending = 0
         seq.status = SequenceStatus.FINISHED   # scheduler skips it
@@ -599,6 +624,9 @@ class InferenceEngineV2:
         drain): journal the finish so a replayed journal drops the
         sequence, then free through the state manager (shared blocks
         decref'd, private blocks to the allocator)."""
+        if self._obs is not None:
+            self._obs.on_flush(self.state.get(uid),
+                               uid in self.rejections, self._draining())
         if self.journal is not None \
                 and self.state.get(uid) is not None:
             self.journal.finish(uid)
@@ -622,6 +650,7 @@ class InferenceEngineV2:
                 "drain() called with steps in flight — request_drain() "
                 "and let the interrupted engine call return first")
         self.request_drain()
+        t_drain0 = time.perf_counter()
         manifest = build_manifest(self)
         if self.journal is not None:
             # retire the journal BEFORE flushing: the flush loop must not
@@ -641,6 +670,15 @@ class InferenceEngineV2:
             "fully_recovered": free == self.config.num_blocks,
         }
         manifest["rejections"] = list(self.rejections.values())
+        if self._obs is not None:
+            # the drain span + Chrome-trace auto-dump pair with the
+            # manifest (docs/observability.md); the registry SLO report
+            # rides the manifest — attached BEFORE the publish so the
+            # on-disk copy carries it too
+            self._obs.flight.record("drain", t_drain0,
+                                    time.perf_counter(),
+                                    step=self._step_counter)
+            self._obs.on_drain(manifest)
         path = path or self._manifest_path
         if path:
             write_manifest(manifest, path)
@@ -676,7 +714,12 @@ class InferenceEngineV2:
         recs = manifest.get("sequences", [])
         uids = [int(r["uid"]) for r in recs]
         chains = [list(r["prompt"]) + list(r["generated"]) for r in recs]
-        out = self.put(uids, chains, _greedy=True)
+        if self._obs is not None:
+            with self._obs.flight.span("replay", step=self._step_counter,
+                                       sequences=len(recs)):
+                out = self.put(uids, chains, _greedy=True)
+        else:
+            out = self.put(uids, chains, _greedy=True)
         for r in recs:
             seq = self.state.get(int(r["uid"]))
             if seq is not None:
@@ -702,6 +745,8 @@ class InferenceEngineV2:
             except (OSError, ConnectionError) as e:
                 attempt += 1
                 self.pipeline_stats["retries"] += 1
+                if self._obs is not None:
+                    self._obs.on_retry()
                 if attempt > self.serve_step_retries:
                     raise ServeStepError(
                         f"serve step dispatch failed {attempt} times; "
@@ -720,6 +765,8 @@ class InferenceEngineV2:
         fault site. Registered DSL001 hot path — pure host work."""
         if self._watchdog is not None:
             self._watchdog.phase("commit")
+        if self._obs is not None:
+            self._obs.phase("commit", self._step_counter)
         get_fault_injector().maybe_fire("mid_commit")
 
     def _finish_commit(self, fl: _InFlightStep) -> None:
@@ -845,6 +892,28 @@ class InferenceEngineV2:
     def free_blocks(self) -> int:
         return self.kv_cache.free_blocks
 
+    # --------------------- telemetry accessors ------------------------ #
+    # (telemetry/serve.py, docs/observability.md; all None/empty when
+    # DSTPU_TELEMETRY=0)
+
+    @property
+    def metrics(self):
+        """This engine's MetricsRegistry (per-engine, so a drill's dead
+        replica and survivor never mix stats), or None."""
+        return self._obs.registry if self._obs is not None else None
+
+    @property
+    def flight(self):
+        """This engine's phase FlightRecorder, or None."""
+        return self._obs.flight if self._obs is not None else None
+
+    def slo_report(self) -> Dict[str, Any]:
+        """TTFT/TPOT/queue-wait percentiles, outcome counts and goodput
+        fraction for everything this engine served ({} when telemetry
+        is off) — the numbers the serving layer above keys SLO-aware
+        routing on."""
+        return self._obs.slo_report() if self._obs is not None else {}
+
     def decode_greedy(self, batch_uids: Sequence[int],
                       first_tokens: Sequence[int],
                       n: int) -> Dict[int, List[int]]:
@@ -938,6 +1007,8 @@ class InferenceEngineV2:
         self._step_counter += n
         out: Dict[int, List[int]] = {}
         journal_toks: Dict[int, List[int]] = {}
+        obs = self._obs
+        now = time.monotonic() if obs is not None else 0.0
         for i, (uid, seq) in enumerate(zip(batch_uids, seqs)):
             used = int(consumed[i]) if consumed is not None else n
             if greedy:
@@ -958,8 +1029,15 @@ class InferenceEngineV2:
             seq.last_step = self._step_counter
             seq.status = SequenceStatus.WAITING
             out[uid] = toks[i].tolist()
+            if obs is not None and used > 0:
+                # one fused chunk commits `used` tokens at one host
+                # timestamp: TPOT is the inter-chunk interval split
+                # evenly (telemetry/serve.py)
+                obs.on_token_commit(seq, now, n=used)
         if self.journal is not None:
             self.journal.tokens(journal_toks)
+        if obs is not None:
+            obs.after_commit(self._step_counter)
         return out
 
     # ------------------------------------------------------------------ #
@@ -1002,6 +1080,9 @@ class InferenceEngineV2:
         for item in sched:
             item.seq.last_step = self._step_counter
             item.seq.last_sched = self.state.step
+        if self._obs is not None:
+            # first-schedule stamps -> queue-wait histogram (pure host)
+            self._obs.on_sched(sched, time.monotonic())
         cfg = self.config
         # shape bucketing: a pure-decode step (every scheduled slot carries
         # one token) runs the [S, 1] program instead of padding every slot
@@ -1045,7 +1126,10 @@ class InferenceEngineV2:
             # multi-token prefill chunk (tokens consumed host-side, step
             # never dispatched)
             get_fault_injector().maybe_fire("during_prefill_chunk")
-        self.pipeline_stats["plan_s"] += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.pipeline_stats["plan_s"] += dt
+        if self._obs is not None:
+            self._obs.on_plan(dt)
         return _PlannedStep(sched, tokens, start, ntok, tables,
                             feed_mask if has_feed else None, feed_idx,
                             use_greedy)
@@ -1081,7 +1165,10 @@ class InferenceEngineV2:
             self._feed_slot = {item.seq.uid: i
                                for i, item in enumerate(plan.sched)}
         self.pipeline_stats["steps"] += 1
-        self.pipeline_stats["dispatch_s"] += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.pipeline_stats["dispatch_s"] += dt
+        if self._obs is not None:
+            self._obs.on_dispatch(dt, plan.feed_mask is not None)
         return _InFlightStep(plan.sched, result, plan.use_greedy)
 
     def _commit_step(self, fl: _InFlightStep) -> Tuple[int, Dict[int, Any]]:
@@ -1096,7 +1183,12 @@ class InferenceEngineV2:
         self._pre_commit(fl)
         t0 = time.perf_counter()
         result = np.asarray(fl.result)
-        self.pipeline_stats["commit_block_s"] += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.pipeline_stats["commit_block_s"] += dt
+        obs = self._obs
+        now = time.monotonic() if obs is not None else 0.0
+        if obs is not None:
+            obs.on_commit_block(dt)
         out: Dict[int, Any] = {}
         journal_toks: Dict[int, List[int]] = {}
         for i, item in enumerate(fl.sched):
@@ -1111,10 +1203,16 @@ class InferenceEngineV2:
                         journal_toks[item.seq.uid] = [tok]
                 else:
                     out[item.seq.uid] = result[i]
+                if obs is not None:
+                    # the last chunk's output (token or logits) is this
+                    # request's first host-visible result -> TTFT/TPOT
+                    obs.on_token_commit(item.seq, now)
                 item.seq.status = SequenceStatus.WAITING
         if self.journal is not None:
             self.journal.tokens(journal_toks)
         self._finish_commit(fl)
+        if obs is not None:
+            obs.after_commit(self._step_counter)
         return len(fl.sched), out
 
     def decode_pipelined(self, batch_uids: Sequence[int],
@@ -1191,8 +1289,12 @@ class InferenceEngineV2:
             self._pre_commit(fl)
             t0 = time.perf_counter()
             toks = np.asarray(fl.result)
-            self.pipeline_stats["commit_block_s"] += \
-                time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.pipeline_stats["commit_block_s"] += dt
+            obs = self._obs
+            now = time.monotonic() if obs is not None else 0.0
+            if obs is not None:
+                obs.on_commit_block(dt)
             journal_toks: Dict[int, List[int]] = {}
             for i, item in enumerate(fl.sched):
                 seq = item.seq
@@ -1209,6 +1311,8 @@ class InferenceEngineV2:
                 seq.status = SequenceStatus.WAITING
                 out[u].append(tok)
                 seq.gen_log.append(tok)       # committed replay history
+                if obs is not None:
+                    obs.on_token_commit(seq, now)
                 if self.journal is not None:
                     journal_toks.setdefault(u, []).append(tok)
                 if patch and seq.spec_pending and seq.pending_tokens \
@@ -1248,6 +1352,8 @@ class InferenceEngineV2:
             if self.journal is not None:
                 self.journal.tokens(journal_toks)
             self._finish_commit(fl)
+            if obs is not None:
+                obs.after_commit(self._step_counter)
 
         def speculate(plan, fl):
             # speculate the next step: every live sequence scheduled in

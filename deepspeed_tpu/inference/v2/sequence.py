@@ -69,6 +69,16 @@ class SequenceDescriptor:
     # deadline); the engine aborts expired sequences with a structured
     # rejection instead of serving them late
     deadline_at: Optional[float] = None
+    # telemetry lifecycle stamps (time.monotonic; None until reached /
+    # when DSTPU_TELEMETRY=0): admission, first scheduled chunk, first
+    # and latest COMMITTED output token. Per-request SLO invariants
+    # (TTFT >= queue wait, monotone token times) are checkable straight
+    # off these; the registry histograms aggregate them
+    # (telemetry/serve.py, docs/observability.md).
+    admitted_at: Optional[float] = None
+    first_sched_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+    last_token_at: Optional[float] = None
 
     @property
     def in_flight(self) -> int:

@@ -47,18 +47,38 @@ class CSVMonitor(Monitor):
     def __init__(self, cfg):
         self.output_path = os.path.join(cfg.output_path or "csv_logs", cfg.job_name)
         os.makedirs(self.output_path, exist_ok=True)
+        # per-tag open handles, kept for the monitor's lifetime: a
+        # telemetry bridge emits dozens of tags per interval, and
+        # reopening each file per event turned every snapshot into
+        # O(tags) open/close syscalls
         self._files = {}
         self.enabled = True
 
+    def _file(self, tag: str):
+        f = self._files.get(tag)
+        if f is None:
+            fname = os.path.join(self.output_path,
+                                 tag.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            f = open(fname, "a", newline="")
+            if new:
+                csv.writer(f).writerow(["step", tag])
+            self._files[tag] = f
+        return f
+
     def write_events(self, events: List[Event]) -> None:
         for tag, value, step in events:
-            fname = os.path.join(self.output_path, tag.replace("/", "_") + ".csv")
-            new = not os.path.exists(fname)
-            with open(fname, "a", newline="") as f:
-                w = csv.writer(f)
-                if new:
-                    w.writerow(["step", tag])
-                w.writerow([step, value])
+            f = self._file(tag)
+            csv.writer(f).writerow([step, value])
+            f.flush()
+
+    def close(self) -> None:
+        for f in self._files.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._files.clear()
 
 
 class WandbMonitor(Monitor):

@@ -76,6 +76,13 @@ class FlopsProfiler:
             "bytes_accessed": cost.get("bytes accessed", 0.0) if cost else 0.0,
         }
         self.results = result
+        # publish the phase-labelled roofline gauges (telemetry/
+        # registry.py): bench rows and monitor bridges read achieved
+        # TFLOPS from the registry instead of re-deriving it locally
+        from ..telemetry import record_phase_tflops
+        record_phase_tflops("train", flops_per_step=flops,
+                            latency_s=latency,
+                            utilization=result["utilization"])
         self._print(result)
         if self.cfg.output_file:
             import json

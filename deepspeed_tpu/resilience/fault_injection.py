@@ -151,6 +151,15 @@ class FaultInjector:
                 f.truncate(max(1, size // 2))
         logger.error(f"FAULT INJECTION: firing {self.mode} at site "
                      f"'{site}' (step={step})")
+        try:
+            # leave a flight-recorder trace artifact next to the crash
+            # (telemetry/flight_recorder.py; no-op unless
+            # DSTPU_FLIGHT_DIR is set) — the drill asserts its presence.
+            # Must never interfere with the fault being injected.
+            from ..telemetry.flight_recorder import auto_dump
+            auto_dump(f"fault_{site}")
+        except Exception:
+            pass
         if self.mode == "ioerror":
             raise OSError(f"injected I/O error at site '{site}'")
         if self.mode == "raise":

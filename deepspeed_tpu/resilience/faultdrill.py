@@ -319,7 +319,8 @@ def _serve_env(workdir: str, phase: str, **extra) -> dict:
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)            # single CPU device: fastest drill
     for k in ("DSTPU_FAULT_SITE", "DSTPU_SERVE_JOURNAL",
-              "DSTPU_SERVE_DRAIN_MANIFEST"):
+              "DSTPU_SERVE_DRAIN_MANIFEST", "DSTPU_FLIGHT_DIR",
+              "DSTPU_TELEMETRY"):
         env.pop(k, None)
     env.update({
         "JAX_PLATFORMS": "cpu",
@@ -363,7 +364,11 @@ def drill_serve_site(site: str, workdir: str, verbose: bool = True) -> dict:
 
     env = _serve_env(workdir, "serve",
                      DRILL_JOURNAL=journal, DRILL_MANIFEST=manifest,
-                     DSTPU_SERVE_JOURNAL=journal)
+                     DSTPU_SERVE_JOURNAL=journal,
+                     # crash-path observability: the injector (or the
+                     # sigterm drain) must leave a Chrome-trace flight
+                     # dump next to the replay state — asserted below
+                     DSTPU_FLIGHT_DIR=site_dir)
     if site == SIGTERM_SITE:
         # a REAL preemption signal mid-decode: PreemptionHandler ->
         # pipeline unwind -> drain() -> atomic manifest publish
@@ -395,6 +400,20 @@ def drill_serve_site(site: str, workdir: str, verbose: bool = True) -> dict:
         result.update(recovered=False,
                       error="drain published no manifest")
         return result
+    # the crash (injector fire) or drain must have auto-dumped the phase
+    # flight recorder — the trace artifact a postmortem starts from
+    # (docs/observability.md). Validated as loadable Chrome-trace JSON.
+    dumps = [f for f in os.listdir(site_dir)
+             if f.startswith("flight_") and f.endswith(".json")]
+    flight_ok = False
+    for f in dumps:
+        try:
+            with open(os.path.join(site_dir, f)) as fh:
+                trace = json.load(fh)
+            flight_ok |= isinstance(trace.get("traceEvents"), list)
+        except ValueError:
+            pass
+    result["flight_dump"] = flight_ok
 
     rc_rec = _run_worker(
         _serve_env(workdir, "recover", DRILL_JOURNAL=journal,
@@ -415,7 +434,8 @@ def drill_serve_site(site: str, workdir: str, verbose: bool = True) -> dict:
     parity = bool(toks) and all(toks[u] == oracle[u] for u in toks)
     result["token_parity"] = parity
     result["recovered"] = (rc_rec == 0 and parity
-                           and replayed.get("pool_recovered") is True)
+                           and replayed.get("pool_recovered") is True
+                           and flight_ok)
     if verbose:
         print(f"[faultdrill:serve:{site}] crash_rc={rc_crash} "
               f"recover_rc={rc_rec} replayed={result['replayed_sequences']} "

@@ -137,6 +137,15 @@ class StepWatchdog:
             f"WATCHDOG: step {step} stalled — {elapsed:.1f}s elapsed vs "
             f"median {median:.3f}s (budget {budget:.1f}s); last phase "
             f"'{phase}', last collective {diag['last_collective']}")
+        try:
+            # dump every live phase flight recorder (telemetry/
+            # flight_recorder.py): the postmortem shows the spans leading
+            # into the stall. Best-effort — a dump failure must never
+            # mask the stall being reported.
+            from ..telemetry.flight_recorder import auto_dump
+            diag["flight_dumps"] = auto_dump("watchdog_stall")
+        except Exception as e:
+            logger.warning(f"watchdog flight dump failed: {e}")
         if self.on_stall is not None:
             try:
                 self.on_stall(diag)

@@ -1,0 +1,35 @@
+"""Telemetry — metrics registry, phase flight recorder, trace hooks.
+
+The observability substrate (docs/observability.md): counters, gauges
+and log-bucketed streaming histograms with Prometheus/JSON export
+(:mod:`.registry`), a bounded ring of plan/dispatch/commit/drain/replay
+spans dumped as Chrome-trace JSON on watchdog fire / fault-drill crash /
+drain (:mod:`.flight_recorder`), per-request SLO instrumentation for the
+v2 serve engine (:mod:`.serve`), a MonitorMaster bridge
+(:mod:`.monitor_bridge`), optional ``jax.profiler`` capture
+(:mod:`.trace`) and the ``bin/dstpu_top`` renderer (:mod:`.top`).
+
+Kill switch: ``DSTPU_TELEMETRY=0`` — every registry call becomes a
+shared no-op and the serve engine skips instrumentation entirely.
+"""
+
+from .flight_recorder import (FlightRecorder, auto_dump, flight_dir,
+                              register_recorder)
+from .monitor_bridge import MonitorBridge, attach_monitor
+from .registry import (COMM_CANONICAL_KINDS, REGISTERED_METRICS, Counter,
+                       Gauge, Histogram, MetricsRegistry, NullRegistry,
+                       comm_counter, get_registry, new_registry,
+                       record_phase_tflops, set_registry,
+                       telemetry_enabled)
+from .serve import ServeObserver, serve_observer
+from .trace import annotate, maybe_trace, trace_dir
+
+__all__ = [
+    "COMM_CANONICAL_KINDS", "Counter", "FlightRecorder", "Gauge",
+    "Histogram", "MetricsRegistry", "MonitorBridge", "NullRegistry",
+    "REGISTERED_METRICS", "ServeObserver", "annotate", "attach_monitor",
+    "auto_dump", "comm_counter", "flight_dir", "get_registry",
+    "maybe_trace", "new_registry", "record_phase_tflops",
+    "register_recorder", "serve_observer", "set_registry",
+    "telemetry_enabled", "trace_dir",
+]

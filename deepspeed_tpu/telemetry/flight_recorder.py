@@ -1,0 +1,182 @@
+"""Phase flight recorder — a bounded ring of serve-loop spans.
+
+Postmortems of a wedged or preempted replica keep asking the same
+question: *what was the engine doing right before it died?* The watchdog
+names the last phase and collective; this module keeps the last N
+plan/dispatch/commit/drain/replay spans (reusing the exact phase names
+the watchdog brackets carry) in a fixed-size ring and dumps them as
+Chrome-trace JSON (``chrome://tracing`` / Perfetto "Load trace") when
+something goes wrong:
+
+  * **watchdog fire** — ``StepWatchdog.check_once`` auto-dumps on a
+    diagnosed stall, so the trace shows the seconds leading into it;
+  * **fault-drill crash** — ``FaultInjector.maybe_fire`` dumps before it
+    raises or ``os._exit``s, so every drill leaves a trace artifact the
+    drill result asserts on;
+  * **drain** — the engine dumps at cooperative preemption, pairing the
+    replay manifest with the timeline that led to it.
+
+Dumps land under ``DSTPU_FLIGHT_DIR`` (unset = auto-dump disabled; the
+ring itself is always recording — append cost is a lock + tuple). The
+ring is bounded (``DSTPU_FLIGHT_CAPACITY``, default 512 spans) so a
+month-long serving process holds a constant-size recorder.
+
+Span times use ``time.perf_counter`` (monotonic, sub-µs); the dump
+carries a wall-clock anchor so traces can be correlated across replicas.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+#: every live recorder, for crash-path auto-dumps (weak: a flushed
+#: engine's recorder must not be kept alive by the dump hook)
+_RECORDERS: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()
+
+
+def flight_dir() -> Optional[str]:
+    return os.environ.get("DSTPU_FLIGHT_DIR") or None
+
+
+def flight_capacity() -> int:
+    return int(os.environ.get("DSTPU_FLIGHT_CAPACITY", "512") or "512")
+
+
+def register_recorder(rec: "FlightRecorder") -> None:
+    _RECORDERS.add(rec)
+
+
+def auto_dump(reason: str) -> List[str]:
+    """Dump every live recorder to DSTPU_FLIGHT_DIR (no-op when unset).
+    Crash-path safe: never raises — a failed dump must not mask the
+    fault being reported. Returns the paths written."""
+    d = flight_dir()
+    if not d:
+        return []
+    paths: List[str] = []
+    for rec in list(_RECORDERS):
+        name = f"flight_{reason}_{os.getpid()}_{id(rec) & 0xffff:04x}.json"
+        path = os.path.join(d, name)
+        try:
+            rec.dump(path, reason=reason)
+            paths.append(path)
+        except Exception:
+            # never-raises contract: a failed dump (disk, or a span arg
+            # json.dump rejects) must not mask the crash/drain being
+            # reported — drain() calls this with state already released
+            pass
+    return paths
+
+
+class FlightRecorder:
+    """Bounded ring of (name, t0, t1, step, args) spans.
+
+    Two recording styles share the ring:
+
+      * :meth:`phase` — watchdog-style transitions: starting phase B
+        closes the open phase A span; ``phase("idle")`` closes without
+        opening (the serve loop's step_end). This is the hot path — the
+        engine calls it at its existing plan/dispatch/commit brackets.
+      * :meth:`span` / :meth:`record` — explicit bracketed spans for
+        long operations (drain, replay, checkpoint save).
+    """
+
+    def __init__(self, capacity: Optional[int] = None):
+        cap = flight_capacity() if capacity is None else int(capacity)
+        self.capacity = max(1, cap)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._open: Optional[Tuple[str, float, Optional[int]]] = None
+        self._lock = threading.Lock()
+        # wall-clock anchor: perf_counter t=anchor_perf corresponds to
+        # wall time anchor_wall (cross-replica correlation)
+        self.anchor_perf = time.perf_counter()
+        self.anchor_wall = time.time()
+
+    # --------------------------- recording ---------------------------- #
+
+    def phase(self, name, step=None):
+        """Transition to ``name`` (closing any open span); "idle" only
+        closes. Registered DSL001 hot path — lock + tuple append."""
+        now = time.perf_counter()
+        with self._lock:
+            if self._open is not None:
+                n0, t0, s0 = self._open
+                self._ring.append((n0, t0, now, s0, None))
+            self._open = None if name == "idle" else (name, now, step)
+
+    def record(self, name, t0, t1, step=None, args=None):
+        """Append a completed span. Registered DSL001 hot path."""
+        with self._lock:
+            self._ring.append((name, t0, t1, step, args))
+
+    @contextmanager
+    def span(self, name: str, step: Optional[int] = None, **args):
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            self.record(name, t0, time.perf_counter(), step=step,
+                        args=args or None)
+
+    # ---------------------------- reading ----------------------------- #
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def spans(self) -> List[Tuple]:
+        """Snapshot copy of the ring, oldest first."""
+        with self._lock:
+            return list(self._ring)
+
+    def to_chrome_trace(self, reason: Optional[str] = None
+                        ) -> Dict[str, Any]:
+        """Chrome-trace JSON ("Trace Event Format"): complete ("X")
+        events in µs relative to the oldest span, one pid per process.
+        Loadable directly in chrome://tracing or Perfetto."""
+        spans = self.spans
+        base = spans[0][1] if spans else self.anchor_perf
+        events = []
+        for name, t0, t1, step, args in spans:
+            ev: Dict[str, Any] = {
+                "name": name,
+                "ph": "X",
+                "ts": round((t0 - base) * 1e6, 1),
+                "dur": round((t1 - t0) * 1e6, 1),
+                "pid": os.getpid(),
+                "tid": 0,
+            }
+            a = dict(args) if args else {}
+            if step is not None:
+                a["step"] = step
+            if a:
+                ev["args"] = a
+            events.append(ev)
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "source": "dstpu.flight_recorder",
+                "reason": reason,
+                "capacity": self.capacity,
+                "wall_time_base": self.anchor_wall
+                + (base - self.anchor_perf),
+            },
+        }
+
+    def dump(self, path: str, reason: Optional[str] = None) -> None:
+        """Atomic Chrome-trace publish (tmp + rename)."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self.to_chrome_trace(reason=reason), f)
+        os.replace(tmp, path)

@@ -1,0 +1,459 @@
+"""Metrics registry — counters, gauges and streaming histograms.
+
+The observability substrate every serving/scheduling decision in
+ROADMAP's fleet item keys on (docs/observability.md): per-request SLO
+numbers (TTFT/TPOT/queue-wait percentiles, goodput), cache and pool
+health, and comm-schedule counters as *first-class engine outputs*
+instead of ad-hoc bench arithmetic.
+
+Design constraints (why this is not just a dict of floats):
+
+  * **Host-only, commit-boundary cheap.** Every record call is a few
+    Python arithmetic ops on host ints/floats — no device access, no
+    locks on the count path. The serve engine records inside its
+    existing host-side plan/commit boundaries, so the dslint DSL001
+    no-host-sync discipline and the audited zero-callback programs are
+    untouched (tier-1 asserts both).
+  * **Percentiles without samples.** :class:`Histogram` is a log-bucketed
+    streaming sketch (DDSketch-style): bucket ``i`` holds values in
+    ``(gamma^(i-1), gamma^i]`` with ``gamma = (1+alpha)/(1-alpha)``, so
+    any quantile is answered with relative error <= ``alpha`` (default
+    5%) from O(log range) ints — p50/p99 over millions of tokens with no
+    sample buffer.
+  * **No-op when off.** ``DSTPU_TELEMETRY=0`` routes every caller to the
+    :class:`NullRegistry`, whose metric handles are shared do-nothing
+    singletons — the zero-overhead kill switch (``bench.py serve_obs``
+    measures the on-path against it).
+
+Metric names live in :data:`REGISTERED_METRICS`; the dslint DSL006 rule
+keeps that table and the docs/observability.md catalog from drifting in
+either direction.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+#: metric-name catalog: name -> one-line meaning. The single source of
+#: truth dslint DSL006 checks two-way against docs/observability.md's
+#: "Metric catalog" table. Keep this a PURE literal dict — the rule
+#: reads it from the AST, not by importing this module.
+REGISTERED_METRICS = {
+    # -- serve request lifecycle (counters) ---------------------------- #
+    "serve_requests_admitted": "fresh requests admitted by put()",
+    "serve_requests_completed": "requests flushed after clean completion",
+    "serve_requests_shed": "requests load-shed (kv_pool_exhausted)",
+    "serve_requests_deadline_expired": "requests aborted past deadline",
+    "serve_requests_aborted": "requests cancelled via engine.abort()",
+    "serve_requests_rejected_draining": "fresh requests refused mid-drain",
+    "serve_requests_drained": "live requests manifested by drain()",
+    "serve_tokens_committed": "output tokens committed (host-visible)",
+    "serve_steps": "engine steps dispatched",
+    "serve_steps_device_fed": "steps fed from the device token buffer",
+    "serve_step_retries": "transient dispatch failures retried",
+    # -- serve latency (histograms, seconds) --------------------------- #
+    "serve_ttft_s": "admission -> first committed token",
+    "serve_tpot_s": "per-token gap between committed tokens",
+    "serve_queue_wait_s": "admission -> first scheduled chunk",
+    "serve_plan_s": "per-step plan (scheduler + staging) time",
+    "serve_dispatch_s": "per-step dispatch (enqueue) time",
+    "serve_commit_block_s": "per-commit blocking readback time",
+    # -- prefix cache (counters + gauges) ------------------------------ #
+    "prefix_matched_tokens": "prompt tokens served from cached blocks",
+    "prefix_prefill_tokens": "prompt tokens that ran a prefill chunk",
+    "prefix_cow_copies": "partial-tail copy-on-write block copies",
+    "prefix_hit_blocks": "full cached blocks matched",
+    "prefix_evicted_blocks": "cached blocks reclaimed under pressure",
+    "prefix_cached_blocks": "blocks currently held by the cache",
+    "prefix_evictable_blocks": "refcount-0 cached blocks (reclaimable)",
+    # -- KV pool (gauges) ---------------------------------------------- #
+    "kv_pool_blocks_total": "KV pool capacity in blocks",
+    "kv_pool_blocks_free": "allocator-free KV blocks",
+    "kv_pool_bytes_total": "KV pool bytes across all chips",
+    "kv_pool_bytes_per_chip": "KV pool bytes one chip holds",
+    # -- comm schedule (counters, auditor-canonical kinds) ------------- #
+    "comm_traced_all_reduce": "all-reduce sites traced (program builds)",
+    "comm_traced_all_gather": "all-gather sites traced (incl. ring sites)",
+    "comm_traced_reduce_scatter": "reduce-scatter sites traced (incl. ring sites)",
+    "comm_traced_ppermute": "raw ppermute sites traced",
+    "comm_traced_all_to_all": "all-to-all sites traced",
+    "comm_traced_broadcast": "broadcast sites traced",
+    # -- FLOPs / roofline (gauges, phase-labelled) --------------------- #
+    "achieved_tflops": "achieved TFLOPS for a phase (label: phase)",
+    "flops_per_step": "model FLOPs per step for a phase (label: phase)",
+    "mxu_utilization": "achieved/peak FLOPs fraction (label: phase)",
+}
+
+
+def telemetry_enabled() -> bool:
+    """The process-wide kill switch: ``DSTPU_TELEMETRY=0`` (or
+    ``false``/``off``) disables every registry, recorder and bridge."""
+    return os.environ.get("DSTPU_TELEMETRY", "1") \
+        not in ("0", "false", "off")
+
+
+class Counter:
+    """Monotone float counter. ``inc`` is the hot path — one add."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, n=1.0):
+        self.value += n
+
+
+class Gauge:
+    """Last-write-wins scalar."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v):
+        self.value = v
+
+
+class Histogram:
+    """Log-bucketed streaming histogram (DDSketch-style).
+
+    ``observe(v, n)`` adds ``n`` occurrences of value ``v`` to the bucket
+    ``ceil(log_gamma(v))``; ``quantile(q)`` walks the (sorted) buckets
+    and returns the geometric midpoint of the covering bucket, clamped
+    to the observed [min, max] — relative error <= ``alpha`` by
+    construction, exact-ish on single-bucket (constant) distributions.
+    Non-positive values land in a dedicated zero bucket.
+    """
+
+    __slots__ = ("alpha", "gamma", "_lg", "buckets", "zero", "count",
+                 "sum", "min", "max")
+
+    def __init__(self, alpha: float = 0.05):
+        if not 0.0 < alpha < 1.0:
+            raise ValueError(f"alpha must be in (0, 1), got {alpha}")
+        self.alpha = alpha
+        self.gamma = (1.0 + alpha) / (1.0 - alpha)
+        self._lg = math.log(self.gamma)
+        self.buckets: Dict[int, int] = {}
+        self.zero = 0
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, v, n=1):
+        self.count += n
+        self.sum += v * n
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        if v <= 0.0:
+            self.zero += n
+            return
+        i = math.ceil(math.log(v) / self._lg)
+        b = self.buckets
+        b[i] = b.get(i, 0) + n
+
+    def quantile(self, q: float) -> Optional[float]:
+        if self.count <= 0:
+            return None
+        # nearest-rank (1-based ceil(q*n)) — an upper quantile over a
+        # tiny count lands on the top value instead of collapsing into
+        # the median bucket; converges to interpolated percentiles as
+        # counts grow, within the alpha bucket error
+        target = q * self.count
+        if self.zero and target <= self.zero:
+            return min(0.0, self.max)
+        acc = self.zero
+        for i in sorted(self.buckets):
+            acc += self.buckets[i]
+            if acc >= target:
+                est = 2.0 * self.gamma ** i / (self.gamma + 1.0)
+                return max(self.min, min(est, self.max))
+        return self.max
+
+    def summary(self) -> Dict[str, Any]:
+        if self.count == 0:
+            return {"count": 0, "sum": 0.0}
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.quantile(0.50),
+            "p90": self.quantile(0.90),
+            "p99": self.quantile(0.99),
+        }
+
+
+def _key(name: str, labels: Dict[str, Any]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class MetricsRegistry:
+    """A named family of metrics with snapshot / Prometheus / JSON
+    export and optional monitor bridges (telemetry.attach_monitor).
+
+    Metric handles are get-or-create by (name, labels) and safe to cache
+    — the serve observer binds its hot counters once at engine build."""
+
+    enabled = True
+
+    def __init__(self, name: str = "default"):
+        self.name = name
+        self._metrics: Dict[str, Any] = {}
+        self._types: Dict[str, str] = {}
+        self._bridges: List[Any] = []
+        self.created_at = time.time()
+
+    # ------------------------- metric handles ------------------------- #
+
+    def _get(self, kind: str, cls, name: str, labels: Dict[str, Any],
+             **kw):
+        prev = self._types.get(name)
+        if prev is not None and prev != kind:
+            raise ValueError(
+                f"metric {name!r} already registered as {prev}")
+        key = _key(name, labels)
+        m = self._metrics.get(key)
+        if m is None:
+            m = cls(**kw)
+            self._metrics[key] = m
+            self._types[name] = kind
+        return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get("counter", Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get("gauge", Gauge, name, labels)
+
+    def histogram(self, name: str, alpha: float = 0.05,
+                  **labels) -> Histogram:
+        return self._get("histogram", Histogram, name, labels, alpha=alpha)
+
+    def metric_names(self) -> List[str]:
+        """Base metric names (labels stripped) registered so far."""
+        return sorted(self._types)
+
+    # --------------------------- exports ------------------------------ #
+
+    def snapshot(self) -> Dict[str, Any]:
+        """{"counters": {...}, "gauges": {...}, "histograms": {...}} —
+        histogram values are ``summary()`` dicts (count/sum/min/max/
+        p50/p90/p99)."""
+        out: Dict[str, Any] = {"counters": {}, "gauges": {},
+                               "histograms": {}}
+        for key, m in sorted(self._metrics.items()):
+            if isinstance(m, Counter):
+                out["counters"][key] = m.value
+            elif isinstance(m, Gauge):
+                out["gauges"][key] = m.value
+            else:
+                out["histograms"][key] = m.summary()
+        return out
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition: counters/gauges as-is, histograms
+        as summaries (quantile label rows + _count/_sum)."""
+        lines: List[str] = []
+        seen_type = set()
+        for key, m in sorted(self._metrics.items()):
+            base = key.split("{", 1)[0]
+            if isinstance(m, Counter):
+                if base not in seen_type:
+                    lines.append(f"# TYPE {base} counter")
+                    seen_type.add(base)
+                lines.append(f"{key} {m.value:g}")
+            elif isinstance(m, Gauge):
+                if base not in seen_type:
+                    lines.append(f"# TYPE {base} gauge")
+                    seen_type.add(base)
+                lines.append(f"{key} {m.value:g}")
+            else:
+                if base not in seen_type:
+                    lines.append(f"# TYPE {base} summary")
+                    seen_type.add(base)
+                labels = key[len(base):].strip("{}")
+                for q in (0.5, 0.9, 0.99):
+                    val = m.quantile(q)
+                    if val is None:
+                        continue
+                    ql = f'quantile="{q}"'
+                    full = f"{base}{{{labels + ',' if labels else ''}{ql}}}"
+                    lines.append(f"{full} {val:g}")
+                lines.append(f"{base}_count{{{labels}}} {m.count}"
+                             if labels else f"{base}_count {m.count}")
+                lines.append(f"{base}_sum{{{labels}}} {m.sum:g}"
+                             if labels else f"{base}_sum {m.sum:g}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def to_json(self, extra: Optional[Dict[str, Any]] = None) -> str:
+        blob = {"time": time.time(), "registry": self.name,
+                "uptime_s": time.time() - self.created_at}
+        if extra:
+            blob.update(extra)
+        blob.update(self.snapshot())
+        return json.dumps(blob)
+
+    def export(self, path: str,
+               extra: Optional[Dict[str, Any]] = None) -> None:
+        """Atomic JSON snapshot publish (tmp + rename) — the file
+        ``bin/dstpu_top`` tails; a reader never sees a torn snapshot."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(self.to_json(extra))
+        os.replace(tmp, path)
+
+    # ---------------------- monitor bridging -------------------------- #
+
+    def tick(self, step: int) -> None:
+        """Drive attached monitor bridges (telemetry.attach_monitor):
+        each emits a snapshot to its MonitorMaster every
+        ``interval_steps``. Called by the serve observer at commit
+        boundaries and usable from any train loop."""
+        for b in self._bridges:
+            b.step(step)
+
+
+class _NullMetric:
+    """Shared do-nothing handle for counters/gauges/histograms when
+    telemetry is off — callers keep their cached-handle code shape."""
+
+    __slots__ = ()
+    value = 0.0
+    count = 0
+    sum = 0.0
+
+    def inc(self, n=1.0):
+        return
+
+    def set(self, v):
+        return
+
+    def observe(self, v, n=1):
+        return
+
+    def quantile(self, q):
+        return None
+
+    def summary(self):
+        return {"count": 0, "sum": 0.0}
+
+
+_NULL_METRIC = _NullMetric()
+
+
+class NullRegistry(MetricsRegistry):
+    """The DSTPU_TELEMETRY=0 path: every handle is the shared no-op
+    metric, every export is empty. ``enabled`` lets callers skip work
+    (building label dicts, timestamps) entirely."""
+
+    enabled = False
+
+    def counter(self, name, **labels):
+        return _NULL_METRIC
+
+    def gauge(self, name, **labels):
+        return _NULL_METRIC
+
+    def histogram(self, name, alpha=0.05, **labels):
+        return _NULL_METRIC
+
+    def snapshot(self):
+        return {"counters": {}, "gauges": {}, "histograms": {}}
+
+    def tick(self, step):
+        return
+
+
+_DEFAULT: Optional[MetricsRegistry] = None
+
+
+def new_registry(name: str = "default") -> MetricsRegistry:
+    """A fresh registry honoring the DSTPU_TELEMETRY kill switch."""
+    return MetricsRegistry(name) if telemetry_enabled() else \
+        NullRegistry(name)
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-default registry (train-side metrics, comm counters).
+    Serve engines carry their OWN registry (``engine.metrics``) so two
+    engines in one process — e.g. a drill's dead replica and survivor —
+    never mix request stats."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = new_registry("default")
+    return _DEFAULT
+
+
+def set_registry(reg: Optional[MetricsRegistry]) -> None:
+    """Install a registry (tests), or None to re-read the env lazily."""
+    global _DEFAULT
+    _DEFAULT = reg
+
+
+# ---------------------------------------------------------------------- #
+# cross-subsystem recording helpers
+# ---------------------------------------------------------------------- #
+
+#: comm-facade op name -> the program auditor's canonical collective
+#: kind (analysis/program_audit.py COLLECTIVE_PRIMS values) — the ring
+#: builders record their decomposed sites as reduce_scatter/all_gather,
+#: so these counters and an audited CollectiveBudget speak the same
+#: vocabulary (per-hop execution counts come from the auditor's
+#: trip-weighted reports, not from here).
+COMM_CANONICAL_KINDS = {
+    "all_reduce": "all_reduce",
+    "inference_all_reduce": "all_reduce",
+    "all_gather": "all_gather",
+    "reduce_scatter": "reduce_scatter",
+    "ppermute": "ppermute",
+    "all_to_all_single": "all_to_all",
+    "broadcast": "broadcast",
+}
+
+
+def comm_counter(op: str) -> None:
+    """Count a traced collective site on the default registry, keyed by
+    canonical kind. Called from ``comm._record`` — TRACE time, like the
+    CommsLogger: 'sites the programs being built contain', not per-step
+    executions (the auditor's trip-weighted counts cover those)."""
+    kind = COMM_CANONICAL_KINDS.get(op)
+    if kind is None:
+        return
+    reg = get_registry()
+    if reg.enabled:
+        reg.counter("comm_traced_" + kind).inc()
+
+
+def record_phase_tflops(phase: str, flops_per_step: float,
+                        latency_s: float,
+                        utilization: Optional[float] = None,
+                        registry: Optional[MetricsRegistry] = None
+                        ) -> float:
+    """Set the phase-labelled achieved-TFLOPS / FLOPs-per-step gauges
+    from a model-shape FLOPs estimate plus a measured step time — the
+    one roofline formula the flops profiler and the bench phases share
+    (satellite: replaces bench-local arithmetic where they overlap).
+    Returns the achieved TFLOPS."""
+    tf = flops_per_step / latency_s / 1e12 if latency_s > 0 else 0.0
+    reg = registry if registry is not None else get_registry()
+    if reg.enabled:
+        reg.gauge("achieved_tflops", phase=phase).set(tf)
+        reg.gauge("flops_per_step", phase=phase).set(flops_per_step)
+        if utilization is not None:
+            reg.gauge("mxu_utilization", phase=phase).set(utilization)
+    return tf

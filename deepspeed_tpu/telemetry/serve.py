@@ -1,0 +1,257 @@
+"""Serve-side telemetry observer — per-request SLO instrumentation.
+
+One object the v2 ragged engine owns (``engine._obs``; None when
+``DSTPU_TELEMETRY=0`` so every call site is a single ``is not None``
+guard): it binds the hot metric handles once at engine build and turns
+the engine's EXISTING host-side boundaries into SLO numbers —
+
+  * admission (``put``)          -> ``serve_requests_admitted`` +
+    ``seq.admitted_at`` stamp;
+  * first schedule (plan)        -> ``serve_queue_wait_s``;
+  * token commit (commit/fused)  -> ``serve_ttft_s`` on the first
+    committed token, ``serve_tpot_s`` on every later one,
+    ``serve_tokens_committed``;
+  * rejection / abort / flush    -> the outcome counters goodput is
+    computed from;
+  * plan/dispatch/commit phases  -> flight-recorder spans (the same
+    phase names the watchdog brackets carry).
+
+Everything is pure host work (floats, dict lookups on pre-bound
+handles) on paths that already run at those boundaries — no device
+access, no callbacks into traced programs; the audited serve programs
+are bit-identical with telemetry on or off (tier-1 asserts 0 host
+callbacks and 0 fresh compiles on the warm path either way). The
+per-request timestamps additionally live on the SequenceDescriptor
+(``admitted_at``/``first_sched_at``/``first_token_at``/
+``last_token_at``), so TTFT >= queue-wait is checkable per request, not
+just in aggregate.
+
+Export: every ``DSTPU_TELEMETRY_EXPORT_EVERY`` committed steps the
+registry snapshot is atomically published to ``DSTPU_TELEMETRY_EXPORT``
+(the file ``bin/dstpu_top`` renders) and attached monitor bridges tick.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+from .flight_recorder import FlightRecorder, auto_dump, register_recorder
+from .registry import MetricsRegistry, new_registry, telemetry_enabled
+
+#: rejection reason (engine._reject) -> outcome counter name
+_REJECT_COUNTERS = {
+    "kv_pool_exhausted": "serve_requests_shed",
+    "deadline_exceeded": "serve_requests_deadline_expired",
+    "draining": "serve_requests_rejected_draining",
+}
+
+
+def serve_observer(engine) -> Optional["ServeObserver"]:
+    """The engine's telemetry attach point: a ServeObserver, or None
+    when DSTPU_TELEMETRY=0 (the zero-overhead path — the engine then
+    never calls into this module again)."""
+    if not telemetry_enabled():
+        return None
+    return ServeObserver(engine)
+
+
+class ServeObserver:
+    def __init__(self, engine):
+        self.engine = engine
+        self.registry: MetricsRegistry = new_registry("serve")
+        self.flight = FlightRecorder()
+        register_recorder(self.flight)
+        # env knobs read with LITERAL names (dslint DSL004/5 scan)
+        self.export_path = os.environ.get("DSTPU_TELEMETRY_EXPORT") or None
+        self.export_every = int(
+            os.environ.get("DSTPU_TELEMETRY_EXPORT_EVERY", "50") or "50")
+        self._last_export_step = 0
+        self._prefix_prev: Dict[str, float] = {}
+        r = self.registry
+        # hot handles bound once — the record paths below are pre-bound
+        # attribute ops, no registry lookups per token
+        self.c_admitted = r.counter("serve_requests_admitted")
+        self.c_completed = r.counter("serve_requests_completed")
+        self.c_aborted = r.counter("serve_requests_aborted")
+        self.c_drained = r.counter("serve_requests_drained")
+        self.c_tokens = r.counter("serve_tokens_committed")
+        self.c_steps = r.counter("serve_steps")
+        self.c_fed = r.counter("serve_steps_device_fed")
+        self.c_retries = r.counter("serve_step_retries")
+        self.h_ttft = r.histogram("serve_ttft_s")
+        self.h_tpot = r.histogram("serve_tpot_s")
+        self.h_queue = r.histogram("serve_queue_wait_s")
+        self.h_plan = r.histogram("serve_plan_s")
+        self.h_dispatch = r.histogram("serve_dispatch_s")
+        self.h_commit = r.histogram("serve_commit_block_s")
+        self._reject_counters = {
+            reason: r.counter(name)
+            for reason, name in _REJECT_COUNTERS.items()}
+
+    # ------------------- request lifecycle (hot) ---------------------- #
+    # Registered DSL001 hot paths: these run inside the pipeline's
+    # plan-ahead/commit window — pure host arithmetic only.
+
+    def on_admit(self, seq, now):
+        seq.admitted_at = now
+        self.c_admitted.inc()
+
+    def on_sched(self, sched, now):
+        """First-schedule stamps for this plan's sequences -> queue
+        wait. Continuations keep their original stamp (queue wait is an
+        admission-time property)."""
+        for item in sched:
+            seq = item.seq
+            if seq.first_sched_at is None:
+                seq.first_sched_at = now
+                if seq.admitted_at is not None:
+                    self.h_queue.observe(now - seq.admitted_at)
+
+    def on_token_commit(self, seq, now, n=1):
+        """``n`` output tokens of ``seq`` became host-visible at ``now``
+        (one per pipelined commit; ``n`` per fused decode_batch chunk).
+        First commit -> TTFT; later commits -> per-token TPOT. A fused
+        chunk's follow-on tokens share one wall interval, so TPOT is the
+        interval split evenly (weight n) — the same quantity the bench's
+        per-chunk arithmetic reported."""
+        self.c_tokens.inc(n)
+        if seq.first_token_at is None:
+            seq.first_token_at = now
+            if seq.admitted_at is not None:
+                self.h_ttft.observe(now - seq.admitted_at)
+        else:
+            last = seq.last_token_at
+            if last is not None and now > last:
+                self.h_tpot.observe((now - last) / n, n=n)
+        seq.last_token_at = now
+
+    def on_plan(self, dt):
+        self.h_plan.observe(dt)
+
+    def on_dispatch(self, dt, fed):
+        self.c_steps.inc()
+        if fed:
+            self.c_fed.inc()
+        self.h_dispatch.observe(dt)
+
+    def on_commit_block(self, dt):
+        self.h_commit.observe(dt)
+
+    def on_retry(self):
+        self.c_retries.inc()
+
+    def on_reject(self, reason):
+        c = self._reject_counters.get(reason)
+        if c is not None:
+            c.inc()
+
+    def on_abort(self, rejected):
+        """engine.abort() on a live uid; shed/deadline aborts arrive
+        with their rejection already counted."""
+        if not rejected:
+            self.c_aborted.inc()
+
+    def on_flush(self, seq, rejected, draining):
+        """Outcome classification at the one release path: drained
+        sequences ride the manifest (neither good nor bad), rejected/
+        aborted ones were counted at their failure site, everything
+        else completed cleanly — the goodput numerator."""
+        if seq is None:
+            return
+        if draining:
+            self.c_drained.inc()
+        elif rejected or seq.status.value == "finished":
+            # FINISHED is only ever set by abort() — counted there (the
+            # value comparison avoids importing the enum: telemetry must
+            # stay import-cycle-free below the engine)
+            return
+        else:
+            self.c_completed.inc()
+
+    def phase(self, name, step=None):
+        self.flight.phase(name, step)
+
+    # --------------------- boundaries / exports ----------------------- #
+
+    def after_commit(self, step: int) -> None:
+        """Periodic work at the commit boundary: gauge refresh, export
+        publish, monitor-bridge tick — every ``export_every`` steps."""
+        if step - self._last_export_step < self.export_every:
+            return
+        self._last_export_step = step
+        self.sync_gauges()
+        if self.export_path:
+            self.registry.export(self.export_path,
+                                 extra={"engine": "serve"})
+        self.registry.tick(step)
+
+    def sync_gauges(self) -> None:
+        """Refresh pool/prefix gauges and mirror the host-side prefix
+        dict counters into registry counters (delta-sync keeps them
+        monotone). Cheap host metadata reads only."""
+        eng = self.engine
+        r = self.registry
+        r.gauge("kv_pool_blocks_total").set(eng.config.num_blocks)
+        r.gauge("kv_pool_blocks_free").set(eng.kv_cache.free_blocks)
+        rep = eng.state.kv_memory_report()
+        r.gauge("kv_pool_bytes_total").set(rep["kv_pool_bytes_total"])
+        r.gauge("kv_pool_bytes_per_chip").set(
+            rep["kv_pool_bytes_per_chip"])
+        st = eng.prefix_stats if eng._prefix is not None \
+            else dict(eng.state.prefix_stats)
+        for key, metric in (("matched_tokens", "prefix_matched_tokens"),
+                            ("prefill_tokens", "prefix_prefill_tokens"),
+                            ("cow_copies", "prefix_cow_copies"),
+                            ("hit_blocks", "prefix_hit_blocks"),
+                            ("evicted", "prefix_evicted_blocks")):
+            cur = st.get(key, 0)
+            prev = self._prefix_prev.get(key, 0)
+            if cur > prev:
+                r.counter(metric).inc(cur - prev)
+                self._prefix_prev[key] = cur
+        if eng._prefix is not None:
+            r.gauge("prefix_cached_blocks").set(st["cached_blocks"])
+            r.gauge("prefix_evictable_blocks").set(st["evictable_blocks"])
+
+    def on_drain(self, manifest: Dict[str, Any]) -> None:
+        """Drain published: attach the SLO report to the manifest (the
+        registry-fed consumer) and auto-dump the flight ring next to the
+        replay state."""
+        manifest["telemetry"] = self.slo_report()
+        auto_dump("drain")
+
+    # ---------------------------- reports ----------------------------- #
+
+    def slo_report(self) -> Dict[str, Any]:
+        """The serving-layer summary: TTFT/TPOT/queue-wait percentiles,
+        outcome counts and the goodput fraction (completed / terminal
+        outcomes; drained requests are in flight to a survivor, not an
+        outcome)."""
+        self.sync_gauges()
+        r = self.registry
+        bad = (r.counter("serve_requests_shed").value
+               + r.counter("serve_requests_deadline_expired").value
+               + r.counter("serve_requests_rejected_draining").value
+               + self.c_aborted.value)
+        good = self.c_completed.value
+        done = good + bad
+        return {
+            "ttft_s": self.h_ttft.summary(),
+            "tpot_s": self.h_tpot.summary(),
+            "queue_wait_s": self.h_queue.summary(),
+            "tokens_committed": self.c_tokens.value,
+            "requests": {
+                "admitted": self.c_admitted.value,
+                "completed": good,
+                "shed": r.counter("serve_requests_shed").value,
+                "deadline_expired":
+                    r.counter("serve_requests_deadline_expired").value,
+                "rejected_draining":
+                    r.counter("serve_requests_rejected_draining").value,
+                "aborted": self.c_aborted.value,
+                "drained": self.c_drained.value,
+            },
+            "goodput_frac": good / done if done else None,
+        }

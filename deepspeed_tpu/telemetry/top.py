@@ -1,0 +1,149 @@
+"""``bin/dstpu_top`` — render a serving engine's metrics snapshot.
+
+Reads the atomic JSON export a running engine publishes at
+``DSTPU_TELEMETRY_EXPORT`` (every ``DSTPU_TELEMETRY_EXPORT_EVERY``
+committed steps) and renders a compact operator view: request outcome
+counts and rates, TTFT/TPOT/queue-wait percentiles, goodput, prefix
+cache hit fraction and KV pool occupancy. One-shot by default;
+``--watch N`` refreshes every N seconds and derives rates from
+consecutive snapshots.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+
+def _ms(v: Optional[float]) -> str:
+    return "-" if v is None else f"{v * 1e3:8.1f}"
+
+
+def _frac(n: float, d: float) -> Optional[float]:
+    return n / d if d else None
+
+
+def _pct(v: Optional[float]) -> str:
+    return "-" if v is None else f"{v * 100:5.1f}%"
+
+
+def load_snapshot(path: str) -> Dict[str, Any]:
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def render(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
+           ) -> str:
+    """The operator table for one snapshot; ``prev`` (an earlier
+    snapshot) turns counter deltas into rates."""
+    c = snap.get("counters", {})
+    g = snap.get("gauges", {})
+    h = snap.get("histograms", {})
+
+    def rate(name: str) -> str:
+        if prev is None:
+            return "      -"
+        dt = snap.get("time", 0.0) - prev.get("time", 0.0)
+        if dt <= 0:
+            return "      -"
+        d = c.get(name, 0.0) - prev.get("counters", {}).get(name, 0.0)
+        return f"{d / dt:7.1f}"
+
+    lines: List[str] = []
+    when = time.strftime("%H:%M:%S",
+                         time.localtime(snap.get("time", time.time())))
+    lines.append(f"dstpu_top — registry '{snap.get('registry', '?')}' "
+                 f"@ {when}  (uptime {snap.get('uptime_s', 0.0):.0f}s)")
+    lines.append("")
+    lines.append("requests            total     /s")
+    for label, name in (("admitted", "serve_requests_admitted"),
+                        ("completed", "serve_requests_completed"),
+                        ("shed", "serve_requests_shed"),
+                        ("deadline", "serve_requests_deadline_expired"),
+                        ("aborted", "serve_requests_aborted"),
+                        ("drained", "serve_requests_drained")):
+        lines.append(f"  {label:<14}{c.get(name, 0):9.0f} {rate(name)}")
+    good = c.get("serve_requests_completed", 0.0)
+    bad = (c.get("serve_requests_shed", 0.0)
+           + c.get("serve_requests_deadline_expired", 0.0)
+           + c.get("serve_requests_rejected_draining", 0.0)
+           + c.get("serve_requests_aborted", 0.0))
+    lines.append(f"  goodput        {_pct(_frac(good, good + bad))}")
+    lines.append("")
+    lines.append(f"tokens committed {c.get('serve_tokens_committed', 0):11.0f}"
+                 f"  {rate('serve_tokens_committed')} tok/s   "
+                 f"steps {c.get('serve_steps', 0):.0f} "
+                 f"(device-fed {c.get('serve_steps_device_fed', 0):.0f})")
+    lines.append("")
+    lines.append("latency (ms)          p50      p90      p99    count")
+    for label, name in (("ttft", "serve_ttft_s"),
+                        ("tpot", "serve_tpot_s"),
+                        ("queue wait", "serve_queue_wait_s"),
+                        ("commit block", "serve_commit_block_s")):
+        s = h.get(name, {})
+        lines.append(f"  {label:<14}{_ms(s.get('p50'))} {_ms(s.get('p90'))}"
+                     f" {_ms(s.get('p99'))} {s.get('count', 0):8d}")
+    lines.append("")
+    hit = c.get("prefix_matched_tokens", 0.0)
+    ran = c.get("prefix_prefill_tokens", 0.0)
+    lines.append(f"prefix cache   hit frac {_pct(_frac(hit, hit + ran))}"
+                 f"   cached {g.get('prefix_cached_blocks', 0):.0f}"
+                 f" blocks (evictable {g.get('prefix_evictable_blocks', 0):.0f})"
+                 f"   cow {c.get('prefix_cow_copies', 0):.0f}"
+                 f"   evicted {c.get('prefix_evicted_blocks', 0):.0f}")
+    total = g.get("kv_pool_blocks_total", 0.0)
+    free = g.get("kv_pool_blocks_free", 0.0)
+    lines.append(f"kv pool        occupancy "
+                 f"{_pct(_frac(total - free, total))}   "
+                 f"{free:.0f}/{total:.0f} blocks free   "
+                 f"{g.get('kv_pool_bytes_per_chip', 0) / 1e6:.1f} MB/chip")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dstpu_top",
+        description="render a serving engine's telemetry export "
+                    "(docs/observability.md)")
+    ap.add_argument("--file", default=None,
+                    help="export file (default: $DSTPU_TELEMETRY_EXPORT)")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                    help="refresh every N seconds (0 = one-shot)")
+    args = ap.parse_args(argv)
+    path = args.file or os.environ.get("DSTPU_TELEMETRY_EXPORT")
+    if not path:
+        print("dstpu_top: no export file (--file or "
+              "DSTPU_TELEMETRY_EXPORT)", file=sys.stderr)
+        return 2
+    if not os.path.exists(path):
+        print(f"dstpu_top: export file not found: {path} — is the "
+              f"engine running with DSTPU_TELEMETRY_EXPORT set?",
+              file=sys.stderr)
+        return 2
+    prev = None
+    while True:
+        try:
+            snap = load_snapshot(path)
+        except (OSError, ValueError) as e:
+            print(f"dstpu_top: unreadable snapshot: {e}",
+                  file=sys.stderr)
+            return 2
+        out = render(snap, prev)
+        if args.watch > 0:
+            print("\x1b[2J\x1b[H" + out, flush=True)
+        else:
+            print(out)
+            return 0
+        prev = snap
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

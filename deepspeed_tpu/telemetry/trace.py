@@ -1,0 +1,46 @@
+"""Optional ``jax.profiler`` hooks, gated on ``DSTPU_TRACE_DIR``.
+
+The flight recorder answers "what was the HOST doing"; a real device
+timeline needs the XLA profiler. These helpers make that a zero-code
+knob: set ``DSTPU_TRACE_DIR`` and the bench phases (and any caller of
+:func:`maybe_trace`) capture a TensorBoard-loadable trace of their
+measured window; unset, both helpers are inert nullcontexts — no jax
+import, no overhead.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager, nullcontext
+from typing import Optional
+
+
+def trace_dir() -> Optional[str]:
+    return os.environ.get("DSTPU_TRACE_DIR") or None
+
+
+@contextmanager
+def maybe_trace(label: str = "dstpu"):
+    """``jax.profiler.trace`` around the body when DSTPU_TRACE_DIR is
+    set (trace lands in ``<dir>/<label>``); yields whether tracing is
+    active."""
+    d = trace_dir()
+    if not d:
+        yield False
+        return
+    import jax
+    jax.profiler.start_trace(os.path.join(d, label))
+    try:
+        yield True
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """A ``jax.profiler.TraceAnnotation`` context when tracing is
+    enabled (names host spans inside the captured device timeline),
+    else a free nullcontext."""
+    if not trace_dir():
+        return nullcontext()
+    import jax
+    return jax.profiler.TraceAnnotation(name)

@@ -50,6 +50,9 @@ RULES: Mapping[str, str] = {
               "docs/CONFIG.md (re-run tools/gen_config_doc.py)",
     "DSL005": "DSTPU_* knob documented in docs/CONFIG.md but read "
               "nowhere (re-run tools/gen_config_doc.py)",
+    "DSL006": "telemetry metric drift: telemetry.REGISTERED_METRICS and "
+              "the docs/observability.md metric catalog must match "
+              "two-way",
 }
 
 #: overlap-critical functions (relative path suffix -> function names):
@@ -90,6 +93,19 @@ HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
         ("overlap_all_reduce", "decomposed_all_reduce",
          "ring_reduce_scatter", "ring_all_gather",
          "_ring_reduce_scatter_impl", "_ring_all_gather_impl"),
+    # the telemetry record paths run INSIDE the serve pipeline's
+    # plan-ahead/commit window on every step and token: pre-bound
+    # counter/gauge/histogram arithmetic and ring appends over host
+    # floats only — one device readback here would tax every committed
+    # token (docs/observability.md "Overhead methodology")
+    "deepspeed_tpu/telemetry/serve.py":
+        ("on_admit", "on_sched", "on_token_commit", "on_plan",
+         "on_dispatch", "on_commit_block", "on_retry", "on_reject",
+         "on_abort", "on_flush", "phase"),
+    "deepspeed_tpu/telemetry/registry.py":
+        ("inc", "set", "observe", "quantile"),
+    "deepspeed_tpu/telemetry/flight_recorder.py":
+        ("phase", "record"),
 }
 
 #: roots scanned for DSTPU_* env reads (knob rules + gen_config_doc) —
@@ -413,6 +429,83 @@ def _knob_findings(repo_root: str) -> List[Finding]:
 
 
 # ------------------------------------------------------------------ #
+# telemetry metric catalog (DSL006 + docs/observability.md)
+# ------------------------------------------------------------------ #
+
+#: where the REGISTERED_METRICS literal lives (scanned from the AST so
+#: the rule never imports the package)
+METRICS_TABLE_FILE = os.path.join("deepspeed_tpu", "telemetry",
+                                  "registry.py")
+OBSERVABILITY_DOC = os.path.join("docs", "observability.md")
+
+_METRIC_DOC_ROW_RE = re.compile(r"^\|\s*`([A-Za-z0-9_]+)`")
+
+
+def registered_metrics(registry_py: str) -> List[Tuple[str, int]]:
+    """(name, line) pairs of the ``REGISTERED_METRICS = {...}`` literal
+    dict keys in the telemetry registry source."""
+    with open(registry_py, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=registry_py)
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        names = {t.id for t in node.targets if isinstance(t, ast.Name)}
+        if "REGISTERED_METRICS" not in names \
+                or not isinstance(node.value, ast.Dict):
+            continue
+        for key in node.value.keys:
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                out.append((key.value, key.lineno))
+    return out
+
+
+def documented_metrics(obs_md: str) -> List[Tuple[str, int]]:
+    """(metric, line) rows of the "Metric catalog" table in
+    docs/observability.md."""
+    out: List[Tuple[str, int]] = []
+    in_section = False
+    for i, line in enumerate(obs_md.splitlines(), 1):
+        if line.startswith("## "):
+            in_section = "Metric catalog" in line
+        if in_section:
+            m = _METRIC_DOC_ROW_RE.match(line)
+            if m:
+                out.append((m.group(1), i))
+    return out
+
+
+def _metric_findings(repo_root: str) -> List[Finding]:
+    reg_path = os.path.join(repo_root, METRICS_TABLE_FILE)
+    if not os.path.exists(reg_path):
+        return []                 # tree predates the telemetry layer
+    table = registered_metrics(reg_path)
+    doc_path = os.path.join(repo_root, OBSERVABILITY_DOC)
+    if not os.path.exists(doc_path):
+        return [Finding("DSL006", OBSERVABILITY_DOC.replace(os.sep, "/"),
+                        0, "missing — every REGISTERED_METRICS entry "
+                           "needs a metric-catalog row")]
+    with open(doc_path, encoding="utf-8") as f:
+        doc_rows = documented_metrics(f.read())
+    documented = {name for name, _ in doc_rows}
+    registered = {name for name, _ in table}
+    findings: List[Finding] = []
+    for name, line in table:
+        if name not in documented:
+            findings.append(Finding(
+                "DSL006", METRICS_TABLE_FILE.replace(os.sep, "/"), line,
+                f"metric {name} is registered but has no "
+                f"docs/observability.md catalog row"))
+    for name, line in doc_rows:
+        if name not in registered:
+            findings.append(Finding(
+                "DSL006", OBSERVABILITY_DOC.replace(os.sep, "/"), line,
+                f"documented metric {name} is not in "
+                f"telemetry.REGISTERED_METRICS"))
+    return findings
+
+
+# ------------------------------------------------------------------ #
 # driver
 # ------------------------------------------------------------------ #
 
@@ -420,9 +513,10 @@ def _knob_findings(repo_root: str) -> List[Finding]:
 def lint(paths: Sequence[str], repo_root: str = REPO,
          hot_paths: Optional[Mapping[str, Tuple[str, ...]]] = None,
          knob_rules: bool = True) -> List[Finding]:
-    """Lint ``paths`` (files or directories). The knob-drift rules
-    (DSL004/DSL005) are repo-level — they scan ENV_SCAN_ROOTS under
-    ``repo_root`` regardless of ``paths``."""
+    """Lint ``paths`` (files or directories). The repo-level drift rules
+    — DSL004/DSL005 (env knobs) and DSL006 (telemetry metric catalog) —
+    scan their anchors under ``repo_root`` regardless of ``paths``;
+    ``knob_rules=False`` disables all three (synthetic-tree tests)."""
     hot_paths = HOT_PATHS if hot_paths is None else hot_paths
     findings: List[Finding] = []
     for p in paths:
@@ -432,6 +526,7 @@ def lint(paths: Sequence[str], repo_root: str = REPO,
                 path, os.path.relpath(path, repo_root), hot_paths))
     if knob_rules:
         findings.extend(_knob_findings(repo_root))
+        findings.extend(_metric_findings(repo_root))
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
 
 

@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-12 on-chip sequence: serve/train telemetry (ISSUE 9). The CPU
+# story is proven in tier-1 (histogram accuracy, SLO invariants,
+# zero-callback audits, drill flight dumps); on-chip this captures
+# (a) the telemetry overhead number with the real paged/TP programs in
+# the loop (serve_obs: on-vs-off decode steps/s + the registry SLO
+# report), (b) a dstpu_top render off the live export file, (c) the
+# serve_drill registry-vs-bench goodput agreement, and (d) lint
+# cleanliness (DSL006 metric catalog + the telemetry DSL001 registry).
+# Strictly sequential (one process owns the chip), no timeouts around
+# TPU clients (a killed client wedges the grant).
+cd /root/repo || exit 1
+LOG=profiles/r12_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round12 start $(date -u +%FT%TZ)"
+
+echo "--- [1/5] dstpu_lint (DSL006 metric-catalog drift + DSL001 over"
+echo "    the telemetry record paths; DSTPU_TELEMETRY*/DSTPU_FLIGHT*/"
+echo "    DSTPU_TRACE_DIR knobs in docs/CONFIG.md)"
+python bin/dstpu_lint deepspeed_tpu
+
+echo "--- [2/5] serve_obs bench: telemetry on-vs-off decode steps/s"
+echo "    (gate <= 3% overhead), registry TTFT/TPOT/queue-wait p50/p99"
+echo "    + goodput, 0 fresh compiles in every measured window"
+DSTPU_TELEMETRY_EXPORT=profiles/serve_obs_export_r12.json \
+    python bench.py serve_obs > BENCH_OBS_r12.json
+tail -c 1200 BENCH_OBS_r12.json
+
+echo "--- [3/5] dstpu_top one-shot render off the export the bench"
+echo "    just published (the operator view)"
+python bin/dstpu_top --file profiles/serve_obs_export_r12.json
+
+echo "--- [4/5] serve_drill: incident goodput now ALSO computed from"
+echo "    the registry's committed-token counters — must match the"
+echo "    bench arithmetic within 10%"
+python bench.py serve_drill > BENCH_DRILL_r12.json
+tail -c 1200 BENCH_DRILL_r12.json
+
+echo "--- [5/5] serve control (flagship serve numbers must hold with"
+echo "    the telemetry layer wired in)"
+python bench.py serve > BENCH_SERVE_r12.json
+tail -c 700 BENCH_SERVE_r12.json
+echo "=== tpu_round12 done $(date -u +%FT%TZ)"
