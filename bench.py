@@ -1259,6 +1259,159 @@ def bench_serve_obs():
     return 0 if ok else 1
 
 
+def bench_serve_capacity():
+    """Open-loop capacity search (ISSUE 10): sweep offered QPS with the
+    wall-clock loadgen (telemetry/loadgen.py) and emit the
+    goodput-vs-offered-load curve plus the located KNEE — the highest
+    offered rate whose goodput fraction (requests completing within
+    their deadline, anchored at the request's scheduled ARRIVAL) still
+    meets ``DSTPU_CAP_SLO``.
+
+    Method: (1) a saturating warmup pass compiles every program and
+    measures the engine's max completion rate C (arrivals at ~infinite
+    rate = the closed-loop throughput ceiling); (2) a light pass at
+    0.4·C measures the unloaded completion-latency p99 L, and the SLO
+    deadline defaults to 3·L (generous at light load, violated once
+    queueing dominates); (3) the sweep offers ``DSTPU_CAP_FRACS``·C
+    with every request deadline'd, under the recompile tripwire (warm
+    passes must not compile). Gates: >= 3 curve points, a located knee,
+    per-request token streams identical with the observer attached vs
+    detached (the same toggle discipline as serve_obs), and 0 fresh
+    compiles across the measured sweep."""
+    import os
+
+    import jax
+
+    from deepspeed_tpu.analysis import RecompileTripwire
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceConfig)
+    from deepspeed_tpu.telemetry.loadgen import (PoissonArrivals,
+                                                 WorkloadMix,
+                                                 build_requests,
+                                                 run_open_loop,
+                                                 sweep_capacity)
+
+    on_tpu = jax.default_backend() == "tpu"
+    big = os.environ.get("DSTPU_CAP_MODEL",
+                         "big" if on_tpu else "tiny") == "big"
+    model, mcfg = _serve_llama(big)
+    params = _pseudo_params(model, mcfg)
+    if big:
+        S, PROMPT, GEN, dtype = 64, 128, 48, "bfloat16"
+    else:
+        S, PROMPT, GEN, dtype = 8, 24, 12, "float32"
+    S = int(os.environ.get("DSTPU_CAP_SEQS", str(S)))
+    GEN = int(os.environ.get("DSTPU_CAP_GEN", str(GEN)))
+    # enough requests that an above-capacity rate builds a backlog the
+    # SLO deadline actually catches: the tail wait of n requests offered
+    # at r > C is ~ (n/C)·(1 - C/r), which must exceed the deadline at
+    # the top swept rate for the knee to be BRACKETED from above
+    N_REQ = int(os.environ.get("DSTPU_CAP_REQS", "64"))
+    BURST = int(os.environ.get("DSTPU_CAP_BURST", "6"))
+    slo_frac = float(os.environ.get("DSTPU_CAP_SLO", "0.9"))
+    bs = 32
+    per_seq = -(-(PROMPT + GEN + 8) // bs)
+    cfg = RaggedInferenceConfig(
+        max_seqs=S, chunk_size=PROMPT, block_size=bs,
+        num_blocks=S * per_seq + 8, max_blocks_per_seq=per_seq + 1,
+        dtype=dtype, attention_impl="paged_flash" if on_tpu else "dense",
+        decode_loop_steps=0, serve_pipeline_depth=2, prefix_cache=True)
+    eng = InferenceEngineV2(mcfg, params, cfg)
+    mix = WorkloadMix(
+        prompt_lens=(PROMPT,), prompt_probs=(1.0,),
+        gen_lens=(GEN,), gen_probs=(1.0,),
+        shared_prefix_frac=0.5, shared_prefix_len=PROMPT // 2,
+        vocab_size=mcfg.vocab_size)
+
+    def pass_at(rate, n, seed, uid_base, mix_=None):
+        reqs = build_requests(PoissonArrivals(rate, seed=seed),
+                              mix_ or mix, n, seed=seed,
+                              uid_base=uid_base)
+        return reqs, run_open_loop(eng, reqs, decode_burst=BURST,
+                                   max_live=S)
+
+    # (1) warmup+calibration: saturating arrivals; the first pass eats
+    # every compile, the second measures the warm completion ceiling C
+    pass_at(1e4, min(N_REQ, 16), seed=90, uid_base=90_000_000)
+    _, cal = pass_at(1e4, N_REQ, seed=91, uid_base=91_000_000)
+    cap_rps = cal.report["rates_rps"]["completed"] or 1.0
+    # (2) unloaded latency -> the SLO deadline (3x light-load p99)
+    _, light = pass_at(0.4 * cap_rps, N_REQ, seed=92,
+                       uid_base=92_000_000)
+    lat = light.report["latency"]["ttft_s"]
+    l99 = (lat.get("p99") or 0.1) + GEN * (
+        light.report["decode"]["step_lat"].get("p50") or 0.01)
+    deadline_s = float(os.environ.get("DSTPU_CAP_DEADLINE_S", "0")) \
+        or max(0.2, 3.0 * l99)
+    sweep_mix = WorkloadMix(
+        prompt_lens=(PROMPT,), prompt_probs=(1.0,),
+        gen_lens=(GEN,), gen_probs=(1.0,),
+        shared_prefix_frac=0.5, shared_prefix_len=PROMPT // 2,
+        deadline_frac=1.0, deadline_s=deadline_s,
+        vocab_size=mcfg.vocab_size)
+    fracs = [float(f) for f in os.environ.get(
+        "DSTPU_CAP_FRACS", "0.4,0.7,1.0,1.5,2.5").split(",") if f]
+    rates = [round(f * cap_rps, 3) for f in fracs]
+    # (3) the measured sweep, compile-free by construction
+    tw = RecompileTripwire()
+    with tw:
+        sweep = sweep_capacity(
+            eng, rates, N_REQ, sweep_mix, seed=7,
+            goodput_slo_frac=slo_frac, decode_burst=BURST, max_live=S)
+    fresh = tw.fresh_compiles if tw.available else 0
+    # parity: replay one mid-sweep rate with the observer DETACHED —
+    # per-request token streams must be identical with instrumentation
+    # on vs off (request identity is (mix, seed, index), engine greedy
+    # decode is deterministic per request). The parity mix carries NO
+    # deadlines: a deadline abort truncates a stream at a wall-clock
+    # instant, which would make lengths timing-dependent
+    par_rate = rates[1] if len(rates) > 1 else rates[0]
+    par_reqs, on_res = pass_at(par_rate, N_REQ, seed=55,
+                               uid_base=55_000_000)
+    obs = eng._obs
+    eng._obs = None
+    try:
+        off_res = run_open_loop(eng, par_reqs, decode_burst=BURST,
+                                max_live=S)
+    finally:
+        eng._obs = obs
+    parity = on_res.streams == off_res.streams \
+        and all(off_res.streams.values())
+    slo = eng.slo_report()
+    # a knee is LOCATED only when bracketed: some swept rate must
+    # violate the SLO, else the true knee lies above the sweep
+    bracketed = any(r["goodput_frac"] is not None
+                    and r["goodput_frac"] < slo_frac
+                    for r in sweep["curve"])
+    row = {
+        "model": f"llama {mcfg.num_layers}L hidden={mcfg.hidden_size}",
+        "capacity_rps_measured": round(cap_rps, 3),
+        "slo_deadline_s": round(deadline_s, 4),
+        "slo_goodput_frac": slo_frac,
+        "curve": sweep["curve"],
+        "knee_rps": sweep["knee_rps"],
+        "knee_bracketed": bracketed,
+        "knee_goodput_rps": sweep["knee_goodput_rps"],
+        "knee_frac_of_capacity": round(sweep["knee_rps"] / cap_rps, 3)
+        if sweep["knee_rps"] else None,
+        "token_parity_obs_on_off": parity,
+        "fresh_compiles_measured": fresh,
+        "cumulative_goodput_frac": slo.get("goodput_frac")
+        if slo else None,
+        "serve_config": {
+            "DSTPU_CAP_MODEL": "big" if big else "tiny",
+            "DSTPU_CAP_SEQS": S, "DSTPU_CAP_GEN": GEN,
+            "DSTPU_CAP_REQS": N_REQ, "DSTPU_CAP_BURST": BURST,
+            "DSTPU_CAP_FRACS": ",".join(str(f) for f in fracs),
+            "DSTPU_CAP_SLO": slo_frac,
+        },
+    }
+    print(json.dumps(row))
+    ok = (len(sweep["curve"]) >= 3 and sweep["knee_rps"] is not None
+          and bracketed and parity and fresh == 0)
+    return 0 if ok else 1
+
+
 def _moe_param_counts(shapes, num_experts: int, top_k: int):
     """(total, active) param counts from a Mixtral param tree: expert
     leaves carry a leading E axis under a 'moe' subtree; only k/E of each
@@ -1442,19 +1595,28 @@ def bench_moe_train():
 def bench_serve_fastgen():
     """FastGen-WORKLOAD serving benchmark (VERDICT r3 #4): Poisson request
     arrivals, mixed prompt/generation lengths, continuous batching through
-    the ragged engine with evict-then-loop under KV pressure. Reports
-    throughput, TTFT and per-token decode latency percentiles (the
-    SLA-style metrics of blogs/deepspeed-fastgen/README.md:139-169) plus
-    decode-phase HBM bandwidth utilization (the honest roofline for
-    bandwidth-bound decode)."""
+    the ragged engine. Reports throughput, TTFT and per-token decode
+    latency percentiles (the SLA-style metrics of
+    blogs/deepspeed-fastgen/README.md:139-169) plus decode-phase HBM
+    bandwidth utilization (the honest roofline for bandwidth-bound
+    decode).
+
+    Since ISSUE 10 the arrival/admission loop IS the open-loop loadgen
+    (telemetry/loadgen.py) — one arrival-process implementation in the
+    repo: seeded Poisson schedule, slot-bounded admission (max_live=S,
+    the seed-era behavior), arrival-anchored TTFT. The row shape is
+    unchanged so the r4/r5 trajectory stays comparable."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
                                             RaggedInferenceConfig)
-    from deepspeed_tpu.inference.v2.blocked_allocator import OutOfBlocksError
-    from deepspeed_tpu.inference.v2.sequence import SequenceStatus
+    from deepspeed_tpu.telemetry.loadgen import (PoissonArrivals,
+                                                 WorkloadMix,
+                                                 build_requests,
+                                                 run_open_loop)
+    from deepspeed_tpu.telemetry.registry import Histogram
     from deepspeed_tpu.models.llama import Llama, LlamaConfig
 
     import os
@@ -1493,107 +1655,54 @@ def bench_serve_fastgen():
 
     n_req = int(os.environ.get("DSTPU_FG_REQS", "384"))
 
+    mix = WorkloadMix(prompt_lens=(128, 256, 512),
+                      prompt_probs=(0.4, 0.4, 0.2),
+                      gen_lens=tuple(max(g, N) for g in (32, 64, 128)),
+                      gen_probs=(0.3, 0.5, 0.2), vocab_size=32000)
+
     def run_load(lam, n_req, seed):
-        """One Poisson-arrival pass at ``lam`` offered req/s; returns the
-        SLA metrics dict. uids are offset by the seed so passes never
-        collide in the engine's sequence table."""
-        rng = np.random.RandomState(seed)
-        base = seed * 1_000_000
-        arr = np.cumsum(rng.exponential(1.0 / lam, size=n_req))
-        plens = rng.choice([128, 256, 512], size=n_req, p=[0.4, 0.4, 0.2])
-        glens = rng.choice([32, 64, 128], size=n_req, p=[0.3, 0.5, 0.2])
-        glens = np.maximum(glens, N)        # budgets are multiples of N
-        prompts = {base + i: rng.randint(1, 32000, size=int(p)).tolist()
-                   for i, p in enumerate(plens)}
-        glen_of = {base + i: int(g) for i, g in enumerate(glens)}
-
-        ttft, tok_lat = {}, []
-        remaining, last_tok = {}, {}
-        queued = [base + i for i in range(n_req)]
-        arr_of = {base + i: arr[i] for i in range(n_req)}
-        decoding = []
-        t0 = time.perf_counter()
-        decode_time = 0.0
-        decode_bytes = 0.0
-        decode_tokens = 0
-        while queued or decoding:
-            now = time.perf_counter() - t0
-            # admit arrivals into free slots (prefill in arrival order)
-            admit = []
-            while queued and arr_of[queued[0]] <= now and \
-                    len(decoding) + len(admit) < S and \
-                    eng.free_blocks - len(admit) > 0:
-                admit.append(queued.pop(0))
-            if admit:
-                res = eng.put(admit, [prompts[u] for u in admit],
-                              _greedy=True)
-                tnow = time.perf_counter() - t0
-                for u in admit:
-                    ttft[u] = tnow - arr_of[u]
-                    last_tok[u] = res[u]
-                    remaining[u] = glen_of[u] - 1
-                    decoding.append(u)
-            if not decoding:
-                if queued:
-                    time.sleep(max(0.0, arr_of[queued[0]]
-                                   - (time.perf_counter() - t0)))
-                continue
-            # one fused decode chunk over every decoding sequence
-            lu = [u for u in decoding
-                  if eng.state.sequences[u].status
-                  is not SequenceStatus.PAUSED]
-            if not lu:
-                eng._try_resume()
-                continue
-            ts = time.perf_counter()
-            try:
-                outs = eng.decode_batch(lu, [last_tok[u] for u in lu], N)
-            except OutOfBlocksError:
-                if not eng._relieve_kv_pressure():
-                    raise
-                continue
-            dt = time.perf_counter() - ts
-            decode_time += dt
-            ctx = sum(eng.state.sequences[u].seen_tokens for u in lu)
-            decode_bytes += N * (weight_bytes + ctx * kv_row_bytes)
-            decode_tokens += N * len(lu)
-            tok_lat.append(dt / N)
-            tnow = time.perf_counter() - t0
-            for u in lu:
-                remaining[u] -= N
-                last_tok[u] = outs[u][-1]
-                if remaining[u] <= 0:
-                    eng.flush(u)
-                    decoding.remove(u)
-            eng._try_resume()
-        total = time.perf_counter() - t0
-
-        lat = np.array(sorted(tok_lat))
-        gen_total = int(sum(glens))
+        """One seeded open-loop Poisson pass at ``lam`` offered req/s
+        through the loadgen; returns the seed-era SLA row. uids are
+        offset by the seed so passes never collide in the engine's
+        sequence table. decode_burst=N keeps the N-token device-call
+        granularity the r4/r5 series measured; max_live=S is the
+        seed-era slot-bounded admission."""
+        reqs = build_requests(PoissonArrivals(lam, seed=seed), mix,
+                              n_req, seed=seed,
+                              uid_base=seed * 1_000_000)
+        res = run_open_loop(eng, reqs, decode_burst=N, max_live=S)
+        rep = res.report
+        dec = rep["decode"]
+        decode_time = dec["time_s"] or 1e-9
+        decode_bytes = (dec["steps"] * weight_bytes
+                        + dec["ctx_step_sum"] * kv_row_bytes)
+        ttft = Histogram.from_state(rep["latency"]["ttft_s"])
+        steplat = Histogram.from_state(dec["step_lat"])
         return {
             "offered_rate_req_s": lam,
-            "completed_req_per_sec": round(n_req / total, 2),
-            "output_tokens_per_sec": round(gen_total / total, 1),
-            "decode_tokens_per_sec": round(decode_tokens / decode_time, 1),
-            "ttft_ms_p50": round(
-                1e3 * float(np.median(list(ttft.values()))), 1),
-            "ttft_ms_p95": round(1e3 * float(np.percentile(
-                list(ttft.values()), 95)), 1),
+            "completed_req_per_sec": rep["rates_rps"]["completed"],
+            "output_tokens_per_sec": round(
+                rep["output_tokens"] / rep["duration_s"], 1),
+            "decode_tokens_per_sec": round(
+                dec["tokens"] / decode_time, 1),
+            "ttft_ms_p50": round(1e3 * (ttft.quantile(0.5) or 0.0), 1),
+            "ttft_ms_p95": round(1e3 * (ttft.quantile(0.95) or 0.0), 1),
             "decode_token_latency_ms_p50": round(
-                1e3 * float(lat[len(lat) // 2]), 2),
+                1e3 * (steplat.quantile(0.5) or 0.0), 2),
             "decode_token_latency_ms_p95": round(
-                1e3 * float(np.percentile(lat, 95)), 2),
+                1e3 * (steplat.quantile(0.95) or 0.0), 2),
             "decode_hbm_bandwidth_util": round(
                 decode_bytes / decode_time / HBM_BW, 3),
-            "wall_s": round(total, 1),
+            "wall_s": round(rep["duration_s"], 1),
         }
 
-    # warmup compiles: fused decode loop + the prefill slot-buckets the
-    # arrival pattern will hit (admission batches vary in size; bucketed
-    # shapes otherwise compile inside the measured TTFT)
+    # warmup compiles: the pipelined decode path (step_greedy_fb — what
+    # the loadgen's bursts run) + the prefill slot-buckets the arrival
+    # pattern will hit (admission batches vary in size; bucketed shapes
+    # otherwise compile inside the measured TTFT)
     wp = np.random.RandomState(0).randint(1, 32000, size=256).tolist()
     w = eng.put([99991, 99992], [wp[:8], wp[8:16]], _greedy=True)
-    eng.decode_batch([99991, 99992], [w[99991], w[99992]], N)
+    eng.decode_pipelined([99991, 99992], [w[99991], w[99992]], N)
     for u in (99991, 99992):
         eng.flush(u)
     # derive warmup sizes from the slot buckets the run can reach (any
@@ -1678,6 +1787,8 @@ def main():
         return bench_serve_overlap()
     if sys.argv[1:] == ["serve_obs"]:
         return bench_serve_obs()
+    if sys.argv[1:] == ["serve_capacity"]:
+        return bench_serve_capacity()
     if sys.argv[1:] == ["fastgen"]:
         return bench_serve_fastgen()
     if sys.argv[1:] == ["moe"]:
@@ -1717,8 +1828,8 @@ def main():
     dead = False
     for phase in ("train", "train_xl", "train_1p3b", "serve",
                   "serve_pipeline", "serve_prefix", "serve_drill",
-                  "serve_overlap", "serve_obs", "fastgen", "moe",
-                  "moe_train"):
+                  "serve_overlap", "serve_obs", "serve_capacity",
+                  "fastgen", "moe", "moe_train"):
         if dead:
             out[phase] = {"error": "skipped_backend_dead"}
             continue
@@ -1789,6 +1900,7 @@ def main():
                    "serve_drill": out.get("serve_drill", {}),
                    "serve_overlap": out.get("serve_overlap", {}),
                    "serve_obs": out.get("serve_obs", {}),
+                   "serve_capacity": out.get("serve_capacity", {}),
                    "fastgen": out.get("fastgen", {}),
                    "moe_serve": out.get("moe", {}),
                    "moe_train": out.get("moe_train", {}),

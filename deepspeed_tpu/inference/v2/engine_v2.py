@@ -238,6 +238,11 @@ class InferenceEngineV2:
         self.request_deadline_s = float(
             os.environ.get("DSTPU_SERVE_DEADLINE_S")
             or cfg.request_deadline_s)
+        #: True once ANY sequence carries a deadline (engine-level knob
+        #: or a per-request ``put(..., deadlines=...)`` entry) — the
+        #: deadline sweep's cheap skip must not assume the engine knob
+        #: is the only deadline source
+        self._has_deadlines = self.request_deadline_s > 0
         self.serve_step_retries = int(
             os.environ.get("DSTPU_SERVE_RETRY") or cfg.serve_step_retries)
         self.serve_retry_backoff_s = float(
@@ -285,7 +290,10 @@ class InferenceEngineV2:
 
     def put(self, batch_uids: Sequence[int],
             batch_tokens: Sequence[Sequence[int]],
-            _greedy: bool = False) -> Dict[int, Any]:
+            _greedy: bool = False,
+            arrivals: Optional[Dict[int, float]] = None,
+            deadlines: Optional[Dict[int, float]] = None
+            ) -> Dict[int, Any]:
         """Feed tokens, run scheduled steps until all fed work is consumed,
         return {uid: last-token logits} for sequences with no pending work
         (or {uid: argmax token id} on the internal ``_greedy`` fast path,
@@ -307,7 +315,17 @@ class InferenceEngineV2:
         engine is DRAINING, and for fresh prompts that could never fit
         the KV pool even after eviction, the request is refused with a
         structured record in :attr:`rejections` (never a crash) and its
-        uid is simply absent from the returned dict."""
+        uid is simply absent from the returned dict.
+
+        Admission hooks for open-loop drivers (telemetry/loadgen.py):
+        ``arrivals`` maps uid -> the request's ``time.monotonic()``
+        ARRIVAL stamp (typically in the past when admission lagged the
+        arrival clock) — used as the telemetry admission stamp and the
+        deadline anchor, so queue-wait/TTFT measure from when the
+        request was offered, not from when the engine got around to it;
+        ``deadlines`` maps uid -> a per-request deadline in seconds
+        (overriding the engine-level ``request_deadline_s``). Both
+        apply to FRESH sequences only."""
         admitted: List[int] = []
         bs = self.config.block_size
         for uid, toks in zip(batch_uids, batch_tokens):
@@ -346,11 +364,20 @@ class InferenceEngineV2:
             # request failed", which must only ever mean THIS admission
             self.rejections.pop(uid, None)
             if fresh:
+                arrived = arrivals.get(uid) if arrivals else None
                 if self._obs is not None:
-                    self._obs.on_admit(seq, time.monotonic())
-                if self.request_deadline_s > 0 and seq.deadline_at is None:
-                    seq.deadline_at = time.monotonic() \
-                        + self.request_deadline_s
+                    self._obs.on_admit(
+                        seq, arrived if arrived is not None
+                        else time.monotonic())
+                dl = deadlines.get(uid) if deadlines else None
+                if dl is None and self.request_deadline_s > 0:
+                    dl = self.request_deadline_s
+                if dl is not None and dl > 0 and seq.deadline_at is None:
+                    seq.deadline_at = dl + (
+                        arrived if arrived is not None
+                        else time.monotonic())
+                    seq.deadline_s = dl
+                    self._has_deadlines = True
                 if self.journal is not None \
                         and seq.seen_tokens == 0 and not seq.kv_blocks:
                     # prompt still building: (re-)journal the full chain
@@ -464,6 +491,12 @@ class InferenceEngineV2:
                         continue
                     if self._draining():
                         break
+                    if not work_left():
+                        # the fill loop consumed the last pending work
+                        # without dispatching (a deadline expiry or
+                        # abort cleared it) — that is completion, not
+                        # starvation; the outer condition exits
+                        continue
                     if not self._relieve_kv_pressure() \
                             and not self._shed_starved():
                         # nothing schedulable, evictable, resumable or
@@ -526,15 +559,18 @@ class InferenceEngineV2:
         rec = {"uid": uid, "reason": reason, "time": time.time(), **fields}
         self.rejections[uid] = rec
         if self._obs is not None:
-            self._obs.on_reject(reason)
+            self._obs.on_reject(reason, uid)
         logger.warning(f"serve rejection uid={uid}: {reason} "
                        + (str(fields) if fields else ""))
 
     def _expire_deadlines(self) -> None:
-        """Abort requests whose admission-stamped deadline has passed —
+        """Abort requests whose arrival-anchored deadline has passed —
         serving them late wastes pool and steps the on-time requests
-        need. Runs at every pipeline fill boundary; pure host checks."""
-        if self.request_deadline_s <= 0:
+        need. Runs at every pipeline fill boundary; pure host checks.
+        Covers the engine-level ``request_deadline_s`` AND per-request
+        ``put(..., deadlines=...)`` stamps (``_has_deadlines`` keeps
+        the deadline-free common case a single attribute check)."""
+        if not self._has_deadlines:
             return
         now = time.monotonic()
         for seq in list(self.state.sequences.values()):
@@ -547,7 +583,10 @@ class InferenceEngineV2:
             if seq.deadline_at is not None and now > seq.deadline_at \
                     and seq.status is not SequenceStatus.FINISHED:
                 self._reject(seq.uid, "deadline_exceeded",
-                             deadline_s=self.request_deadline_s,
+                             deadline_s=seq.deadline_s
+                             if seq.deadline_s is not None
+                             else self.request_deadline_s,
+                             deadline_at=seq.deadline_at,
                              seen_tokens=seq.seen_tokens,
                              generated=len(seq.gen_log))
                 self.abort(seq.uid)

@@ -67,8 +67,12 @@ class SequenceDescriptor:
     gen_log: List[int] = field(default_factory=list)
     # absolute time.monotonic() deadline for this request (0/None = no
     # deadline); the engine aborts expired sequences with a structured
-    # rejection instead of serving them late
+    # rejection instead of serving them late. deadline_s keeps the
+    # DURATION it was derived from (engine default or the per-request
+    # put(..., deadlines=...) value) so rejection records report the
+    # request's actual budget, not the engine knob
     deadline_at: Optional[float] = None
+    deadline_s: Optional[float] = None
     # telemetry lifecycle stamps (time.monotonic; None until reached /
     # when DSTPU_TELEMETRY=0): admission, first scheduled chunk, first
     # and latest COMMITTED output token. Per-request SLO invariants

@@ -15,21 +15,26 @@ shared no-op and the serve engine skips instrumentation entirely.
 
 from .flight_recorder import (FlightRecorder, auto_dump, flight_dir,
                               register_recorder)
+from .loadgen import (LoadResult, PoissonArrivals, Request,
+                      TraceArrivals, UniformArrivals, WorkloadMix,
+                      build_requests, run_open_loop, sweep_capacity)
 from .monitor_bridge import MonitorBridge, attach_monitor
 from .registry import (COMM_CANONICAL_KINDS, REGISTERED_METRICS, Counter,
                        Gauge, Histogram, MetricsRegistry, NullRegistry,
-                       comm_counter, get_registry, new_registry,
-                       record_phase_tflops, set_registry,
+                       comm_counter, get_registry, merge_snapshots,
+                       new_registry, record_phase_tflops, set_registry,
                        telemetry_enabled)
 from .serve import ServeObserver, serve_observer
 from .trace import annotate, maybe_trace, trace_dir
 
 __all__ = [
     "COMM_CANONICAL_KINDS", "Counter", "FlightRecorder", "Gauge",
-    "Histogram", "MetricsRegistry", "MonitorBridge", "NullRegistry",
-    "REGISTERED_METRICS", "ServeObserver", "annotate", "attach_monitor",
-    "auto_dump", "comm_counter", "flight_dir", "get_registry",
-    "maybe_trace", "new_registry", "record_phase_tflops",
-    "register_recorder", "serve_observer", "set_registry",
-    "telemetry_enabled", "trace_dir",
+    "Histogram", "LoadResult", "MetricsRegistry", "MonitorBridge",
+    "NullRegistry", "PoissonArrivals", "REGISTERED_METRICS", "Request",
+    "ServeObserver", "TraceArrivals", "UniformArrivals", "WorkloadMix",
+    "annotate", "attach_monitor", "auto_dump", "build_requests",
+    "comm_counter", "flight_dir", "get_registry", "maybe_trace",
+    "merge_snapshots", "new_registry", "record_phase_tflops",
+    "register_recorder", "run_open_loop", "serve_observer",
+    "set_registry", "sweep_capacity", "telemetry_enabled", "trace_dir",
 ]

@@ -94,6 +94,11 @@ class FlightRecorder:
         self._ring: deque = deque(maxlen=self.capacity)
         self._open: Optional[Tuple[str, float, Optional[int]]] = None
         self._lock = threading.Lock()
+        #: spans silently evicted by ring wrap — monotone; surfaced as
+        #: the ``flight_spans_dropped`` registry counter and in every
+        #: dump header, so a trace that only shows the last N spans
+        #: SAYS how much history it lost
+        self.dropped = 0
         # wall-clock anchor: perf_counter t=anchor_perf corresponds to
         # wall time anchor_wall (cross-replica correlation)
         self.anchor_perf = time.perf_counter()
@@ -108,13 +113,26 @@ class FlightRecorder:
         with self._lock:
             if self._open is not None:
                 n0, t0, s0 = self._open
+                if len(self._ring) == self.capacity:
+                    self.dropped += 1
                 self._ring.append((n0, t0, now, s0, None))
             self._open = None if name == "idle" else (name, now, step)
 
     def record(self, name, t0, t1, step=None, args=None):
         """Append a completed span. Registered DSL001 hot path."""
         with self._lock:
+            if len(self._ring) == self.capacity:
+                self.dropped += 1
             self._ring.append((name, t0, t1, step, args))
+
+    def event(self, name, step=None, duration=0.0, **args):
+        """Instant (or ``duration``-long, ending now) span — the
+        request-lifecycle marks (admit/first-token/finish) the serve
+        observer tags with ``uid`` so one request's life reads off a
+        single dump. Registered DSL001 hot path."""
+        t1 = time.perf_counter()
+        self.record(name, t1 - duration, t1, step=step,
+                    args=args or None)
 
     @contextmanager
     def span(self, name: str, step: Optional[int] = None, **args):
@@ -152,7 +170,12 @@ class FlightRecorder:
                 "ts": round((t0 - base) * 1e6, 1),
                 "dur": round((t1 - t0) * 1e6, 1),
                 "pid": os.getpid(),
-                "tid": 0,
+                # uid-tagged request spans land on a per-request track
+                # (tid = uid + 1; track 0 stays the engine phase lane)
+                # so one request's admit->...->finish life reads as one
+                # row in chrome://tracing / Perfetto
+                "tid": int(args["uid"]) + 1
+                if args and "uid" in args else 0,
             }
             a = dict(args) if args else {}
             if step is not None:
@@ -167,6 +190,7 @@ class FlightRecorder:
                 "source": "dstpu.flight_recorder",
                 "reason": reason,
                 "capacity": self.capacity,
+                "spans_dropped": self.dropped,
                 "wall_time_base": self.anchor_wall
                 + (base - self.anchor_perf),
             },

@@ -1,0 +1,728 @@
+"""Open-loop, wall-clock load generation for the v2 ragged engine.
+
+The ROADMAP's fleet item needs capacity numbers a closed-loop bench
+cannot produce: a closed loop only offers a new request when an old one
+completes, so the engine is never observed *past* its capacity and the
+measured "throughput" is just the engine's pace. This module drives any
+``InferenceEngineV2`` **open-loop**: request arrival times come from a
+seeded stochastic process evaluated against the WALL CLOCK, and the
+arrival clock is **never back-pressured by engine state** — when the
+engine falls behind, late arrivals queue in the driver (their measured
+queue-wait/TTFT grows, which is the phenomenon being measured) or are
+shed after ``shed_after_s``; they never stall the generator. That is
+the DeepSpeed-FastGen workload-evaluation regime (PAPER.md §7): offered
+load is an independent variable, goodput/latency are the response.
+
+Pieces:
+
+  * arrival processes — :class:`PoissonArrivals` (exponential gaps),
+    :class:`UniformArrivals` (deterministic spacing),
+    :class:`TraceArrivals` (recorded-trace replay). All seeded: the same
+    (process, seed, n) always yields the identical schedule, so runs
+    are reproducible and on-vs-off comparisons see the same offered
+    stream.
+  * :class:`WorkloadMix` — prompt/generation length distributions, a
+    shared-prefix fraction (those prompts open with one common preamble
+    and ride the prefix cache), and a per-request deadline fraction.
+  * :func:`run_open_loop` — the driver: admit due arrivals through
+    ``put(..., arrivals=..., deadlines=...)`` (so the engine's SLO
+    stamps anchor at the request's scheduled arrival, not at whenever
+    admission happened), decode in short pipelined bursts between
+    admission polls, and emit a structured :class:`LoadResult` — offered
+    vs completed vs goodput rates, TTFT/TPOT/queue-wait p50/p90/p99
+    aggregated through the telemetry registry's streaming histograms,
+    and the shed/deadline-miss breakdown.
+  * :func:`sweep_capacity` — offered-QPS sweep locating the knee: the
+    highest offered rate whose goodput fraction still meets the SLO
+    threshold (``bench.py serve_capacity`` / ``bin/dstpu_loadgen``).
+
+The driver's per-iteration work (:meth:`_OpenLoopDriver._admit_due`,
+:meth:`_OpenLoopDriver._decode_burst`) is dslint DSL001-registered: it
+brackets the engine's overlapped pipeline, so a blocking host sync here
+would serialize the very hot path whose capacity is being measured.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .registry import Histogram
+
+# ---------------------------------------------------------------------- #
+# arrival processes
+# ---------------------------------------------------------------------- #
+
+
+class ArrivalProcess:
+    """Seeded generator of nondecreasing arrival offsets (seconds from
+    the run's t=0). ``schedule(n)`` is a pure function of the process's
+    construction arguments — determinism is the contract the capacity
+    bench and the on-vs-off parity gates stand on."""
+
+    kind = "base"
+
+    def schedule(self, n: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def describe(self) -> Dict[str, Any]:
+        return {"process": self.kind}
+
+
+class PoissonArrivals(ArrivalProcess):
+    """Memoryless arrivals at ``rate_rps`` offered requests/second —
+    i.i.d. exponential inter-arrival gaps from a seeded RNG."""
+
+    kind = "poisson"
+
+    def __init__(self, rate_rps: float, seed: int = 0):
+        if rate_rps <= 0:
+            raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+        self.rate_rps = float(rate_rps)
+        self.seed = int(seed)
+
+    def schedule(self, n: int) -> np.ndarray:
+        rng = np.random.RandomState(self.seed)
+        return np.cumsum(rng.exponential(1.0 / self.rate_rps, size=n))
+
+    def describe(self) -> Dict[str, Any]:
+        return {"process": self.kind, "rate_rps": self.rate_rps,
+                "seed": self.seed}
+
+
+class UniformArrivals(ArrivalProcess):
+    """Deterministic arrivals: one request every ``1/rate_rps`` seconds
+    (the jitter-free control against the Poisson runs)."""
+
+    kind = "uniform"
+
+    def __init__(self, rate_rps: float):
+        if rate_rps <= 0:
+            raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+        self.rate_rps = float(rate_rps)
+
+    def schedule(self, n: int) -> np.ndarray:
+        return (np.arange(n, dtype=np.float64) + 1.0) / self.rate_rps
+
+    def describe(self) -> Dict[str, Any]:
+        return {"process": self.kind, "rate_rps": self.rate_rps}
+
+
+class TraceArrivals(ArrivalProcess):
+    """Recorded-trace replay: arrival offsets from a captured workload
+    (a JSON list of seconds, absolute or already-relative — the
+    schedule is normalized to start at 0). ``time_scale`` compresses or
+    stretches the trace (0.5 = replay at double speed)."""
+
+    kind = "trace"
+
+    def __init__(self, times: Sequence[float], time_scale: float = 1.0,
+                 path: Optional[str] = None):
+        if not len(times):
+            raise ValueError("empty arrival trace")
+        t = np.sort(np.asarray(times, dtype=np.float64))
+        self.times = (t - t[0]) * float(time_scale)
+        self.time_scale = float(time_scale)
+        self.path = path
+
+    @classmethod
+    def from_file(cls, path: str,
+                  time_scale: float = 1.0) -> "TraceArrivals":
+        with open(path, encoding="utf-8") as f:
+            blob = json.load(f)
+        times = blob["arrivals"] if isinstance(blob, dict) else blob
+        return cls(times, time_scale=time_scale, path=path)
+
+    def schedule(self, n: int) -> np.ndarray:
+        if n > len(self.times):
+            raise ValueError(
+                f"trace holds {len(self.times)} arrivals, {n} requested")
+        return self.times[:n].copy()
+
+    def describe(self) -> Dict[str, Any]:
+        span = float(self.times[-1]) if len(self.times) > 1 else 0.0
+        return {"process": self.kind, "n_times": int(len(self.times)),
+                "time_scale": self.time_scale, "path": self.path,
+                "rate_rps": round(len(self.times) / span, 3)
+                if span > 0 else None}
+
+
+# ---------------------------------------------------------------------- #
+# workload mix
+# ---------------------------------------------------------------------- #
+
+
+@dataclass
+class Request:
+    """One offered request: identity, scheduled arrival offset, prompt,
+    decode budget, optional per-request deadline."""
+
+    uid: int
+    arrival_s: float
+    prompt: List[int]
+    gen_len: int
+    deadline_s: Optional[float] = None
+
+
+@dataclass
+class WorkloadMix:
+    """Seeded request-shape distribution. ``shared_prefix_frac`` of the
+    requests open with ONE common ``shared_prefix_len``-token preamble
+    (the prefix-cache hit population); ``deadline_frac`` of them carry
+    a ``deadline_s`` deadline measured from their scheduled arrival."""
+
+    prompt_lens: Sequence[int] = (128, 256, 512)
+    prompt_probs: Sequence[float] = (0.4, 0.4, 0.2)
+    gen_lens: Sequence[int] = (32, 64, 128)
+    gen_probs: Sequence[float] = (0.3, 0.5, 0.2)
+    shared_prefix_frac: float = 0.0
+    shared_prefix_len: int = 0
+    deadline_frac: float = 0.0
+    deadline_s: float = 0.0
+    vocab_size: int = 32000
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "prompt_mix": list(self.prompt_lens),
+            "gen_mix": list(self.gen_lens),
+            "shared_prefix_frac": self.shared_prefix_frac,
+            "shared_prefix_len": self.shared_prefix_len,
+            "deadline_frac": self.deadline_frac,
+            "deadline_s": self.deadline_s,
+        }
+
+
+def build_requests(process: ArrivalProcess, mix: WorkloadMix, n: int,
+                   seed: int = 0, uid_base: int = 0) -> List[Request]:
+    """Materialize ``n`` requests: arrival offsets from ``process``,
+    shapes/contents from ``mix`` under ``seed``. Pure and deterministic
+    — request identity (prompt, budget, deadline) depends only on
+    (mix, seed, index), never on engine timing, so per-request token
+    streams are comparable across instrumentation settings."""
+    arrivals = process.schedule(n)
+    rng = np.random.RandomState(seed)
+    plens = rng.choice(list(mix.prompt_lens), size=n,
+                       p=list(mix.prompt_probs))
+    glens = rng.choice(list(mix.gen_lens), size=n, p=list(mix.gen_probs))
+    shared = rng.random_sample(n) < mix.shared_prefix_frac
+    deadlined = rng.random_sample(n) < mix.deadline_frac
+    prefix = rng.randint(1, mix.vocab_size,
+                         size=mix.shared_prefix_len).tolist() \
+        if mix.shared_prefix_len else []
+    out: List[Request] = []
+    for i in range(n):
+        plen = int(plens[i])
+        if shared[i] and prefix and plen > len(prefix):
+            body = rng.randint(1, mix.vocab_size,
+                               size=plen - len(prefix)).tolist()
+            prompt = prefix + body
+        else:
+            prompt = rng.randint(1, mix.vocab_size, size=plen).tolist()
+        out.append(Request(
+            uid=uid_base + i, arrival_s=float(arrivals[i]),
+            prompt=prompt, gen_len=int(glens[i]),
+            deadline_s=mix.deadline_s
+            if deadlined[i] and mix.deadline_s > 0 else None))
+    return out
+
+
+# ---------------------------------------------------------------------- #
+# the open-loop driver
+# ---------------------------------------------------------------------- #
+
+
+@dataclass
+class LoadResult:
+    """One open-loop pass: the structured report plus the per-request
+    committed token streams (the parity-gate evidence)."""
+
+    report: Dict[str, Any]
+    streams: Dict[int, List[int]] = field(default_factory=dict)
+
+
+class _OpenLoopDriver:
+    """One pass of :func:`run_open_loop` — split into the DSL001-
+    registered per-iteration methods (`_admit_due`, `_decode_burst`)
+    and cold bookkeeping."""
+
+    def __init__(self, engine, requests: Sequence[Request],
+                 decode_burst: int, shed_after_s: float,
+                 poll_s: float, max_live: Optional[int] = None):
+        self.engine = engine
+        self.requests = sorted(requests, key=lambda r: r.arrival_s)
+        self.decode_burst = max(1, int(decode_burst))
+        self.shed_after_s = shed_after_s
+        self.poll_s = poll_s
+        self.max_live = max(1, int(max_live)) \
+            if max_live is not None else None
+        self.pending: deque = deque(self.requests)
+        self.live: Dict[int, Dict[str, Any]] = {}
+        self.streams: Dict[int, List[int]] = {}
+        self.by_uid = {r.uid: r for r in self.requests}
+        # outcome bookkeeping
+        self.completed: Dict[int, float] = {}    # uid -> completion offset
+        self.shed_late: List[int] = []
+        self.offer_lags: List[float] = []
+        self.first_seen: Dict[int, float] = {}   # driver-side fallback
+        self._stamp_cache: Dict[int, Dict[str, float]] = {}
+        # decode accounting (the fastgen HBM-roofline inputs)
+        self.decode_time_s = 0.0
+        self.decode_tokens = 0
+        self.decode_steps = 0
+        self.decode_ctx_step_sum = 0
+        self.decode_step_lat = Histogram()
+        self.t0 = 0.0
+
+    # ------------------ hot loop (DSL001-registered) ------------------- #
+
+    def _admit_due(self, now: float) -> None:
+        """Offer every arrival whose scheduled time has passed. The
+        schedule is the precomputed process output — engine state never
+        delays an offer (the open-loop invariant); it only decides
+        whether the offered request is admitted, held at the door
+        (``max_live`` concurrency bound — held requests keep their
+        ORIGINAL arrival stamp, so door wait lands in queue-wait/TTFT),
+        queued into this batch late, or shed (``shed_after_s``)."""
+        due: List[Request] = []
+        while self.pending and self.pending[0].arrival_s <= now:
+            if self.max_live is not None \
+                    and len(self.live) + len(due) >= self.max_live:
+                break
+            r = self.pending.popleft()
+            lag = now - r.arrival_s
+            self.offer_lags.append(lag)
+            if self.shed_after_s > 0 and lag > self.shed_after_s:
+                self.shed_late.append(r.uid)
+                continue
+            due.append(r)
+        if not due:
+            return
+        arrivals = {r.uid: self.t0 + r.arrival_s for r in due}
+        deadlines = {r.uid: r.deadline_s for r in due
+                     if r.deadline_s is not None}
+        res = self.engine.put([r.uid for r in due],
+                              [r.prompt for r in due], _greedy=True,
+                              arrivals=arrivals, deadlines=deadlines)
+        t_seen = time.monotonic() - self.t0
+        for r in due:
+            if r.uid in res:
+                tok = res[r.uid]
+                self.streams[r.uid] = [tok]
+                self.first_seen[r.uid] = t_seen
+                if r.gen_len <= 1:
+                    self._finish(r.uid, "completed")
+                else:
+                    self.live[r.uid] = {"last": tok,
+                                        "remaining": r.gen_len - 1}
+            # admitted-then-rejected (deadline/shed inside put) and
+            # refused requests both carry engine.rejections records —
+            # the report's breakdown reads them after the pass
+
+    def _decode_burst(self) -> None:
+        """One short pipelined decode burst over the live set — short so
+        the admission poll (the arrival clock) runs between bursts."""
+        eng = self.engine
+        uids = [u for u in self.live
+                if u in eng.state.sequences and u not in eng.rejections]
+        for u in list(self.live):
+            if u not in uids:
+                self.live.pop(u)            # shed/expired mid-flight
+        if not uids:
+            return
+        budgets = [min(self.decode_burst, self.live[u]["remaining"])
+                   for u in uids]
+        ctx = 0
+        for u in uids:
+            ctx += eng.state.sequences[u].seen_tokens
+        t0 = time.perf_counter()
+        outs = eng.decode_pipelined(
+            uids, [self.live[u]["last"] for u in uids], budgets)
+        dt = time.perf_counter() - t0
+        steps = 0
+        got_total = 0
+        t_seen = time.monotonic() - self.t0
+        for u in uids:
+            got = outs.get(u) or []
+            if got:
+                self.streams[u].extend(got)
+                self.first_seen.setdefault(u, t_seen)
+            got_total += len(got)
+            if len(got) > steps:
+                steps = len(got)
+            if u in eng.rejections:
+                self.live.pop(u, None)      # aborted inside the burst
+                continue
+            st = self.live[u]
+            st["remaining"] -= len(got)
+            if got:
+                st["last"] = got[-1]
+            if st["remaining"] <= 0:
+                self.live.pop(u)
+                self._finish(u, "completed")
+        self.decode_time_s += dt
+        self.decode_tokens += got_total
+        self.decode_steps += steps
+        self.decode_ctx_step_sum += steps * ctx
+        if steps:
+            self.decode_step_lat.observe(dt / steps)
+
+    # --------------------------- cold paths ---------------------------- #
+
+    def _finish(self, uid: int, outcome: str) -> None:
+        """Clean completion: read the per-seq SLO stamps (PR 8) before
+        the flush releases the descriptor, then flush."""
+        seq = self.engine.state.get(uid)
+        now = time.monotonic() - self.t0
+        self.completed[uid] = now
+        if seq is not None:
+            self._stamps_of(uid, seq)
+            self.engine.flush(uid)
+
+    def _stamps_of(self, uid: int, seq) -> None:
+        r = self.by_uid[uid]
+        st = {"arrival_s": r.arrival_s}
+        if seq.admitted_at is not None:
+            adm = seq.admitted_at - self.t0
+            if seq.first_sched_at is not None:
+                st["queue_wait_s"] = seq.first_sched_at - seq.admitted_at
+            if seq.first_token_at is not None:
+                st["ttft_s"] = seq.first_token_at - seq.admitted_at
+                n_tok = len(self.streams.get(uid, ()))
+                if seq.last_token_at is not None and n_tok > 1:
+                    st["tpot_s"] = (seq.last_token_at
+                                    - seq.first_token_at) / (n_tok - 1)
+            st["admitted_s"] = adm
+        self._stamp_cache[uid] = st
+
+    def run(self) -> LoadResult:
+        self.t0 = time.monotonic()
+        while self.pending or self.live:
+            now = time.monotonic() - self.t0
+            self._admit_due(now)
+            if self.live:
+                self._decode_burst()
+            elif self.pending:
+                wait = self.t0 + self.pending[0].arrival_s \
+                    - time.monotonic()
+                if wait > 0:
+                    time.sleep(min(wait, self.poll_s))
+        duration = time.monotonic() - self.t0
+        return LoadResult(report=self._report(duration),
+                          streams=self.streams)
+
+    def _report(self, duration: float) -> Dict[str, Any]:
+        eng = self.engine
+        n = len(self.requests)
+        span = self.requests[-1].arrival_s if n else 0.0
+        # per-pass latency histograms from the per-seq SLO stamps
+        # (telemetry on), falling back to driver-observed first-output
+        # times when the engine runs uninstrumented — the report always
+        # has TTFT, just at burst granularity in the fallback
+        h = {name: Histogram() for name in
+             ("ttft_s", "tpot_s", "queue_wait_s")}
+        stamps_used = 0
+        for uid in self.completed:
+            st = self._stamp_cache.get(uid, {})
+            if "ttft_s" in st:
+                stamps_used += 1
+                h["ttft_s"].observe(st["ttft_s"])
+                if "queue_wait_s" in st:
+                    h["queue_wait_s"].observe(st["queue_wait_s"])
+                if "tpot_s" in st:
+                    h["tpot_s"].observe(st["tpot_s"])
+            elif uid in self.first_seen:
+                h["ttft_s"].observe(self.first_seen[uid]
+                                    - self.by_uid[uid].arrival_s)
+        # shed/deadline breakdown from the engine's structured records
+        shed = deadline = drained = other = 0
+        for uid, rec in eng.rejections.items():
+            if uid not in self.by_uid:
+                continue
+            reason = rec.get("reason")
+            if reason == "kv_pool_exhausted":
+                shed += 1
+            elif reason == "deadline_exceeded":
+                deadline += 1
+            elif reason == "draining":
+                drained += 1
+            else:
+                other += 1
+        completed = len(self.completed)
+        # goodput: completed AND met its deadline (deadline-free
+        # requests count on completion; the engine aborts most late
+        # ones, this closes the completed-just-past-deadline window)
+        goodput = 0
+        for uid, t_done in self.completed.items():
+            r = self.by_uid[uid]
+            if r.deadline_s is None \
+                    or t_done - r.arrival_s <= r.deadline_s:
+                goodput += 1
+        offered_rate = n / span if span > 0 else None
+        lags = self.offer_lags
+        refused = sum(1 for uid in eng.rejections
+                      if uid in self.by_uid and uid not in self.streams)
+        report = {
+            "requests": {
+                "offered": n,
+                "admitted": n - len(self.shed_late) - refused,
+                "completed": completed,
+                "goodput": goodput,
+                "shed": shed,
+                "deadline_expired": deadline,
+                "shed_late": len(self.shed_late),
+                "rejected_draining": drained,
+                "rejected_other": other,
+            },
+            "rates_rps": {
+                "offered": round(offered_rate, 3)
+                if offered_rate else None,
+                "completed": round(completed / duration, 3)
+                if duration > 0 else None,
+                "goodput": round(goodput / duration, 3)
+                if duration > 0 else None,
+            },
+            "goodput_frac": goodput / n if n else None,
+            "latency": {name: hist.summary()
+                        for name, hist in h.items()},
+            "latency_source": "registry_stamps"
+            if stamps_used else "driver_observed",
+            "open_loop": {
+                "max_offer_lag_s": round(max(lags), 4) if lags else 0.0,
+                "mean_offer_lag_s": round(sum(lags) / len(lags), 4)
+                if lags else 0.0,
+            },
+            "decode": {
+                "time_s": round(self.decode_time_s, 4),
+                "tokens": self.decode_tokens,
+                "steps": self.decode_steps,
+                "ctx_step_sum": self.decode_ctx_step_sum,
+                "step_lat": self.decode_step_lat.summary(),
+            },
+            "output_tokens": sum(len(s) for s in self.streams.values()),
+            "duration_s": round(duration, 4),
+        }
+        if duration > 0:
+            report["output_tokens_per_sec"] = round(
+                report["output_tokens"] / duration, 2)
+        return report
+
+
+def run_open_loop(engine, requests: Sequence[Request],
+                  decode_burst: int = 8, shed_after_s: float = 0.0,
+                  poll_s: float = 0.02,
+                  max_live: Optional[int] = None) -> LoadResult:
+    """Drive one open-loop pass of ``requests`` against ``engine``.
+
+    The arrival clock is the precomputed schedule against
+    ``time.monotonic()`` — never gated on engine completions. Late
+    offers (engine busy in a burst) are admitted with their ORIGINAL
+    arrival stamp (``put(..., arrivals=...)``), so measured queue-wait
+    and TTFT include the driver-side wait; offers later than
+    ``shed_after_s`` past their arrival are shed driver-side
+    (0 = queue indefinitely). ``decode_burst`` bounds how long the
+    admission poll can starve (smaller = finer arrival granularity,
+    more host/dispatch round-trips); ``max_live`` bounds in-engine
+    concurrency (further due requests wait at the door with their
+    arrival stamp intact — their wait is measured, not hidden).
+
+    Leaves the engine empty (every request completed, aborted or
+    flushed) and accumulates rejection records in
+    ``engine.rejections``."""
+    return _OpenLoopDriver(engine, requests, decode_burst, shed_after_s,
+                           poll_s, max_live=max_live).run()
+
+
+# ---------------------------------------------------------------------- #
+# capacity search
+# ---------------------------------------------------------------------- #
+
+
+def sweep_capacity(engine, rates: Sequence[float], n_per_rate: int,
+                   mix: WorkloadMix, seed: int = 0,
+                   goodput_slo_frac: float = 0.9,
+                   process: str = "poisson",
+                   decode_burst: int = 8, shed_after_s: float = 0.0,
+                   max_live: Optional[int] = None) -> Dict[str, Any]:
+    """Sweep offered QPS and locate the knee: the highest offered rate
+    whose goodput fraction still meets ``goodput_slo_frac``. Each rate
+    runs an independent seeded pass (disjoint uid ranges; the engine's
+    compiled programs stay warm across passes). Returns the
+    goodput-vs-offered-load curve plus the located knee — the
+    ``bench.py serve_capacity`` payload."""
+    if process not in ("poisson", "uniform"):
+        # a recorded trace pins its own rate — sweeping offered rates
+        # over it has no meaning, and silently substituting Poisson
+        # would measure a different workload than the caller asked for
+        raise ValueError(
+            f"sweep_capacity supports 'poisson'|'uniform' arrivals, "
+            f"got {process!r}")
+    curve: List[Dict[str, Any]] = []
+    for i, rate in enumerate(sorted(rates)):
+        proc = UniformArrivals(rate) if process == "uniform" \
+            else PoissonArrivals(rate, seed=seed + i)
+        reqs = build_requests(proc, mix, n_per_rate, seed=seed + i,
+                              uid_base=(i + 1) * 1_000_000)
+        res = run_open_loop(engine, reqs, decode_burst=decode_burst,
+                            shed_after_s=shed_after_s, max_live=max_live)
+        rep = res.report
+        lat = rep["latency"]
+        curve.append({
+            "offered_rps": round(rate, 3),
+            "offered_realized_rps": rep["rates_rps"]["offered"],
+            "completed_rps": rep["rates_rps"]["completed"],
+            "goodput_rps": rep["rates_rps"]["goodput"],
+            "goodput_frac": round(rep["goodput_frac"], 4)
+            if rep["goodput_frac"] is not None else None,
+            "ttft_ms_p50": _ms(lat["ttft_s"].get("p50")),
+            "ttft_ms_p99": _ms(lat["ttft_s"].get("p99")),
+            "shed": rep["requests"]["shed"],
+            "deadline_expired": rep["requests"]["deadline_expired"],
+            "shed_late": rep["requests"]["shed_late"],
+        })
+    knee = None
+    for row in curve:
+        gf = row["goodput_frac"]
+        if gf is not None and gf >= goodput_slo_frac:
+            if knee is None or row["offered_rps"] > knee["offered_rps"]:
+                knee = row
+    return {
+        "curve": curve,
+        "slo_goodput_frac": goodput_slo_frac,
+        "knee_rps": knee["offered_rps"] if knee else None,
+        "knee_goodput_rps": knee["goodput_rps"] if knee else None,
+        "n_per_rate": n_per_rate,
+        "process": process,
+        "seed": seed,
+    }
+
+
+def _ms(v: Optional[float]) -> Optional[float]:
+    return round(1e3 * v, 3) if v is not None else None
+
+
+# ---------------------------------------------------------------------- #
+# CLI (bin/dstpu_loadgen)
+# ---------------------------------------------------------------------- #
+
+
+def _tiny_engine(max_seqs: int = 8, num_blocks: int = 96,
+                 block_size: int = 16, vocab: int = 96):
+    """CPU-harness GPT-2 engine for the CLI's self-contained mode and
+    the tier-1 capacity smoke — small enough that a decode step is a
+    few ms."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..inference.v2 import InferenceEngineV2, RaggedInferenceConfig
+    from ..models.gpt2 import GPT2, GPT2Config
+    mcfg = GPT2Config(vocab_size=vocab, max_seq_len=block_size * 16,
+                      num_layers=2, num_heads=2, hidden_size=32,
+                      dtype=jnp.float32)
+    params = GPT2(mcfg).init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, 8), jnp.int32))["params"]
+    cfg = RaggedInferenceConfig(
+        max_seqs=max_seqs, chunk_size=16, block_size=block_size,
+        num_blocks=num_blocks, max_blocks_per_seq=16, dtype="float32",
+        attention_impl="dense", decode_loop_steps=0,
+        serve_pipeline_depth=2, prefix_cache=True)
+    return InferenceEngineV2(mcfg, params, cfg), mcfg
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``bin/dstpu_loadgen`` — run an open-loop pass (or a rate sweep)
+    against a self-contained tiny CPU engine and print the report JSON.
+    The env knobs mirror the flags (flags win); docs/CONFIG.md has the
+    catalog."""
+    import argparse
+    import os
+
+    ap = argparse.ArgumentParser(
+        prog="dstpu_loadgen",
+        description="open-loop wall-clock load generator for the v2 "
+                    "ragged engine (docs/observability.md)")
+    ap.add_argument("--rate", default=os.environ.get(
+        "DSTPU_LOADGEN_RATE", "8"),
+        help="offered req/s; a comma list runs a capacity sweep")
+    ap.add_argument("--requests", type=int, default=int(os.environ.get(
+        "DSTPU_LOADGEN_REQS", "32")))
+    ap.add_argument("--seed", type=int, default=int(os.environ.get(
+        "DSTPU_LOADGEN_SEED", "0")))
+    ap.add_argument("--burst", type=int, default=int(os.environ.get(
+        "DSTPU_LOADGEN_BURST", "8")),
+        help="decode tokens per pipelined burst between admission polls")
+    ap.add_argument("--process", choices=("poisson", "uniform", "trace"),
+                    default=os.environ.get("DSTPU_LOADGEN_PROCESS",
+                                           "poisson"))
+    ap.add_argument("--trace", default=os.environ.get(
+        "DSTPU_LOADGEN_TRACE"),
+        help="JSON arrival-trace file for --process trace")
+    ap.add_argument("--shed-after", type=float, default=float(
+        os.environ.get("DSTPU_LOADGEN_SHED_AFTER_S", "0")),
+        help="driver-side shed bound in seconds (0 = queue forever)")
+    ap.add_argument("--prompt-len", type=int, default=24)
+    ap.add_argument("--gen-len", type=int, default=16)
+    ap.add_argument("--shared-prefix-frac", type=float, default=0.0)
+    ap.add_argument("--deadline-s", type=float, default=0.0)
+    ap.add_argument("--deadline-frac", type=float, default=0.0)
+    ap.add_argument("--slo-goodput", type=float, default=0.9,
+                    help="goodput fraction the sweep's knee must meet")
+    ap.add_argument("--out", default=None,
+                    help="also write the report JSON here")
+    args = ap.parse_args(argv)
+
+    eng, mcfg = _tiny_engine()
+    mix = WorkloadMix(
+        prompt_lens=(args.prompt_len,), prompt_probs=(1.0,),
+        gen_lens=(args.gen_len,), gen_probs=(1.0,),
+        shared_prefix_frac=args.shared_prefix_frac,
+        shared_prefix_len=min(16, args.prompt_len // 2)
+        if args.shared_prefix_frac > 0 else 0,
+        deadline_frac=args.deadline_frac, deadline_s=args.deadline_s,
+        vocab_size=mcfg.vocab_size)
+    rates = [float(r) for r in str(args.rate).split(",") if r]
+    if len(rates) > 1:
+        if args.process == "trace":
+            ap.error("--process trace replays a recorded schedule and "
+                     "cannot sweep offered rates; give one --rate or "
+                     "use poisson/uniform")
+        out = sweep_capacity(
+            eng, rates, args.requests, mix, seed=args.seed,
+            goodput_slo_frac=args.slo_goodput, process=args.process,
+            decode_burst=args.burst, shed_after_s=args.shed_after)
+    else:
+        if args.process == "trace":
+            if not args.trace:
+                ap.error("--process trace needs --trace FILE")
+            proc: ArrivalProcess = TraceArrivals.from_file(args.trace)
+        elif args.process == "uniform":
+            proc = UniformArrivals(rates[0])
+        else:
+            proc = PoissonArrivals(rates[0], seed=args.seed)
+        reqs = build_requests(proc, mix, args.requests, seed=args.seed)
+        res = run_open_loop(eng, reqs, decode_burst=args.burst,
+                            shed_after_s=args.shed_after)
+        out = {"arrival": proc.describe(), "workload": mix.describe(),
+               **res.report}
+        slo = eng.slo_report()
+        if slo:
+            out["slo_cumulative"] = {
+                "goodput_frac": slo["goodput_frac"],
+                "ttft_ms_p50": _ms(slo["ttft_s"].get("p50")),
+                "ttft_ms_p99": _ms(slo["ttft_s"].get("p99")),
+            }
+    blob = json.dumps(out)
+    print(blob)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(blob)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -36,7 +36,8 @@ import json
 import math
 import os
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 #: metric-name catalog: name -> one-line meaning. The single source of
 #: truth dslint DSL006 checks two-way against docs/observability.md's
@@ -86,7 +87,22 @@ REGISTERED_METRICS = {
     "achieved_tflops": "achieved TFLOPS for a phase (label: phase)",
     "flops_per_step": "model FLOPs per step for a phase (label: phase)",
     "mxu_utilization": "achieved/peak FLOPs fraction (label: phase)",
+    # -- flight recorder (counter) -------------------------------------- #
+    "flight_spans_dropped": "flight-recorder spans evicted by ring wrap",
 }
+
+
+def series_capacity() -> int:
+    """Bounded per-metric time-series ring length
+    (``DSTPU_SERIES_CAPACITY``, default 120 samples)."""
+    return int(os.environ.get("DSTPU_SERIES_CAPACITY", "120") or "120")
+
+
+def series_interval() -> float:
+    """Minimum seconds between time-series samples
+    (``DSTPU_SERIES_EVERY_S``, default 1.0; the serve observer calls
+    ``maybe_sample`` at every commit boundary and this throttles it)."""
+    return float(os.environ.get("DSTPU_SERIES_EVERY_S", "1.0") or "1.0")
 
 
 def telemetry_enabled() -> bool:
@@ -179,9 +195,76 @@ class Histogram:
                 return max(self.min, min(est, self.max))
         return self.max
 
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold ``other`` into this sketch bucket-wise — EXACT: two
+        sketches with the same ``gamma`` hold integer counts in the same
+        bucket lattice, so the merged buckets (and zero bucket, count,
+        min, max) are identical to a single sketch fed the union of the
+        two observation streams — merged quantiles therefore equal
+        single-stream quantiles on the same data, which is what makes
+        this the fleet-rollup primitive (``MetricsRegistry.merge``).
+        Mixed-gamma merges are refused rather than silently degraded —
+        except when one side holds no positive observations (an idle
+        replica's sketch, or one holding only the lattice-free zero
+        bucket): such a side carries no bucket information, so the
+        merge adopts the populated side's lattice and stays exact."""
+        if other.buckets and self.buckets:
+            if not math.isclose(self.gamma, other.gamma,
+                                rel_tol=1e-12):
+                raise ValueError(
+                    f"histogram merge needs identical gamma "
+                    f"({self.gamma} vs {other.gamma}) — bucket-wise "
+                    f"merge is only exact on one bucket lattice")
+        elif other.buckets:
+            self.alpha = other.alpha
+            self.gamma = other.gamma
+            self._lg = other._lg
+        b = self.buckets
+        for i, n in other.buckets.items():
+            b[i] = b.get(i, 0) + n
+        self.zero += other.zero
+        self.count += other.count
+        self.sum += other.sum
+        if other.min < self.min:
+            self.min = other.min
+        if other.max > self.max:
+            self.max = other.max
+        return self
+
+    def state(self) -> Dict[str, Any]:
+        """JSON-safe full sketch state (buckets included) — what
+        ``snapshot()`` exports so :func:`merge_snapshots` can rebuild
+        and merge exactly across processes."""
+        out: Dict[str, Any] = {"alpha": self.alpha, "count": self.count,
+                               "sum": self.sum, "zero": self.zero,
+                               "buckets": {str(i): n for i, n
+                                           in self.buckets.items()}}
+        if self.count:
+            out["min"] = self.min
+            out["max"] = self.max
+        return out
+
+    @classmethod
+    def from_state(cls, state: Dict[str, Any]) -> "Histogram":
+        h = cls(alpha=float(state.get("alpha", 0.05)))
+        h.count = int(state.get("count", 0))
+        h.sum = float(state.get("sum", 0.0))
+        h.zero = int(state.get("zero", 0))
+        h.buckets = {int(i): int(n)
+                     for i, n in state.get("buckets", {}).items()}
+        if h.count:
+            h.min = float(state["min"])
+            h.max = float(state["max"])
+        return h
+
     def summary(self) -> Dict[str, Any]:
+        """Percentile summary PLUS the full sketch state: ``buckets`` /
+        ``zero`` / ``alpha`` ride along so an exported snapshot stays
+        exactly mergeable (:func:`merge_snapshots`). ``alpha`` is kept
+        even when empty — an idle replica's sketch rebuilds on the
+        lattice it was configured with, not the default."""
         if self.count == 0:
-            return {"count": 0, "sum": 0.0}
+            return {"count": 0, "sum": 0.0, "alpha": self.alpha}
         return {
             "count": self.count,
             "sum": self.sum,
@@ -190,6 +273,9 @@ class Histogram:
             "p50": self.quantile(0.50),
             "p90": self.quantile(0.90),
             "p99": self.quantile(0.99),
+            "alpha": self.alpha,
+            "zero": self.zero,
+            "buckets": {str(i): n for i, n in self.buckets.items()},
         }
 
 
@@ -198,6 +284,38 @@ def _key(name: str, labels: Dict[str, Any]) -> str:
         return name
     inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
     return f"{name}{{{inner}}}"
+
+
+_LABEL_RE = None
+
+
+def _dedupe_source(base: str, labels: Dict[str, Any],
+                   used: set) -> None:
+    """Suffix ``labels['source']`` until ``(base, labels)`` is a fresh
+    key in ``used`` (mutates ``labels``; records the final key). Two
+    distinct merge inputs must never silently overwrite one gauge."""
+    orig = labels.get("source", "")
+    key = _key(base, labels)
+    n = 0
+    while key in used:
+        n += 1
+        labels["source"] = f"{orig}#{n}"
+        key = _key(base, labels)
+    used.add(key)
+
+
+def _parse_key(key: str) -> Tuple[str, Dict[str, str]]:
+    """Inverse of :func:`_key`: ``name{a="b",c="d"}`` -> (name, labels).
+    Label values never contain quotes (they come from ``str()`` of knob
+    values / phase names), so a non-greedy quoted scan is exact."""
+    global _LABEL_RE
+    if "{" not in key:
+        return key, {}
+    if _LABEL_RE is None:
+        import re
+        _LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
+    name, inner = key.split("{", 1)
+    return name, {k: v for k, v in _LABEL_RE.findall(inner.rstrip("}"))}
 
 
 class MetricsRegistry:
@@ -215,6 +333,16 @@ class MetricsRegistry:
         self._types: Dict[str, str] = {}
         self._bridges: List[Any] = []
         self.created_at = time.time()
+        # bounded per-metric time series: key -> deque[(wall_t, value)]
+        # (counters + gauges; histograms export their full sketch state
+        # instead). maybe_sample() throttles to one sample per
+        # DSTPU_SERIES_EVERY_S; the ring keeps the last
+        # DSTPU_SERIES_CAPACITY samples — a month-long process holds a
+        # constant-size series.
+        self._series: Dict[str, deque] = {}
+        self._series_cap = max(2, series_capacity())
+        self._series_every = series_interval()
+        self._last_sample = 0.0
 
     # ------------------------- metric handles ------------------------- #
 
@@ -245,6 +373,94 @@ class MetricsRegistry:
     def metric_names(self) -> List[str]:
         """Base metric names (labels stripped) registered so far."""
         return sorted(self._types)
+
+    # ------------------------- time series ----------------------------- #
+
+    def sample(self, now: Optional[float] = None) -> None:
+        """Append one time-series point per counter/gauge. Bounded ring
+        per key; pure host arithmetic (the serve observer drives this
+        from its commit boundary via :meth:`maybe_sample`)."""
+        now = time.time() if now is None else now
+        for key, m in self._metrics.items():
+            if isinstance(m, (Counter, Gauge)):
+                dq = self._series.get(key)
+                if dq is None:
+                    dq = deque(maxlen=self._series_cap)
+                    self._series[key] = dq
+                dq.append((now, m.value))
+        self._last_sample = now
+
+    def maybe_sample(self, now: Optional[float] = None) -> None:
+        """Sample iff ``DSTPU_SERIES_EVERY_S`` elapsed since the last
+        sample — the per-commit throttle."""
+        now = time.time() if now is None else now
+        if now - self._last_sample >= self._series_every:
+            self.sample(now)
+
+    def series(self) -> Dict[str, List[List[float]]]:
+        """{metric key: [[t, value], ...]} — the sampled rings, oldest
+        first. Exported alongside snapshots; ``bin/dstpu_top`` turns
+        counter series into per-window rates and sparklines."""
+        return {key: [[t, v] for t, v in dq]
+                for key, dq in self._series.items() if len(dq)}
+
+    def rate(self, name: str, window_s: Optional[float] = None,
+             **labels) -> Optional[float]:
+        """Windowed rate of a sampled counter: (last - earliest-within-
+        window) / dt, or None with fewer than two samples. ``window_s``
+        None uses the whole ring."""
+        dq = self._series.get(_key(name, labels))
+        if not dq or len(dq) < 2:
+            return None
+        t1, v1 = dq[-1]
+        t0, v0 = dq[0]
+        if window_s is not None:
+            for t, v in dq:
+                if t >= t1 - window_s:
+                    t0, v0 = t, v
+                    break
+        return (v1 - v0) / (t1 - t0) if t1 > t0 else None
+
+    # ------------------------- fleet rollup ---------------------------- #
+
+    @classmethod
+    def merge(cls, registries: Sequence["MetricsRegistry"],
+              name: str = "fleet") -> "MetricsRegistry":
+        """Roll N registries (e.g. one per serving replica) into one:
+        counters SUM, gauges keep per-source identity via an added
+        ``source`` label (a pool's free-block gauges must stay per
+        replica, not averaged into fiction), histograms merge
+        bucket-wise EXACTLY (same gamma ⇒ merged quantiles identical to
+        a single stream over the union — :meth:`Histogram.merge`).
+        Source labels come from each registry's ``name``,
+        disambiguated by index on collision. A gauge that ALREADY
+        carries a ``source`` label (this registry is itself a rollup)
+        keeps it — re-merging rollups preserves the original
+        per-replica identities — and if two DIFFERENT inputs still
+        land on one gauge key (two pools each holding a replica named
+        "a"), the later source is suffixed rather than silently
+        overwriting the earlier value."""
+        out = cls(name)
+        seen: Dict[str, int] = {}
+        gauge_keys: set = set()
+        for reg in registries:
+            src = reg.name
+            n = seen.get(src, 0)
+            seen[src] = n + 1
+            if n:
+                src = f"{src}#{n}"
+            for key, m in reg._metrics.items():
+                base, labels = _parse_key(key)
+                if isinstance(m, Counter):
+                    out.counter(base, **labels).inc(m.value)
+                elif isinstance(m, Gauge):
+                    labels.setdefault("source", src)
+                    _dedupe_source(base, labels, gauge_keys)
+                    out.gauge(base, **labels).set(m.value)
+                elif isinstance(m, Histogram):
+                    out.histogram(base, alpha=m.alpha,
+                                  **labels).merge(m)
+        return out
 
     # --------------------------- exports ------------------------------ #
 
@@ -304,6 +520,9 @@ class MetricsRegistry:
         if extra:
             blob.update(extra)
         blob.update(self.snapshot())
+        series = self.series()
+        if series:
+            blob["series"] = series
         return json.dumps(blob)
 
     def export(self, path: str,
@@ -377,6 +596,75 @@ class NullRegistry(MetricsRegistry):
 
     def tick(self, step):
         return
+
+    def sample(self, now=None):
+        return
+
+    def maybe_sample(self, now=None):
+        return
+
+    def series(self):
+        return {}
+
+    def rate(self, name, window_s=None, **labels):
+        return None
+
+
+def merge_snapshots(snaps: Sequence[Dict[str, Any]],
+                    sources: Optional[Iterable[str]] = None
+                    ) -> Dict[str, Any]:
+    """Merge exported snapshot dicts (``MetricsRegistry.snapshot()`` /
+    the ``export()`` JSON) with the same semantics as
+    :meth:`MetricsRegistry.merge` — counters sum, gauges gain a
+    ``source`` label, histograms rebuild from their exported bucket
+    state (:meth:`Histogram.from_state`) and merge bucket-wise exactly.
+    This is the cross-process path: N replicas each publish a snapshot
+    file, the pool rolls them up without sharing memory. ``sources``
+    overrides the per-snapshot label (default: the snapshot's
+    ``registry`` name, index-disambiguated)."""
+    snaps = list(snaps)
+    src_list = list(sources) if sources is not None else [
+        snap.get("registry") or f"r{i}" for i, snap in enumerate(snaps)]
+    if len(src_list) != len(snaps):
+        raise ValueError(
+            f"sources has {len(src_list)} entries for {len(snaps)} "
+            f"snapshots — a short list would silently drop replicas "
+            f"from the rollup")
+    seen: Dict[str, int] = {}
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    gauge_keys: set = set()
+    hists: Dict[str, Histogram] = {}
+    for snap, src in zip(snaps, src_list):
+        n = seen.get(src, 0)
+        seen[src] = n + 1
+        if n:
+            src = f"{src}#{n}"
+        for key, v in snap.get("counters", {}).items():
+            counters[key] = counters.get(key, 0.0) + v
+        for key, v in snap.get("gauges", {}).items():
+            base, labels = _parse_key(key)
+            # an already-rolled-up snapshot's gauges keep their
+            # original per-replica source (re-merging rollups must not
+            # collapse replicas onto one key); residual collisions
+            # (two pools each holding a replica named "a") suffix
+            # rather than overwrite
+            labels.setdefault("source", src)
+            _dedupe_source(base, labels, gauge_keys)
+            gauges[_key(base, labels)] = v
+        for key, state in snap.get("histograms", {}).items():
+            h = Histogram.from_state(state)
+            if key in hists:
+                hists[key].merge(h)
+            else:
+                hists[key] = h
+    return {
+        "registry": f"fleet({len(src_list)})",
+        "time": max((s.get("time", 0.0) for s in snaps), default=0.0),
+        "counters": dict(sorted(counters.items())),
+        "gauges": dict(sorted(gauges.items())),
+        "histograms": {k: hists[k].summary() for k in sorted(hists)},
+    }
 
 
 _DEFAULT: Optional[MetricsRegistry] = None

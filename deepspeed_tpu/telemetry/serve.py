@@ -67,8 +67,16 @@ class ServeObserver:
         self.export_path = os.environ.get("DSTPU_TELEMETRY_EXPORT") or None
         self.export_every = int(
             os.environ.get("DSTPU_TELEMETRY_EXPORT_EVERY", "50") or "50")
+        # request-scoped flight spans: uid-tagged admit/queue/prefill/
+        # first-token/decode/finish marks so ONE request's life is
+        # reconstructable from a single Chrome-trace dump (each request
+        # renders as its own track). A handful of ring entries per
+        # request; DSTPU_FLIGHT_REQUESTS=0 keeps the ring phases-only.
+        self.req_spans = os.environ.get("DSTPU_FLIGHT_REQUESTS", "1") \
+            not in ("0", "false", "off")
         self._last_export_step = 0
         self._prefix_prev: Dict[str, float] = {}
+        self._flight_dropped_prev = 0
         r = self.registry
         # hot handles bound once — the record paths below are pre-bound
         # attribute ops, no registry lookups per token
@@ -86,28 +94,56 @@ class ServeObserver:
         self.h_plan = r.histogram("serve_plan_s")
         self.h_dispatch = r.histogram("serve_dispatch_s")
         self.h_commit = r.histogram("serve_commit_block_s")
+        self.c_flight_dropped = r.counter("flight_spans_dropped")
         self._reject_counters = {
             reason: r.counter(name)
             for reason, name in _REJECT_COUNTERS.items()}
+
+    def _req_span(self, name, t0_m, t1_m, uid, **args):
+        """Record a request-lifecycle span from MONOTONIC endpoints
+        (the per-seq SLO stamps) onto the flight ring's perf_counter
+        axis — the clock offset is measured at record time, so the span
+        lands exactly where it happened. DSL001-registered hot path:
+        two clock reads + a ring append."""
+        off = time.perf_counter() - time.monotonic()
+        self.flight.record(name, t0_m + off, t1_m + off,
+                           args={"uid": uid, **args})
 
     # ------------------- request lifecycle (hot) ---------------------- #
     # Registered DSL001 hot paths: these run inside the pipeline's
     # plan-ahead/commit window — pure host arithmetic only.
 
     def on_admit(self, seq, now):
+        """``now`` is the request's admission stamp — the open-loop
+        loadgen passes the request's scheduled ARRIVAL time here (via
+        ``put(..., arrivals=...)``), so queue-wait/TTFT include any time
+        the request waited outside the engine; the default is the
+        put() call time."""
         seq.admitted_at = now
         self.c_admitted.inc()
+        if self.req_spans:
+            # anchored at the (possibly past) admission stamp so the
+            # uid track reads admit -> queue -> ttft in order even when
+            # admission lagged the arrival (the loadgen's regime)
+            self._req_span("req_admit", now, now, seq.uid)
 
     def on_sched(self, sched, now):
         """First-schedule stamps for this plan's sequences -> queue
         wait. Continuations keep their original stamp (queue wait is an
         admission-time property)."""
+        req = self.req_spans
         for item in sched:
             seq = item.seq
             if seq.first_sched_at is None:
                 seq.first_sched_at = now
                 if seq.admitted_at is not None:
                     self.h_queue.observe(now - seq.admitted_at)
+                    if req:
+                        self._req_span("req_queue_wait",
+                                       seq.admitted_at, now, seq.uid)
+            if req and len(item.tokens) > 1:
+                self.flight.event("req_prefill_chunk", uid=seq.uid,
+                                  ntok=len(item.tokens))
 
     def on_token_commit(self, seq, now, n=1):
         """``n`` output tokens of ``seq`` became host-visible at ``now``
@@ -121,6 +157,9 @@ class ServeObserver:
             seq.first_token_at = now
             if seq.admitted_at is not None:
                 self.h_ttft.observe(now - seq.admitted_at)
+                if self.req_spans:
+                    self._req_span("req_ttft", seq.admitted_at, now,
+                                   seq.uid)
         else:
             last = seq.last_token_at
             if last is not None and now > last:
@@ -142,10 +181,12 @@ class ServeObserver:
     def on_retry(self):
         self.c_retries.inc()
 
-    def on_reject(self, reason):
+    def on_reject(self, reason, uid=None):
         c = self._reject_counters.get(reason)
         if c is not None:
             c.inc()
+        if self.req_spans and uid is not None:
+            self.flight.event("req_reject", uid=uid, reason=reason)
 
     def on_abort(self, rejected):
         """engine.abort() on a live uid; shed/deadline aborts arrive
@@ -162,13 +203,21 @@ class ServeObserver:
             return
         if draining:
             self.c_drained.inc()
+            outcome = "drained"
         elif rejected or seq.status.value == "finished":
             # FINISHED is only ever set by abort() — counted there (the
             # value comparison avoids importing the enum: telemetry must
             # stay import-cycle-free below the engine)
-            return
+            outcome = "rejected" if rejected else "aborted"
         else:
             self.c_completed.inc()
+            outcome = "completed"
+        if self.req_spans:
+            ft, lt = seq.first_token_at, seq.last_token_at
+            if ft is not None and lt is not None and lt > ft:
+                self._req_span("req_decode", ft, lt, seq.uid)
+            self.flight.event("req_finish", uid=seq.uid,
+                              outcome=outcome)
 
     def phase(self, name, step=None):
         self.flight.phase(name, step)
@@ -176,8 +225,10 @@ class ServeObserver:
     # --------------------- boundaries / exports ----------------------- #
 
     def after_commit(self, step: int) -> None:
-        """Periodic work at the commit boundary: gauge refresh, export
+        """Periodic work at the commit boundary: time-series sampling
+        (throttled to DSTPU_SERIES_EVERY_S), then gauge refresh, export
         publish, monitor-bridge tick — every ``export_every`` steps."""
+        self.registry.maybe_sample()
         if step - self._last_export_step < self.export_every:
             return
         self._last_export_step = step
@@ -214,6 +265,10 @@ class ServeObserver:
         if eng._prefix is not None:
             r.gauge("prefix_cached_blocks").set(st["cached_blocks"])
             r.gauge("prefix_evictable_blocks").set(st["evictable_blocks"])
+        dropped = self.flight.dropped
+        if dropped > self._flight_dropped_prev:
+            self.c_flight_dropped.inc(dropped - self._flight_dropped_prev)
+            self._flight_dropped_prev = dropped
 
     def on_drain(self, manifest: Dict[str, Any]) -> None:
         """Drain published: attach the SLO report to the manifest (the

@@ -4,9 +4,11 @@ Reads the atomic JSON export a running engine publishes at
 ``DSTPU_TELEMETRY_EXPORT`` (every ``DSTPU_TELEMETRY_EXPORT_EVERY``
 committed steps) and renders a compact operator view: request outcome
 counts and rates, TTFT/TPOT/queue-wait percentiles, goodput, prefix
-cache hit fraction and KV pool occupancy. One-shot by default;
-``--watch N`` refreshes every N seconds and derives rates from
-consecutive snapshots.
+cache hit fraction and KV pool occupancy. When the snapshot carries the
+registry's sampled time series (``series`` — DSTPU_SERIES_* knobs), the
+render adds per-window rates and sparklines, so even a ONE-SHOT render
+shows the recent rate history. ``--watch N`` refreshes every N seconds
+(rates then also derive from consecutive snapshots).
 """
 
 from __future__ import annotations
@@ -36,6 +38,31 @@ def load_snapshot(path: str) -> Dict[str, Any]:
         return json.load(f)
 
 
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(vals: List[float], width: int = 32) -> str:
+    """Unicode block sparkline over the last ``width`` values (empty
+    string for fewer than 2 points)."""
+    vals = [v for v in vals if v is not None][-width:]
+    if len(vals) < 2:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK[3] * len(vals)
+    scale = (len(_SPARK) - 1) / (hi - lo)
+    return "".join(_SPARK[int((v - lo) * scale)] for v in vals)
+
+
+def _series_rates(pairs: List[List[float]]) -> List[float]:
+    """Per-window rates from a sampled counter series [[t, v], ...]."""
+    out: List[float] = []
+    for (t0, v0), (t1, v1) in zip(pairs, pairs[1:]):
+        if t1 > t0:
+            out.append((v1 - v0) / (t1 - t0))
+    return out
+
+
 def render(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
            ) -> str:
     """The operator table for one snapshot; ``prev`` (an earlier
@@ -43,15 +70,22 @@ def render(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
     c = snap.get("counters", {})
     g = snap.get("gauges", {})
     h = snap.get("histograms", {})
+    series = snap.get("series", {})
+
+    def series_rate(name: str) -> Optional[float]:
+        rates = _series_rates(series.get(name, []))
+        return rates[-1] if rates else None
 
     def rate(name: str) -> str:
-        if prev is None:
-            return "      -"
-        dt = snap.get("time", 0.0) - prev.get("time", 0.0)
-        if dt <= 0:
-            return "      -"
-        d = c.get(name, 0.0) - prev.get("counters", {}).get(name, 0.0)
-        return f"{d / dt:7.1f}"
+        if prev is not None:
+            dt = snap.get("time", 0.0) - prev.get("time", 0.0)
+            if dt <= 0:
+                return "      -"
+            d = c.get(name, 0.0) - prev.get("counters", {}).get(name, 0.0)
+            return f"{d / dt:7.1f}"
+        # one-shot render: the sampled series still yields a rate
+        r = series_rate(name)
+        return f"{r:7.1f}" if r is not None else "      -"
 
     lines: List[str] = []
     when = time.strftime("%H:%M:%S",
@@ -101,6 +135,25 @@ def render(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
                  f"{_pct(_frac(total - free, total))}   "
                  f"{free:.0f}/{total:.0f} blocks free   "
                  f"{g.get('kv_pool_bytes_per_chip', 0) / 1e6:.1f} MB/chip")
+    dropped = c.get("flight_spans_dropped", 0.0)
+    if dropped:
+        lines.append(f"flight ring    {dropped:.0f} spans dropped "
+                     f"(ring wrapped — raise DSTPU_FLIGHT_CAPACITY for "
+                     f"longer postmortems)")
+    # sampled time series -> per-window rate sparklines (the recent
+    # history a single snapshot carries; DSTPU_SERIES_* knobs)
+    spark_rows = []
+    for label, name in (("admitted/s", "serve_requests_admitted"),
+                        ("completed/s", "serve_requests_completed"),
+                        ("tokens/s", "serve_tokens_committed")):
+        rates = _series_rates(series.get(name, []))
+        spark = _sparkline(rates)
+        if spark:
+            spark_rows.append(f"  {label:<14}{rates[-1]:9.1f}  {spark}")
+    if spark_rows:
+        lines.append("")
+        lines.append("rates (sampled series)   now  trend")
+        lines.extend(spark_rows)
     return "\n".join(lines)
 
 

@@ -93,7 +93,9 @@ class TestHistogram:
     def test_empty(self):
         h = Histogram()
         assert h.quantile(0.5) is None
-        assert h.summary() == {"count": 0, "sum": 0.0}
+        # alpha rides even the empty summary: an idle replica's sketch
+        # must rebuild on its configured lattice (merge_snapshots)
+        assert h.summary() == {"count": 0, "sum": 0.0, "alpha": 0.05}
 
 
 # ------------------------------------------------------------------ #
@@ -627,3 +629,322 @@ class TestServeDrillFlightDump:
         assert res["fault_fired"]
         assert res["flight_dump"] is True
         assert res["recovered"], res
+
+
+# ------------------------------------------------------------------ #
+# fleet rollup (ISSUE 10): bucket-wise EXACT histogram merge,
+# registry merge, snapshot merge
+# ------------------------------------------------------------------ #
+
+
+class TestHistogramMerge:
+    QS = (0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0)
+
+    def _split_check(self, data):
+        """merge(h1, h2) must equal the single-stream sketch EXACTLY —
+        same buckets, same count/min/max, identical quantiles — which
+        is the property the multi-replica rollup stands on."""
+        h1, h2, hall = Histogram(), Histogram(), Histogram()
+        for i, v in enumerate(data):
+            (h1 if i % 3 else h2).observe(float(v))
+            hall.observe(float(v))
+        merged = h1.merge(h2)
+        assert merged.buckets == hall.buckets
+        assert merged.zero == hall.zero
+        assert merged.count == hall.count
+        assert merged.min == hall.min and merged.max == hall.max
+        for q in self.QS:
+            assert merged.quantile(q) == hall.quantile(q), q
+
+    def test_uniform_split_exact(self):
+        self._split_check(
+            np.random.RandomState(0).uniform(1e-3, 10.0, 8000))
+
+    def test_lognormal_split_exact(self):
+        self._split_check(
+            np.random.RandomState(1).lognormal(0.0, 2.0, 8000))
+
+    def test_bimodal_split_exact(self):
+        low = np.abs(np.random.RandomState(2).normal(1e-3, 1e-4, 5000))
+        high = np.random.RandomState(3).normal(100.0, 1.0, 3000)
+        self._split_check(np.concatenate([low, high]))
+
+    def test_zero_and_empty_merge(self):
+        h1, h2 = Histogram(), Histogram()
+        h1.observe(0.0)
+        h1.observe(-2.0)
+        h1.merge(h2)                      # empty right side: no-op
+        assert h1.count == 2 and h1.zero == 2
+        h2.merge(h1)                      # empty left side absorbs
+        assert h2.count == 2 and h2.quantile(1.0) <= 0.0
+
+    def test_gamma_mismatch_refused(self):
+        h1, h2 = Histogram(alpha=0.05), Histogram(alpha=0.01)
+        h1.observe(1.0)
+        h2.observe(2.0)
+        with pytest.raises(ValueError):
+            h1.merge(h2)
+        # but a side with NO positive observations carries no lattice:
+        # merging it is exact under any alpha (idle replica case)
+        empty = Histogram(alpha=0.01)
+        h1.merge(empty)
+        empty2 = Histogram(alpha=0.01)
+        empty2.merge(h1)
+        assert empty2.count == 1
+        assert empty2.quantile(1.0) == h1.quantile(1.0)
+
+    def test_state_roundtrip_preserves_quantiles(self):
+        h = Histogram()
+        for v in np.random.RandomState(4).lognormal(0, 1, 3000):
+            h.observe(float(v))
+        for blob in (h.state(), h.summary()):
+            h2 = Histogram.from_state(
+                json.loads(json.dumps(blob)))   # through JSON
+            for q in self.QS:
+                assert h2.quantile(q) == h.quantile(q)
+
+
+def _replica(name, steps, ttfts):
+    """Shared rollup-test fixture: one synthetic replica registry."""
+    r = MetricsRegistry(name)
+    r.counter("serve_steps").inc(steps)
+    r.gauge("kv_pool_blocks_free").set(steps * 2)
+    for v in ttfts:
+        r.histogram("serve_ttft_s").observe(v)
+    return r
+
+
+class TestFleetRollup:
+    def test_merge_counters_gauges_histograms(self):
+        a = _replica("a", 3, [0.1, 0.2])
+        b = _replica("b", 4, [0.3])
+        m = MetricsRegistry.merge([a, b], name="fleet")
+        snap = m.snapshot()
+        assert snap["counters"]["serve_steps"] == 7.0
+        assert snap["gauges"]['kv_pool_blocks_free{source="a"}'] == 6
+        assert snap["gauges"]['kv_pool_blocks_free{source="b"}'] == 8
+        h = snap["histograms"]["serve_ttft_s"]
+        assert h["count"] == 3 and h["min"] == 0.1 and h["max"] == 0.3
+
+    def test_merge_quantiles_equal_single_stream(self):
+        vals = np.random.RandomState(5).lognormal(-3, 1, 4000)
+        regs = [MetricsRegistry(f"r{i}") for i in range(4)]
+        hall = Histogram()
+        for i, v in enumerate(vals):
+            regs[i % 4].histogram("serve_tpot_s").observe(float(v))
+            hall.observe(float(v))
+        m = MetricsRegistry.merge(regs)
+        merged = m._metrics["serve_tpot_s"]
+        for q in (0.5, 0.9, 0.99):
+            assert merged.quantile(q) == hall.quantile(q)
+
+    def test_merge_snapshots_cross_process(self):
+        """The file-based path: exported snapshot JSONs merge with the
+        same exactness (histogram summaries carry the sketch state)."""
+        a = _replica("a", 2, [0.1, 0.4, 0.4])
+        b = _replica("a", 5, [0.2])       # name COLLISION
+        snaps = [json.loads(a.to_json()), json.loads(b.to_json())]
+        merged = telemetry.merge_snapshots(snaps)
+        assert merged["counters"]["serve_steps"] == 7.0
+        gk = set(merged["gauges"])
+        assert 'kv_pool_blocks_free{source="a"}' in gk
+        assert 'kv_pool_blocks_free{source="a#1"}' in gk
+        h = merged["histograms"]["serve_ttft_s"]
+        assert h["count"] == 4
+        ref = Histogram()
+        for v in (0.1, 0.4, 0.4, 0.2):
+            ref.observe(v)
+        assert h["p99"] == ref.quantile(0.99)
+        # gauge labels merge with existing labels intact
+        c = MetricsRegistry("c")
+        c.gauge("achieved_tflops", phase="serve").set(1.5)
+        out = telemetry.merge_snapshots([c.snapshot()], sources=["x"])
+        assert out["gauges"][
+            'achieved_tflops{phase="serve",source="x"}'] == 1.5
+
+
+# ------------------------------------------------------------------ #
+# time series (ISSUE 10): bounded sampling, windowed rates, export
+# ------------------------------------------------------------------ #
+
+
+class TestTimeSeries:
+    def test_sample_rate_and_bounded_ring(self, monkeypatch):
+        monkeypatch.setenv("DSTPU_SERIES_CAPACITY", "8")
+        r = MetricsRegistry("t")
+        c = r.counter("serve_tokens_committed")
+        for i in range(20):
+            c.inc(10)
+            r.sample(now=100.0 + i)
+        series = r.series()["serve_tokens_committed"]
+        assert len(series) == 8                  # ring bounded
+        assert r.rate("serve_tokens_committed") == pytest.approx(10.0)
+        assert r.rate("serve_tokens_committed",
+                      window_s=3.0) == pytest.approx(10.0)
+        assert r.rate("nope") is None
+
+    def test_maybe_sample_throttles(self, monkeypatch):
+        monkeypatch.setenv("DSTPU_SERIES_EVERY_S", "5.0")
+        r = MetricsRegistry("t")
+        r.counter("serve_steps").inc()
+        r.maybe_sample(now=100.0)
+        r.maybe_sample(now=102.0)               # < interval: skipped
+        r.maybe_sample(now=106.0)
+        assert len(r.series()["serve_steps"]) == 2
+
+    def test_series_rides_export_and_top_render(self, tmp_path):
+        from deepspeed_tpu.telemetry.top import render
+        r = MetricsRegistry("serve")
+        c = r.counter("serve_tokens_committed")
+        for i in range(6):
+            c.inc(30 + 5 * i)
+            r.sample(now=200.0 + i)
+        path = str(tmp_path / "snap.json")
+        r.export(path)
+        blob = json.loads(open(path).read())
+        assert "serve_tokens_committed" in blob["series"]
+        out = render(blob)
+        assert "rates (sampled series)" in out
+        assert "tokens/s" in out
+
+    def test_null_registry_series_noop(self, monkeypatch):
+        monkeypatch.setenv("DSTPU_TELEMETRY", "0")
+        r = telemetry.new_registry("t")
+        r.sample()
+        r.maybe_sample()
+        assert r.series() == {} and r.rate("x") is None
+
+
+# ------------------------------------------------------------------ #
+# flight recorder: drop accounting + uid-tagged request spans
+# ------------------------------------------------------------------ #
+
+
+class TestFlightDropsAndRequestSpans:
+    def test_ring_wrap_counts_drops(self, tmp_path):
+        rec = FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.record(f"s{i}", float(i), float(i) + 0.5)
+        assert rec.dropped == 6
+        rec.phase("plan")
+        rec.phase("idle")                      # closes -> 7th drop
+        assert rec.dropped == 7
+        path = str(tmp_path / "t.json")
+        rec.dump(path)
+        trace = json.loads(open(path).read())
+        assert trace["otherData"]["spans_dropped"] == 7
+
+    def test_uid_events_get_per_request_tracks(self):
+        rec = FlightRecorder(capacity=16)
+        rec.event("req_admit", uid=3)
+        rec.phase("plan")
+        rec.phase("idle")
+        trace = rec.to_chrome_trace()
+        by_name = {ev["name"]: ev for ev in trace["traceEvents"]}
+        assert by_name["req_admit"]["tid"] == 4        # uid + 1
+        assert by_name["req_admit"]["args"]["uid"] == 3
+        assert by_name["plan"]["tid"] == 0             # engine lane
+
+    def test_serve_run_emits_request_lifecycle_spans(self):
+        """One request's admit -> queue -> prefill chunks -> first
+        token -> decode -> finish life must be reconstructable from the
+        engine's flight ring (uid-tagged spans, ISSUE 10)."""
+        eng = _engine()
+        toks = _serve(eng, _workload())
+        for u in list(toks):
+            eng.flush(u)
+        spans = eng.flight.spans
+        for uid in toks:
+            names = [s[0] for s in spans
+                     if s[4] and s[4].get("uid") == uid]
+            for expected in ("req_admit", "req_queue_wait",
+                             "req_prefill_chunk", "req_ttft",
+                             "req_decode", "req_finish"):
+                assert expected in names, (uid, expected, names)
+            fin = [s for s in spans if s[4]
+                   and s[4].get("uid") == uid
+                   and s[0] == "req_finish"]
+            assert fin[-1][4]["outcome"] == "completed"
+
+    def test_request_spans_disabled_by_knob(self, monkeypatch):
+        monkeypatch.setenv("DSTPU_FLIGHT_REQUESTS", "0")
+        eng = _engine()
+        toks = _serve(eng, _workload(), n=2)
+        for u in list(toks):
+            eng.flush(u)
+        assert not [s for s in eng.flight.spans
+                    if s[0].startswith("req_")]
+
+    def test_flight_drops_surface_as_registry_counter(self,
+                                                     monkeypatch):
+        monkeypatch.setenv("DSTPU_FLIGHT_CAPACITY", "6")
+        eng = _engine()
+        toks = _serve(eng, _workload())
+        for u in list(toks):
+            eng.flush(u)
+        eng._obs.sync_gauges()
+        assert eng.flight.dropped > 0
+        c = eng.metrics.snapshot()["counters"]
+        assert c["flight_spans_dropped"] == eng.flight.dropped
+
+
+class TestRollupHardening:
+    """Review-driven edge cases on the fleet rollup."""
+
+    def test_remerging_rollups_keeps_replica_sources(self):
+        """Hierarchical rollup (review-driven): merging two rollups —
+        or re-merging a rollup's snapshot — must preserve the ORIGINAL
+        per-replica gauge sources, not crash or collapse them."""
+        a = _replica("a", 1, [0.1])
+        b = _replica("b", 1, [0.2])
+        c = _replica("c", 1, [0.3])
+        fleet_ab = MetricsRegistry.merge([a, b], name="pool0")
+        fleet = MetricsRegistry.merge([fleet_ab, c], name="global")
+        g = fleet.snapshot()["gauges"]
+        for src in ("a", "b", "c"):
+            assert f'kv_pool_blocks_free{{source="{src}"}}' in g, g
+        assert fleet.snapshot()["counters"]["serve_steps"] == 3.0
+        # and the snapshot path, same property
+        snap = telemetry.merge_snapshots(
+            [fleet_ab.snapshot(), c.snapshot()], sources=["p0", "c"])
+        for src in ("a", "b", "c"):
+            assert f'kv_pool_blocks_free{{source="{src}"}}' \
+                in snap["gauges"]
+
+    def test_idle_replica_with_custom_alpha_merges(self):
+        """Review-driven: an idle replica's empty sketch (no buckets)
+        carries no lattice information — merging it with a populated
+        non-default-alpha sketch must stay exact, not raise
+        mixed-gamma, in both the object and snapshot paths."""
+        idle, busy = MetricsRegistry("i"), MetricsRegistry("b")
+        idle.histogram("serve_ttft_s", alpha=0.01)
+        hb = busy.histogram("serve_ttft_s", alpha=0.01)
+        for v in (0.1, 0.2, 0.4):
+            hb.observe(v)
+        m = MetricsRegistry.merge([idle, busy])
+        merged = m._metrics["serve_ttft_s"]
+        assert merged.count == 3
+        assert merged.quantile(0.99) == hb.quantile(0.99)
+        snap = telemetry.merge_snapshots(
+            [idle.snapshot(), busy.snapshot()])
+        assert snap["histograms"]["serve_ttft_s"]["count"] == 3
+        assert snap["histograms"]["serve_ttft_s"]["p99"] \
+            == hb.quantile(0.99)
+
+    def test_colliding_sources_suffix_not_overwrite(self):
+        """Two pools each holding a replica named 'a': the second 'a'
+        gauge is suffixed, never silently overwritten (both paths)."""
+        p0 = MetricsRegistry.merge([_replica("a", 1, [])], name="p0")
+        p1 = MetricsRegistry.merge([_replica("a", 4, [])], name="p1")
+        g = MetricsRegistry.merge([p0, p1]).snapshot()["gauges"]
+        assert g['kv_pool_blocks_free{source="a"}'] == 2
+        assert g['kv_pool_blocks_free{source="a#1"}'] == 8
+        snap = telemetry.merge_snapshots([p0.snapshot(), p1.snapshot()])
+        assert snap["gauges"]['kv_pool_blocks_free{source="a"}'] == 2
+        assert snap["gauges"]['kv_pool_blocks_free{source="a#1"}'] == 8
+
+    def test_short_sources_list_rejected(self):
+        with pytest.raises(ValueError):
+            telemetry.merge_snapshots(
+                [_replica("a", 1, []).snapshot(),
+                 _replica("b", 1, []).snapshot()], sources=["only-one"])

@@ -101,11 +101,19 @@ HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
     "deepspeed_tpu/telemetry/serve.py":
         ("on_admit", "on_sched", "on_token_commit", "on_plan",
          "on_dispatch", "on_commit_block", "on_retry", "on_reject",
-         "on_abort", "on_flush", "phase"),
+         "on_abort", "on_flush", "phase", "_req_span"),
     "deepspeed_tpu/telemetry/registry.py":
-        ("inc", "set", "observe", "quantile"),
+        ("inc", "set", "observe", "quantile", "sample",
+         "maybe_sample"),
     "deepspeed_tpu/telemetry/flight_recorder.py":
-        ("phase", "record"),
+        ("phase", "record", "event"),
+    # the open-loop loadgen's per-iteration driver brackets the engine's
+    # overlapped pipeline (admit due arrivals, run a short decode
+    # burst): a blocking host sync here would serialize the very hot
+    # path whose capacity the bench is measuring, and stall the arrival
+    # clock the open-loop invariant protects
+    "deepspeed_tpu/telemetry/loadgen.py":
+        ("_admit_due", "_decode_burst"),
 }
 
 #: roots scanned for DSTPU_* env reads (knob rules + gen_config_doc) —

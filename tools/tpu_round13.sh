@@ -1,0 +1,46 @@
+#!/bin/bash
+# Round-13 on-chip sequence: the capacity observatory (ISSUE 10). The
+# CPU story is proven in tier-1 (histogram-merge exactness, loadgen
+# seed determinism, the never-back-pressured arrival clock, the tiny
+# capacity smoke); on-chip this captures (a) lint cleanliness (DSL006
+# incl. flight_spans_dropped + the loadgen DSL001 registry + the
+# DSTPU_LOADGEN_*/DSTPU_CAP_*/DSTPU_SERIES_* knob table), (b) the REAL
+# goodput-vs-offered-load curve and knee on the 1.1B-shape model with
+# the paged/TP programs in the loop (serve_capacity), (c) a live
+# dstpu_top --watch render off the exported snapshot series (rates +
+# sparklines), and (d) the ported fastgen row for trajectory
+# comparability. Strictly sequential (one process owns the chip), no
+# timeouts around TPU clients (a killed client wedges the grant);
+# dstpu_top is a pure JSON reader, so backgrounding/killing IT is safe.
+cd /root/repo || exit 1
+LOG=profiles/r13_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round13 start $(date -u +%FT%TZ)"
+
+echo "--- [1/4] dstpu_lint (loadgen DSL001 registry, flight_spans_dropped"
+echo "    DSL006 row, capacity/series/loadgen knobs in docs/CONFIG.md)"
+python bin/dstpu_lint deepspeed_tpu
+
+echo "--- [2/4] serve_capacity: open-loop QPS sweep on the 1.1B-shape"
+echo "    model — goodput-vs-offered-load curve, bracketed knee, token"
+echo "    parity obs-on/off, 0 fresh compiles across the sweep; the"
+echo "    engine publishes snapshots (incl. sampled series) for [3/4]"
+EXPORT=profiles/serve_capacity_export_r13.json
+DSTPU_TELEMETRY_EXPORT=$EXPORT DSTPU_TELEMETRY_EXPORT_EVERY=16 \
+    python bench.py serve_capacity > BENCH_CAP_r13.json
+tail -c 1600 BENCH_CAP_r13.json
+
+echo "--- [3/4] dstpu_top: one-shot render (series sparklines) plus a"
+echo "    short --watch capture off the same export file"
+python bin/dstpu_top --file "$EXPORT"
+python bin/dstpu_top --file "$EXPORT" --watch 1 > profiles/r13_top_watch.txt &
+TOP_PID=$!
+sleep 5
+kill "$TOP_PID" 2>/dev/null
+tail -n 40 profiles/r13_top_watch.txt
+
+echo "--- [4/4] fastgen on the shared loadgen (row shape unchanged —"
+echo "    the r4/r5 TTFT/latency trajectory must stay comparable)"
+python bench.py fastgen > BENCH_FG_r13.json
+tail -c 900 BENCH_FG_r13.json
+echo "=== tpu_round13 done $(date -u +%FT%TZ)"
