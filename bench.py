@@ -1412,6 +1412,350 @@ def bench_serve_capacity():
     return 0 if ok else 1
 
 
+def bench_serve_fleet():
+    """Replica-pool fleet capacity (ISSUE 11): prove the routing policy
+    earns its keep and the fleet scales.
+
+    Two experiments on pools of tiny CPU-harness engines (the capacity
+    unit here is replica SLOTS — per-step cost is flat across the
+    shape-bucketed batch, so tokens/step scales with live sequences and
+    fleet capacity with replica count; on real chips each replica owns
+    its own device slice and the same gates run via tpu_round14.sh):
+
+      1. ROUTING — N replicas, a grouped shared-prefix workload with
+         more preamble groups than ONE replica's prefix-cache cap holds
+         (``prefix_cache_max_blocks``), offered at the same load under
+         ``prefix_aware`` vs ``random`` routing. Prefix-aware must beat
+         random on BOTH the fleet prefix-cache hit fraction (affinity
+         keeps each replica's group subset resident; random thrashes
+         the caps) and TTFT p99 (skipped prefill is freed service
+         time).
+      2. SCALING — ``sweep_capacity`` over a 1-replica and a 2-replica
+         pool (same per-replica config, same SLO deadline, round-robin
+         placement so the capacity axis is isolated from routing
+         skew): the goodput knee must move up ≥
+         ``DSTPU_FLEET_KNEE_MIN`` (1.6×).
+
+    Gates: routing wins both metrics, knee ratio met, and every request
+    of every pass completed or was accounted (offered == completed +
+    shed + deadline breakdown books balance)."""
+    import os
+
+    REPLICAS = int(os.environ.get("DSTPU_FLEET_REPLICAS", "2"))
+    # per-replica devices BEFORE the backend initializes: each replica's
+    # engine is pinned to its own host device (build_replica_engines),
+    # so replica steps execute concurrently — the in-process stand-in
+    # for disjoint TPU slices (on a real backend the devices are
+    # whatever the platform provides). Same shim the serve_overlap
+    # phase uses — it picks whichever API this jax supports.
+    from deepspeed_tpu.utils.jax_compat import request_cpu_devices
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        request_cpu_devices(max(2, REPLICAS))
+
+    # replica worker threads trade the GIL many times per decode round;
+    # the default 5 ms switch interval quantizes every handoff to the
+    # scheduler clock and turns overlap quality into a coin flip —
+    # sub-ms switching makes the measured scaling repeatable
+    sys.setswitchinterval(0.001)
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceConfig)
+    from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+    from deepspeed_tpu.serving import (ReplicaPool, build_replica_engines,
+                                       fleet_prefix_stats)
+    from deepspeed_tpu.telemetry.loadgen import (PoissonArrivals,
+                                                 WorkloadMix,
+                                                 build_requests,
+                                                 run_open_loop,
+                                                 sweep_capacity)
+
+    SEQS = int(os.environ.get("DSTPU_FLEET_SEQS", "4"))
+    GEN = int(os.environ.get("DSTPU_FLEET_GEN", "24"))
+    N_REQ = int(os.environ.get("DSTPU_FLEET_REQS", "48"))
+    GROUPS = int(os.environ.get("DSTPU_FLEET_GROUPS", "6"))
+    # burst = the full decode budget: one fused decode_batch program per
+    # request generation (pool-side bucketing), so host python per token
+    # stays negligible and replica device work overlaps cleanly
+    BURST = int(os.environ.get("DSTPU_FLEET_BURST", "24"))
+    slo_frac = float(os.environ.get("DSTPU_FLEET_SLO", "0.9"))
+    knee_min = float(os.environ.get("DSTPU_FLEET_KNEE_MIN", "1.6"))
+    bs = 16
+    # two workload shapes, one per experiment: the ROUTING pass wants a
+    # heavy shared preamble (6 blocks — a miss re-prefills 96 tokens,
+    # large enough that the policy's hit-rate edge clears scheduler
+    # noise in TTFT); the SCALING pass wants prefill to stay a sliver
+    # (prefill runs the per-step pipelined path whose host half cannot
+    # overlap across replicas — decode, which dominates this mix, runs
+    # the fused loop and scales)
+    ROUTE_PROMPT, ROUTE_PREFIX = 112, 96
+    KNEE_PROMPT, KNEE_PREFIX = 48, 32
+
+    # decode-heavy shape: per-step device work large enough that the
+    # replicas' concurrent decode overlaps (the scaling axis), prefill
+    # small enough that the serialized admission path stays a sliver
+    mcfg = GPT2Config(vocab_size=256, max_seq_len=256, num_layers=8,
+                      num_heads=4, hidden_size=128, dtype=jnp.float32)
+    params0 = GPT2(mcfg).init(jax.random.PRNGKey(0),
+                              jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def engine(dev, cache_cap, prompt_len, seqs=None, gen=None):
+        params = jax.device_put(params0, dev)
+        per_seq = -(-(prompt_len + (gen or GEN) + 2) // bs)
+        cfg = RaggedInferenceConfig(
+            max_seqs=seqs or SEQS, chunk_size=bs, block_size=bs,
+            num_blocks=(seqs or SEQS) * per_seq + cache_cap + 2,
+            max_blocks_per_seq=per_seq + 1, dtype="float32",
+            attention_impl="dense", decode_loop_steps=0,
+            serve_pipeline_depth=2, prefix_cache=True,
+            prefix_cache_max_blocks=cache_cap)
+        return InferenceEngineV2(mcfg, params, cfg)
+
+    def pool_of(n, policy, cache_cap, prompt_len, seqs=None, gen=None):
+        engines = build_replica_engines(
+            lambda i, dev: engine(dev, cache_cap, prompt_len, seqs,
+                                  gen), n)
+        return ReplicaPool(engines, policy=policy, seed=0)
+
+    def mix(prompt_len, prefix_len, deadline_s=0.0, gen=None):
+        return WorkloadMix(
+            prompt_lens=(prompt_len,), prompt_probs=(1.0,),
+            gen_lens=(gen or GEN,), gen_probs=(1.0,),
+            shared_prefix_frac=1.0, shared_prefix_len=prefix_len,
+            prefix_group_count=GROUPS,
+            deadline_frac=1.0 if deadline_s else 0.0,
+            deadline_s=deadline_s, vocab_size=mcfg.vocab_size)
+
+    def one_pass(pool, rate, n, seed, uid_base, m, burst=None):
+        reqs = build_requests(PoissonArrivals(rate, seed=seed), m, n,
+                              seed=seed, uid_base=uid_base)
+        slots = sum(r.engine.config.max_seqs for r in pool.replicas()
+                    if r.state != "dead") or SEQS
+        return run_open_loop(pool, reqs,
+                             decode_burst=burst if burst else BURST,
+                             max_live=slots)
+
+    # ---- experiment 1: routing policy at matched offered load -------- #
+    # one replica's prefix cap holds HALF the groups' preambles: with
+    # affinity each replica's subset stays resident; random makes every
+    # replica see every group and thrash the cap
+    cache_cap = (GROUPS * (ROUTE_PREFIX // bs)) // 2
+    route_mix = mix(ROUTE_PROMPT, ROUTE_PREFIX)
+    # calibrate the fleet's saturated completion rate once (shared by
+    # both policies so they face the SAME offered stream); the first
+    # pass eats every compile, the second measures the warm ceiling
+    ROUTE_SEQS = 2 * SEQS
+    cal_pool = pool_of(REPLICAS, "round_robin", cache_cap, ROUTE_PROMPT,
+                       seqs=ROUTE_SEQS)
+    one_pass(cal_pool, 1e4, min(N_REQ, 16), 10, 10_000_000, route_mix)
+    cal = one_pass(cal_pool, 1e4, min(N_REQ, 24), 11, 11_000_000,
+                   route_mix).report
+    fleet_rps = cal["rates_rps"]["completed"] or 1.0
+    # 0.6x the saturated ceiling with short bursts: loaded enough that
+    # extra prefill work shows up in TTFT, gentle enough that the tail
+    # measures SERVICE time (the routing signal) rather than
+    # load-vs-capacity resonance at the admission door
+    route_rate = round(0.6 * fleet_rps, 3)
+    route_burst = min(8, BURST)
+
+    def measure_routing(attempt):
+        routing = {}
+        for policy in ("random", "prefix_aware"):
+            pool = pool_of(REPLICAS, policy, cache_cap, ROUTE_PROMPT,
+                           seqs=ROUTE_SEQS)
+            # warm pass: compiles + first-touch of every preamble, then
+            # 3 measured passes against a steady-state fleet — the
+            # headline per policy is the MEDIAN (a p99 over ~50
+            # requests is one worst-request sample; a single scheduler
+            # blip must not decide the comparison either way)
+            one_pass(pool, route_rate, min(N_REQ, 16),
+                     21 + 10 * attempt, (21 + 10 * attempt) * 1_000_000,
+                     route_mix, burst=route_burst)
+            p99s, p50s, hits, completed = [], [], [], []
+            st0 = fleet_prefix_stats(pool)   # baseline AFTER warm pass
+            prev = [st0["matched_tokens"], st0["prefill_tokens"]]
+            for seed in (23, 24, 25):
+                seed += 10 * attempt
+                res = one_pass(pool, route_rate, N_REQ,
+                               seed, seed * 1_000_000, route_mix,
+                               burst=route_burst)
+                st = fleet_prefix_stats(pool)
+                # per-pass hit fraction from this pass's counter deltas
+                d_hit = st["matched_tokens"] - prev[0]
+                d_ran = st["prefill_tokens"] - prev[1]
+                prev = [st["matched_tokens"], st["prefill_tokens"]]
+                hits.append(d_hit / (d_hit + d_ran)
+                            if d_hit + d_ran else 0)
+                rep = res.report
+                completed.append(rep["requests"]["completed"])
+                p50s.append(rep["latency"]["ttft_s"].get("p50"))
+                p99s.append(rep["latency"]["ttft_s"].get("p99"))
+            routing[policy] = {
+                "offered_rps": route_rate,
+                "completed": completed,
+                "hit_frac": round(sorted(hits)[1], 4),
+                "ttft_ms_p50": _ms_b(sorted(p50s)[1]),
+                "ttft_ms_p99": _ms_b(sorted(p99s)[1]),
+                "ttft_ms_p99_passes": [_ms_b(v) for v in p99s],
+                "router": pool.router.describe(),
+            }
+        pa, rnd = routing["prefix_aware"], routing["random"]
+        ok = (pa["hit_frac"] > rnd["hit_frac"]
+              and pa["ttft_ms_p99"] is not None
+              and rnd["ttft_ms_p99"] is not None
+              and pa["ttft_ms_p99"] <= rnd["ttft_ms_p99"]
+              and all(c == N_REQ for c in pa["completed"]))
+        return routing, ok
+
+    # one re-measure attempt on a contended box (the serve_obs
+    # discipline, same as the knee sweep below): a real routing
+    # regression fails BOTH fresh-fleet comparisons
+    routing, routing_ok = measure_routing(0)
+    routing_re_measured = False
+    if not routing_ok:
+        routing_re_measured = True
+        routing, routing_ok = measure_routing(1)
+
+    # ---- experiment 2: knee vs replica count ------------------------- #
+    # ample caches here — scaling isolates the slot-capacity axis
+    knee_cap = GROUPS * (KNEE_PREFIX // bs) + 2
+    # geometric grid, step ~1.22: fine enough that one noisy notch in
+    # either pool's located knee cannot push a true ~2x ratio below the
+    # 1.6x gate; the top rates exist to BRACKET (some rate must
+    # violate, or the knee is a fiction of a too-short sweep)
+    fracs = [float(f) for f in os.environ.get(
+        "DSTPU_FLEET_FRACS",
+        "0.55,0.82,1.0,1.22,1.49,1.82,2.22,2.71").split(",") if f]
+    KNEE_GEN = int(os.environ.get("DSTPU_FLEET_KNEE_GEN", "32"))
+    knee_mix = mix(KNEE_PROMPT, KNEE_PREFIX, gen=KNEE_GEN)
+
+    def measure_knees(attempt):
+        knees = {}
+        deadline_s = 0.0
+        base = 50 + 100 * attempt
+        for n_rep in (1, 2):
+            pool = pool_of(n_rep, "round_robin", knee_cap, KNEE_PROMPT,
+                           gen=KNEE_GEN)
+            # per-pool calibration: a warmup pass eats the compiles,
+            # then a saturating pass measures the warm ceiling
+            one_pass(pool, 1e4, min(N_REQ, 16), base - 20 + n_rep,
+                     (base - 22 + n_rep) * 1_000_000, knee_mix,
+                     burst=KNEE_GEN)
+            cal = one_pass(pool, 1e4, min(N_REQ, 24), base - 19 + n_rep,
+                           (base - 20 + n_rep) * 1_000_000,
+                           knee_mix, burst=KNEE_GEN).report
+            cap_rps = cal["rates_rps"]["completed"] or 1.0
+            if not deadline_s:
+                # one SLO for every pool, from the 1-replica light pass
+                # — 2x the light-load completion latency (TTFT p99 + a
+                # full decode budget at the unloaded step cadence),
+                # FLOORED well above per-request service time: the knee
+                # must bind on BACKLOG (offered load vs capacity — the
+                # axis replica count scales), not on tail service
+                # latency, whose run-to-run noise flips the regime
+                light = one_pass(pool, 0.4 * cap_rps, min(N_REQ, 24),
+                                 base - 9, (base - 9) * 1_000_000,
+                                 knee_mix, burst=KNEE_GEN).report
+                l99 = (light["latency"]["ttft_s"].get("p99") or 0.1) \
+                    + KNEE_GEN * (light["decode"]["step_lat"].get("p50")
+                                  or 0.01)
+                deadline_s = float(
+                    os.environ.get("DSTPU_FLEET_DEADLINE_S", "0")) \
+                    or max(0.3, 2.0 * l99)
+            # enough requests per rate that an over-capacity rate
+            # builds a backlog worth SEVERAL deadlines — with too few,
+            # every swept rate finishes inside the deadline and the
+            # curve lies flat (the serve_capacity bracketing lesson)
+            n_knee = max(N_REQ, int(8.0 * deadline_s * cap_rps) + 1)
+            rates = [round(f * cap_rps, 3) for f in fracs]
+            sweep = sweep_capacity(
+                pool, rates, n_knee, mix(KNEE_PROMPT, KNEE_PREFIX,
+                                         deadline_s, gen=KNEE_GEN),
+                seed=base + n_rep, goodput_slo_frac=slo_frac,
+                decode_burst=KNEE_GEN, max_live=SEQS * n_rep)
+            # monotone-envelope knee: the last rate before the SLO
+            # violations become PERSISTENT — two consecutive violating
+            # rates, or a violation at the end of the grid (one
+            # isolated mid-curve blip is measurement noise, forgiven;
+            # a lucky goodput recovery past a persistent violation is
+            # noise too, not recovered capacity). Only when bracketed.
+            knee = None
+            bracketed = False
+            curve = sweep["curve"]
+            for i, row in enumerate(curve):
+                gf = row["goodput_frac"]
+                violated = gf is not None and gf < slo_frac
+                if violated:
+                    nxt = curve[i + 1]["goodput_frac"] \
+                        if i + 1 < len(curve) else None
+                    if nxt is None or nxt < slo_frac:
+                        bracketed = True
+                        break
+                    continue          # isolated blip: forgiven
+                knee = row
+            knees[n_rep] = {
+                "capacity_rps": round(cap_rps, 3),
+                "n_per_rate": n_knee,
+                "knee_rps": knee["offered_rps"]
+                if knee is not None and bracketed else None,
+                "knee_goodput_rps": knee["goodput_rps"]
+                if knee is not None and bracketed else None,
+                "knee_bracketed": bracketed,
+                "curve": sweep["curve"],
+            }
+        r1, r2 = knees[1]["knee_rps"], knees[2]["knee_rps"]
+        return knees, (round(r2 / r1, 3) if r1 and r2 else None), \
+            deadline_s
+
+    # one re-measure attempt on a contended box (the serve_obs
+    # discipline): a box-noise dip must not read as a scaling
+    # regression — a genuine regression fails BOTH fresh-pool attempts
+    knees, knee_ratio, deadline_s = measure_knees(0)
+    re_measured = False
+    if knee_ratio is None or knee_ratio < knee_min:
+        re_measured = True
+        knees2, ratio2, deadline2 = measure_knees(1)
+        if ratio2 is not None and (knee_ratio is None
+                                   or ratio2 > knee_ratio):
+            knees, knee_ratio, deadline_s = knees2, ratio2, deadline2
+    k1, k2 = knees[1]["knee_rps"], knees[2]["knee_rps"]
+    knee_ok = knee_ratio is not None and knee_ratio >= knee_min
+
+    row = {
+        "model": f"gpt2 {mcfg.num_layers}L hidden={mcfg.hidden_size} "
+                 f"(CPU-harness synthetic)",
+        "replicas": REPLICAS,
+        "routing": routing,
+        "routing_ok": routing_ok,
+        "routing_re_measured": routing_re_measured,
+        "slo_deadline_s": round(deadline_s, 4),
+        "knee_1_replica_rps": k1,
+        "knee_2_replica_rps": k2,
+        "knee_ratio": knee_ratio,
+        "knee_min": knee_min,
+        "knee_ok": knee_ok,
+        "knee_re_measured": re_measured,
+        "knees": knees,
+        "serve_config": {
+            "DSTPU_FLEET_SEQS": SEQS, "DSTPU_FLEET_GEN": GEN,
+            "DSTPU_FLEET_REQS": N_REQ, "DSTPU_FLEET_GROUPS": GROUPS,
+            "DSTPU_FLEET_BURST": BURST,
+            "DSTPU_FLEET_REPLICAS": REPLICAS,
+            "DSTPU_FLEET_SLO": slo_frac,
+            "DSTPU_FLEET_KNEE_MIN": knee_min,
+            "DSTPU_FLEET_FRACS": ",".join(str(f) for f in fracs),
+        },
+    }
+    print(json.dumps(row))
+    return 0 if routing_ok and knee_ok else 1
+
+
+def _ms_b(v):
+    return round(1e3 * v, 3) if v is not None else None
+
+
 def _moe_param_counts(shapes, num_experts: int, top_k: int):
     """(total, active) param counts from a Mixtral param tree: expert
     leaves carry a leading E axis under a 'moe' subtree; only k/E of each
@@ -1789,6 +2133,8 @@ def main():
         return bench_serve_obs()
     if sys.argv[1:] == ["serve_capacity"]:
         return bench_serve_capacity()
+    if sys.argv[1:] == ["serve_fleet"]:
+        return bench_serve_fleet()
     if sys.argv[1:] == ["fastgen"]:
         return bench_serve_fastgen()
     if sys.argv[1:] == ["moe"]:
@@ -1829,7 +2175,7 @@ def main():
     for phase in ("train", "train_xl", "train_1p3b", "serve",
                   "serve_pipeline", "serve_prefix", "serve_drill",
                   "serve_overlap", "serve_obs", "serve_capacity",
-                  "fastgen", "moe", "moe_train"):
+                  "serve_fleet", "fastgen", "moe", "moe_train"):
         if dead:
             out[phase] = {"error": "skipped_backend_dead"}
             continue
@@ -1901,6 +2247,7 @@ def main():
                    "serve_overlap": out.get("serve_overlap", {}),
                    "serve_obs": out.get("serve_obs", {}),
                    "serve_capacity": out.get("serve_capacity", {}),
+                   "serve_fleet": out.get("serve_fleet", {}),
                    "fastgen": out.get("fastgen", {}),
                    "moe_serve": out.get("moe", {}),
                    "moe_train": out.get("moe_train", {}),

@@ -61,6 +61,15 @@ SERVE_DRILL_TOKENS = 8
 #: the cooperative-drain pseudo-site (a real SIGTERM, not an injector)
 SIGTERM_SITE = "sigterm"
 
+#: fleet drill shape (``--mode fleet``): replicas, shared-prefix groups,
+#: requests offered before/after the kill, tokens served per uid
+FLEET_REPLICAS = 3
+FLEET_GROUPS = 2
+FLEET_REQS = 6
+FLEET_LATE_REQS = 2
+FLEET_TOKENS = 8
+FLEET_SITE = "fleet_sigterm"
+
 
 def _worker() -> int:
     """The drill's training worker (run in a subprocess; configured by
@@ -237,6 +246,225 @@ def _serve_worker() -> int:
         with open(os.environ["DRILL_ORACLE_FILE"], "w") as f:
             json.dump({str(u): t for u, t in toks.items()}, f)
     return 0
+
+
+def _fleet_worker() -> int:
+    """The fleet drill's worker (subprocess; configured by env): a
+    replica POOL under offered load loses one member to a real SIGTERM
+    mid-decode and must come out token-identical.
+
+    One process plays the whole drill — the in-process pool is the
+    single-host fleet shape, and a process-wide SIGTERM mapped to one
+    replica's PreemptionHandler is exactly what a per-host preemption
+    looks like from inside that host:
+
+      1. ORACLE: a kill-free pool of FLEET_REPLICAS tiny engines serves
+         FLEET_REQS shared-prefix requests (FLEET_GROUPS preambles) plus
+         FLEET_LATE_REQS unique late arrivals; records {uid: tokens}.
+      2. DRILL: a fresh identical pool serves the same workload; at the
+         kill round the BUSIEST replica gets a PreemptionHandler and the
+         worker SIGTERMs itself. The pool absorbs the drain — survivors
+         replay the manifest with their warm prefix caches — then a
+         LATE JOINER registers and the late requests are admitted.
+      3. GATES (written to DRILL_RESULT_FILE): token parity for every
+         request vs the oracle; ``pool.fully_recovered`` on the victim's
+         manifest; the merged survivor rollup's TTFT quantiles EXACTLY
+         equal to a single-stream histogram of the driver-observed TTFT
+         values (the fleet-rollup exactness oracle, end-to-end through
+         real engines); merged admitted == sum of per-replica admitted;
+         the joiner took traffic; ledger carries the fleet events.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import signal
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..inference.v2 import InferenceEngineV2, RaggedInferenceConfig
+    from ..models.gpt2 import GPT2, GPT2Config
+    from ..serving import ReplicaPool, single_stream_oracle
+    from ..telemetry.registry import Histogram, merge_snapshots
+    from .ledger import RestartLedger
+    from .preemption import PreemptionHandler
+
+    n_tok = FLEET_TOKENS
+    mcfg = GPT2Config(vocab_size=96, max_seq_len=256, num_layers=2,
+                      num_heads=2, hidden_size=32, dtype=jnp.float32)
+    params = GPT2(mcfg).init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def engine():
+        cfg = RaggedInferenceConfig(
+            max_seqs=4, chunk_size=8, block_size=4, num_blocks=64,
+            max_blocks_per_seq=32, dtype="float32",
+            attention_impl="dense", decode_loop_steps=0,
+            serve_pipeline_depth=2, prefix_cache=True)
+        return InferenceEngineV2(mcfg, params, cfg)
+
+    # workload: FLEET_GROUPS shared 12-token preambles (3 full blocks
+    # each — the replay lands on a survivor whose cache already holds
+    # them) + unique tails; the late arrivals are unique-prompt (the
+    # traffic a cold joiner wins on the queue term)
+    rng = np.random.default_rng(77)
+    prefixes = [rng.integers(1, 96, 12).tolist()
+                for _ in range(FLEET_GROUPS)]
+    prompts = {u: prefixes[u % FLEET_GROUPS]
+               + rng.integers(1, 96, 5).tolist()
+               for u in range(FLEET_REQS)}
+    late = {100 + i: rng.integers(1, 96, 9).tolist()
+            for i in range(FLEET_LATE_REQS)}
+
+    def drive(pool, kill_round=None, joiner=False):
+        toks = {}
+        ttft = {}
+
+        def admit(batch):
+            out = pool.put(list(batch), [batch[u] for u in batch],
+                           _greedy=True)
+            for u in batch:
+                if u in out:
+                    toks[u] = [int(out[u])]
+
+        def finish(u):
+            seq = pool.state.get(u)
+            if seq is not None and seq.first_token_at is not None \
+                    and seq.admitted_at is not None:
+                rep = pool.owner_of(u)
+                ttft[u] = (seq.first_token_at - seq.admitted_at,
+                           rep.replica_id if rep is not None else None)
+            pool.flush(u)
+
+        admit(prompts)
+        rounds = 0
+        victim = None
+        while True:
+            live = [u for u in toks if len(toks[u]) < n_tok
+                    and u in pool.state.sequences]
+            if not live and len(toks) == len(prompts) + len(late):
+                break
+            if rounds == kill_round:
+                # the busiest replica takes the preemption: a real
+                # process-level SIGTERM routed to ITS handler alone —
+                # the single-process stand-in for a per-host signal
+                busy = {}
+                for u in live:
+                    rep = pool.owner_of(u)
+                    if rep is not None:
+                        busy[rep.replica_id] = \
+                            busy.get(rep.replica_id, 0) + 1
+                vid = max(busy, key=busy.get)
+                victim = pool.replica(vid)
+                victim.engine.attach_preemption(PreemptionHandler())
+                os.kill(os.getpid(), signal.SIGTERM)
+            if live:
+                outs = pool.decode_pipelined(
+                    live, [toks[u][-1] for u in live], 2)
+                for u in live:
+                    toks[u].extend(outs[u][:n_tok - len(toks[u])])
+            if rounds == kill_round and joiner:
+                pool.add_replica(engine(), replica_id="joiner")
+            if rounds == (kill_round if kill_round is not None else 1) \
+                    and len(toks) == len(prompts):
+                admit(late)          # offered load continues post-kill
+            for u in list(toks):
+                if len(toks[u]) >= n_tok and u in pool.state.sequences:
+                    finish(u)
+            rounds += 1
+        for u in list(toks):
+            if pool.state.get(u) is not None:
+                finish(u)
+        return toks, ttft, victim
+
+    oracle_pool = ReplicaPool([engine() for _ in range(FLEET_REPLICAS)],
+                              policy="prefix_aware", seed=0)
+    oracle, _, _ = drive(oracle_pool)
+
+    ledger = RestartLedger(os.environ.get("DRILL_FLEET_LEDGER"))
+    pool = ReplicaPool([engine() for _ in range(FLEET_REPLICAS)],
+                       policy="prefix_aware", seed=0, ledger=ledger)
+    toks, ttft, victim = drive(pool, kill_round=1, joiner=True)
+
+    result = {
+        "replicas": FLEET_REPLICAS,
+        "fault_fired": victim is not None and victim.state == "dead",
+        "victim": victim.replica_id if victim is not None else None,
+        "manifested": len(victim.manifest["sequences"])
+        if victim is not None and victim.manifest else 0,
+        "pool_recovered": bool(
+            victim.manifest["pool"]["fully_recovered"])
+        if victim is not None and victim.manifest else False,
+        "token_parity": toks == oracle and len(toks) == len(oracle),
+        "joiner_requests": sum(
+            1 for _u, (_t, rid) in ttft.items() if rid == "joiner"),
+    }
+    # fleet-rollup exactness: the merged survivors' TTFT histogram must
+    # equal a single-stream sketch of the driver-observed TTFT values —
+    # same observations through two paths (per-engine registries ->
+    # export-shaped states -> exact merge vs one raw-value stream)
+    survivors = [r for r in pool.replicas() if r.state == "serving"]
+    snaps = [r.engine.metrics.snapshot() for r in survivors]
+    merged = merge_snapshots(snaps, sources=[r.replica_id
+                                             for r in survivors])
+    surv_ids = {r.replica_id for r in survivors}
+    values = [t for t, rid in ttft.values() if rid in surv_ids]
+    single = single_stream_oracle(values)
+    mstate = merged["histograms"].get("serve_ttft_s", {})
+    mhist = Histogram.from_state(mstate)
+    result["rollup_count_exact"] = mhist.count == single.count
+    result["rollup_quantiles_exact"] = all(
+        mhist.quantile(q) == single.quantile(q)
+        for q in (0.5, 0.9, 0.99))
+    result["rollup_admitted_exact"] = (
+        merged["counters"].get("serve_requests_admitted", 0)
+        == sum(s["counters"].get("serve_requests_admitted", 0)
+               for s in snaps))
+    events = {e["event"] for e in ledger.events}
+    result["ledger_events"] = sorted(events)
+    result["ledger_ok"] = {"fleet_drain", "fleet_replay",
+                           "fleet_join"} <= events
+    with open(os.environ["DRILL_RESULT_FILE"], "w") as f:
+        json.dump(result, f)
+    ok = (result["fault_fired"] and result["token_parity"]
+          and result["pool_recovered"] and result["manifested"] > 0
+          and result["rollup_count_exact"]
+          and result["rollup_quantiles_exact"]
+          and result["rollup_admitted_exact"]
+          and result["joiner_requests"] >= 1 and result["ledger_ok"])
+    return 0 if ok else 1
+
+
+def drill_fleet(workdir: str, verbose: bool = True) -> dict:
+    """Kill-one-of-N drill for the replica pool: SIGTERM the busiest
+    replica mid-decode under offered load, gate on token-identical
+    replay on the survivors, exact pool recovery on the victim, an
+    exactly-merged fleet rollup, and a late joiner taking traffic."""
+    site_dir = os.path.join(workdir, "fleet")
+    os.makedirs(site_dir, exist_ok=True)
+    result_file = os.path.join(site_dir, "result.json")
+    env = _serve_env(site_dir, "fleet",
+                     DRILL_RESULT_FILE=result_file,
+                     DRILL_FLEET_LEDGER=os.path.join(site_dir,
+                                                     "ledger.json"))
+    env.pop("DSTPU_RESTART_LEDGER", None)
+    rc = _run_worker(env, fn="_fleet_worker")
+    result = {"site": FLEET_SITE, "mode": "fleet", "worker_rc": rc}
+    if os.path.exists(result_file):
+        with open(result_file) as f:
+            result.update(json.load(f))
+    result["recovered"] = (
+        rc == 0 and result.get("fault_fired") is True
+        and result.get("token_parity") is True
+        and result.get("pool_recovered") is True)
+    if verbose:
+        print(f"[faultdrill:fleet] rc={rc} "
+              f"victim={result.get('victim')} "
+              f"manifested={result.get('manifested')} "
+              f"parity={result.get('token_parity')} "
+              f"rollup_exact={result.get('rollup_quantiles_exact')} "
+              f"joiner={result.get('joiner_requests')} "
+              f"recovered={result['recovered']}", file=sys.stderr)
+    return result
 
 
 def _run_worker(env: dict, fn: str = "_worker") -> int:
@@ -450,10 +678,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "fault-injection site and verify recovery (exit "
                     "non-zero on any unrecovered failure)")
     ap.add_argument("--mode", default="train",
-                    choices=("train", "serve", "all"),
+                    choices=("train", "serve", "fleet", "all"),
                     help="train: checkpoint-recovery drill (PR 1 sites); "
                          "serve: drain/replay drill (serve sites + "
-                         "sigterm); all: both")
+                         "sigterm); fleet: kill-one-of-N replica-pool "
+                         "drill (SIGTERM under offered load, survivor "
+                         "replay + rollup exactness); all: every mode")
     ap.add_argument("--sites", default=None,
                     help="comma-separated site subset (default: every "
                          "site of the selected mode)")
@@ -464,7 +694,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     serve_sites = list(SERVE_FAULT_SITES) + [SIGTERM_SITE]
     if args.sites:
         sites = [s for s in args.sites.split(",") if s]
-        valid = set(FAULT_SITES) | {SIGTERM_SITE}
+        valid = set(FAULT_SITES) | {SIGTERM_SITE, FLEET_SITE}
         unknown = set(sites) - valid
         if unknown:
             ap.error(f"unknown sites {sorted(unknown)}; valid: "
@@ -473,11 +703,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         sites = list(TRAIN_FAULT_SITES)
     elif args.mode == "serve":
         sites = serve_sites
+    elif args.mode == "fleet":
+        sites = [FLEET_SITE]
     else:
-        sites = list(TRAIN_FAULT_SITES) + serve_sites
+        sites = list(TRAIN_FAULT_SITES) + serve_sites + [FLEET_SITE]
     workdir = args.workdir or tempfile.mkdtemp(prefix="dstpu_faultdrill_")
 
-    results = [drill_serve_site(site, workdir)
+    results = [drill_fleet(workdir) if site == FLEET_SITE
+               else drill_serve_site(site, workdir)
                if site in serve_sites else drill_site(site, workdir)
                for site in sites]
     ok = all(r["recovered"] for r in results)

@@ -1,0 +1,24 @@
+"""Serving fleet — replica pool, prefix-aware router, fleet rollup.
+
+The layer above a single ``InferenceEngineV2`` (docs/serving.md
+"Replica pool"): a :class:`ReplicaPool` owns N engine replicas over
+disjoint device sets behind one engine-shaped surface, a
+:class:`Router` places each request by cached-prefix overlap / queue
+depth / SLO headroom (``random`` and ``round_robin`` as controls), and
+elastic membership drains preempted replicas through the PR 7 manifest
+onto survivors whose warm prefix caches absorb the re-prefill. Fleet
+telemetry rolls up through the exact histogram merge with stable
+``source=<replica id>`` labels.
+"""
+
+from .pool import (Replica, ReplicaPool, build_replica_engines,
+                   fleet_prefix_stats, single_stream_oracle,
+                   slo_report_from_registry)
+from .router import ROUTING_POLICIES, NoServingReplicaError, Router
+
+__all__ = [
+    "NoServingReplicaError", "ROUTING_POLICIES", "Replica",
+    "ReplicaPool", "Router", "build_replica_engines",
+    "fleet_prefix_stats", "single_stream_oracle",
+    "slo_report_from_registry",
+]

@@ -1,0 +1,687 @@
+"""Replica pool — the serving layer above ``InferenceEngineV2``.
+
+One :class:`ReplicaPool` owns N v2 ragged engines over disjoint device
+sets and presents ONE engine-shaped serving surface (``put`` /
+``decode_pipelined`` / ``flush`` / ``state`` / ``rejections`` /
+``slo_report``), so every driver written against a single engine — the
+open-loop loadgen (:func:`~deepspeed_tpu.telemetry.loadgen.run_open_loop`
+and its capacity sweep), the fault drills, the benches — drives a whole
+fleet unchanged. This is the DeepSpeed-MII/FastGen deployment shape
+(PAPER.md: a load-balanced pool of engine replicas behind one endpoint)
+composed from pieces earlier PRs built:
+
+  * **Routing** (:mod:`.router`): each fresh request is placed by a
+    pluggable policy; ``prefix_aware`` scores replicas by cached-prefix
+    overlap (PR 5 chain keys), queue depth and SLO headroom (PR 8
+    per-engine registries).
+  * **Elastic membership**: a preempted replica (SIGTERM →
+    ``PreemptionHandler`` → ``engine.draining``) is absorbed
+    transparently — the pool drains it through the PR 7 manifest,
+    routes every manifested sequence onto survivors (whose warm prefix
+    caches eat most of the re-prefill), and splices the survivors'
+    replay tokens into the caller's streams so they stay gapless and
+    token-identical. Late joiners ``add_replica`` and start taking
+    traffic on the next routing decision.
+  * **Fleet rollup**: per-replica registries merge into one fleet
+    snapshot through the exact PR 9 histogram merge, with ``source``
+    labels keyed by STABLE replica ids (each replica's registry is
+    renamed to its id at registration), so repeated rollups of the same
+    fleet are idempotent. The cross-process path is unchanged: each
+    replica process exports its snapshot file and
+    ``telemetry.merge_snapshots`` (or ``bin/dstpu_top file1 file2`` /
+    a glob) rolls them up without shared memory.
+
+Deployment shapes (docs/serving.md "Replica pool"):
+
+  * **in-process** (this module's direct mode, the CPU-harness and
+    single-host path): N engines in one process, each built over its
+    own device subset (the ``data`` mesh axis position); the pool
+    dispatches to them sequentially from the host thread.
+  * **multi-host**: one engine per process; the pool abstraction runs
+    degenerate (N=1) in each process and the FLEET view exists only in
+    telemetry — snapshot files rolled up via ``merge_snapshots``.
+
+Everything here is host-side bookkeeping (dict lookups, list grouping)
+around the engines' own overlapped pipelines; the pool's ``put`` /
+``decode_pipelined`` and the replica scoring accessors are dslint
+DSL001-registered — a blocking device sync in the dispatch path would
+serialize every replica behind one readback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..inference.v2.blocked_allocator import OutOfBlocksError
+from ..telemetry.registry import Histogram, MetricsRegistry
+from ..telemetry.serve import slo_report_from_registry
+from .router import NoServingReplicaError, Router
+
+#: replica lifecycle states (docs/serving.md "Membership protocol")
+REPLICA_SERVING = "serving"
+REPLICA_DRAINING = "draining"
+REPLICA_DEAD = "dead"
+
+
+class Replica:
+    """One pool member: an ``InferenceEngineV2`` plus its fleet
+    identity and lifecycle state. The scoring accessors below are the
+    router's only view of the engine — all pure host reads
+    (DSL001-registered)."""
+
+    __slots__ = ("replica_id", "engine", "state", "joined_at", "manifest",
+                 "pending_routed")
+
+    def __init__(self, replica_id: str, engine):
+        self.replica_id = replica_id
+        self.engine = engine
+        self.state = REPLICA_SERVING
+        self.joined_at = time.time()
+        #: requests routed here in the CURRENT admission batch but not
+        #: yet admitted by the engine — counted into :meth:`queue_frac`
+        #: so consecutive placements in one batch see each other (a
+        #: burst of arrivals must spread by the post-batch load, not
+        #: all score the same stale pre-batch state and pile onto one
+        #: replica past its slots)
+        self.pending_routed = 0
+        #: the drain manifest once this replica died (None while alive);
+        #: ``manifest["pool"]["fully_recovered"]`` is the leak oracle the
+        #: fleet drill asserts on
+        self.manifest: Optional[Dict[str, Any]] = None
+        m = engine.metrics
+        if m is not None:
+            # stable rollup identity: the engine's registry takes the
+            # replica id as its name, so fleet merges label gauges
+            # source=<replica id> (idempotent across repeated rollups)
+            # and the engine's own snapshot exports self-identify
+            m.name = replica_id
+
+    @property
+    def available(self) -> bool:
+        """Routable: serving and not already unwinding toward a drain
+        (the engine's drain flag flips on SIGTERM before the pool hears
+        about it — the router must see it immediately)."""
+        return self.state == REPLICA_SERVING and not self.engine.draining
+
+    # ------------- routing signals (host-only, DSL001) ---------------- #
+
+    def prefix_overlap(self, tokens: Sequence[int]) -> int:
+        """Prompt tokens this replica's prefix cache would serve from
+        already-written KV blocks: full matched chain blocks plus the
+        copy-on-write tail span. A pure (side-effect-free) trie walk —
+        ``PrefixCache.match`` neither acquires nor stats-bumps."""
+        pc = self.engine._prefix
+        if pc is None:
+            return 0
+        entries, _cow, cow_len = pc.match(tokens)
+        return len(entries) * pc.block_size + cow_len
+
+    def queue_frac(self) -> float:
+        """(Live + batch-routed) sequences over slots — the load half
+        of the routing score (can exceed 1.0 when the engine
+        oversubscribes its pool with paused/queued sequences, which is
+        exactly when the replica should repel traffic)."""
+        ms = self.engine.config.max_seqs
+        if not ms:
+            return 0.0
+        return (len(self.engine.state.sequences)
+                + self.pending_routed) / ms
+
+    def slo_headroom(self, slo_ttft_s: float) -> float:
+        """1 − (this replica's TTFT p99 / the fleet target), clamped to
+        [−1, 1]: positive while the replica meets its SLO, negative once
+        it violates. Neutral (1.0) with telemetry off or before any
+        request completed."""
+        m = self.engine.metrics
+        if m is None or not m.enabled:
+            return 1.0
+        p99 = m.histogram("serve_ttft_s").quantile(0.99)
+        if p99 is None:
+            return 1.0
+        h = 1.0 - p99 / slo_ttft_s
+        return h if h > -1.0 else -1.0
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "state": self.state,
+            "live_sequences": len(self.engine.state.sequences),
+            "queue_frac": round(self.queue_frac(), 4),
+            "free_blocks": self.engine.kv_cache.free_blocks,
+            "draining": bool(self.engine.draining),
+        }
+
+
+class _FleetStateView:
+    """The pool's ``.state`` facade — just enough of ``StateManager``'s
+    read surface (``sequences``, ``get``) for single-engine drivers
+    (the loadgen, the drills) to run against the fleet unchanged."""
+
+    __slots__ = ("_pool",)
+
+    def __init__(self, pool: "ReplicaPool"):
+        self._pool = pool
+
+    @property
+    def sequences(self) -> Dict[int, Any]:
+        out: Dict[int, Any] = {}
+        for rep in self._pool.replicas():
+            if rep.state != REPLICA_DEAD:
+                out.update(rep.engine.state.sequences)
+        return out
+
+    def get(self, uid: int):
+        rep = self._pool.owner_of(uid)
+        return rep.engine.state.get(uid) if rep is not None else None
+
+
+class ReplicaPool:
+    """N engine replicas behind one router (module docstring has the
+    architecture; docs/serving.md "Replica pool" the protocol)."""
+
+    def __init__(self, engines: Sequence[Any] = (),
+                 policy: Optional[str] = None,
+                 seed: Optional[int] = None,
+                 slo_ttft_s: Optional[float] = None,
+                 ledger: Any = None, name: str = "fleet",
+                 replica_ids: Optional[Sequence[str]] = None):
+        # env knobs read with LITERAL names (dslint DSL004/5 scan):
+        # DSTPU_FLEET_POLICY is the operational routing kill-switch
+        # (prefix_aware -> round_robin/random without a rebuild),
+        # DSTPU_FLEET_SEED pins tie-break reproducibility,
+        # DSTPU_FLEET_SLO_TTFT_S arms the router's headroom term
+        if policy is None:
+            policy = os.environ.get("DSTPU_FLEET_POLICY") \
+                or "prefix_aware"
+        if seed is None:
+            seed = int(os.environ.get("DSTPU_FLEET_SEED") or "0")
+        if slo_ttft_s is None:
+            slo_ttft_s = float(
+                os.environ.get("DSTPU_FLEET_SLO_TTFT_S") or "0")
+        self.name = name
+        self.router = Router(policy=policy, seed=seed,
+                             slo_ttft_s=slo_ttft_s)
+        self._replicas: Dict[str, Replica] = {}
+        self._owner: Dict[int, str] = {}          # uid -> replica id
+        #: replay tokens a drained replica's sequences earned on their
+        #: new survivor before the caller's next decode call — spliced
+        #: into that call's result so caller streams stay gapless
+        self._replayed: Dict[int, List[int]] = {}
+        #: drain manifests still owed a survivor (every replica died
+        #: before a replay target existed) — replayed as soon as a
+        #: joiner registers; until then fresh work gets the structured
+        #: no_serving_replica rejection, never a crash
+        self._orphans: List[Dict[str, Any]] = []
+        #: pool-level structured rejections (no serving replica); the
+        #: engines' own rejection records merge in via :attr:`rejections`
+        self._pool_rejections: Dict[int, Dict[str, Any]] = {}
+        self._executor = None        # lazy per-replica worker threads
+        self.state = _FleetStateView(self)
+        if ledger is None and os.environ.get("DSTPU_RESTART_LEDGER"):
+            from ..resilience.ledger import RestartLedger
+            ledger = RestartLedger(os.environ["DSTPU_RESTART_LEDGER"])
+        self._ledger = ledger
+        ids = list(replica_ids) if replica_ids is not None else [
+            f"r{i}" for i in range(len(engines))]
+        if len(ids) != len(engines):
+            raise ValueError(
+                f"{len(ids)} replica_ids for {len(engines)} engines")
+        for rid, eng in zip(ids, engines):
+            self.add_replica(eng, replica_id=rid)
+
+    # ------------------------------------------------------------------ #
+    # membership
+    # ------------------------------------------------------------------ #
+
+    def replicas(self) -> List[Replica]:
+        """Members in join order (the router's candidate order)."""
+        return list(self._replicas.values())
+
+    def replica(self, replica_id: str) -> Replica:
+        return self._replicas[replica_id]
+
+    def owner_of(self, uid: int) -> Optional[Replica]:
+        rid = self._owner.get(uid)
+        return self._replicas.get(rid) if rid is not None else None
+
+    @property
+    def serving_count(self) -> int:
+        return sum(1 for r in self._replicas.values() if r.available)
+
+    def add_replica(self, engine, replica_id: Optional[str] = None
+                    ) -> Replica:
+        """Register a (late-)joining replica: it becomes a routing
+        candidate immediately — a fresh joiner has an empty queue, so
+        the score's load term starts steering traffic its way on the
+        very next placement."""
+        if replica_id is None:
+            replica_id = f"r{len(self._replicas)}"
+        if replica_id in self._replicas:
+            raise ValueError(f"replica id {replica_id!r} already joined")
+        rep = Replica(replica_id, engine)
+        self._replicas[replica_id] = rep
+        if self._ledger is not None:
+            self._ledger.record("fleet_join", replica=replica_id,
+                                pool=self.name,
+                                serving=self.serving_count)
+        return rep
+
+    def drain_replica(self, replica_id: str,
+                      path: Optional[str] = None) -> Dict[str, Any]:
+        """Cooperatively drain one replica through the PR 7 protocol:
+        its live sequences land in a replay manifest, ALL its engine
+        state is released (``manifest["pool"]["fully_recovered"]`` is
+        the exactness verdict), and the replica leaves the routing set
+        for good. Idempotent on an already-dead replica (returns its
+        manifest). Does NOT replay — pair with
+        :meth:`replay_manifest`, or let :meth:`absorb_draining` do both."""
+        rep = self._replicas[replica_id]
+        if rep.state == REPLICA_DEAD:
+            return rep.manifest or {}
+        rep.state = REPLICA_DRAINING
+        rep.engine.request_drain()
+        manifest = rep.engine.drain(path)
+        rep.manifest = manifest
+        rep.state = REPLICA_DEAD
+        if self._ledger is not None:
+            self._ledger.record(
+                "fleet_drain", replica=replica_id, pool=self.name,
+                sequences=len(manifest.get("sequences", ())),
+                fully_recovered=manifest.get("pool", {}).get(
+                    "fully_recovered"),
+                survivors=self.serving_count)
+        return manifest
+
+    def replay_manifest(self, manifest: Dict[str, Any]
+                        ) -> Dict[int, Any]:
+        """Route a dead replica's manifested sequences onto survivors —
+        each sequence is placed by the router scoring its FULL chain
+        (prompt + generated), so on shared-prefix workloads the replica
+        already holding the preamble's blocks wins and the re-prefill is
+        mostly cache hits. Returns {uid: next committed greedy token}
+        (the same continuation the dead replica would have emitted —
+        replay parity is PR 7's oracle). Raises
+        :class:`NoServingReplicaError` with no survivors."""
+        recs = manifest.get("sequences", [])
+        if not recs:
+            return {}
+        groups: Dict[str, List[Dict[str, Any]]] = {}
+        try:
+            for rec in recs:
+                chain = list(rec["prompt"]) + list(rec["generated"])
+                rep = self.router.select(self.replicas(), chain)
+                rep.pending_routed += 1
+                groups.setdefault(rep.replica_id, []).append(rec)
+        finally:
+            for rep in self._replicas.values():
+                rep.pending_routed = 0
+        out: Dict[int, Any] = {}
+        for rid, rs in groups.items():
+            rep = self._replicas[rid]
+            sub = {"version": manifest.get("version", 1),
+                   "source": "fleet_replay", "sequences": rs}
+            res = rep.engine.replay(sub)
+            for rec in rs:
+                uid = int(rec["uid"])
+                self._owner[uid] = rid
+                if uid in res:
+                    out[uid] = res[uid]
+        if self._ledger is not None:
+            self._ledger.record(
+                "fleet_replay", pool=self.name, sequences=len(recs),
+                placement={rid: len(rs) for rid, rs in groups.items()})
+        return out
+
+    def absorb_draining(self) -> None:
+        """Drain-and-replay every replica whose engine has flipped its
+        drain flag (SIGTERM between engine calls): survivors absorb the
+        manifested sequences, and the replay tokens are stashed for the
+        caller's next :meth:`decode_pipelined`, which splices them into
+        its result. With NO survivor the manifests wait as orphans —
+        published to disk by the drain as usual — and replay onto the
+        first joiner. Called automatically at every pool entry point;
+        cheap (one flag read per replica) when nothing is draining."""
+        for rep in list(self._replicas.values()):
+            if rep.state == REPLICA_SERVING and rep.engine.draining:
+                self._orphans.append(
+                    self.drain_replica(rep.replica_id))
+        if not self._orphans \
+                or not any(r.available for r in self._replicas.values()):
+            return
+        orphans, self._orphans = self._orphans, []
+        for manifest in orphans:
+            for uid, tok in self.replay_manifest(manifest).items():
+                self._replayed.setdefault(uid, []).append(tok)
+
+    # ------------------------------------------------------------------ #
+    # the engine-shaped serving surface (DSL001-registered hot paths)
+    # ------------------------------------------------------------------ #
+
+    def put(self, batch_uids: Sequence[int],
+            batch_tokens: Sequence[Sequence[int]],
+            _greedy: bool = False,
+            arrivals: Optional[Dict[int, float]] = None,
+            deadlines: Optional[Dict[int, float]] = None
+            ) -> Dict[int, Any]:
+        """Fleet admission. Placement is SEQUENTIAL per request (pure
+        host scoring — each decision sees the queue/ownership state the
+        previous one created), then the routed per-replica prompt
+        batches PREFILL CONCURRENTLY, one worker thread per replica,
+        exactly like the decode rounds — admission wall time stays that
+        of the busiest replica, not the sum. Continuations go to their
+        owner. Returns the merged {uid: result} map; refusals surface
+        through :attr:`rejections` exactly like a single engine's."""
+        self.absorb_draining()
+        done: Dict[int, Any] = {}
+        groups: Dict[str, List[int]] = {}
+        toks_of: Dict[int, Sequence[int]] = {}
+        try:
+            for uid, toks in zip(batch_uids, batch_tokens):
+                rep = self.owner_of(uid)
+                live = rep is not None \
+                    and rep.engine.state.get(uid) is not None
+                if not live:
+                    # fresh request (or a reused/stale uid): route it.
+                    # A LIVE continuation stays with its owner even
+                    # mid-drain — the sequence rides that replica's
+                    # manifest; rerouting its tokens would re-admit
+                    # them as a bogus new prompt elsewhere
+                    try:
+                        rep = self.router.select(self.replicas(), toks)
+                    except NoServingReplicaError:
+                        self._reject(uid, "no_serving_replica")
+                        continue
+                    self._owner[uid] = rep.replica_id
+                    rep.pending_routed += 1
+                    # a uid retried after an earlier refusal sheds its
+                    # stale records EVERYWHERE — a present record must
+                    # only ever mean THIS admission failed. The engine
+                    # clears only its own on re-admission, but a retry
+                    # may land on a different replica while the old
+                    # record (possibly on a now-dead replica) would
+                    # keep polluting the merged :attr:`rejections` view
+                    self._pool_rejections.pop(uid, None)
+                    for other in self._replicas.values():
+                        other.engine.rejections.pop(uid, None)
+                groups.setdefault(rep.replica_id, []).append(uid)
+                toks_of[uid] = toks
+        finally:
+            for rep in self._replicas.values():
+                rep.pending_routed = 0
+
+        def run_one(rid: str) -> Dict[int, Any]:
+            members = groups[rid]
+            return self._replicas[rid].engine.put(
+                members, [toks_of[u] for u in members], _greedy=_greedy,
+                arrivals=arrivals, deadlines=deadlines)
+
+        results = self._run_groups(run_one, groups)
+        for res in results:
+            done.update(res)
+        return done
+
+    def _run_groups(self, fn, groups: Dict[str, Any]) -> List[Any]:
+        """Run ``fn(replica_id)`` for every routed group — concurrently
+        on the pool's persistent per-replica worker threads when more
+        than one replica is involved (each worker blocks only on ITS
+        engine's device, GIL released, so replica device work overlaps);
+        inline for a single group."""
+        if len(groups) <= 1:
+            return [fn(rid) for rid in groups]
+        if self._executor is None \
+                or self._executor._max_workers < len(groups):
+            from concurrent.futures import ThreadPoolExecutor
+            if self._executor is not None:
+                self._executor.shutdown(wait=False)
+            self._executor = ThreadPoolExecutor(
+                max_workers=max(len(groups), len(self._replicas)),
+                thread_name_prefix=f"{self.name}-replica")
+        return list(self._executor.map(fn, groups))
+
+    def decode_pipelined(self, batch_uids: Sequence[int],
+                         first_tokens: Sequence[int], n,
+                         eos_token_id: Optional[int] = None
+                         ) -> Dict[int, List[int]]:
+        """One fleet decode round: group uids by owning replica and run
+        every replica's overlapped ``decode_pipelined`` batch
+        CONCURRENTLY — one worker thread per replica, because that is
+        what replicas over disjoint device sets are: each thread blocks
+        only on ITS engine's commit readbacks (releasing the GIL), so
+        the replicas' device work overlaps instead of serializing
+        behind one host loop, and fleet throughput scales with replica
+        count on the in-process path too. Engines share no mutable
+        state (each owns its pool, scheduler and staging buffers), and
+        per-engine token streams stay deterministic — thread
+        interleaving can reorder nothing inside one engine.
+
+        A replica SIGTERMed before or during the round is absorbed
+        (drain → survivor replay) and the replay tokens are spliced
+        into this round's result — the caller's per-uid stream stays
+        gapless and token-identical through the membership change."""
+        self.absorb_draining()
+        if isinstance(n, (list, tuple)):
+            budgets = {u: b for u, b in zip(batch_uids, n)}
+        else:
+            budgets = {u: n for u in batch_uids}
+        out: Dict[int, List[int]] = {u: [] for u in batch_uids}
+        rem: Dict[int, int] = {}
+        last: Dict[int, int] = {}
+        for u, t in zip(batch_uids, first_tokens):
+            took = self._take_stash(u, budgets[u], out)
+            rem[u] = budgets[u] - took
+            last[u] = out[u][-1] if out[u] else t
+        groups: Dict[str, List[int]] = {}
+        for u in batch_uids:
+            if rem[u] <= 0:
+                continue
+            rep = self.owner_of(u)
+            if rep is None or not rep.available:
+                continue              # absorbed: the stash carries it
+            groups.setdefault(rep.replica_id, []).append(u)
+
+        def run_one(rid: str) -> Dict[int, List[int]]:
+            eng = self._replicas[rid].engine
+            members = groups[rid]
+            if eos_token_id is None and hasattr(eng.runner,
+                                               "decode_loop"):
+                # fused fleet decode: bucket the replica's batch by
+                # budget and run ONE device program per bucket
+                # (token-identical to the per-step path — PR 3's
+                # parity oracle). Host python per token drops to ~one
+                # dispatch per burst, so N replicas' decode rounds
+                # genuinely overlap instead of contending for the
+                # interpreter; block-pressure falls back to the
+                # incremental pipelined path, which can shed.
+                res: Dict[int, List[int]] = {}
+                by_budget: Dict[int, List[int]] = {}
+                for u in members:
+                    by_budget.setdefault(rem[u], []).append(u)
+                for b, us in by_budget.items():
+                    if len(us) <= eng.config.max_seqs:
+                        try:
+                            res.update(eng.decode_batch(
+                                us, [last[u] for u in us], b))
+                            continue
+                        except (OutOfBlocksError, ValueError):
+                            # pool pressure / paused member / oversized
+                            # batch: the incremental path paces it
+                            pass
+                    res.update(eng.decode_pipelined(
+                        us, [last[u] for u in us], b))
+                return res
+            return eng.decode_pipelined(
+                members, [last[u] for u in members],
+                [rem[u] for u in members], eos_token_id=eos_token_id)
+
+        results = self._run_groups(run_one, groups)
+        for rid, res in zip(groups, results):
+            for u in groups[rid]:
+                got = res.get(u) or []
+                out[u].extend(got)
+                rem[u] -= len(got)
+        # a SIGTERM mid-round: the victim unwound with partial output —
+        # absorb now so its replay tokens land in THIS result (budget
+        # permitting; the rest waits in the stash)
+        self.absorb_draining()
+        for u in batch_uids:
+            if rem[u] > 0:
+                self._take_stash(u, rem[u], out)
+        return out
+
+    def _take_stash(self, uid: int, budget: int,
+                    out: Dict[int, List[int]]) -> int:
+        """Move up to ``budget`` stashed replay tokens for ``uid`` into
+        ``out``; leftovers stay stashed. Pure host list work."""
+        stash = self._replayed.pop(uid, None)
+        if not stash:
+            return 0
+        if budget <= 0:
+            self._replayed[uid] = stash
+            return 0
+        take = stash[:budget]
+        out[uid].extend(take)
+        if stash[budget:]:
+            self._replayed[uid] = stash[budget:]
+        return len(take)
+
+    def flush(self, uid: int) -> None:
+        self._replayed.pop(uid, None)
+        rid = self._owner.pop(uid, None)
+        rep = self._replicas.get(rid) if rid is not None else None
+        if rep is not None and rep.engine.state.get(uid) is not None:
+            rep.engine.flush(uid)
+
+    def _reject(self, uid: int, reason: str, **fields) -> None:
+        self._pool_rejections[uid] = {
+            "uid": uid, "reason": reason, "time": time.time(), **fields}
+
+    @property
+    def rejections(self) -> Dict[int, Dict[str, Any]]:
+        """Merged structured-rejection view: pool-level refusals plus
+        every replica's engine records (a uid lives on exactly one
+        replica, so the union is collision-free)."""
+        out = dict(self._pool_rejections)
+        for rep in self._replicas.values():
+            out.update(rep.engine.rejections)
+        return out
+
+    # ------------------------------------------------------------------ #
+    # fleet telemetry rollup
+    # ------------------------------------------------------------------ #
+
+    def fleet_registry(self) -> Optional[MetricsRegistry]:
+        """Merge live replicas' per-engine registries into one fleet
+        registry: counters sum, gauges keep per-replica identity via
+        ``source=<replica id>`` labels (STABLE — keyed by id, not
+        insertion index, so re-rolling the same fleet is idempotent),
+        histograms merge bucket-wise exactly. None when telemetry is
+        off. The dead replicas' final stats live in their drain
+        manifests (``manifest["telemetry"]``), not here."""
+        regs: List[MetricsRegistry] = []
+        srcs: List[str] = []
+        for rid, rep in self._replicas.items():
+            if rep.state == REPLICA_DEAD:
+                continue
+            m = rep.engine.metrics
+            if m is not None:
+                # pool/prefix gauges refresh on export boundaries; a
+                # rollup must not read stale (or never-set) values
+                rep.engine._obs.sync_gauges()
+                regs.append(m)
+                srcs.append(rid)
+        if not regs:
+            return None
+        return MetricsRegistry.merge(regs, name=self.name, sources=srcs)
+
+    def fleet_snapshot(self) -> Dict[str, Any]:
+        """One merged, export-shaped snapshot of the whole pool (the
+        in-process analogue of ``telemetry.merge_snapshots`` over
+        per-process export files), plus per-replica membership detail
+        and the router's dispatch stats."""
+        reg = self.fleet_registry()
+        snap: Dict[str, Any] = reg.snapshot() if reg is not None else {
+            "counters": {}, "gauges": {}, "histograms": {}}
+        snap["time"] = time.time()
+        snap["registry"] = f"{self.name}({self.serving_count})"
+        snap["replicas"] = {rid: rep.describe()
+                            for rid, rep in self._replicas.items()}
+        snap["router"] = self.router.describe()
+        return snap
+
+    def export(self, path: str) -> None:
+        """Atomic fleet-snapshot publish (tmp + rename) — same torn-read
+        discipline as ``MetricsRegistry.export``; ``bin/dstpu_top``
+        renders the file like any single-engine export."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self.fleet_snapshot(), f)
+        os.replace(tmp, path)
+
+    def slo_report(self) -> Dict[str, Any]:
+        """Fleet-wide SLO summary in the same shape as a single
+        engine's ``slo_report()`` — computed from the merged registry,
+        so the percentiles are EXACTLY what one stream over every
+        replica's requests would report ({} when telemetry is off)."""
+        reg = self.fleet_registry()
+        if reg is None:
+            return {}
+        return slo_report_from_registry(reg)
+
+
+def fleet_prefix_stats(pool: ReplicaPool) -> Dict[str, Any]:
+    """Summed host-side prefix-cache counters across live replicas plus
+    the fleet-wide skipped-prefill fraction — the number the routing
+    bench gates on (prefix-aware must beat random here)."""
+    keys = ("matched_tokens", "prefill_tokens", "cow_tokens",
+            "matched_blocks", "cow_copies")
+    out: Dict[str, Any] = {k: 0 for k in keys}
+    for rep in pool.replicas():
+        if rep.state == REPLICA_DEAD:
+            continue
+        st = rep.engine.prefix_stats
+        for k in keys:
+            out[k] += st.get(k, 0)
+    hit, ran = out["matched_tokens"], out["prefill_tokens"]
+    out["prefill_chunks_skipped_frac"] = \
+        hit / (hit + ran) if hit + ran else 0.0
+    return out
+
+
+def build_replica_engines(engine_factory, n: int,
+                          devices: Optional[Sequence[Any]] = None
+                          ) -> List[Any]:
+    """Build ``n`` engines for a pool, each pinned to its OWN JAX
+    device (cycling ``devices``, default ``jax.devices()``): arrays the
+    factory creates under the ``jax.default_device`` scope — params it
+    ``device_put``s, the KV pool, the compiled programs' outputs — all
+    land on that replica's device, so the replicas' steps execute
+    concurrently instead of queueing on one device. This is the
+    in-process realization of "N replicas over disjoint device sets":
+    on the CPU harness the devices come from
+    ``--xla_force_host_platform_device_count``, on real hardware from
+    the ``data`` mesh axis. ``engine_factory(i, device)`` returns
+    replica ``i``'s engine."""
+    import jax
+    devs = list(devices) if devices is not None else jax.devices()
+    engines = []
+    for i in range(n):
+        dev = devs[i % len(devs)]
+        with jax.default_device(dev):
+            engines.append(engine_factory(i, dev))
+    return engines
+
+
+def single_stream_oracle(values: Sequence[float],
+                         alpha: float = 0.05) -> Histogram:
+    """One histogram fed the union of ``values`` in a single stream —
+    the oracle the fleet drill compares the merged rollup against
+    (``Histogram.merge`` exactness means the two must agree bucket for
+    bucket, hence quantile for quantile)."""
+    h = Histogram(alpha=alpha)
+    for v in values:
+        h.observe(v)
+    return h

@@ -1,0 +1,156 @@
+"""Request router for the replica-pool serving fleet.
+
+Places each fresh request on one replica of a :class:`~.pool.ReplicaPool`
+by a pluggable policy (``ReplicaPool(policy=...)`` /
+``DSTPU_FLEET_POLICY``):
+
+  * ``random``       — seeded uniform choice over available replicas
+    (the control the fleet bench compares against);
+  * ``round_robin``  — cycle over available replicas in id order;
+  * ``prefix_aware`` — score every available replica and take the max.
+
+The ``prefix_aware`` score composes the three signals ROADMAP's fleet
+item names, all already maintained by lower layers:
+
+  * **cached-prefix overlap** — how many of the request's prompt tokens
+    the replica's content-addressed prefix cache would serve from
+    already-written KV blocks (``PrefixCache.match`` is a pure host trie
+    walk over the PR 5 chain keys: full matched blocks plus the
+    copy-on-write tail span). Requests sharing a system prompt
+    gravitate to the replica that already holds its blocks, so the
+    fleet-wide skipped-prefill fraction approaches the single-replica
+    warm-cache number instead of paying one cold prefill per replica
+    per preamble;
+  * **queue depth** — live sequences over slots: with no cache signal
+    the score reduces to least-loaded, which is also the fallback that
+    keeps one hot preamble from collapsing the whole fleet onto one
+    replica;
+  * **SLO headroom** — distance of the replica's own TTFT p99 (its
+    per-engine PR 8 ``MetricsRegistry``) from the fleet's TTFT target:
+    a replica already violating its SLO stops attracting traffic even
+    when its cache looks attractive.
+
+``score = w_prefix·overlap_frac − w_queue·queue_frac
+          + w_headroom·headroom``   (headroom term only with a target).
+
+Determinism is part of the contract (the fleet drill replays routing
+decisions): the same request sequence against the same replica states
+yields the same placements — ties (e.g. a cold fleet where every score
+is equal) break through a seeded RNG, so cold traffic spreads without
+becoming irreproducible.
+
+``select``/``score`` are dslint DSL001-registered hot paths: they run
+between the engines' overlapped pipelines on the admission path and
+must never block on a device sync — every input they read (trie walk,
+host dicts, streaming-histogram quantiles) is host-side metadata by
+construction.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional, Sequence
+
+#: the pluggable placement policies (validated at construction)
+ROUTING_POLICIES = ("random", "round_robin", "prefix_aware")
+
+
+class NoServingReplicaError(RuntimeError):
+    """Every replica is draining, dead or not yet joined — the pool has
+    nowhere to place the request (the caller turns this into a
+    structured rejection, never a crash)."""
+
+
+class Router:
+    def __init__(self, policy: str = "prefix_aware", seed: int = 0,
+                 slo_ttft_s: float = 0.0, w_prefix: float = 1.0,
+                 w_queue: float = 1.0, w_headroom: float = 0.25):
+        # w_queue >= w_prefix on purpose: overlap_frac < 1 always, so a
+        # SATURATED replica (queue_frac -> 1) loses to an idle one even
+        # on a perfect cache hit — affinity concentrates traffic only
+        # up to the point where it would starve the rest of the fleet
+        if policy not in ROUTING_POLICIES:
+            raise ValueError(
+                f"routing policy must be one of {ROUTING_POLICIES}, "
+                f"got {policy!r}")
+        self.policy = policy
+        self.seed = int(seed)
+        self.slo_ttft_s = float(slo_ttft_s)
+        self.w_prefix = float(w_prefix)
+        self.w_queue = float(w_queue)
+        self.w_headroom = float(w_headroom)
+        self._rng = random.Random(self.seed)
+        self._rr = 0
+        self.stats = {"dispatched": 0, "ties_broken": 0}
+
+    # ------------------------------------------------------------------ #
+    # scoring + selection — the admission hot path (DSL001-registered)
+    # ------------------------------------------------------------------ #
+
+    def score(self, replica, prompt: Sequence[int]) -> float:
+        """The prefix-aware placement score of one replica for one
+        prompt. Pure host arithmetic: a trie walk over cached chain
+        keys, two dict-size reads and (with an SLO target) a streaming
+        histogram quantile — never a device sync."""
+        n = len(prompt)
+        overlap = replica.prefix_overlap(prompt) / n if n else 0.0
+        s = self.w_prefix * overlap - self.w_queue * replica.queue_frac()
+        if self.slo_ttft_s > 0:
+            s += self.w_headroom * replica.slo_headroom(self.slo_ttft_s)
+        return s
+
+    def select(self, replicas: Sequence[Any], prompt: Sequence[int]):
+        """Place ``prompt`` on one of ``replicas``. Only AVAILABLE
+        replicas (serving and not draining) are candidates — a draining
+        replica's live sequences ride its manifest, and handing it fresh
+        work would just bounce off the engine's admission refusal.
+        Raises :class:`NoServingReplicaError` when none are available.
+
+        Deterministic given (policy, seed, call history, replica
+        states): exact-score ties break through the seeded RNG, so a
+        cold fleet spreads reproducibly.
+
+        Slot admission control, applied BEFORE any policy: a replica
+        already at its slot capacity (``queue_frac() >= 1``) is only a
+        candidate when every available replica is — placing fresh work
+        on a full replica makes its engine juggle more sequences than
+        slots (pause/offload churn, multi-second tails) while a
+        neighbor idles, and no cache hit is worth that."""
+        avail = [r for r in replicas if r.available]
+        if not avail:
+            raise NoServingReplicaError(
+                f"no serving replica among {len(replicas)} "
+                f"(all draining, dead or not joined)")
+        open_ = [r for r in avail if r.queue_frac() < 1.0]
+        avail = open_ or avail
+        self.stats["dispatched"] += 1
+        if self.policy == "round_robin":
+            pick = avail[self._rr % len(avail)]
+            self._rr += 1
+            return pick
+        if self.policy == "random":
+            return avail[self._rng.randrange(len(avail))]
+        best_score = None
+        ties: List[Any] = []
+        for r in avail:
+            s = self.score(r, prompt)
+            if best_score is None or s > best_score:
+                best_score = s
+                ties = [r]
+            elif s == best_score:
+                ties.append(r)
+        if len(ties) == 1:
+            return ties[0]
+        self.stats["ties_broken"] += 1
+        return ties[self._rng.randrange(len(ties))]
+
+    # ------------------------------------------------------------------ #
+
+    def describe(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"policy": self.policy, "seed": self.seed,
+                               **self.stats}
+        if self.policy == "prefix_aware":
+            out.update(w_prefix=self.w_prefix, w_queue=self.w_queue,
+                       w_headroom=self.w_headroom,
+                       slo_ttft_s=self.slo_ttft_s or None)
+        return out

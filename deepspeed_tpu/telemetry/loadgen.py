@@ -160,21 +160,28 @@ class TraceArrivals(ArrivalProcess):
 @dataclass
 class Request:
     """One offered request: identity, scheduled arrival offset, prompt,
-    decode budget, optional per-request deadline."""
+    decode budget, optional per-request deadline. ``group`` is the
+    shared-prefix group index (None for unique-prompt requests) — the
+    fleet bench reads it to check routing affinity."""
 
     uid: int
     arrival_s: float
     prompt: List[int]
     gen_len: int
     deadline_s: Optional[float] = None
+    group: Optional[int] = None
 
 
 @dataclass
 class WorkloadMix:
     """Seeded request-shape distribution. ``shared_prefix_frac`` of the
-    requests open with ONE common ``shared_prefix_len``-token preamble
-    (the prefix-cache hit population); ``deadline_frac`` of them carry
-    a ``deadline_s`` deadline measured from their scheduled arrival."""
+    requests open with a common ``shared_prefix_len``-token preamble
+    (the prefix-cache hit population); ``prefix_group_count`` spreads
+    those over that many DISTINCT preambles (>1 is the replica-fleet
+    workload: more shared-prefix groups than one replica's cache wants
+    to hold, so routing affinity — not cache size — decides the
+    fleet-wide hit rate); ``deadline_frac`` of the requests carry a
+    ``deadline_s`` deadline measured from their scheduled arrival."""
 
     prompt_lens: Sequence[int] = (128, 256, 512)
     prompt_probs: Sequence[float] = (0.4, 0.4, 0.2)
@@ -182,6 +189,7 @@ class WorkloadMix:
     gen_probs: Sequence[float] = (0.3, 0.5, 0.2)
     shared_prefix_frac: float = 0.0
     shared_prefix_len: int = 0
+    prefix_group_count: int = 1
     deadline_frac: float = 0.0
     deadline_s: float = 0.0
     vocab_size: int = 32000
@@ -192,6 +200,7 @@ class WorkloadMix:
             "gen_mix": list(self.gen_lens),
             "shared_prefix_frac": self.shared_prefix_frac,
             "shared_prefix_len": self.shared_prefix_len,
+            "prefix_group_count": self.prefix_group_count,
             "deadline_frac": self.deadline_frac,
             "deadline_s": self.deadline_s,
         }
@@ -211,23 +220,40 @@ def build_requests(process: ArrivalProcess, mix: WorkloadMix, n: int,
     glens = rng.choice(list(mix.gen_lens), size=n, p=list(mix.gen_probs))
     shared = rng.random_sample(n) < mix.shared_prefix_frac
     deadlined = rng.random_sample(n) < mix.deadline_frac
-    prefix = rng.randint(1, mix.vocab_size,
-                         size=mix.shared_prefix_len).tolist() \
-        if mix.shared_prefix_len else []
+    # shared-prefix preambles: one (the single-group classic) or
+    # prefix_group_count distinct ones (the fleet workload). The
+    # single-group path draws exactly what it always drew, so request
+    # identity under existing (mix, seed) pairs is unchanged.
+    grouped = mix.shared_prefix_len and mix.prefix_group_count > 1
+    if grouped:
+        prefixes = [rng.randint(1, mix.vocab_size,
+                                size=mix.shared_prefix_len).tolist()
+                    for _ in range(mix.prefix_group_count)]
+        group_of = rng.randint(0, mix.prefix_group_count, size=n)
+    else:
+        prefixes = [rng.randint(1, mix.vocab_size,
+                                size=mix.shared_prefix_len).tolist()
+                    if mix.shared_prefix_len else []]
+        group_of = np.zeros(n, np.int64)
     out: List[Request] = []
     for i in range(n):
         plen = int(plens[i])
+        g = int(group_of[i])
+        prefix = prefixes[g]
         if shared[i] and prefix and plen > len(prefix):
             body = rng.randint(1, mix.vocab_size,
                                size=plen - len(prefix)).tolist()
             prompt = prefix + body
+            group: Optional[int] = g
         else:
             prompt = rng.randint(1, mix.vocab_size, size=plen).tolist()
+            group = None
         out.append(Request(
             uid=uid_base + i, arrival_s=float(arrivals[i]),
             prompt=prompt, gen_len=int(glens[i]),
             deadline_s=mix.deadline_s
-            if deadlined[i] and mix.deadline_s > 0 else None))
+            if deadlined[i] and mix.deadline_s > 0 else None,
+            group=group))
     return out
 
 
@@ -327,8 +353,15 @@ class _OpenLoopDriver:
         """One short pipelined decode burst over the live set — short so
         the admission poll (the arrival clock) runs between bursts."""
         eng = self.engine
+        # bind the pre-burst views ONCE: against a replica pool these
+        # are merged-dict properties rebuilt per access, so a per-uid
+        # property read would cost O(live² · replicas) host time inside
+        # the very loop being measured (the post-burst rejection check
+        # below stays a fresh read — aborts can land DURING the burst)
+        seqs = eng.state.sequences
+        rejected = eng.rejections
         uids = [u for u in self.live
-                if u in eng.state.sequences and u not in eng.rejections]
+                if u in seqs and u not in rejected]
         for u in list(self.live):
             if u not in uids:
                 self.live.pop(u)            # shed/expired mid-flight
@@ -338,7 +371,7 @@ class _OpenLoopDriver:
                    for u in uids]
         ctx = 0
         for u in uids:
-            ctx += eng.state.sequences[u].seen_tokens
+            ctx += seqs[u].seen_tokens
         t0 = time.perf_counter()
         outs = eng.decode_pipelined(
             uids, [self.live[u]["last"] for u in uids], budgets)
@@ -346,7 +379,8 @@ class _OpenLoopDriver:
         steps = 0
         got_total = 0
         t_seen = time.monotonic() - self.t0
-        for u in uids:
+        rejected = eng.rejections           # re-read: aborts can land
+        for u in uids:                      # DURING the burst
             got = outs.get(u) or []
             if got:
                 self.streams[u].extend(got)
@@ -354,7 +388,7 @@ class _OpenLoopDriver:
             got_total += len(got)
             if len(got) > steps:
                 steps = len(got)
-            if u in eng.rejections:
+            if u in rejected:
                 self.live.pop(u, None)      # aborted inside the burst
                 continue
             st = self.live[u]
@@ -636,15 +670,19 @@ def _tiny_engine(max_seqs: int = 8, num_blocks: int = 96,
 def main(argv: Optional[List[str]] = None) -> int:
     """``bin/dstpu_loadgen`` — run an open-loop pass (or a rate sweep)
     against a self-contained tiny CPU engine and print the report JSON.
-    The env knobs mirror the flags (flags win); docs/CONFIG.md has the
-    catalog."""
+    ``--replicas N`` swaps the single engine for an N-replica
+    :class:`~deepspeed_tpu.serving.ReplicaPool` (same knobs, same
+    report shape, plus a ``fleet`` section) with the routing policy
+    from ``--policy`` / ``DSTPU_FLEET_POLICY``. The env knobs mirror
+    the flags (flags win); docs/CONFIG.md has the catalog."""
     import argparse
     import os
 
     ap = argparse.ArgumentParser(
         prog="dstpu_loadgen",
         description="open-loop wall-clock load generator for the v2 "
-                    "ragged engine (docs/observability.md)")
+                    "ragged engine or a replica-pool fleet "
+                    "(docs/observability.md)")
     ap.add_argument("--rate", default=os.environ.get(
         "DSTPU_LOADGEN_RATE", "8"),
         help="offered req/s; a comma list runs a capacity sweep")
@@ -667,21 +705,59 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--prompt-len", type=int, default=24)
     ap.add_argument("--gen-len", type=int, default=16)
     ap.add_argument("--shared-prefix-frac", type=float, default=0.0)
+    ap.add_argument("--prefix-groups", type=int, default=1,
+                    help="distinct shared preambles (>1 = the fleet "
+                         "routing workload)")
     ap.add_argument("--deadline-s", type=float, default=0.0)
     ap.add_argument("--deadline-frac", type=float, default=0.0)
+    ap.add_argument("--replicas", type=int, default=int(os.environ.get(
+        "DSTPU_FLEET_REPLICAS", "1")),
+        help="serve through a ReplicaPool of N tiny engines instead of "
+             "one engine")
+    ap.add_argument("--policy", default=None,
+        choices=("random", "round_robin", "prefix_aware"),
+        help="fleet routing policy (default: DSTPU_FLEET_POLICY or "
+             "prefix_aware)")
     ap.add_argument("--slo-goodput", type=float, default=0.9,
                     help="goodput fraction the sweep's knee must meet")
     ap.add_argument("--out", default=None,
                     help="also write the report JSON here")
     args = ap.parse_args(argv)
 
-    eng, mcfg = _tiny_engine()
+    pool = None
+    if args.replicas > 1:
+        from ..serving import ReplicaPool, build_replica_engines
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            # per-replica host devices BEFORE the backend initializes —
+            # without them every tiny engine lands on ONE device and
+            # the pool's replica threads serialize, so the fleet
+            # numbers would not scale with --replicas (the same shim
+            # bench.py serve_fleet uses)
+            from ..utils.jax_compat import request_cpu_devices
+            request_cpu_devices(max(2, args.replicas))
+        mcfg_box = []
+
+        def factory(i, dev):
+            e, m = _tiny_engine()
+            mcfg_box.append(m)
+            return e
+
+        engines = build_replica_engines(factory, args.replicas)
+        mcfg = mcfg_box[0]
+        pool = ReplicaPool(engines, policy=args.policy)
+        eng = pool
+    else:
+        eng, mcfg = _tiny_engine()
     mix = WorkloadMix(
         prompt_lens=(args.prompt_len,), prompt_probs=(1.0,),
         gen_lens=(args.gen_len,), gen_probs=(1.0,),
         shared_prefix_frac=args.shared_prefix_frac,
-        shared_prefix_len=min(16, args.prompt_len // 2)
-        if args.shared_prefix_frac > 0 else 0,
+        # one full 16-token block (the tiny engine's block size) so the
+        # shared span is actually cacheable; shorter prompts get no
+        # prefix rather than a sub-block span no match can ever hit
+        shared_prefix_len=16
+        if args.shared_prefix_frac > 0 and args.prompt_len >= 24 else 0,
+        prefix_group_count=max(1, args.prefix_groups),
         deadline_frac=args.deadline_frac, deadline_s=args.deadline_s,
         vocab_size=mcfg.vocab_size)
     rates = [float(r) for r in str(args.rate).split(",") if r]
@@ -715,6 +791,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "ttft_ms_p50": _ms(slo["ttft_s"].get("p50")),
                 "ttft_ms_p99": _ms(slo["ttft_s"].get("p99")),
             }
+    if pool is not None:
+        from ..serving import fleet_prefix_stats
+        out["fleet"] = {
+            "replicas": args.replicas,
+            "router": pool.router.describe(),
+            "prefix": fleet_prefix_stats(pool),
+            "slo_merged": bool(pool.fleet_registry() is not None),
+        }
     blob = json.dumps(out)
     print(blob)
     if args.out:

@@ -425,26 +425,46 @@ class MetricsRegistry:
 
     @classmethod
     def merge(cls, registries: Sequence["MetricsRegistry"],
-              name: str = "fleet") -> "MetricsRegistry":
+              name: str = "fleet",
+              sources: Optional[Sequence[str]] = None
+              ) -> "MetricsRegistry":
         """Roll N registries (e.g. one per serving replica) into one:
         counters SUM, gauges keep per-source identity via an added
         ``source`` label (a pool's free-block gauges must stay per
         replica, not averaged into fiction), histograms merge
         bucket-wise EXACTLY (same gamma ⇒ merged quantiles identical to
         a single stream over the union — :meth:`Histogram.merge`).
-        Source labels come from each registry's ``name``,
-        disambiguated by index on collision. A gauge that ALREADY
-        carries a ``source`` label (this registry is itself a rollup)
-        keeps it — re-merging rollups preserves the original
-        per-replica identities — and if two DIFFERENT inputs still
-        land on one gauge key (two pools each holding a replica named
-        "a"), the later source is suffixed rather than silently
-        overwriting the earlier value."""
+
+        ``sources`` is the STABLE label scheme the fleet path uses
+        (docs/observability.md "Fleet rollup"): one label per input
+        registry, keyed by replica id — NOT by insertion index — so
+        repeated rollups of the same replicas produce identical gauge
+        keys regardless of membership-list order, and a rollup of
+        rollups stays idempotent. Without ``sources`` the labels fall
+        back to each registry's ``name``, disambiguated by index on
+        collision (index suffixes are order-dependent; fleet callers
+        should always pass ids). A short ``sources`` list is refused —
+        it would silently drop replicas. A gauge that ALREADY carries a
+        ``source`` label (this registry is itself a rollup) keeps it —
+        re-merging rollups preserves the original per-replica
+        identities — and if two DIFFERENT inputs still land on one
+        gauge key (two pools each holding a replica named "a"), the
+        later source is suffixed rather than silently overwriting the
+        earlier value."""
+        registries = list(registries)
+        if sources is not None:
+            src_list = [str(s) for s in sources]
+            if len(src_list) != len(registries):
+                raise ValueError(
+                    f"sources has {len(src_list)} entries for "
+                    f"{len(registries)} registries — a short list would "
+                    f"silently drop replicas from the rollup")
+        else:
+            src_list = [reg.name for reg in registries]
         out = cls(name)
         seen: Dict[str, int] = {}
         gauge_keys: set = set()
-        for reg in registries:
-            src = reg.name
+        for reg, src in zip(registries, src_list):
             n = seen.get(src, 0)
             seen[src] = n + 1
             if n:

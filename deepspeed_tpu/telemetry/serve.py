@@ -285,28 +285,39 @@ class ServeObserver:
         outcomes; drained requests are in flight to a survivor, not an
         outcome)."""
         self.sync_gauges()
-        r = self.registry
-        bad = (r.counter("serve_requests_shed").value
-               + r.counter("serve_requests_deadline_expired").value
-               + r.counter("serve_requests_rejected_draining").value
-               + self.c_aborted.value)
-        good = self.c_completed.value
-        done = good + bad
-        return {
-            "ttft_s": self.h_ttft.summary(),
-            "tpot_s": self.h_tpot.summary(),
-            "queue_wait_s": self.h_queue.summary(),
-            "tokens_committed": self.c_tokens.value,
-            "requests": {
-                "admitted": self.c_admitted.value,
-                "completed": good,
-                "shed": r.counter("serve_requests_shed").value,
-                "deadline_expired":
-                    r.counter("serve_requests_deadline_expired").value,
-                "rejected_draining":
-                    r.counter("serve_requests_rejected_draining").value,
-                "aborted": self.c_aborted.value,
-                "drained": self.c_drained.value,
-            },
-            "goodput_frac": good / done if done else None,
-        }
+        return slo_report_from_registry(self.registry)
+
+
+def slo_report_from_registry(registry) -> Dict[str, Any]:
+    """The one copy of the SLO-report arithmetic, over any registry
+    holding the serve_* metrics: a live engine's own registry
+    (:meth:`ServeObserver.slo_report`) or a merged fleet rollup
+    (`serving.ReplicaPool.slo_report`) — per-engine and fleet goodput
+    can never disagree on the formula."""
+    r = registry
+
+    def c(name: str) -> float:
+        return r.counter(name).value
+
+    bad = (c("serve_requests_shed")
+           + c("serve_requests_deadline_expired")
+           + c("serve_requests_rejected_draining")
+           + c("serve_requests_aborted"))
+    good = c("serve_requests_completed")
+    done = good + bad
+    return {
+        "ttft_s": r.histogram("serve_ttft_s").summary(),
+        "tpot_s": r.histogram("serve_tpot_s").summary(),
+        "queue_wait_s": r.histogram("serve_queue_wait_s").summary(),
+        "tokens_committed": c("serve_tokens_committed"),
+        "requests": {
+            "admitted": c("serve_requests_admitted"),
+            "completed": good,
+            "shed": c("serve_requests_shed"),
+            "deadline_expired": c("serve_requests_deadline_expired"),
+            "rejected_draining": c("serve_requests_rejected_draining"),
+            "aborted": c("serve_requests_aborted"),
+            "drained": c("serve_requests_drained"),
+        },
+        "goodput_frac": good / done if done else None,
+    }

@@ -1,4 +1,4 @@
-"""``bin/dstpu_top`` — render a serving engine's metrics snapshot.
+"""``bin/dstpu_top`` — render serving metrics snapshots.
 
 Reads the atomic JSON export a running engine publishes at
 ``DSTPU_TELEMETRY_EXPORT`` (every ``DSTPU_TELEMETRY_EXPORT_EVERY``
@@ -9,16 +9,25 @@ registry's sampled time series (``series`` — DSTPU_SERIES_* knobs), the
 render adds per-window rates and sparklines, so even a ONE-SHOT render
 shows the recent rate history. ``--watch N`` refreshes every N seconds
 (rates then also derive from consecutive snapshots).
+
+Fleet mode: MULTIPLE export files (repeated ``--file``, positional
+paths, or a shell-quoted glob like ``'profiles/replica_*.json'``) are
+rolled up through the EXACT cross-process merge
+(``telemetry.merge_snapshots`` — counters sum, gauges gain stable
+``source`` labels, histogram quantiles equal a single stream over the
+union) and rendered as ONE fleet view plus a per-source breakdown line
+per replica (docs/observability.md "Fleet rollup").
 """
 
 from __future__ import annotations
 
 import argparse
+import glob as _glob
 import json
 import os
 import sys
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 
 def _ms(v: Optional[float]) -> str:
@@ -124,17 +133,28 @@ def render(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
     lines.append("")
     hit = c.get("prefix_matched_tokens", 0.0)
     ran = c.get("prefix_prefill_tokens", 0.0)
+    def g_sum(name: str) -> float:
+        # a fleet-merged snapshot carries gauges under per-replica
+        # source labels; the headline row sums them (pool capacity /
+        # occupancy across the fleet is the sum of the replicas')
+        if name in g:
+            return g[name]
+        return sum(v for k, v in g.items()
+                   if k.split("{", 1)[0] == name)
+
     lines.append(f"prefix cache   hit frac {_pct(_frac(hit, hit + ran))}"
-                 f"   cached {g.get('prefix_cached_blocks', 0):.0f}"
-                 f" blocks (evictable {g.get('prefix_evictable_blocks', 0):.0f})"
+                 f"   cached {g_sum('prefix_cached_blocks'):.0f}"
+                 f" blocks (evictable {g_sum('prefix_evictable_blocks'):.0f})"
                  f"   cow {c.get('prefix_cow_copies', 0):.0f}"
                  f"   evicted {c.get('prefix_evicted_blocks', 0):.0f}")
-    total = g.get("kv_pool_blocks_total", 0.0)
-    free = g.get("kv_pool_blocks_free", 0.0)
+    total = g_sum("kv_pool_blocks_total")
+    free = g_sum("kv_pool_blocks_free")
+    per_chip = [v for k, v in g.items()
+                if k.split("{", 1)[0] == "kv_pool_bytes_per_chip"]
     lines.append(f"kv pool        occupancy "
                  f"{_pct(_frac(total - free, total))}   "
                  f"{free:.0f}/{total:.0f} blocks free   "
-                 f"{g.get('kv_pool_bytes_per_chip', 0) / 1e6:.1f} MB/chip")
+                 f"{max(per_chip, default=0.0) / 1e6:.1f} MB/chip")
     dropped = c.get("flight_spans_dropped", 0.0)
     if dropped:
         lines.append(f"flight ring    {dropped:.0f} spans dropped "
@@ -157,35 +177,100 @@ def render(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
     return "\n".join(lines)
 
 
+def _resolve_paths(file_args: List[str],
+                   positional: List[str]) -> List[str]:
+    """Expand the --file/positional path set: each entry may be a
+    literal path or a glob pattern (shells that did not expand it —
+    quoted, or no match locally). Order-stable, de-duplicated."""
+    out: List[str] = []
+    for raw in list(file_args) + list(positional):
+        hits = sorted(_glob.glob(raw)) if _glob.has_magic(raw) else [raw]
+        for p in hits or [raw]:
+            if p not in out:
+                out.append(p)
+    return out
+
+
+def load_fleet(paths: List[str]
+               ) -> Tuple[Dict[str, Any], List[Tuple[str, Dict[str, Any]]]]:
+    """Load every snapshot and merge EXACTLY (counters sum, gauges gain
+    stable source labels, histograms bucket-merge). Sources are the
+    snapshots' registry names when unique (the replica-pool path names
+    each registry after its replica id), else the file basenames.
+    Returns (merged, [(source, snapshot), ...])."""
+    from .registry import merge_snapshots
+    snaps = [load_snapshot(p) for p in paths]
+    names = [s.get("registry") or "" for s in snaps]
+    if len(set(names)) == len(snaps) and all(names):
+        sources = names
+    else:
+        sources = [os.path.splitext(os.path.basename(p))[0]
+                   for p in paths]
+    merged = merge_snapshots(snaps, sources=sources)
+    # the merged view keeps the newest uptime so the header stays sane
+    merged["uptime_s"] = max((s.get("uptime_s", 0.0) for s in snaps),
+                             default=0.0)
+    return merged, list(zip(sources, snaps))
+
+
+def render_sources(per_source: List[Tuple[str, Dict[str, Any]]]) -> str:
+    """The per-replica breakdown under a fleet render: one line per
+    source file with its own outcome counts, token total and TTFT p99."""
+    lines = ["", "per-source breakdown        admitted completed     "
+                 "tokens  ttft p99(ms)"]
+    for src, snap in per_source:
+        c = snap.get("counters", {})
+        h = snap.get("histograms", {}).get("serve_ttft_s", {})
+        lines.append(
+            f"  {src:<24}{c.get('serve_requests_admitted', 0):10.0f}"
+            f"{c.get('serve_requests_completed', 0):10.0f}"
+            f"{c.get('serve_tokens_committed', 0):11.0f}"
+            f"  {_ms(h.get('p99'))}")
+    return "\n".join(lines)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="dstpu_top",
-        description="render a serving engine's telemetry export "
-                    "(docs/observability.md)")
-    ap.add_argument("--file", default=None,
-                    help="export file (default: $DSTPU_TELEMETRY_EXPORT)")
+        description="render one serving engine's telemetry export, or "
+                    "merge several replicas' exports into one fleet "
+                    "view (docs/observability.md)")
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="export file(s); globs accepted — more than "
+                         "one renders the merged fleet view")
+    ap.add_argument("--file", action="append", default=[],
+                    help="export file or glob (repeatable; default: "
+                         "$DSTPU_TELEMETRY_EXPORT)")
     ap.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
                     help="refresh every N seconds (0 = one-shot)")
     args = ap.parse_args(argv)
-    path = args.file or os.environ.get("DSTPU_TELEMETRY_EXPORT")
-    if not path:
-        print("dstpu_top: no export file (--file or "
+    paths = _resolve_paths(args.file, args.paths)
+    if not paths and os.environ.get("DSTPU_TELEMETRY_EXPORT"):
+        paths = [os.environ["DSTPU_TELEMETRY_EXPORT"]]
+    if not paths:
+        print("dstpu_top: no export file (--file, paths or "
               "DSTPU_TELEMETRY_EXPORT)", file=sys.stderr)
         return 2
-    if not os.path.exists(path):
-        print(f"dstpu_top: export file not found: {path} — is the "
-              f"engine running with DSTPU_TELEMETRY_EXPORT set?",
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"dstpu_top: export file not found: {missing[0]} — is "
+              f"the engine running with DSTPU_TELEMETRY_EXPORT set?",
               file=sys.stderr)
         return 2
     prev = None
     while True:
         try:
-            snap = load_snapshot(path)
+            if len(paths) == 1:
+                snap = load_snapshot(paths[0])
+                out = render(snap, prev)
+            else:
+                snap, per_source = load_fleet(paths)
+                out = render(snap, prev) + "\n" \
+                    + render_sources(per_source)
         except (OSError, ValueError) as e:
             print(f"dstpu_top: unreadable snapshot: {e}",
                   file=sys.stderr)
             return 2
-        out = render(snap, prev)
         if args.watch > 0:
             print("\x1b[2J\x1b[H" + out, flush=True)
         else:

@@ -114,6 +114,19 @@ HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
     # clock the open-loop invariant protects
     "deepspeed_tpu/telemetry/loadgen.py":
         ("_admit_due", "_decode_burst"),
+    # the replica-pool router's score/select run on the fleet admission
+    # path between the engines' overlapped pipelines: scoring reads
+    # host-side metadata only (prefix-trie walk, dict sizes, streaming-
+    # histogram quantiles) — one device sync here would gate EVERY
+    # replica's admission behind one readback
+    "deepspeed_tpu/serving/router.py": ("select", "score"),
+    # the pool's engine-shaped surface dispatches to per-replica worker
+    # threads; its own bookkeeping (routing groups, stash splicing, the
+    # replica scoring accessors) must stay pure host work — a sync in
+    # put/decode grouping would serialize the whole fleet's round
+    "deepspeed_tpu/serving/pool.py":
+        ("put", "decode_pipelined", "_take_stash", "_run_groups",
+         "prefix_overlap", "queue_frac", "slo_headroom"),
 }
 
 #: roots scanned for DSTPU_* env reads (knob rules + gen_config_doc) —

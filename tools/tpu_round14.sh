@@ -1,0 +1,67 @@
+#!/bin/bash
+# Round-14 on-chip sequence: the replica-pool serving fleet (ISSUE 11).
+# The CPU story is proven in tier-1 (router policy determinism, slot
+# admission control, 2-replica smoke through the open-loop loadgen,
+# drain/absorb token parity, stable-source rollup idempotence) and in
+# the fleet fault drill; on-chip this captures (a) lint cleanliness
+# (the serving DSL001 registry + DSTPU_FLEET_* knob table), (b) the
+# kill-one-of-N fleet drill with a REAL SIGTERM under offered load
+# (token parity on survivors, exact pool recovery, rollup-quantile
+# exactness, late joiner), and (c) the serve_fleet capacity phase —
+# prefix-aware vs random routing at matched load plus the 1-vs-2
+# replica goodput-knee sweep (on real chips each replica owns its own
+# device slice, so the scaling numbers are the honest ones). Strictly
+# sequential (one process owns the chip), no timeouts around TPU
+# clients (a killed client wedges the grant).
+cd /root/repo || exit 1
+LOG=profiles/r14_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round14 start $(date -u +%FT%TZ)"
+
+echo "--- [1/4] dstpu_lint (serving router/pool DSL001 registry,"
+echo "    DSTPU_FLEET_* knobs in docs/CONFIG.md)"
+python bin/dstpu_lint deepspeed_tpu
+
+echo "--- [2/4] fleet fault drill: SIGTERM the busiest of 3 replicas"
+echo "    mid-decode under offered load; survivors replay with warm"
+echo "    caches, merged rollup quantiles == single-stream oracle,"
+echo "    late joiner takes traffic"
+python bin/dstpu_faultdrill --mode fleet
+
+echo "--- [3/4] serve_fleet: prefix-aware vs random routing at matched"
+echo "    offered load (fleet hit frac + TTFT p99), then the 1-vs-2"
+echo "    replica goodput-knee sweep (gate: knee ratio >= 1.6)"
+python bench.py serve_fleet > BENCH_FLEET_r14.json
+tail -c 1600 BENCH_FLEET_r14.json
+
+echo "--- [4/4] fleet loadgen + merged dstpu_top render: a 2-replica"
+echo "    pool pass, each replica exporting its own snapshot file,"
+echo "    rolled up by the multi-file renderer (the cross-process path)"
+python bin/dstpu_loadgen --replicas 2 --policy prefix_aware \
+    --rate 16 --requests 48 --shared-prefix-frac 0.8 \
+    --prefix-groups 4 --out profiles/r14_fleet_loadgen.json
+python - <<'EOF'
+# the same pass in-process, publishing one export file PER REPLICA —
+# exactly what N separate replica processes would leave behind
+from deepspeed_tpu.serving import ReplicaPool
+from deepspeed_tpu.telemetry.loadgen import (PoissonArrivals,
+                                             WorkloadMix, _tiny_engine,
+                                             build_requests,
+                                             run_open_loop)
+built = [_tiny_engine() for _ in range(2)]
+pool = ReplicaPool([e for e, _ in built], policy="prefix_aware")
+mix = WorkloadMix(prompt_lens=(24,), prompt_probs=(1.0,),
+                  gen_lens=(12,), gen_probs=(1.0,),
+                  shared_prefix_frac=0.8, shared_prefix_len=16,
+                  prefix_group_count=4,
+                  vocab_size=built[0][1].vocab_size)
+reqs = build_requests(PoissonArrivals(16.0, seed=3), mix, 48, seed=3)
+run_open_loop(pool, reqs, decode_burst=8, max_live=16)
+for rep in pool.replicas():
+    rep.engine._obs.sync_gauges()
+    rep.engine.metrics.export(
+        f"profiles/r14_replica_{rep.replica_id}.json")
+print("exported", [r.replica_id for r in pool.replicas()])
+EOF
+python bin/dstpu_top 'profiles/r14_replica_*.json'
+echo "=== tpu_round14 done $(date -u +%FT%TZ)"
