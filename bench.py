@@ -1936,6 +1936,357 @@ def bench_moe_train():
     }))
 
 
+def bench_serve_spec():
+    """Speculative decoding + sampling benchmark (ISSUE 12): greedy vs
+    sampled vs speculative decode tokens/s through the serving surface
+    (``decode_pipelined``, which routes greedy batches through
+    ``decode_spec`` when armed), acceptance rate by workload, and the
+    goodput-knee shift measured by the capacity observatory.
+
+    CPU-harness methodology (the serve_pipeline/serve_overlap
+    discipline): the tiny-model harness is COMPUTE-bound — a K+1-token
+    verify scan genuinely costs ~K+1 single steps of FLOPs — while real
+    TPU decode is dispatch/bandwidth-bound (a multi-token verify costs
+    about one step plus one host->chip round trip, which is the entire
+    reason speculative decoding exists). So every measured path pays a
+    SYNTHETIC per-DISPATCH host gap (``DSTPU_SPEC_HOSTMS``, default
+    auto-calibrated to ~3x the measured device step — the stand-in for
+    the tunnel round-trip + host dispatch work of a real deployment):
+    greedy/sampled pay it once per token step, speculation once per
+    verify round. The raw h=0 ratio rides along as
+    ``raw_speedup_vs_greedy`` (informational: compute-bound),
+    ``dispatches_per_token`` is the hardware-independent win, and
+    tools/tpu_round15.sh captures the real-chip numbers.
+
+    Acceptance control: candidate periodic prompts are PROBED per
+    sequence (the model's greedy continuation must be ngram-predictable
+    — self-drafting acceptance is a workload property), the most
+    predictable S sequences are selected, and ``DSTPU_SPEC_NOISE``
+    degrades the proposer to pin measured acceptance near
+    ``DSTPU_SPEC_TARGET_ACC`` (default 0.7) so the headline speedup is
+    read AT the acceptance the ISSUE names, not at a flattering 1.0.
+
+    Gates: speculative streams token-identical to greedy, sampled
+    temperature->0 token-identical to greedy, measured acceptance
+    inside [0.5, 0.85], 0 fresh compiles in every measured window, and
+    speculative decode tokens/s > 1.5x greedy at the calibrated gap."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.analysis import RecompileTripwire
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceConfig,
+                                            SamplingParams)
+    from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+    from deepspeed_tpu.telemetry.loadgen import (WorkloadMix,
+                                                 sweep_capacity)
+
+    on_tpu = jax.default_backend() == "tpu"
+    S = int(os.environ.get("DSTPU_SPEC_SEQS", "8"))
+    GEN = int(os.environ.get("DSTPU_SPEC_GEN", "96"))
+    WARM = 40                       # settle the greedy tails pre-measure
+    K = int(os.environ.get("DSTPU_SPEC_K", "4"))
+    PROMPT, bsz = 32, 16
+    target_acc = float(os.environ.get("DSTPU_SPEC_TARGET_ACC", "0.7"))
+    mcfg = GPT2Config(vocab_size=96, max_seq_len=1024, num_layers=2,
+                      num_heads=2, hidden_size=32, dtype=jnp.float32)
+    params = GPT2(mcfg).init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, 8), jnp.int32))["params"]
+    per_seq = -(-(PROMPT + WARM + GEN + K + 9) // bsz)
+    base = dict(max_seqs=S, chunk_size=PROMPT, block_size=bsz,
+                num_blocks=3 * S * per_seq + 8,
+                max_blocks_per_seq=per_seq + 1, dtype="float32",
+                attention_impl="paged_flash" if on_tpu else "dense",
+                decode_loop_steps=0, serve_pipeline_depth=2,
+                prefix_cache=True)
+
+    def build(spec="off", noise=None):
+        if noise is None:
+            os.environ.pop("DSTPU_SPEC_NOISE", None)
+        else:
+            os.environ["DSTPU_SPEC_NOISE"] = str(noise)
+        return InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base, spec_decode=spec, spec_k=K))
+
+    # ---- the synthetic per-dispatch host gap ------------------------- #
+    def add_gap(eng, h):
+        if h <= 0:
+            return
+        orig_d, orig_l = eng._dispatch_step, eng.runner.decode_loop
+
+        def costed_dispatch(plan):
+            time.sleep(h)
+            return orig_d(plan)
+
+        def costed_loop(*a, **kw):
+            time.sleep(h)
+            return orig_l(*a, **kw)
+
+        eng._dispatch_step = costed_dispatch
+        eng.runner.decode_loop = costed_loop
+
+    # ---- probe: per-sequence self-predictability --------------------- #
+    # periodic prompts; the probe run's per-seq accepted/proposed is the
+    # selection signal — we keep the S most ngram-predictable sequences
+    probe = build(spec="ngram")
+    r = np.random.RandomState(int(os.environ.get("DSTPU_SPEC_SEED", "7")))
+    cand_prompts = [(r.randint(1, mcfg.vocab_size, size=8).tolist()
+                     * (PROMPT // 8 + 1))[:PROMPT] for _ in range(3 * S)]
+    scored = []
+    for lo in range(0, 3 * S, S):
+        us = list(range(lo, lo + S))
+        batch = cand_prompts[lo:lo + S]
+        fp = probe.put(us, batch, _greedy=True)
+        wp = probe._decode_pipelined_impl(us, [fp[u] for u in us], WARM)
+        pp = probe.decode_spec(us, [wp[u][-1] for u in us], 24)
+        for u in us:
+            seq = probe.state.sequences[u]
+            acc = seq.spec_accepted / seq.spec_proposed \
+                if seq.spec_proposed else 0.0
+            scored.append((acc, cand_prompts[u]))
+            probe.flush(u)
+    scored.sort(key=lambda t: -t[0])
+    prompts = [p for _, p in scored[:S]]
+    clean_acc = sum(a for a, _ in scored[:S]) / S
+
+    # ---- noise calibration to the target acceptance ------------------ #
+    def acc_ratio(p):
+        # accepted/proposed of prefix acceptance at per-position
+        # survival p: E[j]/K = sum_{i=1..K} p^i / K
+        return sum(p ** i for i in range(1, K + 1)) / K
+
+    def solve_p(target):
+        lo, hi = 0.0, 1.0
+        for _ in range(48):
+            mid = (lo + hi) / 2
+            if acc_ratio(mid) < target:
+                lo = mid
+            else:
+                hi = mid
+        return (lo + hi) / 2
+
+    noise = 0.0
+    if clean_acc > target_acc:
+        noise = round(max(0.0, 1.0 - solve_p(target_acc)
+                          / max(solve_p(clean_acc), 1e-6)), 4)
+
+    uids = list(range(S))
+
+    def warm_decode(eng, spec):
+        f = eng.put(uids, prompts, _greedy=True)
+        w = eng._decode_pipelined_impl(uids, [f[u] for u in uids], WARM)
+        if spec:
+            w2 = eng.decode_spec(uids, [w[u][-1] for u in uids], 2)
+            return {u: w[u] + w2[u] for u in uids}
+        return w
+
+    def measure(eng, last):
+        tw = RecompileTripwire()
+        t0 = time.perf_counter()
+        with tw:
+            out = eng.decode_pipelined(uids, last, GEN)
+        dt = time.perf_counter() - t0
+        return out, S * GEN / dt, \
+            tw.fresh_compiles if tw.available else None
+
+    # calibrate the gap from the measured warm device step
+    eng_cal = build()
+    wc = warm_decode(eng_cal, False)
+    t0 = time.perf_counter()
+    eng_cal.decode_pipelined(uids, [wc[u][-1] for u in uids], 24)
+    step_ms = (time.perf_counter() - t0) / 24 * 1e3
+    hostms_env = os.environ.get("DSTPU_SPEC_HOSTMS")
+    h_ms = float(hostms_env) if hostms_env not in (None, "") \
+        else (0.0 if on_tpu else round(3.0 * step_ms, 3))
+    h = h_ms / 1e3
+
+    # ---- measured windows (all warm; tripwire-gated) ----------------- #
+    eng_g = build()
+    add_gap(eng_g, h)
+    wg = warm_decode(eng_g, False)
+    out_g, tps_g, comp_g = measure(eng_g, [wg[u][-1] for u in uids])
+
+    # raw (h=0) speculative ratio rides along for honesty
+    eng_raw = build(spec="ngram", noise=noise)
+    wr = warm_decode(eng_raw, True)
+    out_raw, tps_raw, _ = measure(eng_raw, [wr[u][-1] for u in uids])
+    eng_raw0 = build()
+    wr0 = warm_decode(eng_raw0, False)
+    _, tps_raw0, _ = measure(eng_raw0, [wr0[u][-1] for u in uids])
+
+    eng_s = build(spec="ngram", noise=noise)
+    add_gap(eng_s, h)
+    ws = warm_decode(eng_s, True)
+    c0 = (eng_s.metrics.counter("spec_proposed").value,
+          eng_s.metrics.counter("spec_accepted").value,
+          eng_s.metrics.counter("spec_rounds").value)
+    out_s, tps_s, comp_s = measure(eng_s, [ws[u][-1] for u in uids])
+    proposed = eng_s.metrics.counter("spec_proposed").value - c0[0]
+    accepted = eng_s.metrics.counter("spec_accepted").value - c0[1]
+    rounds = eng_s.metrics.counter("spec_rounds").value - c0[2]
+    acc_meas = accepted / proposed if proposed else 0.0
+    # parity: the FULL warm+measured streams must agree token-for-token
+    # over their common span (the spec engines' warm window is 2 tokens
+    # longer — their measured window starts 2 positions later)
+    span = WARM + GEN
+    full_g = {u: (wg[u] + out_g[u])[:span] for u in uids}
+    full_s = {u: (ws[u] + out_s[u])[:span] for u in uids}
+    full_r = {u: (wr[u] + out_raw[u])[:span] for u in uids}
+    parity_spec = full_s == full_g and full_r == full_g
+
+    # sampled leg: same pipeline, per-slot sampler; plus the temp->0
+    # parity oracle
+    eng_t = build()
+    add_gap(eng_t, h)
+    sp = {u: SamplingParams(temperature=0.8, top_k=16, seed=u)
+          for u in uids}
+    f_t = eng_t.put(uids, prompts, _greedy=True, sampling=sp)
+    w_t = eng_t._decode_pipelined_impl(uids, [f_t[u] for u in uids], WARM)
+    out_t, tps_t, comp_t = measure(eng_t, [w_t[u][-1] for u in uids])
+    distinct_t = len({t for v in out_t.values() for t in v})
+    eng_0 = build()
+    sp0 = {u: SamplingParams(temperature=0.0) for u in uids}
+    f_0 = eng_0.put(uids, prompts, _greedy=True, sampling=sp0)
+    w_0 = eng_0._decode_pipelined_impl(uids, [f_0[u] for u in uids], WARM)
+    out_0 = eng_0.decode_pipelined(uids, [w_0[u][-1] for u in uids], 24)
+    parity_t0 = out_0 == {u: out_g[u][:24] for u in uids} \
+        and w_0 == wg
+
+    # ---- goodput-knee shift via the capacity observatory ------------- #
+    # both engines pay the same per-dispatch gap; speculation shortens
+    # each request's decode service time, so the knee should move right
+    knee = {}
+    if os.environ.get("DSTPU_SPEC_SWEEP", "1") not in ("0", "off"):
+        # enough requests that an above-capacity rate builds a backlog
+        # the SLO deadline actually catches (the serve_capacity
+        # bracketing lesson: tail wait ~ (n/C)(1 - C/r) must exceed the
+        # deadline at the top swept rate)
+        n_req = int(os.environ.get("DSTPU_SPEC_SWEEP_REQS", "56"))
+        GEN_K = 24
+        # the sweep workload draws prompts from the SELECTED
+        # self-predictable pool (WorkloadMix.prompt_pool — recorded-
+        # prompt replay): acceptance is a content property, so the
+        # observatory must offer content speculation can accept, at a
+        # wall-clock rate it does not control
+        def mk_mix(deadline):
+            return WorkloadMix(
+                gen_lens=(GEN_K,), gen_probs=(1.0,),
+                deadline_frac=1.0, deadline_s=deadline,
+                vocab_size=mcfg.vocab_size, prompt_pool=prompts)
+        from deepspeed_tpu.telemetry.loadgen import (PoissonArrivals,
+                                                     build_requests,
+                                                     run_open_loop)
+        eng_ko = build()
+        add_gap(eng_ko, h)
+
+        def pass_at(eng, rate, n, seed, mix):
+            return run_open_loop(
+                eng, build_requests(PoissonArrivals(rate, seed=seed),
+                                    mix, n, seed=seed,
+                                    uid_base=seed * 1_000_000),
+                decode_burst=6, max_live=S)
+        # warm (eats compiles), then calibrate ceiling C + the SLO
+        # deadline off a light pass (the serve_capacity discipline)
+        pass_at(eng_ko, 1e4, 8, 31, mk_mix(0.0))
+        cal = pass_at(eng_ko, 1e4, n_req, 32, mk_mix(0.0))
+        c_rps = cal.report["rates_rps"]["completed"] or 1.0
+        light = pass_at(eng_ko, 0.4 * c_rps, n_req, 33, mk_mix(0.0))
+        lat = light.report["latency"]["ttft_s"]
+        l99 = (lat.get("p99") or 0.05) + GEN_K * (
+            light.report["decode"]["step_lat"].get("p50") or h + 1e-3)
+        deadline = max(0.25, 3.0 * l99)
+        mix = mk_mix(deadline)
+        # the top fracs must overrun BOTH knees: greedy's sits near
+        # 1xC, speculation's ~(tokens-per-round)x higher
+        rates = [round(f * c_rps, 3)
+                 for f in (0.6, 1.0, 1.6, 2.4, 3.6)]
+        sw_off = sweep_capacity(eng_ko, rates, n_req, mix, seed=13,
+                                decode_burst=6, max_live=S)
+        eng_kn = build(spec="ngram", noise=noise)
+        add_gap(eng_kn, h)
+        pass_at(eng_kn, 1e4, 8, 31, mk_mix(0.0))     # warm the spec path
+        sw_on = sweep_capacity(eng_kn, rates, n_req, mix, seed=13,
+                               decode_burst=6, max_live=S)
+
+        def bracketed(sw):
+            return any(r["goodput_frac"] is not None
+                       and r["goodput_frac"] < 0.9 for r in sw["curve"])
+        knee = {
+            "deadline_s": round(deadline, 4),
+            "capacity_rps_greedy": round(c_rps, 3),
+            "rates_swept": rates,
+            "knee_off_rps": sw_off["knee_rps"],
+            "knee_on_rps": sw_on["knee_rps"],
+            "knee_off_bracketed": bracketed(sw_off),
+            "knee_on_bracketed": bracketed(sw_on),
+            "knee_shift": round(sw_on["knee_rps"] / sw_off["knee_rps"], 3)
+            if sw_off["knee_rps"] and sw_on["knee_rps"] else None,
+            "curve_off": sw_off["curve"],
+            "curve_on": sw_on["curve"],
+            "spec_accept_rate_sweep":
+                eng_kn.slo_report().get("spec_accept_rate"),
+        }
+
+    speedup = tps_s / tps_g if tps_g else 0.0
+    compiles = [c for c in (comp_g, comp_s, comp_t) if c is not None]
+    row = {
+        "model": f"gpt2-tiny {mcfg.num_layers}L hidden={mcfg.hidden_size}"
+                 f" (CPU-harness synthetic)" if not on_tpu
+                 else f"gpt2 {mcfg.num_layers}L",
+        "batch_seqs": S, "gen_len": GEN, "spec_k": K,
+        "device_step_ms": round(step_ms, 3),
+        "host_gap_ms_per_dispatch": h_ms,
+        "workload": {
+            "kind": "periodic-prompt self-drafting",
+            "clean_acceptance": round(clean_acc, 4),
+            "noise_injected": noise,
+            "target_acceptance": target_acc,
+        },
+        "greedy": {"decode_tokens_per_sec": round(tps_g, 1),
+                   "fresh_compiles_measured": comp_g},
+        "sampled": {"decode_tokens_per_sec": round(tps_t, 1),
+                    "vs_greedy": round(tps_t / tps_g, 3) if tps_g else 0,
+                    "distinct_tokens": distinct_t,
+                    "fresh_compiles_measured": comp_t},
+        "speculative": {
+            "decode_tokens_per_sec": round(tps_s, 1),
+            "accept_rate_measured": round(acc_meas, 4),
+            "rounds": rounds,
+            "tokens_per_round": round(S * GEN / rounds, 2) if rounds else 0,
+            "dispatches_per_token": round(rounds / (S * GEN), 4)
+            if rounds else None,
+            "fresh_compiles_measured": comp_s,
+        },
+        "speedup_vs_greedy": round(speedup, 3),
+        "raw_speedup_vs_greedy": round(tps_raw / tps_raw0, 3)
+        if tps_raw0 else None,
+        "token_parity_spec_vs_greedy": parity_spec,
+        "token_parity_temp0_vs_greedy": parity_t0,
+        "knee_shift": knee,
+        "serve_config": {
+            "DSTPU_SPEC_SEQS": S, "DSTPU_SPEC_GEN": GEN,
+            "DSTPU_SPEC_K": K, "DSTPU_SPEC_HOSTMS": h_ms,
+            "DSTPU_SPEC_TARGET_ACC": target_acc,
+            "DSTPU_SPEC_NOISE": noise,
+        },
+    }
+    print(json.dumps(row))
+    os.environ.pop("DSTPU_SPEC_NOISE", None)
+    ok = (parity_spec and parity_t0
+          and 0.5 <= acc_meas <= 0.85
+          and speedup > 1.5
+          and all(c == 0 for c in compiles)
+          # a knee SHIFT is only evidence when the greedy knee is
+          # bracketed (some rate must break it below the spec knee)
+          and (not knee or (knee["knee_off_bracketed"]
+                            and knee["knee_shift"] is not None
+                            and knee["knee_shift"] >= 1.0)))
+    return 0 if ok else 1
+
+
 def bench_serve_fastgen():
     """FastGen-WORKLOAD serving benchmark (VERDICT r3 #4): Poisson request
     arrivals, mixed prompt/generation lengths, continuous batching through
@@ -2135,6 +2486,8 @@ def main():
         return bench_serve_capacity()
     if sys.argv[1:] == ["serve_fleet"]:
         return bench_serve_fleet()
+    if sys.argv[1:] == ["serve_spec"]:
+        return bench_serve_spec()
     if sys.argv[1:] == ["fastgen"]:
         return bench_serve_fastgen()
     if sys.argv[1:] == ["moe"]:
@@ -2175,7 +2528,8 @@ def main():
     for phase in ("train", "train_xl", "train_1p3b", "serve",
                   "serve_pipeline", "serve_prefix", "serve_drill",
                   "serve_overlap", "serve_obs", "serve_capacity",
-                  "serve_fleet", "fastgen", "moe", "moe_train"):
+                  "serve_fleet", "serve_spec", "fastgen", "moe",
+                  "moe_train"):
         if dead:
             out[phase] = {"error": "skipped_backend_dead"}
             continue
@@ -2248,6 +2602,7 @@ def main():
                    "serve_obs": out.get("serve_obs", {}),
                    "serve_capacity": out.get("serve_capacity", {}),
                    "serve_fleet": out.get("serve_fleet", {}),
+                   "serve_spec": out.get("serve_spec", {}),
                    "fastgen": out.get("fastgen", {}),
                    "moe_serve": out.get("moe", {}),
                    "moe_train": out.get("moe_train", {}),

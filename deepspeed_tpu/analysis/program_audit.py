@@ -401,11 +401,15 @@ def assert_budget(report: ProgramReport, budget: CollectiveBudget) -> None:
 
 
 def audit_serve_programs(engine, programs: Tuple[str, ...] = (
-        "step", "step_greedy", "step_greedy_fb", "decode_loop",
-        "flush_ring")) -> Dict[str, ProgramReport]:
+        "step", "step_greedy", "step_greedy_fb", "step_sample_fb",
+        "decode_loop", "decode_verify", "flush_ring")
+        ) -> Dict[str, ProgramReport]:
     """Audit the v2 ragged engine's jitted runner programs against
     representative decode-shaped inputs (S = max_seqs slots, one token
-    each). Returns {program name: ProgramReport}."""
+    each). Returns {program name: ProgramReport}. The sampled feedback
+    step and the speculative verify loop are audited alongside the
+    greedy programs: sampling/verification must add ZERO collectives
+    and zero host callbacks over their greedy siblings."""
     import jax.numpy as jnp
 
     from ..inference.v2.kv_quant import pool_parts
@@ -421,6 +425,7 @@ def audit_serve_programs(engine, programs: Tuple[str, ...] = (
         block_tables=jnp.zeros((S, MAXB), jnp.int32))
     zeros_s = jnp.zeros((S,), jnp.int32)
     ones_s = jnp.ones((S,), jnp.int32)
+    ones_f = jnp.ones((S,), jnp.float32)
 
     reports: Dict[str, ProgramReport] = {}
     if "step" in programs:
@@ -432,15 +437,34 @@ def audit_serve_programs(engine, programs: Tuple[str, ...] = (
         reports["step_greedy_fb"] = audit_fn(
             r._step_greedy_fb, params, kv, batch, zeros_s, ones_s, zeros_s,
             name="step_greedy_fb")
+    if "step_sample_fb" in programs and hasattr(r, "_step_sample_fb"):
+        reports["step_sample_fb"] = audit_fn(
+            r._step_sample_fb, params, kv, batch, zeros_s, ones_s, zeros_s,
+            zeros_s, zeros_s, ones_f, zeros_s, ones_f,
+            name="step_sample_fb")
     n = max(2, int(cfg.decode_loop_steps) or 2)
     n = min(n, cfg.block_size)     # linear-layout flush bound (R <= bs)
+    samp_dummies = (jnp.zeros((1,), jnp.int32),
+                    jnp.zeros((1,), jnp.float32),
+                    jnp.zeros((1,), jnp.int32),
+                    jnp.ones((1,), jnp.float32))
     if "decode_loop" in programs:
         reports["decode_loop"] = audit_fn(
             r._decode_loop_ring, params, kv, zeros_s, zeros_s, ones_s,
-            batch.block_tables, jax.random.PRNGKey(0),
-            static_kwargs=dict(n=n, mode="greedy", top_k=0, cand=1,
-                               temp=1.0, top_p=1.0, eos_id=-1),
+            batch.block_tables, *samp_dummies,
+            jnp.zeros((1, 1), jnp.int32),
+            static_kwargs=dict(n=n, mode="greedy", cand=1, eos_id=-1,
+                               feed="self"),
             name="decode_loop")
+    if "decode_verify" in programs:
+        # the speculative verify program: identical scan, draft-fed
+        reports["decode_verify"] = audit_fn(
+            r._decode_loop_ring, params, kv, zeros_s, zeros_s, ones_s,
+            batch.block_tables, *samp_dummies,
+            jnp.zeros((S, n), jnp.int32),
+            static_kwargs=dict(n=n, mode="greedy", cand=1, eos_id=-1,
+                               feed="given"),
+            name="decode_verify")
     if "flush_ring" in programs:
         pool_arr, pool_scales = pool_parts(kv)
         ring = jnp.zeros(

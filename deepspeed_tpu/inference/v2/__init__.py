@@ -25,14 +25,17 @@ from .engine_factory import build_hf_engine
 from .engine_v2 import InferenceEngineV2
 from .kv_cache import BlockedKVCache
 from .prefix_cache import PrefixCache
+from .sampling import SamplingParams
 from .sequence import SequenceDescriptor, SequenceStatus
+from .speculative import DraftModelProposer, NgramProposer
 from .state_manager import StateManager
 from .tp import TPContext, build_tp_context
 
 __all__ = [
-    "BlockedAllocator", "BlockedKVCache", "EngineDrainingError",
-    "InferenceEngineV2", "PrefixCache", "RaggedInferenceConfig",
-    "ReplayJournal", "SequenceDescriptor", "SequenceStatus",
+    "BlockedAllocator", "BlockedKVCache", "DraftModelProposer",
+    "EngineDrainingError", "InferenceEngineV2", "NgramProposer",
+    "PrefixCache", "RaggedInferenceConfig", "ReplayJournal",
+    "SamplingParams", "SequenceDescriptor", "SequenceStatus",
     "ServeDrainError", "ServeStepError", "StateManager", "TPContext",
     "build_hf_engine", "build_tp_context", "load_manifest",
     "load_replay_state", "manifest_from_journal",

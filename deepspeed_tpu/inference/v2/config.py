@@ -128,6 +128,29 @@ class RaggedInferenceConfig(ConfigModel):
     # durability).
     serve_journal: str = ""
 
+    # ---- speculative decoding (speculative.py, docs/serving.md) -------
+    # Draft-and-verify multi-token decode for GREEDY sequences: a
+    # proposer emits up to spec_k candidate tokens per sequence per
+    # round, ONE fused verify program scores all K+1 positions
+    # (decode_loop with draft-fed inputs), and rejected tokens roll back
+    # through the deferred trim_blocks discipline. Token-identical to
+    # non-speculative greedy by construction.
+    #   "off"   — no speculation (the parity oracle);
+    #   "ngram" — model-free self-drafting: propose the continuation of
+    #             the last n-gram's previous occurrence in the
+    #             sequence's own history (prompt lookup decoding);
+    #   "draft" — a config-paired small draft model (attach via
+    #             engine.attach_draft; e.g. gpt2 drafting for llama).
+    # Env override at engine construction: DSTPU_SPEC_MODE; sampled
+    # (temperature > 0) sequences bypass speculation.
+    spec_decode: str = "off"
+    # Draft tokens proposed per sequence per round (the verify program
+    # scores spec_k + 1 positions). Env: DSTPU_SPEC_K.
+    spec_k: int = 4
+    # n-gram width the "ngram" proposer matches against the sequence's
+    # own history (falls back n, n-1, .., 1). Env: DSTPU_SPEC_NGRAM.
+    spec_ngram: int = 3
+
     # sampling defaults for the built-in generate loop
     greedy: bool = True
     temperature: float = 1.0
@@ -194,6 +217,15 @@ class RaggedInferenceConfig(ConfigModel):
             raise ValueError(
                 f"serve_retry_backoff_s must be >= 0, got "
                 f"{self.serve_retry_backoff_s}")
+        if self.spec_decode not in ("off", "ngram", "draft"):
+            raise ValueError(
+                f"spec_decode must be 'off', 'ngram' or 'draft', got "
+                f"{self.spec_decode!r}")
+        if self.spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {self.spec_k}")
+        if self.spec_ngram < 1:
+            raise ValueError(
+                f"spec_ngram must be >= 1, got {self.spec_ngram}")
 
     @property
     def max_context(self) -> int:

@@ -77,12 +77,18 @@ class ReplayJournal:
         if self.fsync:
             os.fsync(self._f.fileno())
 
-    def admit(self, uid: int, prompt: List[int]) -> None:
+    def admit(self, uid: int, prompt: List[int],
+              sampling: Optional[Dict[str, Any]] = None) -> None:
         """A (possibly re-)admitted sequence: the full prompt chain. A
         later ``admit`` for the same uid supersedes the earlier one (a
-        replayed sequence's prompt is its whole resumed chain)."""
-        self._write({"e": "admit", "uid": int(uid),
-                     "prompt": [int(t) for t in prompt]})
+        replayed sequence's prompt is its whole resumed chain).
+        ``sampling`` (a SamplingParams dict) rides along so a
+        hard-crash replay keeps sampled streams deterministic."""
+        rec = {"e": "admit", "uid": int(uid),
+               "prompt": [int(t) for t in prompt]}
+        if sampling:
+            rec["sampling"] = sampling
+        self._write(rec)
 
     def tokens(self, per_uid: Dict[int, List[int]]) -> None:
         """Tokens COMMITTED this step, batched across slots (one record
@@ -119,7 +125,8 @@ def manifest_from_journal(path: str) -> Dict[str, Any]:
                 break                      # torn tail record: stop here
             if rec.get("e") == "admit":
                 seqs[int(rec["uid"])] = {"prompt": list(rec["prompt"]),
-                                         "generated": []}
+                                         "generated": [],
+                                         "sampling": rec.get("sampling")}
             elif rec.get("e") == "tokens":
                 for u, toks in rec.get("t", {}).items():
                     if int(u) in seqs:
@@ -132,7 +139,7 @@ def manifest_from_journal(path: str) -> Dict[str, Any]:
         "time": time.time(),
         "sequences": [
             {"uid": uid, "prompt": s["prompt"], "generated": s["generated"],
-             "scheduler": {}}
+             "sampling": s.get("sampling"), "scheduler": {}}
             for uid, s in sorted(seqs.items())],
     }
 
@@ -153,6 +160,10 @@ def build_manifest(engine) -> Dict[str, Any]:
             "uid": uid,
             "prompt": list(seq.prompt_log),
             "generated": list(seq.gen_log),
+            # sampled requests replay deterministically only with their
+            # sampling identity restored (seed + position-folded keys)
+            "sampling": seq.sampling.to_dict()
+            if seq.sampling is not None else None,
             "scheduler": engine.scheduler.describe(seq),
         })
     return {

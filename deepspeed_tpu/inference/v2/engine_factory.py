@@ -29,7 +29,9 @@ def build_hf_engine(model_dir: str,
                     dtype: Optional[str] = None,
                     quantization_mode: Optional[str] = None,
                     strict: bool = True,
-                    tp_size: Optional[int] = None) -> InferenceEngineV2:
+                    tp_size: Optional[int] = None,
+                    draft_model_dir: Optional[str] = None
+                    ) -> InferenceEngineV2:
     """Build a ragged inference engine from a HuggingFace checkpoint dir.
 
     ``quantization_mode``: None | "wf8" (int8 WOQ) | "wf4" (int4 WOQ) —
@@ -37,6 +39,11 @@ def build_hf_engine(model_dir: str,
     ``tp_size``: tensor-parallel degree over the ``model`` mesh axis
     (overrides ``engine_config.tp_size`` — the reference's AutoTP-style
     one-knob entry; see docs/serving.md).
+    ``draft_model_dir``: a config-paired small DRAFT checkpoint for
+    speculative decoding (e.g. gpt2 drafting for llama — any of the
+    served families; must share the target's tokenizer/vocab). The
+    draft is attached via ``engine.attach_draft`` and used when
+    ``spec_decode='draft'`` (docs/serving.md "Speculative decoding").
     """
     import json
     import os
@@ -67,6 +74,15 @@ def build_hf_engine(model_dir: str,
     if tp_size is not None:
         cfg = dataclasses.replace(cfg, tp_size=int(tp_size))
     engine = InferenceEngineV2(model_cfg, params, cfg)
+    if draft_model_dir is not None:
+        d_arch, d_cfg, d_params = load_hf_model(draft_model_dir,
+                                                strict=strict)
+        if dtype is not None:
+            d_cfg = dataclasses.replace(d_cfg,
+                                        dtype=resolve_dtype(dtype))
+        engine.attach_draft(d_cfg, d_params)
+        log_dist(f"build_hf_engine: draft pair {d_arch} from "
+                 f"{draft_model_dir} (spec_decode={cfg.spec_decode})")
     log_dist(f"build_hf_engine: {arch} from {model_dir} "
              f"(quant={quantization_mode or 'off'}, tp={cfg.tp_size})")
     return engine

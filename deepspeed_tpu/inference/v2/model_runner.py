@@ -28,6 +28,59 @@ from ...parallel.tp_rules import MODEL_AXIS
 from ...utils.jax_compat import manual_axes, shard_map
 from .config import RaggedInferenceConfig
 from .kv_quant import KVPool, RingKV, pool_parts, quantize_rows, repack
+from .sampling import SAMPLE_CANDIDATES
+
+
+# --------------------------------------------------------------------- #
+# on-device per-slot token selection (sampling.py has the host half)
+# --------------------------------------------------------------------- #
+
+
+def _sample_keys(seeds, positions):
+    """Per-slot threefry keys as a pure function of (seed, absolute
+    position of the token being selected) — no key state in any carry,
+    so streams are identical across pipeline depths, fused-vs-per-step
+    paths and drain/replay restarts (sampling.py has the contract)."""
+    def one(s, p):
+        return jax.random.fold_in(jax.random.PRNGKey(s), p)
+    return jax.vmap(one)(seeds, positions)
+
+
+def _select_tokens(logits, keys, temps, top_ks, top_ps, *, cand):
+    """Per-slot temperature/top-k/top-p categorical [S, V] -> [S].
+
+    A slot with ``temps[i] <= 0`` short-circuits to ``argmax`` — the
+    temperature→0 parity oracle (bit-identical to the greedy programs,
+    including first-index tie-breaks: both ``argmax`` and ``top_k``
+    rank ties by index). Sampling draws from a STATIC ``cand``-wide
+    candidate set (the top-``cand`` logits; top-p re-normalizes within
+    it) via the gumbel trick, so the per-step noise tensor is
+    [S, cand], never [S, V].
+    """
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    vals, idxs = jax.lax.top_k(logits, cand)            # [S, cand]
+    x = (vals / jnp.maximum(temps[:, None], 1e-6)).astype(jnp.float32)
+    ar = jnp.arange(cand, dtype=jnp.int32)[None, :]
+    x = jnp.where((top_ks[:, None] > 0) & (ar >= top_ks[:, None]),
+                  -jnp.inf, x)
+    p = jax.nn.softmax(x, axis=-1)
+    mass_before = jnp.cumsum(p, axis=-1) - p
+    x = jnp.where(mass_before < top_ps[:, None], x, -jnp.inf)  # keeps rank 0
+    g = jax.vmap(lambda k: jax.random.gumbel(k, (cand,), jnp.float32))(keys)
+    choice = jnp.argmax(x + g, axis=-1)
+    samp = jnp.take_along_axis(idxs, choice[:, None], axis=-1)[:, 0]
+    return jnp.where(temps <= 0.0, greedy_tok, samp.astype(jnp.int32))
+
+
+def _chosen_logprob(logits, tok):
+    """log p(tok) under the UNMODIFIED model distribution (raw softmax
+    of the full-width logits) — the convention ``logprobs=True``
+    requests surface (docs/serving.md)."""
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(
+        logits.astype(jnp.float32), tok[:, None].astype(jnp.int32),
+        axis=-1)[:, 0]
+    return picked - lse
 
 
 class RaggedBatch(NamedTuple):
@@ -479,6 +532,32 @@ class RaggedRunnerBase:
         self._step_greedy_fb = jax.jit(_step_greedy_fb,
                                        donate_argnums=donate)
 
+        # sampled sibling of the feedback step (the pipelined SAMPLING
+        # path, docs/serving.md "Sampling"): same device-token feed, but
+        # token selection is the per-slot temperature/top-k/top-p
+        # categorical — keys derived IN-PROGRAM from the staged
+        # (seed, position) int32 pairs, so no RNG state crosses the
+        # host boundary and zero new host callbacks appear. Greedy
+        # slots ride along with temperature 0 (in-program argmax), so
+        # one program serves mixed greedy/sampled batches. Returns
+        # ((token ids [S], chosen-token logprobs [S]), kv): the token
+        # buffer is the same device feedback source step_greedy_fb
+        # produces; logprobs ride to the host at commit.
+        def _step_sample_fb(params, kv_data, batch, prev_tok, feed_mask,
+                            feed_idx, seeds, spos, temps, top_ks, top_ps):
+            fed = prev_tok[jnp.clip(feed_idx, 0, prev_tok.shape[0] - 1)]
+            tok0 = jnp.where(feed_mask > 0, fed, batch.tokens[:, 0])
+            batch = batch._replace(tokens=batch.tokens.at[:, 0].set(tok0))
+            logits, kv_out = _step(params, kv_data, batch)
+            keys = _sample_keys(seeds, spos)
+            cand = min(SAMPLE_CANDIDATES, logits.shape[-1])
+            tok = _select_tokens(logits, keys, temps, top_ks, top_ps,
+                                 cand=cand)
+            return (tok, _chosen_logprob(logits, tok)), kv_out
+
+        self._step_sample_fb = jax.jit(_step_sample_fb,
+                                       donate_argnums=donate)
+
         # fused multi-step greedy decode: n forward+argmax+KV-append steps
         # in ONE device program (lax.scan), feeding each step's token to
         # the next. Per-token host round-trips — the decode wall when the
@@ -490,30 +569,9 @@ class RaggedRunnerBase:
         # per-step pool scatter (TPU scatter slow path) AND the 1-GB pool
         # carry out of the scan entirely — the ring is flushed once per
         # loop (_flush_ring).
-        def _select_next(logits, key, temp, top_p, *, mode, top_k, cand):
-            """On-device token selection [S, V] -> [S] (VERDICT r3 #8).
-            ``mode`` "greedy" -> argmax. "sample": temperature + top-k +
-            top-p + gumbel-trick categorical over a STATIC ``cand``-wide
-            candidate set (the top-``cand`` logits — top-p re-normalizes
-            within it; cand=256 captures effectively all mass, and keeps
-            the per-step noise tensor [S, cand] instead of [S, V])."""
-            if mode == "greedy":
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            vals, idxs = jax.lax.top_k(logits, cand)          # [S, cand]
-            x = vals / jnp.maximum(temp, 1e-6)
-            if 0 < top_k < cand:
-                x = jnp.where(jnp.arange(cand) < top_k, x, -jnp.inf)
-            p = jax.nn.softmax(x, axis=-1)
-            mass_before = jnp.cumsum(p, axis=-1) - p
-            x = jnp.where(mass_before < top_p, x, -jnp.inf)   # keeps rank 0
-            g = jax.random.gumbel(key, x.shape, jnp.float32)
-            choice = jnp.argmax(x + g, axis=-1)
-            return jnp.take_along_axis(
-                idxs, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
-
         def _decode_loop_impl(params, kv_data, tok0, start, active, tables,
-                              key, *, n, mode, top_k, cand, temp, top_p,
-                              eos_id):
+                              seeds, temps, top_ks, top_ps, drafts,
+                              *, n, mode, cand, eos_id, feed):
             params = self._local_params(params)
             S = cfg.max_seqs
             pool_arr, pool_scales = pool_parts(kv_data)
@@ -529,7 +587,7 @@ class RaggedRunnerBase:
             done0 = jnp.zeros((S,), jnp.bool_)
 
             def body(carry, t):
-                ring, tok, pos, k0, done = carry
+                ring, tok, pos, done = carry
                 if use_eos:
                     # per-slot EOS freeze: finished slots stop appending KV
                     # (n_tokens 0 -> trash writes) and keep emitting eos_id
@@ -539,6 +597,15 @@ class RaggedRunnerBase:
                     # EOS the scheduler state is static per call and XLA
                     # hoists it out of the scan
                     alive = active
+                if feed == "given":
+                    # speculative VERIFY (docs/serving.md "Speculative
+                    # decoding"): step t consumes the CALLER's token —
+                    # [last committed, draft_1..draft_K] — instead of
+                    # its own previous output, so the scan scores the
+                    # model's selection after every draft prefix in ONE
+                    # program; the host accepts the longest agreeing
+                    # prefix and rolls the rest back
+                    tok = drafts[:, t]
                 batch = RaggedBatch(tokens=tok[:, None], start_pos=pos,
                                     n_tokens=alive, block_tables=tables)
                 logits, kv_out = type(self).step_fn(
@@ -550,49 +617,56 @@ class RaggedRunnerBase:
                 logits = tp_gather_logits(logits, vocab)
                 if mode == "greedy":
                     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    lp = jnp.zeros((S,), jnp.float32)
                 else:
-                    k0, sub = jax.random.split(k0)
-                    nxt = _select_next(logits, sub, jnp.float32(temp),
-                                       jnp.float32(top_p), mode=mode,
-                                       top_k=top_k, cand=cand)
+                    # keys are a pure function of (seed, the position the
+                    # selected token will occupy) — deterministic across
+                    # fused/per-step paths and restarts (sampling.py)
+                    keys = _sample_keys(seeds, pos + 1)
+                    nxt = _select_tokens(logits, keys, temps, top_ks,
+                                         top_ps, cand=cand)
+                    lp = _chosen_logprob(logits, nxt)
                 if use_eos:
                     nxt = jnp.where(done, jnp.int32(eos_id), nxt)
                     new_pos = pos + (1 - done.astype(jnp.int32))
                     done = jnp.logical_or(done, nxt == eos_id)
                 else:
                     new_pos = pos + 1
-                return (ring, nxt, new_pos, k0, done), nxt
+                return (ring, nxt, new_pos, done), (nxt, lp)
 
-            (ring, _, pos_f, _, _), toks = jax.lax.scan(
-                body, (ring, tok0, start, key, done0),
+            (ring, _, pos_f, _), (toks, lps) = jax.lax.scan(
+                body, (ring, tok0, start, done0),
                 jnp.arange(n, dtype=jnp.int32))
             # consumed is shard_map-shape-stable: always an array; the
             # decode_loop wrapper drops it when EOS is disabled
-            return jnp.transpose(toks), ring, pos_f - start
+            return jnp.transpose(toks), jnp.transpose(lps), ring, \
+                pos_f - start
 
         def _decode_loop_ring(params, kv_data, tok0, start, active, tables,
-                              key, *, n, mode, top_k, cand, temp, top_p,
-                              eos_id):
-            # temp/top_p/eos_id are STATIC: they change rarely (per
-            # tokenizer / per sampling profile) and passing them as device
-            # scalars cost tunnel round-trips on every fused-loop call
+                              seeds, temps, top_ks, top_ps, drafts,
+                              *, n, mode, cand, eos_id, feed):
+            # n/mode/cand/eos_id/feed are STATIC: they change rarely (per
+            # tokenizer / per sampling profile) and shape the program;
+            # per-slot sampling params ride as [S] device arrays so one
+            # compiled program serves every request mix
             impl = functools.partial(
-                _decode_loop_impl, n=n, mode=mode, top_k=top_k, cand=cand,
-                temp=temp, top_p=top_p, eos_id=eos_id)
+                _decode_loop_impl, n=n, mode=mode, cand=cand,
+                eos_id=eos_id, feed=feed)
             if tp is not None:
                 impl = self._wrap(
                     impl,
-                    (pspecs, pool_spec, P(), P(), P(), P(), P()),
-                    (P(), ring_spec, P()))
-            return impl(params, kv_data, tok0, start, active, tables, key)
+                    (pspecs, pool_spec, P(), P(), P(), P(), P(), P(),
+                     P(), P(), P()),
+                    (P(), P(), ring_spec, P()))
+            return impl(params, kv_data, tok0, start, active, tables,
+                        seeds, temps, top_ks, top_ps, drafts)
 
         # dslint: allow(DSL002): the pool is strictly READ-ONLY inside
         # the fused loop (fresh K/V rides the small ring carry);
         # _flush_ring consumes — and donates — the pool right after
         self._decode_loop_ring = jax.jit(
             _decode_loop_ring,
-            static_argnames=("n", "mode", "top_k", "cand", "temp", "top_p",
-                             "eos_id"))
+            static_argnames=("n", "mode", "cand", "eos_id", "feed"))
 
         # flush: write the loop's ring rows into the pool. Linear layout
         # (one block per sequence) gets per-sequence dynamic-update-slices
@@ -675,36 +749,68 @@ class RaggedRunnerBase:
         return self._step_greedy_fb(params, kv_data, batch, prev_tok,
                                     feed_mask, feed_idx)
 
+    def step_sample_fb(self, params, kv_data, batch: "RaggedBatch",
+                       prev_tok, feed_mask, feed_idx, seeds, spos, temps,
+                       top_ks, top_ps):
+        """Sampled sibling of :meth:`step_greedy_fb`: per-slot
+        temperature/top-k/top-p selection with in-program
+        ``fold_in(PRNGKey(seeds[i]), spos[i])`` keys; slots with
+        ``temps[i] <= 0`` are exact argmax (the temperature→0 oracle).
+        Returns ((token ids [S] int32, chosen logprobs [S] f32), new
+        kv_data) — the token buffer doubles as the next step's device
+        feedback source."""
+        return self._step_sample_fb(params, kv_data, batch, prev_tok,
+                                    feed_mask, feed_idx, seeds, spos,
+                                    temps, top_ks, top_ps)
+
     def decode_loop(self, params, kv_data, tok0, start_pos, active,
-                    block_tables, n: int, *, key=None, temperature=1.0,
-                    top_k: int = 0, top_p: float = 1.0,
-                    eos_id: int = -1, candidates: int = 256):
+                    block_tables, n: int, *, seeds=None, temps=None,
+                    top_ks=None, top_ps=None, eos_id: int = -1,
+                    draft_toks=None, candidates: int = SAMPLE_CANDIDATES):
         """Decode ``n`` tokens per active slot on-device (greedy when
-        ``key`` is None, else temperature/top-k/top-p categorical — the
-        whole sampler lives inside the scan) and flush the loop's KV into
-        the pool.
+        ``temps`` is None, else per-slot temperature/top-k/top-p
+        categorical — the whole sampler lives inside the scan, keys
+        derived from (seed, position)) and flush the loop's KV into the
+        pool.
 
         tok0 [S] int32: each slot's next input token (KV not yet appended);
         start_pos [S]: its absolute position; active [S]: 1 live / 0 idle.
         ``eos_id`` >= 0 freezes a slot once it emits eos (it keeps emitting
-        eos and stops consuming KV). Returns (tokens [S, n] int32,
-        new kv_data, consumed [S] int32 — KV positions each slot appended).
-        Slots must have KV blocks covering start_pos..start_pos+n-1.
+        eos and stops consuming KV). ``draft_toks`` [S, n] switches the
+        loop to the speculative VERIFY feed: step t consumes
+        ``draft_toks[:, t]`` instead of the previous step's own output,
+        so one program scores the model's choice after every draft
+        prefix. Returns (tokens [S, n] int32, logprobs [S, n] f32 or
+        None, new kv_data, consumed [S] int32 or None — KV positions
+        each slot appended, None when EOS is off). Slots must have KV
+        blocks covering start_pos..start_pos+n-1.
         """
-        mode = "greedy" if key is None else "sample"
-        if key is None:
-            if not hasattr(self, "_dummy_key"):
-                self._dummy_key = jax.random.PRNGKey(0)  # one transfer ever
-            key = self._dummy_key
-        cand = min(candidates, getattr(self.model_cfg, "vocab_size", 1 << 30))
-        toks, ring, consumed = self._decode_loop_ring(
-            params, kv_data, tok0, start_pos, active, block_tables, key,
-            n=n, mode=mode, top_k=int(top_k), cand=int(cand),
-            temp=float(temperature), top_p=float(top_p),
-            eos_id=int(eos_id))
+        jnp_ = jax.numpy
+        mode = "greedy" if temps is None else "sample"
+        feed = "given" if draft_toks is not None else "self"
+        if temps is None:
+            # unused-but-required operands of the greedy variant: [1]
+            # dummies, staged once (shape participates in the jit key,
+            # so the greedy program never retraces over them)
+            if not hasattr(self, "_dummy_samp"):
+                z1 = jnp_.zeros((1,), jnp_.int32)
+                self._dummy_samp = (z1, jnp_.zeros((1,), jnp_.float32),
+                                    z1, jnp_.ones((1,), jnp_.float32))
+            seeds, temps, top_ks, top_ps = self._dummy_samp
+        if draft_toks is None:
+            if not hasattr(self, "_dummy_draft"):
+                self._dummy_draft = jnp_.zeros((1, 1), jnp_.int32)
+            draft_toks = self._dummy_draft
+        cand = min(candidates, getattr(self.model_cfg, "vocab_size",
+                                       1 << 30))
+        toks, lps, ring, consumed = self._decode_loop_ring(
+            params, kv_data, tok0, start_pos, active, block_tables,
+            seeds, temps, top_ks, top_ps, draft_toks,
+            n=n, mode=mode, cand=int(cand), eos_id=int(eos_id), feed=feed)
         kv_data = self._flush_ring(kv_data, ring, block_tables, start_pos,
                                    active)
-        return toks, kv_data, (consumed if int(eos_id) >= 0 else None)
+        return toks, (lps if mode == "sample" else None), kv_data, \
+            (consumed if int(eos_id) >= 0 else None)
 
 
 class GPT2RaggedRunner(RaggedRunnerBase):

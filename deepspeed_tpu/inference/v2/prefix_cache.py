@@ -310,3 +310,23 @@ class PrefixCache:
                 if not e.refs and not e.children}
         assert live <= set(self._heap), \
             "evictable leaf missing from the candidate heap"
+
+    def assert_exact_refs(self, sequences) -> None:
+        """Refcount-EXACTNESS oracle (tests + drills): every cached
+        block's refcount must equal the number of live sequences whose
+        ``shared`` set holds it — the invariant a multi-token trim
+        (speculative rollback, EOS retraction) must preserve by
+        decrefing each released shared block exactly once. A rejected
+        speculative run on a shared-prefix chain that double-decref'd
+        (or skipped a decref) trips here even when the structural
+        invariants still hold."""
+        want: Dict[int, int] = {}
+        for seq in sequences:
+            for b in seq.kv_blocks:
+                if b in seq.shared:
+                    want[b] = want.get(b, 0) + 1
+        for b, e in self._by_block.items():
+            got = want.get(b, 0)
+            assert e.refs == got, (
+                f"refcount drift on block {b}: cache says {e.refs}, "
+                f"{got} live sequences share it")

@@ -1,0 +1,124 @@
+"""Per-sequence on-device sampling for the v2 ragged engine.
+
+The engine's token selection is greedy-by-default; this module carries
+the per-REQUEST sampling identity (``SamplingParams``) and the host-side
+staging that turns a scheduled batch into the per-slot device arrays the
+sampling programs consume (``model_runner.RaggedRunnerBase``:
+``step_sample_fb`` for the pipelined feedback path, the ``mode="sample"``
+fused decode loop for ``decode_batch``).
+
+Determinism contract (the property every test and the drain/replay layer
+stand on): the threefry key for a sampled token is a pure function of
+``(seed, absolute token position)`` —
+
+    key = fold_in(PRNGKey(seed), position_of_the_new_token)
+
+computed INSIDE the compiled program from two staged int32 scalars per
+slot. No key state lives on the host or in the scan carry, so the SAME
+(seed, prompt) pair yields the SAME token stream regardless of pipeline
+depth, chunking, fused-vs-per-step path, or a drain/replay restart in
+the middle (the manifest carries the params; the replayed position is
+the same position). ``temperature <= 0`` short-circuits to ``argmax``
+inside the same program — the temperature→0 parity oracle that must be
+token-identical to the greedy path.
+
+Everything here is pure host bookkeeping (dataclass reads, numpy stores
+into pre-allocated staging buffers); the device half lives in
+``model_runner._select_tokens``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+#: cap on per-request top_k (and the static candidate-set width of the
+#: device sampler): the sampler draws from the top-``SAMPLE_CANDIDATES``
+#: logits only — top-p re-normalizes within them, which captures
+#: effectively all mass while keeping the per-step noise tensor
+#: [S, cand] instead of [S, V]
+SAMPLE_CANDIDATES = 256
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """One request's sampling identity, attached at admission
+    (``engine.put(..., sampling={uid: SamplingParams(...)})``) and
+    carried on the :class:`~.sequence.SequenceDescriptor` for the
+    sequence's whole life — including across a drain/replay restart
+    (the manifest serializes it via :meth:`to_dict`).
+
+    ``temperature <= 0`` means greedy (the parity oracle); ``top_k = 0``
+    and ``top_p = 1.0`` disable their filters. ``seed`` is the threefry
+    seed the per-position keys derive from — ``None`` defaults to the
+    request uid at admission, so restarts stay deterministic without the
+    caller naming a seed. ``logprobs`` asks the engine to record the
+    chosen token's log-probability (under the UNMODIFIED model
+    distribution) into ``seq.logprob_log``.
+    """
+
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: Optional[int] = None
+    logprobs: bool = False
+
+    def __post_init__(self):
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"temperature": self.temperature, "top_k": self.top_k,
+                "top_p": self.top_p, "seed": self.seed,
+                "logprobs": self.logprobs}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SamplingParams":
+        return cls(temperature=float(d.get("temperature", 1.0)),
+                   top_k=int(d.get("top_k", 0)),
+                   top_p=float(d.get("top_p", 1.0)),
+                   seed=None if d.get("seed") is None
+                   else int(d["seed"]),
+                   logprobs=bool(d.get("logprobs", False)))
+
+
+def derive_seed(base: int, uid: int) -> int:
+    """Stable per-uid seed for callers that give one base seed for a
+    whole batch (``generate(seed=...)``): a cheap odd-multiplier mix
+    kept int32-positive so it stages directly into the seed buffer."""
+    return (int(base) * 1_000_003 + int(uid) * 7_919) & 0x7FFFFFFF
+
+
+def seed_of(p: SamplingParams, uid: int) -> int:
+    """The seed actually staged for ``uid``: the explicit one, or the
+    uid itself (deterministic across restarts with zero caller help)."""
+    s = p.seed
+    return int(uid) & 0x7FFFFFFF if s is None else s
+
+
+def stage_slot(bufs, i: int, seq, sample_pos: int) -> bool:
+    """Fill slot ``i`` of the (seeds, spos, temps, topks, topps) staging
+    buffers from ``seq``'s sampling params (greedy slots stage
+    temperature 0 → in-program argmax). ``sample_pos`` is the absolute
+    position the selected token will occupy — the fold_in operand.
+    Returns True when the slot actually samples (non-greedy params).
+    Pure host stores into pre-allocated numpy buffers — this runs inside
+    the pipeline's plan phase (DSL001 via ``_plan_step``)."""
+    seeds, spos, temps, topks, topps = bufs
+    p = seq.sampling
+    spos[i] = sample_pos
+    if p is None or p.greedy:
+        temps[i] = 0.0
+        topps[i] = 1.0
+        return False
+    seeds[i] = seed_of(p, seq.uid)
+    temps[i] = p.temperature
+    topks[i] = min(p.top_k, SAMPLE_CANDIDATES)
+    topps[i] = p.top_p
+    return True

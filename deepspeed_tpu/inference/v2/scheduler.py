@@ -45,7 +45,9 @@ class SplitFuseScheduler:
     def describe(self, seq: SequenceDescriptor) -> dict:
         """Scheduler-state snapshot for one sequence — the diagnostics
         half of a drain manifest (drain.py): where the request stood in
-        the SplitFuse queue when the replica died. Pure host reads."""
+        the SplitFuse queue when the replica died, plus its sampling
+        mode and speculative accepted-length accounting. Pure host
+        reads."""
         waited = self.state.step - seq.last_sched
         return {
             "status": seq.status.value,
@@ -57,6 +59,10 @@ class SplitFuseScheduler:
             "last_sched": seq.last_sched,
             "waited_steps": waited,
             "aged": seq.in_flight > 1 and waited >= PREFILL_AGING_STEPS,
+            "sampled": seq.sampling is not None
+            and not seq.sampling.greedy,
+            "spec_proposed": seq.spec_proposed,
+            "spec_accepted": seq.spec_accepted,
         }
 
     def schedule(self, eligible: Optional[

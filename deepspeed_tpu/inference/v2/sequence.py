@@ -48,6 +48,20 @@ class SequenceDescriptor:
     # prompt length incl. any cache-matched span — scheduler positions
     # below this count as PREFILL work for the skipped-chunk accounting
     prompt_len: int = 0
+    # per-request sampling identity (sampling.SamplingParams; None =
+    # greedy). Attached at admission via put(..., sampling=...), carried
+    # for the sequence's whole life INCLUDING across drain/replay (the
+    # manifest serializes it) — the seed + position-folded keys are what
+    # make sampled streams restart-deterministic.
+    sampling: object = None
+    # chosen-token log-probabilities (UNMODIFIED model distribution),
+    # recorded per committed token when sampling.logprobs is set
+    logprob_log: List[float] = field(default_factory=list)
+    # speculative-decoding accounting (engine.decode_spec): draft tokens
+    # proposed for / accepted by this sequence — the per-request half of
+    # the fleet-level spec_proposed/spec_accepted counters
+    spec_proposed: int = 0
+    spec_accepted: int = 0
     # pipelined serving (engine serve_pipeline_depth > 0): number of
     # SPECULATIVE placeholder tokens in pending_tokens whose value is
     # still on the device (a prior step's in-flight last-token buffer).

@@ -1,0 +1,254 @@
+"""Speculative decoding for the v2 ragged engine — proposers + acceptance.
+
+The FastGen-lineage multi-token-generation idea (PAPER.md L8-L10)
+realized on this repo's substrate: a PROPOSER guesses up to K next
+tokens per sequence, ONE batched pass through the existing fused
+n-token decode program (``RaggedRunnerBase.decode_loop`` with
+``draft_toks`` — the verify feed) scores the model's own greedy choice
+after every draft prefix, and the host accepts the longest agreeing
+prefix. Per round each sequence commits ``accepted_drafts + 1`` tokens
+(the +1 is the model's own token at the first disagreement — or the
+free bonus token when every draft survives), so decode pays ONE
+dispatch + ONE readback per ~(1 + E[accepted]) tokens instead of per
+token. Rejected positions' KV rolls back through PR 3's deferred
+``trim_blocks`` discipline (``StateManager``), which keeps prefix-cache
+refcounts exact — the engine's ``decode_spec`` owns that half.
+
+Greedy verification is EXACT: token streams are identical to
+non-speculative greedy decode by construction, because a draft token is
+only ever accepted when it equals what greedy decode would have emitted
+at that position. Sampled (temperature > 0) sequences bypass
+speculation (lossless rejection sampling is future work).
+
+Two proposers:
+
+  * :class:`NgramProposer` — model-free self-drafting (prompt lookup
+    decoding): propose the continuation of the last n-gram's previous
+    occurrence in the sequence's OWN history (prompt + committed
+    output). Zero extra device work; strong on repetitive spans
+    (code, templated answers, long copies). ``noise`` perturbs a
+    seeded fraction of proposals — the bench's acceptance-calibration
+    knob (``DSTPU_SPEC_NOISE``), useless in production.
+  * :class:`DraftModelProposer` — a config-paired small draft model
+    (the engine serves 9 families; gpt2-drafting-for-llama is one
+    config pair) running its own tiny engine: proposals come from its
+    fused greedy decode loop, and its KV state tracks the target's
+    accepted history exactly (rollback by the same trim discipline,
+    catch-up feed on full acceptance).
+
+``propose``/``accept_length``/``observe_commit`` are dslint
+DSL001-registered hot paths: pure host work (list/dict walks over
+ints) that runs between the engine's verify dispatches — a device sync
+here would serialize the very pipeline speculation is accelerating.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def accept_length(drafts: Sequence[int], emitted: Sequence[int]) -> int:
+    """Longest accepted draft prefix: ``j`` such that
+    ``drafts[i] == emitted[i - 1]`` for every ``i in 1..j`` — draft i is
+    exactly what greedy decode emits after consuming drafts ``1..i-1``.
+    ``drafts`` here is the verify input row WITHOUT its leading
+    last-committed token, i.e. ``[d_1..d_K]``; ``emitted`` is the verify
+    output row ``[m_0..m_K]``. Registered DSL001 hot path: int
+    comparisons only."""
+    j = 0
+    while j < len(drafts) and drafts[j] == emitted[j]:
+        j += 1
+    return j
+
+
+class NgramProposer:
+    """Model-free self-drafting: match the tail n-gram of the
+    sequence's history against its earlier occurrences and propose the
+    tokens that followed (falling back to shorter grams, then to
+    repeating the last token). O(len(history)) scan per propose — the
+    histories this serves are hundreds of tokens, and the scan is pure
+    host ints."""
+
+    kind = "ngram"
+
+    def __init__(self, n: int = 3, noise: float = 0.0,
+                 noise_seed: int = 0, vocab_size: int = 0):
+        self.n = max(1, int(n))
+        #: bench/test acceptance calibration ONLY: perturb this seeded
+        #: fraction of proposed tokens so measured acceptance can be
+        #: pinned (~0.7 for the serve_spec row); 0 in production
+        self.noise = float(noise)
+        self.noise_seed = int(noise_seed)
+        self.vocab_size = int(vocab_size)
+
+    def propose_batch(self, seqs: Sequence[Any],
+                      histories: Sequence[List[int]],
+                      k: int) -> List[List[int]]:
+        """Per-sequence draft lists (each up to ``k`` tokens) — the
+        ngram matcher is per-sequence host work, so the batch is a
+        loop. Registered DSL001 hot path."""
+        return [self.propose(s, h, k) for s, h in zip(seqs, histories)]
+
+    def propose(self, seq, history: List[int], k: int) -> List[int]:
+        """Up to ``k`` draft tokens continuing ``history`` (which ends
+        with the sequence's last committed-but-unconsumed token).
+        Registered DSL001 hot path — list slicing over host ints."""
+        h = history
+        out: List[int] = []
+        ln = len(h)
+        for g in range(min(self.n, ln - 1), 0, -1):
+            tail = h[ln - g:]
+            # newest prior occurrence wins (recency tracks the local
+            # pattern); stop before the tail's own position
+            for p in range(ln - g - 1, -1, -1):
+                if h[p:p + g] == tail:
+                    out = h[p + g:p + g + k]
+                    break
+            if out:
+                break
+        if not out:
+            out = [h[-1]]
+        while len(out) < k:
+            out.append(out[-1])
+        out = out[:k]
+        if self.noise > 0.0 and self.vocab_size > 1:
+            # seeded per (uid, position): deterministic across reruns
+            rng = np.random.default_rng(
+                (self.noise_seed * 1_000_003
+                 + seq.uid * 7_919 + seq.seen_tokens) & 0x7FFFFFFF)
+            for i in range(len(out)):
+                if rng.random() < self.noise:
+                    jump = 1 + rng.integers(0, self.vocab_size - 1)
+                    perturbed = (out[i] + jump) % self.vocab_size
+                    out[i] = int(perturbed)
+        return out
+
+    def observe_commit(self, seq, seen0: int, accepted: List[int],
+                       drafts: List[int]) -> None:
+        """History is read fresh from the sequence each propose — no
+        proposer-side state to roll back."""
+
+    def drop(self, uid: int) -> None:
+        pass
+
+
+class DraftModelProposer:
+    """A small draft model proposing for the target engine.
+
+    The draft runs as its OWN ``InferenceEngineV2`` (same ``max_seqs``;
+    its own KV pool) over a config-paired smaller model sharing the
+    target's vocabulary. Sync invariant, held before every propose:
+    ``draft.seen_tokens == target.seen_tokens`` with the same next
+    input token. One propose = one fused greedy ``decode_batch(k)`` on
+    the draft; after the target's verify, ``observe_commit`` rolls the
+    draft back to the accepted prefix (the accepted drafts are the
+    draft's OWN consumed inputs, so their KV is already correct) or
+    feeds the one-token catch-up a full acceptance owes (the bonus
+    token's predecessor was proposed but never consumed draft-side).
+    """
+
+    kind = "draft"
+
+    def __init__(self, draft_engine):
+        self.draft = draft_engine
+        self._last_drafts: Dict[int, List[int]] = {}
+
+    def _sync(self, seq, history: List[int]) -> None:
+        """(Re-)admit ``seq`` on the draft engine so its state matches
+        the target's: prefill everything but the final unconsumed
+        token. Covers first sight, a post-flush reuse, and drift (an
+        out-of-band target mutation) by re-prefilling from scratch."""
+        d = self.draft.state.get(seq.uid)
+        target_seen = seq.seen_tokens
+        if d is not None and (d.seen_tokens != target_seen or d.in_flight):
+            self.draft.flush(seq.uid)
+            d = None
+        if d is None and len(history) > 1:
+            self.draft.put([seq.uid], [history[:-1]], _greedy=True)
+
+    def propose_batch(self, seqs: Sequence[Any],
+                      histories: Sequence[List[int]],
+                      k: int) -> List[List[int]]:
+        """ONE fused draft dispatch for the whole round: sync every
+        sequence, then ``decode_batch`` across all of them (the draft's
+        own fused greedy loop — k tokens per sequence per device
+        call). A sequence the draft cannot serve this round (pool
+        pressure) proposes nothing and the target just verifies its
+        single next token."""
+        ready, hist_of = [], {}
+        for seq, h in zip(seqs, histories):
+            self._sync(seq, h)
+            if self.draft.state.get(seq.uid) is not None:
+                ready.append(seq)
+                hist_of[seq.uid] = h
+        out: Dict[int, List[int]] = {}
+        if ready:
+            try:
+                res = self.draft.decode_batch(
+                    [s.uid for s in ready],
+                    [hist_of[s.uid][-1] for s in ready], k)
+                out = {u: [int(t) for t in v] for u, v in res.items()}
+            except Exception:
+                # draft-side pressure (OutOfBlocks etc.): skip this
+                # round's proposals rather than stall the target
+                for s in ready:
+                    self.draft.flush(s.uid)
+                out = {}
+        self._last_drafts.update(out)
+        return [out.get(s.uid, []) for s in seqs]
+
+    def observe_commit(self, seq, seen0: int, accepted: List[int],
+                       drafts: List[int]) -> None:
+        """Roll the draft back to the target's accepted history. After
+        its propose the draft consumed ``[last, d_1..d_{k-1}]`` (seen =
+        seen0 + k); the target accepted ``a = len(accepted)`` of the
+        K+1 verified positions. ``a <= k``: retract the draft to
+        seen0 + a (the kept inputs ARE the accepted tokens — their
+        draft KV is already right) via the same trim discipline.
+        ``a == k + 1`` (full acceptance + bonus): the draft never
+        consumed d_k — feed it as a one-token catch-up."""
+        uid = seq.uid
+        d = self.draft.state.get(uid)
+        drafts = self._last_drafts.pop(uid, drafts)
+        if d is None:
+            return
+        k = len(drafts)
+        a = len(accepted)
+        if a <= k:
+            d.seen_tokens = seen0 + a
+            self.draft.state.trim_blocks(d)
+            d.gen_log = d.gen_log[:max(0, len(d.gen_log) - (k - a))]
+        elif k:
+            self.draft.put([uid], [[drafts[-1]]], _greedy=True)
+
+    def drop(self, uid: int) -> None:
+        self._last_drafts.pop(uid, None)
+        if self.draft.state.get(uid) is not None:
+            self.draft.flush(uid)
+
+
+def build_proposer(engine) -> Any:
+    """Engine-config-driven proposer factory (``spec_decode`` /
+    ``DSTPU_SPEC_*``): "ngram" is self-contained; "draft" requires the
+    caller to have paired a draft model via ``engine.attach_draft``."""
+    import os
+
+    cfg = engine.config
+    mode = engine.spec_mode
+    if mode == "ngram":
+        return NgramProposer(
+            n=engine.spec_ngram,
+            noise=float(os.environ.get("DSTPU_SPEC_NOISE", "0") or "0"),
+            noise_seed=0,
+            vocab_size=int(getattr(engine.runner.model_cfg,
+                                   "vocab_size", 0)))
+    if mode == "draft":
+        if engine._draft_engine is None:
+            raise ValueError(
+                "spec_decode='draft' needs a paired draft model: call "
+                "engine.attach_draft(draft_model_cfg, draft_params) "
+                "before decoding (docs/serving.md)")
+        return DraftModelProposer(engine._draft_engine)
+    raise ValueError(f"no proposer for spec mode {mode!r}")

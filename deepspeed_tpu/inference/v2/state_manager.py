@@ -44,7 +44,12 @@ class StateManager:
         #: prefill_tokens did (scheduler-counted, prompt positions only)
         self.prefix_stats = {"matched_tokens": 0, "matched_blocks": 0,
                              "cow_tokens": 0, "cow_copies": 0,
-                             "prefill_tokens": 0, "match_queries": 0}
+                             "prefill_tokens": 0, "match_queries": 0,
+                             # multi-token trims (speculative rollback /
+                             # pipelined EOS retraction) and the blocks
+                             # they returned — the rollback-pressure
+                             # signal the serve_spec bench reads
+                             "trims": 0, "trimmed_blocks": 0}
 
     # ------------------------------------------------------------------ #
 
@@ -237,17 +242,25 @@ class StateManager:
 
     def trim_blocks(self, seq: SequenceDescriptor) -> int:
         """Free KV blocks beyond what ``seq.seen_tokens`` needs — the
-        rollback half of speculative pipelined decode: when the delayed
-        host readback reveals a sequence finished (EOS) at step k, the
-        blocks its speculatively scheduled steps k+1.. over-allocated are
-        returned to the pool. Cache-shared blocks are decref'd, never
-        freed (another sequence — or the cache — may still own them).
-        Returns the number of blocks released."""
+        MULTI-TOKEN rollback primitive shared by pipelined EOS
+        retraction (PR 3) and speculative-decode rejection
+        (``engine.decode_spec``): the caller retracts ``seen_tokens``
+        to the accepted length and this returns every over-allocated
+        block to the pool. Cache-shared blocks are decref'd EXACTLY
+        ONCE, never freed (another sequence — or the cache — may still
+        own them; ``release_blocks`` is the single release path, and
+        the allocator's set-membership double-free detection backstops
+        it). Garbage KV within the retained tail block (positions past
+        ``seen_tokens``) is harmless: appends are position-addressed,
+        so the next accepted tokens overwrite it. Returns the number
+        of blocks released."""
         needed = -(-seq.seen_tokens // self.cfg.block_size)
         extra = seq.kv_blocks[needed:]
         if extra:
             del seq.kv_blocks[needed:]
             self.release_blocks(seq, extra)
+            self.prefix_stats["trims"] += 1
+            self.prefix_stats["trimmed_blocks"] += len(extra)
         return len(extra)
 
     def kv_memory_report(self) -> Dict[str, int]:

@@ -363,7 +363,8 @@ class ReplicaPool:
             batch_tokens: Sequence[Sequence[int]],
             _greedy: bool = False,
             arrivals: Optional[Dict[int, float]] = None,
-            deadlines: Optional[Dict[int, float]] = None
+            deadlines: Optional[Dict[int, float]] = None,
+            sampling: Optional[Dict[int, Any]] = None
             ) -> Dict[int, Any]:
         """Fleet admission. Placement is SEQUENTIAL per request (pure
         host scoring — each decision sees the queue/ownership state the
@@ -372,7 +373,10 @@ class ReplicaPool:
         exactly like the decode rounds — admission wall time stays that
         of the busiest replica, not the sum. Continuations go to their
         owner. Returns the merged {uid: result} map; refusals surface
-        through :attr:`rejections` exactly like a single engine's."""
+        through :attr:`rejections` exactly like a single engine's.
+        ``sampling`` ({uid: SamplingParams}) passes through to each
+        owning engine unchanged-shape — per-request sampling and
+        speculative decode work identically behind the fleet surface."""
         self.absorb_draining()
         done: Dict[int, Any] = {}
         groups: Dict[str, List[int]] = {}
@@ -415,7 +419,7 @@ class ReplicaPool:
             members = groups[rid]
             return self._replicas[rid].engine.put(
                 members, [toks_of[u] for u in members], _greedy=_greedy,
-                arrivals=arrivals, deadlines=deadlines)
+                arrivals=arrivals, deadlines=deadlines, sampling=sampling)
 
         results = self._run_groups(run_one, groups)
         for res in results:
@@ -484,6 +488,17 @@ class ReplicaPool:
         def run_one(rid: str) -> Dict[int, List[int]]:
             eng = self._replicas[rid].engine
             members = groups[rid]
+            if getattr(eng, "spec_enabled", False) or any(
+                    (s := eng.state.get(u)) is not None
+                    and s.sampling is not None
+                    and not s.sampling.greedy for u in members):
+                # speculative / sampled members ride decode_pipelined,
+                # which routes to decode_spec (greedy batches) or the
+                # per-slot sampler pipeline — both budget-exact
+                return eng.decode_pipelined(
+                    members, [last[u] for u in members],
+                    [rem[u] for u in members],
+                    eos_token_id=eos_token_id)
             if eos_token_id is None and hasattr(eng.runner,
                                                "decode_loop"):
                 # fused fleet decode: bucket the replica's batch by

@@ -193,10 +193,20 @@ class WorkloadMix:
     deadline_frac: float = 0.0
     deadline_s: float = 0.0
     vocab_size: int = 32000
+    #: fixed prompt pool (recorded-prompt replay): when set, each
+    #: request draws its prompt from this pool (seeded choice) instead
+    #: of random tokens — prompt_lens/shared-prefix knobs are then
+    #: ignored. This is how content-sensitive workloads (speculative
+    #: decoding's self-drafting acceptance, cache-content studies)
+    #: ride the observatory: offered load stays the independent
+    #: variable while prompt CONTENT stays the controlled one.
+    prompt_pool: Optional[Sequence[Sequence[int]]] = None
 
     def describe(self) -> Dict[str, Any]:
         return {
-            "prompt_mix": list(self.prompt_lens),
+            "prompt_mix": list(self.prompt_lens)
+            if self.prompt_pool is None
+            else f"pool({len(self.prompt_pool)})",
             "gen_mix": list(self.gen_lens),
             "shared_prefix_frac": self.shared_prefix_frac,
             "shared_prefix_len": self.shared_prefix_len,
@@ -235,16 +245,23 @@ def build_requests(process: ArrivalProcess, mix: WorkloadMix, n: int,
                                 size=mix.shared_prefix_len).tolist()
                     if mix.shared_prefix_len else []]
         group_of = np.zeros(n, np.int64)
+    pool = list(mix.prompt_pool) if mix.prompt_pool else None
+    pool_pick = rng.randint(0, len(pool), size=n) if pool else None
     out: List[Request] = []
     for i in range(n):
         plen = int(plens[i])
         g = int(group_of[i])
         prefix = prefixes[g]
-        if shared[i] and prefix and plen > len(prefix):
+        if pool is not None:
+            # recorded-prompt replay: content from the pool, identity
+            # still (mix, seed, index)-deterministic
+            prompt = list(pool[int(pool_pick[i])])
+            group = None
+        elif shared[i] and prefix and plen > len(prefix):
             body = rng.randint(1, mix.vocab_size,
                                size=plen - len(prefix)).tolist()
             prompt = prefix + body
-            group: Optional[int] = g
+            group = g
         else:
             prompt = rng.randint(1, mix.vocab_size, size=plen).tolist()
             group = None
@@ -278,12 +295,17 @@ class _OpenLoopDriver:
 
     def __init__(self, engine, requests: Sequence[Request],
                  decode_burst: int, shed_after_s: float,
-                 poll_s: float, max_live: Optional[int] = None):
+                 poll_s: float, max_live: Optional[int] = None,
+                 sampling: Any = None):
         self.engine = engine
         self.requests = sorted(requests, key=lambda r: r.arrival_s)
         self.decode_burst = max(1, int(decode_burst))
         self.shed_after_s = shed_after_s
         self.poll_s = poll_s
+        #: SamplingParams template applied to EVERY offered request
+        #: (per-uid seeds derive from the uid when the template names
+        #: none — streams stay deterministic per request identity)
+        self.sampling = sampling
         self.max_live = max(1, int(max_live)) \
             if max_live is not None else None
         self.pending: deque = deque(self.requests)
@@ -331,9 +353,12 @@ class _OpenLoopDriver:
         arrivals = {r.uid: self.t0 + r.arrival_s for r in due}
         deadlines = {r.uid: r.deadline_s for r in due
                      if r.deadline_s is not None}
+        sampling = {r.uid: self.sampling for r in due} \
+            if self.sampling is not None else None
         res = self.engine.put([r.uid for r in due],
                               [r.prompt for r in due], _greedy=True,
-                              arrivals=arrivals, deadlines=deadlines)
+                              arrivals=arrivals, deadlines=deadlines,
+                              sampling=sampling)
         t_seen = time.monotonic() - self.t0
         for r in due:
             if r.uid in res:
@@ -549,7 +574,8 @@ class _OpenLoopDriver:
 def run_open_loop(engine, requests: Sequence[Request],
                   decode_burst: int = 8, shed_after_s: float = 0.0,
                   poll_s: float = 0.02,
-                  max_live: Optional[int] = None) -> LoadResult:
+                  max_live: Optional[int] = None,
+                  sampling: Any = None) -> LoadResult:
     """Drive one open-loop pass of ``requests`` against ``engine``.
 
     The arrival clock is the precomputed schedule against
@@ -564,11 +590,19 @@ def run_open_loop(engine, requests: Sequence[Request],
     concurrency (further due requests wait at the door with their
     arrival stamp intact — their wait is measured, not hidden).
 
+    ``sampling`` (a SamplingParams template, or None for greedy)
+    attaches per-request sampling at admission — the engine then
+    selects tokens on-device per slot; speculative decoding (the
+    engine's ``spec_decode`` knob) needs no driver support at all,
+    because ``decode_pipelined`` routes greedy batches through it
+    transparently.
+
     Leaves the engine empty (every request completed, aborted or
     flushed) and accumulates rejection records in
     ``engine.rejections``."""
     return _OpenLoopDriver(engine, requests, decode_burst, shed_after_s,
-                           poll_s, max_live=max_live).run()
+                           poll_s, max_live=max_live,
+                           sampling=sampling).run()
 
 
 # ---------------------------------------------------------------------- #
@@ -581,7 +615,8 @@ def sweep_capacity(engine, rates: Sequence[float], n_per_rate: int,
                    goodput_slo_frac: float = 0.9,
                    process: str = "poisson",
                    decode_burst: int = 8, shed_after_s: float = 0.0,
-                   max_live: Optional[int] = None) -> Dict[str, Any]:
+                   max_live: Optional[int] = None,
+                   sampling: Any = None) -> Dict[str, Any]:
     """Sweep offered QPS and locate the knee: the highest offered rate
     whose goodput fraction still meets ``goodput_slo_frac``. Each rate
     runs an independent seeded pass (disjoint uid ranges; the engine's
@@ -602,7 +637,8 @@ def sweep_capacity(engine, rates: Sequence[float], n_per_rate: int,
         reqs = build_requests(proc, mix, n_per_rate, seed=seed + i,
                               uid_base=(i + 1) * 1_000_000)
         res = run_open_loop(engine, reqs, decode_burst=decode_burst,
-                            shed_after_s=shed_after_s, max_live=max_live)
+                            shed_after_s=shed_after_s, max_live=max_live,
+                            sampling=sampling)
         rep = res.report
         lat = rep["latency"]
         curve.append({
@@ -645,10 +681,12 @@ def _ms(v: Optional[float]) -> Optional[float]:
 
 
 def _tiny_engine(max_seqs: int = 8, num_blocks: int = 96,
-                 block_size: int = 16, vocab: int = 96):
+                 block_size: int = 16, vocab: int = 96,
+                 spec: str = "off", spec_k: int = 4):
     """CPU-harness GPT-2 engine for the CLI's self-contained mode and
     the tier-1 capacity smoke — small enough that a decode step is a
-    few ms."""
+    few ms. ``spec`` arms speculative decoding (``--spec`` /
+    ``DSTPU_SPEC_MODE``) on the tiny engine."""
     import jax
     import jax.numpy as jnp
 
@@ -663,7 +701,8 @@ def _tiny_engine(max_seqs: int = 8, num_blocks: int = 96,
         max_seqs=max_seqs, chunk_size=16, block_size=block_size,
         num_blocks=num_blocks, max_blocks_per_seq=16, dtype="float32",
         attention_impl="dense", decode_loop_steps=0,
-        serve_pipeline_depth=2, prefix_cache=True)
+        serve_pipeline_depth=2, prefix_cache=True,
+        spec_decode=spec, spec_k=spec_k)
     return InferenceEngineV2(mcfg, params, cfg), mcfg
 
 
@@ -702,6 +741,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--shed-after", type=float, default=float(
         os.environ.get("DSTPU_LOADGEN_SHED_AFTER_S", "0")),
         help="driver-side shed bound in seconds (0 = queue forever)")
+    ap.add_argument("--temperature", type=float, default=float(
+        os.environ.get("DSTPU_LOADGEN_TEMPERATURE", "0") or "0"),
+        help="per-request sampling temperature (0 = greedy; the "
+             "on-device per-slot sampler, seeds derived per uid)")
+    ap.add_argument("--top-k", type=int, default=0,
+                    help="sampling top-k filter (with --temperature > 0)")
+    ap.add_argument("--top-p", type=float, default=1.0,
+                    help="sampling top-p filter (with --temperature > 0)")
+    ap.add_argument("--spec", default=os.environ.get(
+        "DSTPU_LOADGEN_SPEC", "off"), choices=("off", "ngram"),
+        help="arm speculative decoding on the tiny engine(s) — the "
+             "observatory then drives draft/verify traffic and the "
+             "report carries the acceptance rate")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens per speculation round")
     ap.add_argument("--prompt-len", type=int, default=24)
     ap.add_argument("--gen-len", type=int, default=16)
     ap.add_argument("--shared-prefix-frac", type=float, default=0.0)
@@ -738,7 +792,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         mcfg_box = []
 
         def factory(i, dev):
-            e, m = _tiny_engine()
+            e, m = _tiny_engine(spec=args.spec, spec_k=args.spec_k)
             mcfg_box.append(m)
             return e
 
@@ -747,7 +801,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         pool = ReplicaPool(engines, policy=args.policy)
         eng = pool
     else:
-        eng, mcfg = _tiny_engine()
+        eng, mcfg = _tiny_engine(spec=args.spec, spec_k=args.spec_k)
+    sampling = None
+    if args.temperature > 0:
+        from ..inference.v2 import SamplingParams
+        sampling = SamplingParams(temperature=args.temperature,
+                                  top_k=args.top_k, top_p=args.top_p)
     mix = WorkloadMix(
         prompt_lens=(args.prompt_len,), prompt_probs=(1.0,),
         gen_lens=(args.gen_len,), gen_probs=(1.0,),
@@ -769,7 +828,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         out = sweep_capacity(
             eng, rates, args.requests, mix, seed=args.seed,
             goodput_slo_frac=args.slo_goodput, process=args.process,
-            decode_burst=args.burst, shed_after_s=args.shed_after)
+            decode_burst=args.burst, shed_after_s=args.shed_after,
+            sampling=sampling)
     else:
         if args.process == "trace":
             if not args.trace:
@@ -781,7 +841,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             proc = PoissonArrivals(rates[0], seed=args.seed)
         reqs = build_requests(proc, mix, args.requests, seed=args.seed)
         res = run_open_loop(eng, reqs, decode_burst=args.burst,
-                            shed_after_s=args.shed_after)
+                            shed_after_s=args.shed_after,
+                            sampling=sampling)
         out = {"arrival": proc.describe(), "workload": mix.describe(),
                **res.report}
         slo = eng.slo_report()
@@ -790,7 +851,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "goodput_frac": slo["goodput_frac"],
                 "ttft_ms_p50": _ms(slo["ttft_s"].get("p50")),
                 "ttft_ms_p99": _ms(slo["ttft_s"].get("p99")),
+                "spec_accept_rate": slo.get("spec_accept_rate"),
             }
+    if args.temperature > 0:
+        out["sampling"] = {"temperature": args.temperature,
+                           "top_k": args.top_k, "top_p": args.top_p}
+    if args.spec != "off":
+        out["spec"] = {"mode": args.spec, "k": args.spec_k}
     if pool is not None:
         from ..serving import fleet_prefix_stats
         out["fleet"] = {

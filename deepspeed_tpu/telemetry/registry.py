@@ -56,6 +56,10 @@ REGISTERED_METRICS = {
     "serve_steps": "engine steps dispatched",
     "serve_steps_device_fed": "steps fed from the device token buffer",
     "serve_step_retries": "transient dispatch failures retried",
+    # -- speculative decoding (counters) -------------------------------- #
+    "spec_proposed": "draft tokens proposed for verification",
+    "spec_accepted": "draft tokens accepted by greedy verification",
+    "spec_rounds": "speculative propose/verify rounds committed",
     # -- serve latency (histograms, seconds) --------------------------- #
     "serve_ttft_s": "admission -> first committed token",
     "serve_tpot_s": "per-token gap between committed tokens",

@@ -88,6 +88,9 @@ class ServeObserver:
         self.c_steps = r.counter("serve_steps")
         self.c_fed = r.counter("serve_steps_device_fed")
         self.c_retries = r.counter("serve_step_retries")
+        self.c_spec_proposed = r.counter("spec_proposed")
+        self.c_spec_accepted = r.counter("spec_accepted")
+        self.c_spec_rounds = r.counter("spec_rounds")
         self.h_ttft = r.histogram("serve_ttft_s")
         self.h_tpot = r.histogram("serve_tpot_s")
         self.h_queue = r.histogram("serve_queue_wait_s")
@@ -180,6 +183,18 @@ class ServeObserver:
 
     def on_retry(self):
         self.c_retries.inc()
+
+    def on_spec(self, proposed, accepted):
+        """One speculative verify round committed: ``proposed`` draft
+        tokens offered across the round's slots, ``accepted`` of them
+        survived greedy verification (the committed corrections/bonus
+        tokens ride serve_tokens_committed). Registered DSL001 hot
+        path — three pre-bound counter adds."""
+        self.c_spec_rounds.inc()
+        if proposed:
+            self.c_spec_proposed.inc(proposed)
+        if accepted:
+            self.c_spec_accepted.inc(accepted)
 
     def on_reject(self, reason, uid=None):
         c = self._reject_counters.get(reason)
@@ -305,7 +320,15 @@ def slo_report_from_registry(registry) -> Dict[str, Any]:
            + c("serve_requests_aborted"))
     good = c("serve_requests_completed")
     done = good + bad
+    spec_prop = c("spec_proposed")
+    spec_acc = c("spec_accepted")
     return {
+        "spec": {
+            "proposed": spec_prop,
+            "accepted": spec_acc,
+            "rounds": c("spec_rounds"),
+        },
+        "spec_accept_rate": spec_acc / spec_prop if spec_prop else None,
         "ttft_s": r.histogram("serve_ttft_s").summary(),
         "tpot_s": r.histogram("serve_tpot_s").summary(),
         "queue_wait_s": r.histogram("serve_queue_wait_s").summary(),

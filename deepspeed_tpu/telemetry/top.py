@@ -72,6 +72,19 @@ def _series_rates(pairs: List[List[float]]) -> List[float]:
     return out
 
 
+def _series_ratio(num: List[List[float]],
+                  den: List[List[float]]) -> List[float]:
+    """Per-window Δnum/Δden over two counter series sampled on the
+    same clock (the speculative accept-rate trend); windows where the
+    denominator did not move are skipped."""
+    out: List[float] = []
+    for (n0, d0), (n1, d1) in zip(zip(num, den), zip(num[1:], den[1:])):
+        dd = d1[1] - d0[1]
+        if dd > 0:
+            out.append((n1[1] - n0[1]) / dd)
+    return out
+
+
 def render(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
            ) -> str:
     """The operator table for one snapshot; ``prev`` (an earlier
@@ -121,6 +134,13 @@ def render(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
                  f"  {rate('serve_tokens_committed')} tok/s   "
                  f"steps {c.get('serve_steps', 0):.0f} "
                  f"(device-fed {c.get('serve_steps_device_fed', 0):.0f})")
+    prop = c.get("spec_proposed", 0.0)
+    if prop:
+        acc = c.get("spec_accepted", 0.0)
+        lines.append(
+            f"speculation    proposed {prop:.0f}   accepted {acc:.0f}   "
+            f"accept rate {_pct(_frac(acc, prop))}   "
+            f"rounds {c.get('spec_rounds', 0):.0f}")
     lines.append("")
     lines.append("latency (ms)          p50      p90      p99    count")
     for label, name in (("ttft", "serve_ttft_s"),
@@ -170,6 +190,13 @@ def render(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
         spark = _sparkline(rates)
         if spark:
             spark_rows.append(f"  {label:<14}{rates[-1]:9.1f}  {spark}")
+    # speculative acceptance trend: per-window Δaccepted/Δproposed over
+    # the two sampled counter series (windows with no proposals skip)
+    accs = _series_ratio(series.get("spec_accepted", []),
+                         series.get("spec_proposed", []))
+    spark = _sparkline(accs)
+    if spark:
+        spark_rows.append(f"  {'accept rate':<14}{accs[-1]:9.2f}  {spark}")
     if spark_rows:
         lines.append("")
         lines.append("rates (sampled series)   now  trend")

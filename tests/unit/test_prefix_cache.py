@@ -184,6 +184,62 @@ class TestBatchedPutRegistration:
         assert pc.cached_blocks == 0
         assert kv.allocator.free_blocks == 16
 
+    def test_rejected_spec_run_on_shared_chain_decrefs_once(self):
+        """The ISSUE-12 rollback exactness case: two sequences share a
+        cached prefix chain; one runs a speculative verify window that
+        is mostly REJECTED. The multi-token trim must release only the
+        over-allocated private blocks and decref nothing it does not
+        own — the shared chain's refcounts stay exact (one per
+        referencing sequence) and no double free is possible."""
+        import jax.numpy as jnp
+        bs = 4
+        cfg = RaggedInferenceConfig(
+            max_seqs=4, chunk_size=8, block_size=bs, num_blocks=16,
+            max_blocks_per_seq=8, dtype="float32", prefix_cache=True)
+        kv = BlockedKVCache(cfg, 1, 1, 4, jnp.float32)
+        pc = PrefixCache(bs)
+        kv.attach_prefix_cache(pc)
+        sm = StateManager(cfg, kv)
+        sm.prefix = pc
+        shared = [1, 2, 3, 4, 5, 6, 7, 8]
+        s0 = sm.put_tokens(0, shared + [9])
+        sm.match_prefix(s0)
+        n = s0.in_flight
+        sm.ensure_blocks(s0, n)
+        del s0.pending_tokens[:n]
+        s0.seen_tokens += n
+        sm.register_prefix(s0)
+        s1 = sm.put_tokens(1, shared + [10])
+        sm.match_prefix(s1)               # hits the registered chain
+        assert len(s1.shared) == 2
+        for e in pc._by_block.values():
+            assert e.refs == 2            # both sequences on the chain
+        n = s1.in_flight
+        sm.ensure_blocks(s1, n)
+        del s1.pending_tokens[:n]
+        s1.seen_tokens += n
+        # speculative verify window: K+1 = 6 positions appended, only 1
+        # accepted -> trim retracts 5, freeing the over-allocation
+        free0 = kv.allocator.free_blocks
+        sm.ensure_blocks(s1, 6)
+        seen0 = s1.seen_tokens
+        s1.seen_tokens = seen0 + 6
+        s1.seen_tokens = seen0 + 1        # host accepted 1 token
+        freed = sm.trim_blocks(s1)
+        assert freed >= 1
+        assert kv.allocator.free_blocks == free0
+        pc.check_invariants()
+        pc.assert_exact_refs([s0, s1])    # chain refs STILL exactly 2
+        for e in pc._by_block.values():
+            assert e.refs == 2
+        # a second trim at the same seen is a no-op (nothing left over)
+        assert sm.trim_blocks(s1) == 0
+        sm.flush(0)
+        sm.flush(1)
+        pc.assert_exact_refs([])
+        kv.allocator.free(pc.evict(16))
+        assert kv.allocator.free_blocks == 16
+
 
 class TestRandomizedRefcountModel:
     """The satellite model checker: random interleavings of the full
@@ -254,11 +310,31 @@ class TestRandomizedRefcountModel:
                     rng.integers(0, seq.seen_tokens - prompt + 1))
             sm.trim_blocks(seq)
 
+        def spec_round(uid):
+            # the decode_spec lifecycle as one op: allocate KV for a
+            # pinned K+1-token verify window, then commit only the
+            # accepted prefix and trim the rest — a rejected run on a
+            # shared-prefix chain must decref each released shared
+            # block exactly once (the conservation + refcount-drift
+            # asserts in check() are the oracle)
+            seq = live[uid]
+            L = int(rng.integers(2, 8))
+            try:
+                sm.ensure_blocks(seq, L)
+            except OutOfBlocksError:
+                return
+            seen0 = seq.seen_tokens
+            seq.seen_tokens = seen0 + L          # verify wrote L slots
+            accepted = int(rng.integers(1, L + 1))
+            seq.seen_tokens = seen0 + accepted   # host accepts a prefix
+            sm.trim_blocks(seq)
+
         def check():
             alloc = kv.allocator
             free = set(alloc._free)
             assert len(free) == alloc.free_blocks          # list == set
             pc.check_invariants()
+            pc.assert_exact_refs(live.values())
             cached = set(pc._by_block)
             assert not free & cached, "freed block still cached"
             refs = {}
@@ -286,13 +362,15 @@ class TestRandomizedRefcountModel:
             assert len(free) + len(cached) + len(private) == num_blocks
 
         for _ in range(300):
-            op = rng.integers(0, 4)
+            op = rng.integers(0, 5)
             if op == 0 or not live:
                 new_seq()
             elif op == 1:
                 decode_some(int(rng.choice(list(live))))
             elif op == 2:
                 trim(int(rng.choice(list(live))))
+            elif op == 3:
+                spec_round(int(rng.choice(list(live))))
             else:
                 uid = int(rng.choice(list(live)))
                 sm.flush(uid)

@@ -69,6 +69,18 @@ HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
          "_staging_bufs", "_match_prefix", "_register_prefix",
          "_pre_commit", "_dispatch_with_retry", "_expire_deadlines",
          "abort", "_shed_starved"),
+    # the per-slot sampling stager fills pre-allocated numpy buffers
+    # inside the plan phase (engine _plan_step calls it per slot):
+    # host stores over ints/floats only
+    "deepspeed_tpu/inference/v2/sampling.py":
+        ("stage_slot", "seed_of", "derive_seed"),
+    # the speculative propose/accept half runs BETWEEN verify
+    # dispatches on the decode hot path: n-gram matching, acceptance
+    # prefix comparison and draft-rollback bookkeeping are pure host
+    # list/dict walks — a device sync here would serialize every
+    # speculation round behind a readback it does not need
+    "deepspeed_tpu/inference/v2/speculative.py":
+        ("accept_length", "propose", "propose_batch", "observe_commit"),
     # the write-ahead replay journal appends on the COMMIT path of every
     # serve step: buffered file writes over host ints only — a device
     # sync here would gate every committed token on the journal
@@ -101,7 +113,7 @@ HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
     "deepspeed_tpu/telemetry/serve.py":
         ("on_admit", "on_sched", "on_token_commit", "on_plan",
          "on_dispatch", "on_commit_block", "on_retry", "on_reject",
-         "on_abort", "on_flush", "phase", "_req_span"),
+         "on_abort", "on_flush", "on_spec", "phase", "_req_span"),
     "deepspeed_tpu/telemetry/registry.py":
         ("inc", "set", "observe", "quantile", "sample",
          "maybe_sample"),

@@ -1,0 +1,44 @@
+#!/bin/bash
+# Round-15 on-chip sequence: speculative decoding + the on-device
+# sampling stack (ISSUE 12). The CPU story is proven in tier-1
+# (temperature->0 parity, seeded-stream determinism across pipeline
+# depths/paths/restarts, ngram + draft-model spec parity, refcount
+# model checker with multi-token trims); on-chip this captures (a)
+# lint cleanliness (sampler/propose/verify DSL001 registry +
+# DSTPU_SPEC_*/sampling knob tables), (b) the temperature-0 parity
+# smoke + the draft-fed verify program compiled through Mosaic
+# (tpu_smoke spec_decode row), and (c) the serve_spec bench — greedy
+# vs sampled vs speculative decode tokens/s, acceptance by workload,
+# and the goodput-knee shift with speculation on, measured by the
+# capacity observatory. Strictly sequential (one process owns the
+# chip), no timeouts around TPU clients (a killed client wedges the
+# grant).
+cd /root/repo || exit 1
+LOG=profiles/r15_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round15 start $(date -u +%FT%TZ)"
+
+echo "--- [1/4] dstpu_lint (sampler/propose/verify DSL001 registry,"
+echo "    DSTPU_SPEC_* + sampling knobs in docs/CONFIG.md)"
+python bin/dstpu_lint deepspeed_tpu
+
+echo "--- [2/4] tpu_smoke: spec_decode row (draft-fed verify program"
+echo "    on chip, ngram parity + temp-0 sampled parity) + the full"
+echo "    kernel/audit sweep it rides with"
+python tools/tpu_smoke.py
+
+echo "--- [3/4] serve_spec: greedy vs sampled vs speculative decode"
+echo "    tokens/s at calibrated ~0.7 acceptance, parity + 0-compile"
+echo "    gates, capacity-observatory knee shift"
+python bench.py serve_spec > BENCH_SPEC_r15.json
+tail -c 1600 BENCH_SPEC_r15.json
+
+echo "--- [4/4] loadgen --spec + --temperature: the observatory"
+echo "    driving speculative and sampled traffic end to end, report"
+echo "    carries acceptance + sampled SLOs"
+python bin/dstpu_loadgen --spec ngram --rate 12 --requests 32 \
+    --prompt-len 32 --gen-len 16 \
+    --out profiles/r15_loadgen_spec.json
+python bin/dstpu_loadgen --temperature 0.8 --top-k 16 --rate 12 \
+    --requests 32 --out profiles/r15_loadgen_sampled.json
+echo "=== tpu_round15 done $(date -u +%FT%TZ)"

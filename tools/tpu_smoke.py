@@ -384,6 +384,41 @@ def main():
     else:
         print("SKIP tp_overlap (single chip)", flush=True)
 
+    # speculative decode (ISSUE 12): the draft-fed verify program ON
+    # CHIP — ngram self-drafting over the fused decode_loop (feed=
+    # "given" compiled through Mosaic, rollback trims live) must be
+    # token-identical to plain greedy decode_pipelined, and the sampled
+    # feedback step's temperature->0 path must reproduce greedy too.
+    rng_s = np.random.RandomState(23)
+    pat_s = rng_s.randint(1, 512, size=12).tolist()
+    prompts_s = [(pat_s * 3)[:30] for _ in range(3)]       # repetitive:
+    uids_s = [0, 1, 2]                                     # ngram food
+    eng_g = InferenceEngineV2(mcfg_a, params_a,
+                              RaggedInferenceConfig(**base_a))
+    f_g = eng_g.put(uids_s, prompts_s, _greedy=True)
+    ref_s = eng_g.decode_pipelined(uids_s, [f_g[u] for u in uids_s], 12)
+    eng_s = InferenceEngineV2(
+        mcfg_a, params_a,
+        RaggedInferenceConfig(**base_a, spec_decode="ngram", spec_k=4))
+    f_s = eng_s.put(uids_s, prompts_s, _greedy=True)
+    got_s = eng_s.decode_pipelined(uids_s, [f_s[u] for u in uids_s], 12)
+    par_s = got_s == ref_s and f_s == f_g
+    slo_s = eng_s.slo_report()
+    acc_s = slo_s.get("spec_accept_rate")
+    from deepspeed_tpu.inference.v2 import SamplingParams
+    eng_t0 = InferenceEngineV2(mcfg_a, params_a,
+                               RaggedInferenceConfig(**base_a))
+    sp0 = {u: SamplingParams(temperature=0.0) for u in uids_s}
+    f_t0 = eng_t0.put(uids_s, prompts_s, _greedy=True, sampling=sp0)
+    got_t0 = eng_t0.decode_pipelined(uids_s, [f_t0[u] for u in uids_s],
+                                     12)
+    par_t0 = got_t0 == ref_s and f_t0 == f_g
+    ok &= par_s and par_t0
+    print(f"{'OK ' if par_s and par_t0 else 'FAIL'} spec_decode: "
+          f"ngram token_parity={par_s} temp0_parity={par_t0} "
+          f"accept_rate={acc_s if acc_s is None else round(acc_s, 3)} "
+          f"rounds={slo_s.get('spec', {}).get('rounds')}", flush=True)
+
     print("TPU_SMOKE " + ("PASS" if ok else "FAIL"), flush=True)
     return 0 if ok else 1
 
