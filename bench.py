@@ -674,6 +674,176 @@ def bench_serve_prefix():
     return 0 if parity and distinct > 1 else 1
 
 
+def bench_serve_hier():
+    """Hierarchical-KV serving benchmark (ISSUE 13): a shared-prefix
+    WORKING SET >= 3x the device KV pool, revisited cyclically — the
+    regime where the destroy-on-pressure prefix cache evicts exactly
+    the chain the next request needs. Tier-on (``prefix_cache_host_
+    blocks``) vs tier-off on the SAME request stream, gated on:
+    skipped-prefill fraction >= 1.3x tier-off, end-to-end goodput
+    (request steps/s) better, token streams identical, promote latency
+    mostly hidden (``promote_exposed_frac`` = promotion dispatch wait /
+    measured wall — the only part the plan path pays; the H2D
+    transfers themselves overlap), and 0 fresh compiles over the
+    measured window (demotion gathers are shape-bucketed)."""
+    import os
+
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceConfig)
+
+    on_tpu = jax.default_backend() == "tpu"
+    big = os.environ.get("DSTPU_HIER_MODEL",
+                         "big" if on_tpu else "tiny") == "big"
+    model, mcfg = _serve_llama(big)
+    if big:
+        SYS, TAIL, GEN, bs, CHUNK, dtype = 768, 128, 16, 256, 256, \
+            "bfloat16"
+    else:
+        SYS, TAIL, GEN, bs, CHUNK, dtype = 96, 16, 8, 32, 32, "float32"
+    G = int(os.environ.get("DSTPU_HIER_GROUPS", "12"))
+    ROUNDS = int(os.environ.get("DSTPU_HIER_ROUNDS", "2"))
+    params = _pseudo_params(model, mcfg)
+
+    pre_blocks = SYS // bs                       # blocks per preamble
+    blocks_per_seq = (SYS + TAIL + GEN + bs - 1) // bs
+    # the pool holds ONE live request plus ~1/3 of the preamble working
+    # set: working_set_blocks / num_blocks >= 3 is the acceptance regime
+    num_blocks = max(blocks_per_seq + 1, (G * pre_blocks) // 3)
+    working_set = G * pre_blocks
+    host_cap = working_set * 2                   # tier holds everything
+
+    rng = np.random.RandomState(0)
+    preambles = [rng.randint(1, mcfg.vocab_size, size=SYS).tolist()
+                 for _ in range(G)]
+    # group-cycled revisits: request j opens preamble j % G — each
+    # group is revisited at exact period G, always after enough other
+    # traffic to have been pressured out of the device pool
+    reqs = [(j, preambles[j % G]
+             + rng.randint(1, mcfg.vocab_size, size=TAIL).tolist())
+            for j in range(ROUNDS * G)]
+
+    base = dict(
+        max_seqs=4, chunk_size=CHUNK, block_size=bs,
+        num_blocks=num_blocks, max_blocks_per_seq=blocks_per_seq,
+        dtype=dtype, attention_impl="paged_flash" if on_tpu else "dense",
+        decode_loop_steps=0, prefix_cache=True)
+
+    def run(host_blocks):
+        eng = InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
+            **base, prefix_cache_host_blocks=host_blocks))
+        # warm: one full group cycle registers every chain and drives
+        # the steady demote/promote traffic (restore scatter + every
+        # pow2 gather bucket the measured cycle will hit), plus two
+        # warm-only tails for the CoW program — warm uids, never
+        # measured, so the measured skipped fraction is the workload's
+        wrng = np.random.RandomState(10_000)
+        for wuid, g in ((90_000 + j, j % G) for j in range(G + 2)):
+            wp = preambles[g] + wrng.randint(
+                1, mcfg.vocab_size, size=TAIL).tolist()
+            w = eng.put([wuid], [wp], _greedy=True)
+            eng.decode_pipelined([wuid], [w[wuid]], GEN)
+            eng.flush(wuid)
+        stats0 = dict(eng.prefix_stats)
+        # warm-phase promotion waits must not leak into the measured
+        # window's exposed fraction — delta the histogram like every
+        # other counter
+        pw0 = eng.metrics.histogram("prefix_promote_wait_s").sum \
+            if eng.metrics is not None else 0.0
+        from deepspeed_tpu.analysis import RecompileTripwire
+        tw = RecompileTripwire()
+        outs = {}
+        t0 = time.perf_counter()
+        with tw:
+            for uid, p in reqs:
+                first = eng.put([uid], [p], _greedy=True)
+                toks = eng.decode_pipelined([uid], [first[uid]], GEN)
+                outs[uid] = [first[uid]] + toks[uid]
+                eng.flush(uid)
+        wall = time.perf_counter() - t0
+        st = eng.prefix_stats
+        skipped = st["matched_tokens"] - stats0["matched_tokens"]
+        ran = st["prefill_tokens"] - stats0["prefill_tokens"]
+        promote_wait = 0.0
+        if eng.metrics is not None:
+            promote_wait = eng.metrics.histogram(
+                "prefix_promote_wait_s").sum - pw0
+        return {
+            "skipped_prefill_frac": round(
+                skipped / (skipped + ran), 3) if skipped + ran else 0.0,
+            "goodput_req_per_s": round(len(reqs) / wall, 3),
+            "wall_s": round(wall, 3),
+            "matched_tokens": skipped,
+            # window delta like every sibling stat — the cumulative
+            # engine fraction would fold the all-miss warm cycle in
+            "host_hit_frac": round(
+                (st.get("host_matched_tokens", 0)
+                 - stats0.get("host_matched_tokens", 0)) / skipped, 3)
+            if skipped else 0.0,
+            "demoted": st.get("demoted", 0) - stats0.get("demoted", 0),
+            "promoted": st.get("promoted", 0)
+            - stats0.get("promoted", 0),
+            "host_evicted": st.get("host_evicted", 0)
+            - stats0.get("host_evicted", 0),
+            "evicted_pressure": st.get("evicted_pressure", 0)
+            - stats0.get("evicted_pressure", 0),
+            "promote_wait_s": round(promote_wait, 4),
+            "promote_exposed_frac": round(promote_wait / wall, 4),
+            "fresh_compiles_measured":
+                tw.fresh_compiles if tw.available else None,
+        }, outs
+
+    off, off_out = run(0)
+    on, on_out = run(host_cap)
+    parity = on_out == off_out
+    distinct = len({t for toks in off_out.values() for t in toks})
+    frac_ratio = (on["skipped_prefill_frac"]
+                  / off["skipped_prefill_frac"]) \
+        if off["skipped_prefill_frac"] > 0 else float("inf")
+    gates = {
+        "token_parity": parity,
+        "skipped_frac_ratio_ge_1p3":
+            on["skipped_prefill_frac"] >= 1.3
+            * off["skipped_prefill_frac"]
+            and on["skipped_prefill_frac"] > 0,
+        "goodput_better":
+            on["goodput_req_per_s"] > off["goodput_req_per_s"],
+        # the CPU harness executes eager dispatches SYNCHRONOUSLY, so
+        # the measured "wait" absorbs in-flight step compute a TPU
+        # overlaps (the dispatch itself is ~1ms, microbenched) — the
+        # honest CPU bound is that promotion stays a small fraction of
+        # the wall it is saving; tpu_round16.sh captures the real
+        # async number and holds the 5% line
+        "promote_mostly_hidden":
+            on["promote_exposed_frac"] < (0.05 if on_tpu else 0.20),
+        "zero_fresh_compiles":
+            (on["fresh_compiles_measured"] in (0, None))
+            and (off["fresh_compiles_measured"] in (0, None)),
+    }
+    print(json.dumps({
+        "model": f"llama {mcfg.num_layers}L hidden={mcfg.hidden_size}",
+        "workload": {
+            "groups": G, "rounds": ROUNDS,
+            "system_prompt_tokens": SYS, "unique_tail_tokens": TAIL,
+            "gen_tokens": GEN, "block_size": bs,
+            "device_pool_blocks": num_blocks,
+            "working_set_blocks": working_set,
+            "working_set_over_pool": round(working_set / num_blocks, 2),
+            "host_tier_blocks": host_cap,
+        },
+        "tier_off": off,
+        "tier_on": on,
+        "skipped_frac_ratio": None if frac_ratio == float("inf")
+        else round(frac_ratio, 2),
+        "e2e_speedup": round(off["wall_s"] / on["wall_s"], 3),
+        "distinct_tokens": distinct,
+        "gates": gates,
+    }))
+    return 0 if all(gates.values()) and distinct > 1 else 1
+
+
 def bench_serve_drill():
     """Elastic-serving drill benchmark (ISSUE 7): preempt a serving
     replica mid-stream and recover on a survivor. Measures what the
@@ -2476,6 +2646,8 @@ def main():
         return bench_serve_pipeline()
     if sys.argv[1:] == ["serve_prefix"]:
         return bench_serve_prefix()
+    if sys.argv[1:] == ["serve_hier"]:
+        return bench_serve_hier()
     if sys.argv[1:] == ["serve_drill"]:
         return bench_serve_drill()
     if sys.argv[1:] == ["serve_overlap"]:
@@ -2526,10 +2698,10 @@ def main():
     out = {"probe": probe}
     dead = False
     for phase in ("train", "train_xl", "train_1p3b", "serve",
-                  "serve_pipeline", "serve_prefix", "serve_drill",
-                  "serve_overlap", "serve_obs", "serve_capacity",
-                  "serve_fleet", "serve_spec", "fastgen", "moe",
-                  "moe_train"):
+                  "serve_pipeline", "serve_prefix", "serve_hier",
+                  "serve_drill", "serve_overlap", "serve_obs",
+                  "serve_capacity", "serve_fleet", "serve_spec",
+                  "fastgen", "moe", "moe_train"):
         if dead:
             out[phase] = {"error": "skipped_backend_dead"}
             continue
@@ -2597,6 +2769,7 @@ def main():
                    "serving": out.get("serve", {}),
                    "serve_pipeline": out.get("serve_pipeline", {}),
                    "serve_prefix": out.get("serve_prefix", {}),
+                   "serve_hier": out.get("serve_hier", {}),
                    "serve_drill": out.get("serve_drill", {}),
                    "serve_overlap": out.get("serve_overlap", {}),
                    "serve_obs": out.get("serve_obs", {}),

@@ -84,6 +84,18 @@ class RaggedInferenceConfig(ConfigModel):
     # Eviction order among refcount-0 cached blocks: "lru" (least
     # recently released, default) or "fifo" (oldest insertion).
     prefix_cache_policy: str = "lru"
+    # Hierarchical KV (docs/serving.md "Hierarchical KV"): a host-RAM
+    # prefix-cache tier of up to this many blocks (0 = off). With it on,
+    # reserve pressure DEMOTES refcount-0 cached blocks (one batched
+    # non-blocking device->host gather per reserve call) instead of
+    # destroying them; a later match on a demoted chain PROMOTES the
+    # links back through fresh device blocks with the H2D scatters
+    # dispatched ahead of the sequence's remaining prefill chunks — a
+    # demoted hit is still a hit, just a slower one. Content is only
+    # lost past this cap (its own LRU/FIFO, prefix_cache_policy order).
+    # Token streams are identical tier on/off. Env override at engine
+    # construction: DSTPU_PREFIX_HOST_BLOCKS.
+    prefix_cache_host_blocks: int = 0
     # Overlapped serving pipeline depth: how many scheduled steps may be
     # in flight on the device at once. The serve loop splits into plan
     # (host: scheduler + batch staging, runs ahead) / dispatch (enqueue
@@ -201,6 +213,10 @@ class RaggedInferenceConfig(ConfigModel):
             raise ValueError(
                 f"prefix_cache_max_blocks must be >= 0 (0 = pool-bounded), "
                 f"got {self.prefix_cache_max_blocks}")
+        if self.prefix_cache_host_blocks < 0:
+            raise ValueError(
+                f"prefix_cache_host_blocks must be >= 0 (0 = host tier "
+                f"off), got {self.prefix_cache_host_blocks}")
         if self.serve_pipeline_depth < 0:
             raise ValueError(
                 f"serve_pipeline_depth must be >= 0 (0 = synchronous), "

@@ -212,14 +212,29 @@ class InferenceEngineV2:
             # pressure-driven eviction inside reserve) and on the state
             # manager (match/register/decref); put() drives it below
             from .prefix_cache import PrefixCache
+            # hierarchical KV: the host-RAM tier size, env-overridable
+            # with a LITERAL knob name (dslint DSL004/5). The env bypass
+            # skips the config validation — re-check the resolved value
+            host_blocks = int(
+                os.environ.get("DSTPU_PREFIX_HOST_BLOCKS")
+                or self.config.prefix_cache_host_blocks)
+            if host_blocks < 0:
+                raise ValueError(
+                    f"DSTPU_PREFIX_HOST_BLOCKS must be >= 0, got "
+                    f"{host_blocks}")
             self._prefix = PrefixCache(
                 self.config.block_size,
                 max_blocks=self.config.prefix_cache_max_blocks,
-                policy=self.config.prefix_cache_policy)
+                policy=self.config.prefix_cache_policy,
+                host_blocks=host_blocks)
             self.kv_cache.attach_prefix_cache(self._prefix)
             self.state.prefix = self._prefix
         self.scheduler = SplitFuseScheduler(self.config, self.state)
         self._kv_data = self.kv_cache.pool
+        # hierarchical KV: demotion gathers must read the engine's
+        # CURRENT functional pool value (every step rethreads it) —
+        # hand the kv cache a live view, not a snapshot
+        self.kv_cache.attach_pool_source(lambda: self._kv_data)
         self._step_counter = 0
         # overlapped serving pipeline: max in-flight steps. The env knob
         # DSTPU_SERVE_ASYNC overrides the config (0 = force synchronous —
@@ -449,19 +464,38 @@ class InferenceEngineV2:
 
     def _match_prefix(self, seq) -> None:
         """Prefix-cache hit path: point a fresh prompt's table at the
-        longest cached block chain and dispatch the CoW row copies a
-        partial-tail match requests — non-blocking enqueue on the
-        functional pool thread, so later steps (and later matchers'
-        reads) order after it on device. A DSL001-registered hot path:
-        matching must never block on the device."""
-        copies = self.state.match_prefix(seq)
-        if copies:
+        longest cached block chain and dispatch the device work the
+        match requested — CoW row copies for partial-tail hits and
+        host→device promotion scatters for hierarchical-KV hits. All
+        non-blocking enqueues on the functional pool thread, so later
+        steps (and later matchers' reads) order after them on device;
+        the scatters additionally get a promote-ahead scheduler tick
+        (scheduler.py) to overlap under other sequences' chunks. A
+        DSL001-registered hot path: matching must never block on the
+        device. ``promote_wait_s`` records the host-side dispatch cost
+        of the promotion — the only part of a demoted hit the plan path
+        pays; the transfer itself overlaps."""
+        plan = self.state.match_prefix(seq)
+        if plan:
             # serve fault site: a replica dying between the match (table
             # already points at shared blocks) and the CoW dispatch
             get_fault_injector().maybe_fire("during_cow_copy")
-        for src, dst in copies:
+        for src, dst in plan.copies:
             self._kv_data = self.kv_cache.copy_block(self._kv_data, src,
                                                      dst)
+        if plan.promotes:
+            # ONE batched scatter for the whole promoted chain — k
+            # per-block dispatches would put k eager-op launches on the
+            # plan path (the promote_exposed_frac lever)
+            t0 = time.perf_counter()
+            self._kv_data = self.kv_cache.promote_blocks(
+                self._kv_data, plan.promotes)
+            if self._obs is not None:
+                # promoted_blocks, not len(promotes): a host-tier CoW
+                # tail scatters without flipping its source entry, and
+                # the live counter must match stats["promoted"] exactly
+                self._obs.on_promote(plan.promoted_blocks,
+                                     time.perf_counter() - t0)
 
     def _register_prefix(self, batch_uids) -> None:
         """Insert this put() call's fully-prefilled prompt blocks into
@@ -482,10 +516,16 @@ class InferenceEngineV2:
             st.update(self._prefix.stats)
             st["cached_blocks"] = self._prefix.cached_blocks
             st["evictable_blocks"] = self._prefix.evictable_blocks
+            st["host_cached_blocks"] = self._prefix.host_cached_blocks
+            st["host_tier_blocks"] = self._prefix.host_blocks
         ran = st["prefill_tokens"]
         hit = st["matched_tokens"]
         st["prefill_chunks_skipped_frac"] = (
             hit / (hit + ran) if hit + ran else 0.0)
+        # hierarchical KV: the fraction of matched tokens the HOST tier
+        # served (the serve_hier bench's honest hit attribution)
+        st["host_hit_frac"] = (
+            st["host_matched_tokens"] / hit if hit else 0.0)
         return st
 
     def _drive_pipeline(self, work_left, make_plan, commit_one,
@@ -742,6 +782,10 @@ class InferenceEngineV2:
                 "and let the interrupted engine call return first")
         self.request_drain()
         t_drain0 = time.perf_counter()
+        # land any in-flight demotion gathers before snapshotting: the
+        # host tier (and whatever it still owes the next match) must
+        # survive the drain on host memory, not as device futures
+        self.kv_cache.finalize_demotions()
         manifest = build_manifest(self)
         if self.journal is not None:
             # retire the journal BEFORE flushing: the flush loop must not
@@ -885,6 +929,10 @@ class InferenceEngineV2:
             self.state.trim_blocks(seq)
         for seq in fl.aborts:
             self._flush_uid(seq.uid)
+        # hierarchical KV: pending demotion gathers are provably complete
+        # (this commit's readback just blocked on a LATER dispatch) —
+        # materialize them to host numpy here, off the plan/dispatch path
+        self.kv_cache.finalize_demotions()
 
     def _resume_headroom(self, seq) -> int:
         """Blocks needed to restore ``seq`` AND schedule its next chunk —
@@ -1148,6 +1196,7 @@ class InferenceEngineV2:
         lps = np.asarray(lps) if lps is not None else None
         # consumed is None when EOS is disabled: every slot fed all n
         consumed = np.asarray(consumed) if consumed is not None else None
+        self.kv_cache.finalize_demotions()   # readback above proved them
         self._step_counter += n
         out: Dict[int, List[int]] = {}
         journal_toks: Dict[int, List[int]] = {}
@@ -1815,6 +1864,7 @@ class InferenceEngineV2:
                 jnp.asarray(tables), L,
                 draft_toks=jnp.asarray(draft_arr), eos_id=-1)
             toks = np.asarray(toks)
+            self.kv_cache.finalize_demotions()
             self._step_counter += L
             now = time.monotonic() if obs is not None else 0.0
             journal_toks: Dict[int, List[int]] = {}

@@ -12,13 +12,101 @@ exists only in the allocator and the block tables. Rows are lane-aligned
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Callable, List, Optional
 
 import jax.numpy as jnp
 
 from .blocked_allocator import BlockedAllocator
 from .config import RaggedInferenceConfig
 from .prefix_cache import PrefixCache
+
+
+class _HostBatch:
+    """One demotion batch: the rows (and int8 scales) of every block one
+    ``reserve`` call demoted, gathered in a SINGLE non-blocking device
+    dispatch. The arrays stay in-flight device values until
+    :meth:`materialize` (called at a commit boundary, where the step
+    readback already proved the gather complete — the ``device_get``
+    there is a plain D2H copy, never a pipeline stall); until then a
+    promotion can consume the device-resident slice directly, paying no
+    host round-trip at all.
+
+    Host-RAM accounting is PER BLOCK, not per batch: materialize copies
+    each still-live index into its own contiguous numpy pair and drops
+    the batch arrays (and the pow2 padding), and :meth:`drop` (an entry
+    promoted or host-cap-evicted) releases that block's copy — so the
+    tier's resident bytes track ``prefix_cache_host_blocks``, never the
+    historical batch sizes."""
+
+    __slots__ = ("rows", "scales", "block_size", "count", "parts",
+                 "dead")
+
+    def __init__(self, rows, scales, block_size: int, count: int):
+        self.rows = rows
+        self.scales = scales
+        self.block_size = block_size
+        self.count = count          # victim blocks (before pow2 padding)
+        #: index -> (rows, scales) contiguous numpy copies, once
+        #: materialized (the batch arrays are then dropped)
+        self.parts = None
+        self.dead: set = set()
+
+    def drop(self, index: int) -> None:
+        self.dead.add(index)
+        if self.parts is not None:
+            self.parts.pop(index, None)
+
+    def slice(self, index: int):
+        if self.parts is not None:
+            return self.parts[index]
+        lo = index * self.block_size
+        hi = lo + self.block_size
+        rows = self.rows[:, :, lo:hi]
+        scales = None if self.scales is None \
+            else self.scales[:, :, :, lo:hi]
+        return rows, scales
+
+    def materialize(self) -> None:
+        if self.parts is not None:
+            return
+        import jax
+        import numpy as np
+        rows = jax.device_get(self.rows)
+        scales = None if self.scales is None \
+            else jax.device_get(self.scales)
+        bs = self.block_size
+        self.parts = {}
+        for i in range(self.count):
+            if i in self.dead:
+                continue
+            lo, hi = i * bs, (i + 1) * bs
+            self.parts[i] = (
+                np.ascontiguousarray(rows[:, :, lo:hi]),
+                None if scales is None
+                else np.ascontiguousarray(scales[:, :, :, lo:hi]))
+        self.rows = None
+        self.scales = None
+
+
+class _HostRef:
+    """A prefix-cache entry's handle onto its slice of a demotion batch
+    (``prefix_cache._Entry.host_ref``). Slicing is lazy: per-block numpy
+    copies after materialize, device-array slices before. ``release``
+    (called by the cache when the entry leaves the host tier) drops the
+    block's bytes so the batch never outlives its survivors."""
+
+    __slots__ = ("batch", "index")
+
+    def __init__(self, batch: _HostBatch, index: int):
+        self.batch = batch
+        self.index = index
+
+    def get(self):
+        """(rows, scales-or-None) for this block."""
+        return self.batch.slice(self.index)
+
+    def release(self) -> None:
+        self.batch.drop(self.index)
 
 
 class BlockedKVCache:
@@ -33,6 +121,14 @@ class BlockedKVCache:
         self.prefix: Optional[PrefixCache] = None   # attach_prefix_cache
         self._mesh = None                           # set by shard()
         self._copy_jit = None                       # built on first CoW
+        # hierarchical KV (docs/serving.md "Hierarchical KV"): the engine
+        # provides the CURRENT functional pool value (its _kv_data) so a
+        # demotion gather dispatched mid-plan reads the same thread every
+        # step writes — device ordering makes the gathered rows exact
+        self._pool_source: Optional[Callable[[], Any]] = None
+        #: demotion batches whose gathers are still device-resident,
+        #: awaiting materialize at a commit boundary
+        self._pending_host: List[_HostBatch] = []
         # +1 trash BLOCK at the end: padded query positions scatter into its
         # last slot, so they can never corrupt a live sequence's KV (see
         # model_runner) — and the pool stays an exact multiple of block_size,
@@ -100,12 +196,128 @@ class BlockedKVCache:
             if freed:
                 self.allocator.free(freed)
 
+    def attach_pool_source(self, fn: Callable[[], Any]) -> None:
+        """Give the cache a view of the engine's CURRENT functional pool
+        value — what a demotion gather must read. Without it (bare
+        kv-cache users, tier-off engines) reserve pressure falls back to
+        destroying refcount-0 cached blocks."""
+        self._pool_source = fn
+
     def reserve(self, n: int):
+        """Allocate ``n`` blocks, reclaiming refcount-0 prefix-cached
+        blocks on demand: with the host tier armed they are DEMOTED
+        (one batched non-blocking device→host gather per reserve call —
+        the cached chain survives, host-resident), otherwise destroyed.
+        Registered DSL001 hot path: the gather is dispatch-only; the
+        D2H materialize happens at a commit boundary."""
         self.collect_prefix_evictions()
         short = n - self.allocator.free_blocks
         if short > 0 and self.prefix is not None:
-            self.allocator.free(self.prefix.evict(short))
+            if self.prefix.host_tier and self._pool_source is not None:
+                short -= self._demote(short)
+            if short > 0:
+                self.allocator.free(self.prefix.evict(short))
         return self.allocator.allocate(n)
+
+    def _demote(self, short: int) -> int:
+        """Demote up to ``short`` refcount-0 cached blocks to the host
+        tier: ONE gather dispatch for the whole victim set (padded to a
+        power-of-two block count so the warm path never compiles a fresh
+        gather shape), entries re-tagged ``tier=host``, device blocks
+        back to the allocator. Returns the number of blocks recovered."""
+        bs = self.cfg.block_size
+        recovered = 0
+        while recovered < short:
+            # rounds, because demoting a leaf makes its parent demotable
+            # (leaf-first cascade); each round is still ONE batched
+            # gather dispatch, and chains are only as deep as a prompt's
+            # block count
+            victims = self.prefix.pop_demotable(short - recovered)
+            if not victims:
+                break
+            blocks = [e.block for e in victims]
+            rows, scales = self._gather_rows(self._pool_source(), blocks)
+            batch = _HostBatch(rows, scales, bs, len(victims))
+            self._pending_host.append(batch)
+            self.prefix.demote(
+                victims,
+                [_HostRef(batch, i) for i in range(len(victims))])
+            self.allocator.free(blocks)
+            recovered += len(blocks)
+        return recovered
+
+    def _gather_rows(self, kv_data, blocks):
+        """Non-blocking gather of ``blocks``' rows (and int8 scales) off
+        the functional pool thread — the device-side half of demotion.
+        The index is padded with trash-block slots up to a power-of-two
+        victim count, so steady pressure reuses a handful of compiled
+        gather shapes instead of one per victim-set size."""
+        from .kv_quant import pool_parts
+        data, scales = pool_parts(kv_data)
+        pad = 1
+        while pad < len(blocks):
+            pad *= 2
+        padded = list(blocks) + [self.cfg.num_blocks] * (pad - len(blocks))
+        idx = jnp.asarray(self._slot_indices(padded))
+        rows = data[:, :, idx]
+        sc = None if scales is None else scales[:, :, :, idx]
+        return rows, sc
+
+    def finalize_demotions(self) -> None:
+        """Materialize pending demotion gathers to host numpy — called
+        at commit boundaries (the blocking step readback just proved the
+        gathers complete, so this is a D2H copy, not a stall) and at
+        drain. Until it runs, promotions consume the device-resident
+        slices directly."""
+        if not self._pending_host:
+            return
+        for batch in self._pending_host:
+            batch.materialize()   # per-live-block copies; padding dropped
+        self._pending_host = []
+
+    def buffer_of(self, entry):
+        """Resolve a host-tier entry's rows for promotion/CoW — numpy
+        (materialized) or an in-flight device slice."""
+        return entry.host_ref.get()
+
+    def promote_block(self, kv_data, buf, dst: int):
+        """Scatter a demoted block's rows into freshly reserved device
+        block ``dst`` — the host→device half of a hierarchical-KV hit.
+        A restore-path scatter on the functional pool thread: dispatch
+        only (the H2D transfer overlaps whatever compute precedes the
+        promoted sequence's own steps), zero collectives under TP (the
+        lane/head dim is untouched). Registered DSL001 hot path."""
+        rows, scales = buf
+        return self.restore(kv_data,
+                            (rows, scales) if scales is not None else rows,
+                            [dst])
+
+    def promote_blocks(self, kv_data, promotes):
+        """Batched promotion: ONE restore scatter for a whole matched
+        chain's ((rows, scales), dst) pairs — per-block dispatches put
+        k eager-op launches on the plan path where one suffices (the
+        measured promote_exposed_frac lever). Buffers concatenate on
+        whichever side they live: all-host numpy stays a host concat
+        (one H2D inside restore), any in-flight device slice upgrades
+        the concat to a device op. Registered DSL001 hot path —
+        dispatch only."""
+        import numpy as np
+        if len(promotes) == 1:
+            return self.promote_block(kv_data, *promotes[0])
+        bufs = [b for b, _ in promotes]
+        blocks = [dst for _, dst in promotes]
+        on_host = all(isinstance(b[0], np.ndarray) for b in bufs)
+        cat = np.concatenate if on_host else jnp.concatenate
+        rows = cat([b[0] for b in bufs], axis=2)
+        scales = None
+        if bufs[0][1] is not None:
+            cats = np.concatenate \
+                if all(isinstance(b[1], np.ndarray) for b in bufs) \
+                else jnp.concatenate
+            scales = cats([b[1] for b in bufs], axis=3)
+        return self.restore(kv_data,
+                            (rows, scales) if scales is not None else rows,
+                            blocks)
 
     def free(self, blocks) -> None:
         self.allocator.free(blocks)

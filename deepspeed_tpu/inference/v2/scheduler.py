@@ -63,6 +63,10 @@ class SplitFuseScheduler:
             and not seq.sampling.greedy,
             "spec_proposed": seq.spec_proposed,
             "spec_accepted": seq.spec_accepted,
+            # hierarchical KV: whether the sequence was mid promote-ahead
+            # when the replica died (diagnostics only — replay re-matches
+            # and re-promotes from whatever tier the survivor holds)
+            "promote_defer": seq.promote_defer,
         }
 
     def schedule(self, eligible: Optional[
@@ -102,6 +106,18 @@ class SplitFuseScheduler:
         for seq in decode + prefill:
             if len(out) == cfg.max_seqs:
                 break
+            if seq.promote_defer and seq.in_flight > 1 and out:
+                # hierarchical-KV promote-ahead: this sequence's prefix
+                # match just dispatched host->device promotion scatters;
+                # yield its first chunk for one tick while OTHER work
+                # fills the step, so the H2D copies overlap a neighbor's
+                # compute instead of sitting in front of this sequence's
+                # own paged-attention reads. Only defers when the step
+                # already has work (an empty schedule here would read as
+                # starvation), and the counter decrements every skip —
+                # bounded, never starving, token-stream-invariant.
+                seq.promote_defer -= 1
+                continue
             if seq.in_flight == 1:
                 n = 1                          # decode rows are budget-EXEMPT
             else:
@@ -129,6 +145,7 @@ class SplitFuseScheduler:
                 is_last_chunk=seq.in_flight == 0))
             seq.seen_tokens += n
             seq.status = SequenceStatus.RUNNING
+            seq.promote_defer = 0     # first chunk ran: head start over
             if n > 1:
                 used += n
         return out

@@ -48,6 +48,16 @@ class SequenceDescriptor:
     # prompt length incl. any cache-matched span — scheduler positions
     # below this count as PREFILL work for the skipped-chunk accounting
     prompt_len: int = 0
+    # hierarchical KV promote-ahead (scheduler.py): set when this
+    # sequence's prefix match promoted host-tier blocks — the scheduler
+    # then yields its first prefill chunk for up to this many ticks
+    # WHEN other work can fill the step, so the H2D promotion scatters
+    # get a head start under another sequence's compute instead of
+    # racing this sequence's own paged-attention reads. Pure timing
+    # (token streams are schedule-order-invariant); never starves — it
+    # only defers when something else schedules, and decrements every
+    # deferral.
+    promote_defer: int = 0
     # per-request sampling identity (sampling.SamplingParams; None =
     # greedy). Attached at admission via put(..., sampling=...), carried
     # for the sequence's whole life INCLUDING across drain/replay (the

@@ -16,13 +16,37 @@ device-side CoW copies that matching requests.
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Optional, Tuple
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from .blocked_allocator import OutOfBlocksError
 from .config import RaggedInferenceConfig
 from .kv_cache import BlockedKVCache
 from .prefix_cache import PrefixCache
 from .sequence import SequenceDescriptor, SequenceStatus
+
+
+@dataclass
+class MatchPlan:
+    """Device work one prefix match requests of the engine: ``copies``
+    are device-to-device CoW row copies (src_block, dst_block) behind a
+    device-tier partial-tail hit; ``promotes`` are host→device restore
+    scatters ((rows, scales), dst_block) behind hierarchical-KV hits —
+    full-block promotions AND host-tier CoW tails. All host bookkeeping
+    (refcounts, tier flips, block-table updates) already happened; the
+    engine only dispatches the data movement, non-blocking, before any
+    step that could read the blocks."""
+
+    copies: List[Tuple[int, int]] = field(default_factory=list)
+    promotes: List[Tuple[Any, int]] = field(default_factory=list)
+    #: promotes entries that FLIPPED a host entry to the device tier
+    #: (a host-tier CoW tail scatters without flipping its source) —
+    #: the live prefix_promoted_blocks counter must match
+    #: PrefixCache.stats["promoted"] exactly
+    promoted_blocks: int = 0
+
+    def __bool__(self) -> bool:
+        return bool(self.copies or self.promotes)
 
 
 class StateManager:
@@ -49,7 +73,12 @@ class StateManager:
                              # pipelined EOS retraction) and the blocks
                              # they returned — the rollback-pressure
                              # signal the serve_spec bench reads
-                             "trims": 0, "trimmed_blocks": 0}
+                             "trims": 0, "trimmed_blocks": 0,
+                             # hierarchical KV: tokens matched out of the
+                             # HOST tier (full promoted blocks + host CoW
+                             # spans) — the "demoted hit is still a hit"
+                             # numerator the serve_hier bench reads
+                             "host_matched_tokens": 0}
 
     # ------------------------------------------------------------------ #
 
@@ -105,21 +134,23 @@ class StateManager:
     # this sequence's full prompt blocks)
     # ------------------------------------------------------------------ #
 
-    def match_prefix(self, seq: SequenceDescriptor
-                     ) -> List[Tuple[int, int]]:
+    def match_prefix(self, seq: SequenceDescriptor) -> MatchPlan:
         """Point a FRESH sequence's block table at the longest cached
         chain of its prompt and skip those tokens' prefill entirely
         (pending -> seen with no scheduled chunk). Returns the
-        ``(src_block, dst_block)`` copy-on-write row copies the engine
-        must dispatch (partial-tail match into a private copy). At least
-        one trailing token is always left to prefill so the last chunk
-        still produces this sequence's logits. Pure host work plus
-        non-blocking device dispatch — a DSL001 hot path."""
-        copies: List[Tuple[int, int]] = []
+        :class:`MatchPlan` of device work the engine must dispatch:
+        copy-on-write row copies (partial-tail match into a private
+        copy) and hierarchical-KV promotion scatters (host-resident
+        chain links restored into fresh device blocks — a demoted hit
+        is still a hit). At least one trailing token is always left to
+        prefill so the last chunk still produces this sequence's
+        logits. Pure host work plus non-blocking device dispatch — a
+        DSL001 hot path."""
+        plan = MatchPlan()
         pc = self.prefix
         if pc is None or seq.seen_tokens or seq.kv_blocks \
                 or seq.in_flight < 2:
-            return copies
+            return plan
         toks = seq.pending_tokens
         seq.prefix_tokens = list(toks)
         self.prefix_stats["match_queries"] += 1
@@ -131,26 +162,91 @@ class StateManager:
         # so at most maxb - 1 full blocks can match; the cow append below
         # carries its own < maxb guard
         matched = 0
+        hit_blocks = 0
+        # demotion is leaf-first, so the matched chain is a DEVICE
+        # prefix followed by a HOST suffix. Acquire the device prefix
+        # FIRST: every entry on it is then pinned (refs > 0) before any
+        # promotion reserve below can go hunting for demotion victims —
+        # a reserve must never demote the very chain being matched
+        n_dev = 0
         for e in entries:
+            if e.tier != "device":
+                break
+            n_dev += 1
             pc.acquire(e)
             seq.kv_blocks.append(e.block)
             seq.shared.add(e.block)
             matched += bs
-        pc.stats["hit_blocks"] += len(entries)
-        self.prefix_stats["matched_blocks"] += len(entries)
-        if cow is not None and len(seq.kv_blocks) < maxb:
-            # pin the source entry across the reserve — with refcount 0
-            # it would itself be an eviction candidate for the block we
-            # are about to allocate as the copy destination
-            pc.acquire(cow)
+            hit_blocks += 1
+        for e in entries[n_dev:]:
+            # hierarchical-KV hit: restore the demoted link through a
+            # fresh device block. The reserve may demote OTHER cold
+            # chains (ours is pinned: the device prefix holds refs, the
+            # host suffix is not a demotion candidate) and may overflow
+            # the host tier's cap — re-check the entry survived before
+            # touching its buffer. Stop the match at the first link the
+            # pool cannot cover: the rest stays host-resident for the
+            # next request.
+            try:
+                dst = self.kv_cache.reserve(1)[0]
+            except OutOfBlocksError:
+                break
+            if e.host_ref is None or e.tier != "host":
+                # host-cap eviction raced us inside that reserve: the
+                # link is gone, nothing left to promote
+                self.kv_cache.free([dst])
+                break
+            buf = self.kv_cache.buffer_of(e)
+            pc.promote(e, dst)
+            pc.acquire(e)
+            plan.promotes.append((buf, dst))
+            plan.promoted_blocks += 1
+            seq.kv_blocks.append(dst)
+            seq.shared.add(dst)
+            matched += bs
+            hit_blocks += 1
+            self.prefix_stats["host_matched_tokens"] += bs
+        pc.stats["hit_blocks"] += hit_blocks
+        self.prefix_stats["matched_blocks"] += hit_blocks
+        if cow is not None and hit_blocks == len(entries) \
+                and len(seq.kv_blocks) < maxb and cow.tier != "dead":
+            # partial-tail hit (only when the full chain matched — a
+            # truncated promotion means the cow child is deeper than the
+            # table reaches). The tier is RE-READ here, not taken from
+            # the match walk: the promotion loop's reserves above may
+            # have demoted a device cow (serve it off the host path) or
+            # host-cap-evicted a host cow outright (tier "dead" — the
+            # guard above skips it; acquiring a dead entry would crash
+            # the serve path). A device-tier source is pinned across
+            # the reserve — with refcount 0 it would itself be a
+            # reclaim candidate for the block we are about to allocate
+            # as the copy destination; a host-tier source is no
+            # candidate but can be host-cap-evicted by the reserve, so
+            # it is re-checked after.
+            host_cow = cow.tier == "host"
+            if not host_cow:
+                pc.acquire(cow)
             try:
                 dst = self.kv_cache.reserve(1)[0]
             except OutOfBlocksError:
                 dst = None
             finally:
-                pc.release_block(cow.block)
+                if not host_cow:
+                    pc.release_block(cow.block)
+            if dst is not None and host_cow \
+                    and (cow.host_ref is None or cow.tier != "host"):
+                self.kv_cache.free([dst])
+                dst = None
             if dst is not None:
-                copies.append((cow.block, dst))
+                if host_cow:
+                    # the agreeing span is scattered host->device into
+                    # the PRIVATE copy; the source entry stays demoted
+                    plan.promotes.append((self.kv_cache.buffer_of(cow),
+                                          dst))
+                    pc.stats["host_hit_blocks"] += 1
+                    self.prefix_stats["host_matched_tokens"] += cow_len
+                else:
+                    plan.copies.append((cow.block, dst))
                 seq.kv_blocks.append(dst)        # private: CoW, not shared
                 matched += cow_len
                 pc.stats["cow_hits"] += 1
@@ -160,7 +256,11 @@ class StateManager:
             seq.seen_tokens += matched
             del seq.pending_tokens[:matched]
             self.prefix_stats["matched_tokens"] += matched
-        return copies
+        if plan.promotes:
+            # promote-ahead (scheduler.py): give the H2D scatters one
+            # scheduler tick of head start under other sequences' chunks
+            seq.promote_defer = 1
+        return plan
 
     def register_prefix(self, seq: SequenceDescriptor) -> None:
         """Insert this sequence's fully-prefilled full prompt blocks into

@@ -53,7 +53,7 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..inference.v2.blocked_allocator import OutOfBlocksError
 from ..telemetry.registry import Histogram, MetricsRegistry
@@ -113,11 +113,30 @@ class Replica:
         already-written KV blocks: full matched chain blocks plus the
         copy-on-write tail span. A pure (side-effect-free) trie walk —
         ``PrefixCache.match`` neither acquires nor stats-bumps."""
+        dev, host = self.prefix_overlap_tiered(tokens)
+        return dev + host
+
+    def prefix_overlap_tiered(self, tokens: Sequence[int]
+                              ) -> Tuple[int, int]:
+        """(device_tokens, host_tokens) split of :meth:`prefix_overlap`
+        — the router scores demoted (host-tier) overlap at a discount:
+        a demoted hit still skips the prefill FLOPs but pays the
+        promotion copies, so a replica holding the chain on DEVICE
+        should win the placement over one that would have to promote
+        it. Same pure trie walk, DSL001-clean."""
         pc = self.engine._prefix
         if pc is None:
-            return 0
-        entries, _cow, cow_len = pc.match(tokens)
-        return len(entries) * pc.block_size + cow_len
+            return 0, 0
+        entries, cow, cow_len = pc.match(tokens)
+        bs = pc.block_size
+        dev = sum(bs for e in entries if e.tier == "device")
+        host = sum(bs for e in entries if e.tier != "device")
+        if cow is not None:
+            if cow.tier == "device":
+                dev += cow_len
+            else:
+                host += cow_len
+        return dev, host
 
     def queue_frac(self) -> float:
         """(Live + batch-routed) sequences over slots — the load half

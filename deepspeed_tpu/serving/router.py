@@ -64,7 +64,8 @@ class NoServingReplicaError(RuntimeError):
 class Router:
     def __init__(self, policy: str = "prefix_aware", seed: int = 0,
                  slo_ttft_s: float = 0.0, w_prefix: float = 1.0,
-                 w_queue: float = 1.0, w_headroom: float = 0.25):
+                 w_queue: float = 1.0, w_headroom: float = 0.25,
+                 w_demoted: float = 0.5):
         # w_queue >= w_prefix on purpose: overlap_frac < 1 always, so a
         # SATURATED replica (queue_frac -> 1) loses to an idle one even
         # on a perfect cache hit — affinity concentrates traffic only
@@ -79,6 +80,12 @@ class Router:
         self.w_prefix = float(w_prefix)
         self.w_queue = float(w_queue)
         self.w_headroom = float(w_headroom)
+        # hierarchical KV: host-tier (demoted) overlap counts, but at a
+        # discount — a demoted hit still skips the prefill FLOPs, yet
+        # pays the promotion copies a device-resident chain would not;
+        # given the choice, the request belongs on the replica that
+        # holds the chain on device
+        self.w_demoted = float(w_demoted)
         self._rng = random.Random(self.seed)
         self._rr = 0
         self.stats = {"dispatched": 0, "ties_broken": 0}
@@ -93,7 +100,18 @@ class Router:
         keys, two dict-size reads and (with an SLO target) a streaming
         histogram quantile — never a device sync."""
         n = len(prompt)
-        overlap = replica.prefix_overlap(prompt) / n if n else 0.0
+        if n == 0:
+            overlap = 0.0
+        else:
+            tiered = getattr(replica, "prefix_overlap_tiered", None)
+            if tiered is not None:
+                # demoted (host-tier) overlap at a discount — see
+                # __init__; plain prefix_overlap keeps fakes/tests and
+                # pre-tier replica objects working unchanged
+                dev, host = tiered(prompt)
+                overlap = (dev + self.w_demoted * host) / n
+            else:
+                overlap = replica.prefix_overlap(prompt) / n
         s = self.w_prefix * overlap - self.w_queue * replica.queue_frac()
         if self.slo_ttft_s > 0:
             s += self.w_headroom * replica.slo_headroom(self.slo_ttft_s)
@@ -152,5 +170,6 @@ class Router:
         if self.policy == "prefix_aware":
             out.update(w_prefix=self.w_prefix, w_queue=self.w_queue,
                        w_headroom=self.w_headroom,
+                       w_demoted=self.w_demoted,
                        slo_ttft_s=self.slo_ttft_s or None)
         return out

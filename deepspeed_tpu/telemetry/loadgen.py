@@ -190,6 +190,21 @@ class WorkloadMix:
     shared_prefix_frac: float = 0.0
     shared_prefix_len: int = 0
     prefix_group_count: int = 1
+    #: hierarchical-KV working-set pattern (0 = off): offer a
+    #: shared-prefix working set of ~this many KV blocks — the group
+    #: count is derived as ceil(blocks·prefix_block_tokens /
+    #: shared_prefix_len) (at least prefix_group_count) and EVERY
+    #: request opens with a preamble, assigned by GROUP CYCLING
+    #: (request i -> group i mod G) instead of a uniform draw: each
+    #: preamble is revisited at exact period G, the honest pattern for
+    #: a tier whose whole point is surviving between revisits (uniform
+    #: assignment revisits hot groups too soon and cold ones maybe
+    #: never). Size it >= 3x the engine's device pool to measure the
+    #: host tier (bench.py serve_hier's workload).
+    prefix_working_set_blocks: int = 0
+    #: tokens per KV block the working-set sizing assumes (the target
+    #: engine's block_size; the CLI's tiny engine uses 16)
+    prefix_block_tokens: int = 16
     deadline_frac: float = 0.0
     deadline_s: float = 0.0
     vocab_size: int = 32000
@@ -211,6 +226,7 @@ class WorkloadMix:
             "shared_prefix_frac": self.shared_prefix_frac,
             "shared_prefix_len": self.shared_prefix_len,
             "prefix_group_count": self.prefix_group_count,
+            "prefix_working_set_blocks": self.prefix_working_set_blocks,
             "deadline_frac": self.deadline_frac,
             "deadline_s": self.deadline_s,
         }
@@ -230,12 +246,36 @@ def build_requests(process: ArrivalProcess, mix: WorkloadMix, n: int,
     glens = rng.choice(list(mix.gen_lens), size=n, p=list(mix.gen_probs))
     shared = rng.random_sample(n) < mix.shared_prefix_frac
     deadlined = rng.random_sample(n) < mix.deadline_frac
-    # shared-prefix preambles: one (the single-group classic) or
-    # prefix_group_count distinct ones (the fleet workload). The
-    # single-group path draws exactly what it always drew, so request
-    # identity under existing (mix, seed) pairs is unchanged.
-    grouped = mix.shared_prefix_len and mix.prefix_group_count > 1
-    if grouped:
+    # shared-prefix preambles: one (the single-group classic),
+    # prefix_group_count distinct ones (the fleet workload), or the
+    # hierarchical-KV WORKING-SET pattern (prefix_working_set_blocks):
+    # enough groups to cover the requested block footprint, every
+    # request prefixed, groups CYCLED so each preamble is revisited at
+    # exact period G. The pre-existing paths draw exactly what they
+    # always drew, so request identity under existing (mix, seed)
+    # pairs is unchanged.
+    if mix.prefix_working_set_blocks > 0:
+        if mix.shared_prefix_len <= 0:
+            raise ValueError(
+                "prefix_working_set_blocks needs shared_prefix_len > 0")
+        if int(min(mix.prompt_lens)) <= mix.shared_prefix_len:
+            # the per-request guard below would silently strip the
+            # preamble from such prompts — the working-set pattern
+            # would then measure NOTHING; fail loud instead
+            raise ValueError(
+                f"prefix_working_set_blocks: every prompt must exceed "
+                f"the {mix.shared_prefix_len}-token preamble (shortest "
+                f"prompt_len is {min(mix.prompt_lens)})")
+        per = max(1, -(-mix.shared_prefix_len
+                       // max(1, mix.prefix_block_tokens)))
+        G = max(mix.prefix_group_count,
+                -(-mix.prefix_working_set_blocks // per))
+        prefixes = [rng.randint(1, mix.vocab_size,
+                                size=mix.shared_prefix_len).tolist()
+                    for _ in range(G)]
+        group_of = np.arange(n, dtype=np.int64) % G
+        shared = np.ones(n, bool)
+    elif mix.shared_prefix_len and mix.prefix_group_count > 1:
         prefixes = [rng.randint(1, mix.vocab_size,
                                 size=mix.shared_prefix_len).tolist()
                     for _ in range(mix.prefix_group_count)]
@@ -682,11 +722,14 @@ def _ms(v: Optional[float]) -> Optional[float]:
 
 def _tiny_engine(max_seqs: int = 8, num_blocks: int = 96,
                  block_size: int = 16, vocab: int = 96,
-                 spec: str = "off", spec_k: int = 4):
+                 spec: str = "off", spec_k: int = 4,
+                 host_blocks: int = 0):
     """CPU-harness GPT-2 engine for the CLI's self-contained mode and
     the tier-1 capacity smoke — small enough that a decode step is a
     few ms. ``spec`` arms speculative decoding (``--spec`` /
-    ``DSTPU_SPEC_MODE``) on the tiny engine."""
+    ``DSTPU_SPEC_MODE``); ``host_blocks`` arms the hierarchical-KV
+    host-RAM tier (``--host-blocks``) so the working-set workload has a
+    second tier to hit."""
     import jax
     import jax.numpy as jnp
 
@@ -702,6 +745,7 @@ def _tiny_engine(max_seqs: int = 8, num_blocks: int = 96,
         num_blocks=num_blocks, max_blocks_per_seq=16, dtype="float32",
         attention_impl="dense", decode_loop_steps=0,
         serve_pipeline_depth=2, prefix_cache=True,
+        prefix_cache_host_blocks=host_blocks,
         spec_decode=spec, spec_k=spec_k)
     return InferenceEngineV2(mcfg, params, cfg), mcfg
 
@@ -762,6 +806,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--prefix-groups", type=int, default=1,
                     help="distinct shared preambles (>1 = the fleet "
                          "routing workload)")
+    ap.add_argument("--prefix-working-set-blocks", type=int,
+                    default=int(os.environ.get(
+                        "DSTPU_LOADGEN_PREFIX_WS", "0") or "0"),
+                    help="offer a group-cycled shared-prefix working "
+                         "set of ~this many KV blocks (the hierarchical"
+                         "-KV workload; size it >= 3x the device pool)")
+    ap.add_argument("--host-blocks", type=int,
+                    default=int(os.environ.get(
+                        "DSTPU_LOADGEN_HOST_BLOCKS", "0") or "0"),
+                    help="arm the tiny engine's host-RAM prefix-cache "
+                         "tier with this many blocks (0 = off)")
+    ap.add_argument("--num-blocks", type=int,
+                    default=int(os.environ.get(
+                        "DSTPU_LOADGEN_NUM_BLOCKS", "96") or "96"),
+                    help="tiny engine KV pool size in blocks — shrink "
+                         "it below the working set to exercise the "
+                         "host tier")
     ap.add_argument("--deadline-s", type=float, default=0.0)
     ap.add_argument("--deadline-frac", type=float, default=0.0)
     ap.add_argument("--replicas", type=int, default=int(os.environ.get(
@@ -792,7 +853,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         mcfg_box = []
 
         def factory(i, dev):
-            e, m = _tiny_engine(spec=args.spec, spec_k=args.spec_k)
+            e, m = _tiny_engine(num_blocks=args.num_blocks,
+                                spec=args.spec, spec_k=args.spec_k,
+                                host_blocks=args.host_blocks)
             mcfg_box.append(m)
             return e
 
@@ -801,7 +864,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         pool = ReplicaPool(engines, policy=args.policy)
         eng = pool
     else:
-        eng, mcfg = _tiny_engine(spec=args.spec, spec_k=args.spec_k)
+        eng, mcfg = _tiny_engine(num_blocks=args.num_blocks,
+                                 spec=args.spec, spec_k=args.spec_k,
+                                 host_blocks=args.host_blocks)
     sampling = None
     if args.temperature > 0:
         from ..inference.v2 import SamplingParams
@@ -811,12 +876,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         prompt_lens=(args.prompt_len,), prompt_probs=(1.0,),
         gen_lens=(args.gen_len,), gen_probs=(1.0,),
         shared_prefix_frac=args.shared_prefix_frac,
-        # one full 16-token block (the tiny engine's block size) so the
+        # full 16-token blocks (the tiny engine's block size) so the
         # shared span is actually cacheable; shorter prompts get no
-        # prefix rather than a sub-block span no match can ever hit
-        shared_prefix_len=16
-        if args.shared_prefix_frac > 0 and args.prompt_len >= 24 else 0,
+        # prefix rather than a sub-block span no match can ever hit.
+        # The working-set pattern always needs a preamble — it exists
+        # to cycle one — and takes the LONGEST block-aligned span the
+        # prompt affords (up to 3 blocks), so the group count is
+        # working-set/preamble-blocks and a realistic request count
+        # actually revisits each group.
+        shared_prefix_len=min(3, max(1, (args.prompt_len - 8) // 16)) * 16
+        if args.prefix_working_set_blocks > 0
+        else (16 if args.shared_prefix_frac > 0 and args.prompt_len >= 24
+              else 0),
         prefix_group_count=max(1, args.prefix_groups),
+        prefix_working_set_blocks=max(0, args.prefix_working_set_blocks),
+        prefix_block_tokens=16,
         deadline_frac=args.deadline_frac, deadline_s=args.deadline_s,
         vocab_size=mcfg.vocab_size)
     rates = [float(r) for r in str(args.rate).split(",") if r]
@@ -858,6 +932,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                            "top_k": args.top_k, "top_p": args.top_p}
     if args.spec != "off":
         out["spec"] = {"mode": args.spec, "k": args.spec_k}
+    if args.host_blocks > 0 and pool is None:
+        # hierarchical-KV evidence: tier residency + churn + the
+        # host-served share of all matched tokens
+        st = eng.prefix_stats
+        out["hier_kv"] = {
+            "host_blocks": args.host_blocks,
+            "host_cached_blocks": st.get("host_cached_blocks", 0),
+            "demoted": st.get("demoted", 0),
+            "promoted": st.get("promoted", 0),
+            "host_hit_blocks": st.get("host_hit_blocks", 0),
+            "host_evicted": st.get("host_evicted", 0),
+            "host_hit_frac": round(st.get("host_hit_frac", 0.0), 4),
+            "skipped_prefill_frac": round(
+                st.get("prefill_chunks_skipped_frac", 0.0), 4),
+        }
     if pool is not None:
         from ..serving import fleet_prefix_stats
         out["fleet"] = {

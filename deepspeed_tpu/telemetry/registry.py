@@ -72,9 +72,18 @@ REGISTERED_METRICS = {
     "prefix_prefill_tokens": "prompt tokens that ran a prefill chunk",
     "prefix_cow_copies": "partial-tail copy-on-write block copies",
     "prefix_hit_blocks": "full cached blocks matched",
-    "prefix_evicted_blocks": "cached blocks reclaimed under pressure",
+    "prefix_evicted_blocks": "cached device blocks destroyed (cap + pressure)",
+    "prefix_evicted_cap": "cached blocks destroyed by the index cap",
+    "prefix_evicted_pressure": "cached blocks destroyed under pool pressure",
     "prefix_cached_blocks": "blocks currently held by the cache",
     "prefix_evictable_blocks": "refcount-0 cached blocks (reclaimable)",
+    # -- hierarchical KV: the host-RAM tier (counters + gauge + hist) -- #
+    "prefix_demoted_blocks": "device blocks demoted to the host tier",
+    "prefix_promoted_blocks": "host-tier blocks promoted back on device",
+    "prefix_host_hit_blocks": "matched blocks served from the host tier",
+    "prefix_host_evicted_blocks": "host-tier blocks destroyed at its cap",
+    "prefix_host_blocks": "blocks currently resident on the host tier",
+    "prefix_promote_wait_s": "per-request promotion dispatch wait",
     # -- KV pool (gauges) ---------------------------------------------- #
     "kv_pool_blocks_total": "KV pool capacity in blocks",
     "kv_pool_blocks_free": "allocator-free KV blocks",

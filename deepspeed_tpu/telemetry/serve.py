@@ -97,6 +97,8 @@ class ServeObserver:
         self.h_plan = r.histogram("serve_plan_s")
         self.h_dispatch = r.histogram("serve_dispatch_s")
         self.h_commit = r.histogram("serve_commit_block_s")
+        self.h_promote = r.histogram("prefix_promote_wait_s")
+        self.c_promoted = r.counter("prefix_promoted_blocks")
         self.c_flight_dropped = r.counter("flight_spans_dropped")
         self._reject_counters = {
             reason: r.counter(name)
@@ -196,6 +198,16 @@ class ServeObserver:
         if accepted:
             self.c_spec_accepted.inc(accepted)
 
+    def on_promote(self, blocks, wait_s):
+        """One request's hierarchical-KV promotion dispatched:
+        ``blocks`` host-tier blocks scattered back on device, paying
+        ``wait_s`` of host-side dispatch time on the plan path (the
+        transfers themselves overlap under subsequent compute — this
+        histogram IS the exposed cost the serve_hier bench gates on).
+        Registered DSL001 hot path: a counter add + one observe."""
+        self.c_promoted.inc(blocks)
+        self.h_promote.observe(wait_s)
+
     def on_reject(self, reason, uid=None):
         c = self._reject_counters.get(reason)
         if c is not None:
@@ -267,11 +279,20 @@ class ServeObserver:
             rep["kv_pool_bytes_per_chip"])
         st = eng.prefix_stats if eng._prefix is not None \
             else dict(eng.state.prefix_stats)
+        # delta-synced host-dict counters (monotone); prefix_promoted_
+        # blocks is NOT here — on_promote counts it live so the
+        # promote-wait histogram and the counter move together
         for key, metric in (("matched_tokens", "prefix_matched_tokens"),
                             ("prefill_tokens", "prefix_prefill_tokens"),
                             ("cow_copies", "prefix_cow_copies"),
                             ("hit_blocks", "prefix_hit_blocks"),
-                            ("evicted", "prefix_evicted_blocks")):
+                            ("evicted", "prefix_evicted_blocks"),
+                            ("evicted_cap", "prefix_evicted_cap"),
+                            ("evicted_pressure", "prefix_evicted_pressure"),
+                            ("demoted", "prefix_demoted_blocks"),
+                            ("host_hit_blocks", "prefix_host_hit_blocks"),
+                            ("host_evicted",
+                             "prefix_host_evicted_blocks")):
             cur = st.get(key, 0)
             prev = self._prefix_prev.get(key, 0)
             if cur > prev:
@@ -280,6 +301,7 @@ class ServeObserver:
         if eng._prefix is not None:
             r.gauge("prefix_cached_blocks").set(st["cached_blocks"])
             r.gauge("prefix_evictable_blocks").set(st["evictable_blocks"])
+            r.gauge("prefix_host_blocks").set(st["host_cached_blocks"])
         dropped = self.flight.dropped
         if dropped > self._flight_dropped_prev:
             self.c_flight_dropped.inc(dropped - self._flight_dropped_prev)

@@ -167,6 +167,21 @@ def render(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
                  f" blocks (evictable {g_sum('prefix_evictable_blocks'):.0f})"
                  f"   cow {c.get('prefix_cow_copies', 0):.0f}"
                  f"   evicted {c.get('prefix_evicted_blocks', 0):.0f}")
+    demoted = c.get("prefix_demoted_blocks", 0.0)
+    host_now = g_sum("prefix_host_blocks")
+    if demoted or host_now:
+        # hierarchical KV: the host-RAM tier line — resident blocks,
+        # demote/promote churn, host-served hits, true losses at the
+        # tier's own cap, and the promotion dispatch wait the plan path
+        # actually paid (the exposed slice of a demoted hit's cost)
+        pw = h.get("prefix_promote_wait_s", {})
+        lines.append(
+            f"host tier      {host_now:.0f} blocks resident   "
+            f"demoted {demoted:.0f}   "
+            f"promoted {c.get('prefix_promoted_blocks', 0):.0f}   "
+            f"host hits {c.get('prefix_host_hit_blocks', 0):.0f}   "
+            f"lost {c.get('prefix_host_evicted_blocks', 0):.0f}   "
+            f"promote wait p99 {_ms(pw.get('p99'))} ms")
     total = g_sum("kv_pool_blocks_total")
     free = g_sum("kv_pool_blocks_free")
     per_chip = [v for k, v in g.items()

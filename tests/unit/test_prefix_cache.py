@@ -241,6 +241,264 @@ class TestBatchedPutRegistration:
         assert kv.allocator.free_blocks == 16
 
 
+def _hier_fixture(bs=4, num_blocks=8, host_blocks=16, policy="lru",
+                  dtype=None):
+    """A BlockedKVCache + two-tier PrefixCache + StateManager wired the
+    way the engine wires them (pool source attached so reserve pressure
+    demotes instead of destroying)."""
+    import jax.numpy as jnp
+    cfg = RaggedInferenceConfig(
+        max_seqs=4, chunk_size=8, block_size=bs, num_blocks=num_blocks,
+        max_blocks_per_seq=8, dtype="float32", prefix_cache=True,
+        kv_cache_dtype="int8" if dtype == "int8" else "auto",
+        attention_impl="dense",
+        prefix_cache_host_blocks=host_blocks)
+    kv = BlockedKVCache(cfg, 1, 1, 4,
+                        None if dtype == "int8" else jnp.float32)
+    pc = PrefixCache(bs, host_blocks=host_blocks, policy=policy)
+    kv.attach_prefix_cache(pc)
+    box = {"pool": kv.pool}
+    kv.attach_pool_source(lambda: box["pool"])
+    sm = StateManager(cfg, kv)
+    sm.prefix = pc
+    return cfg, kv, pc, sm, box
+
+
+def _prefill(sm, seq):
+    """Run a sequence's remaining prefill as pure bookkeeping (the
+    stress/unit tests never dispatch compute)."""
+    n = seq.in_flight
+    sm.ensure_blocks(seq, n)
+    del seq.pending_tokens[:n]
+    seq.seen_tokens += n
+
+
+class TestHostTierIndex:
+    """Hierarchical KV at the cache/kv-cache seam: demotion under
+    reserve pressure, promotion on a match, host-cap eviction, and the
+    evicted_cap/evicted_pressure churn split."""
+
+    def test_pressure_demotes_instead_of_destroying(self):
+        cfg, kv, pc, sm, box = _hier_fixture()
+        s0 = sm.put_tokens(0, [1, 2, 3, 4, 5, 6, 7, 8, 9])
+        sm.match_prefix(s0)
+        _prefill(sm, s0)
+        sm.register_prefix(s0)
+        sm.flush(0)                      # chain cold: 2 refcount-0 blocks
+        assert pc.cached_blocks == 2 and pc.evictable_blocks == 2
+        # demand the whole pool: the cold chain must move to the host
+        # tier, not die
+        blocks = kv.reserve(cfg.num_blocks)
+        assert len(blocks) == cfg.num_blocks
+        assert pc.cached_blocks == 0 and pc.host_cached_blocks == 2
+        assert pc.stats["demoted"] == 2
+        assert pc.stats["evicted"] == 0 == pc.stats["evicted_pressure"]
+        kv.free(blocks)
+        # the chain is STILL matchable — a later identical prompt
+        # promotes it back through fresh device blocks
+        s1 = sm.put_tokens(1, [1, 2, 3, 4, 5, 6, 7, 8, 9])
+        plan = sm.match_prefix(s1)
+        assert len(plan.promotes) == 2 and not plan.copies
+        assert s1.seen_tokens == 8 and len(s1.shared) == 2
+        assert pc.stats["promoted"] == 2
+        assert pc.stats["host_hit_blocks"] == 2
+        assert pc.host_cached_blocks == 0 and pc.cached_blocks == 2
+        pc.check_invariants()
+        pc.assert_exact_refs([s1])
+
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
+    def test_promotion_restores_exact_content(self, kv_dtype):
+        """The data-path half: rows written before demotion come back
+        bit-identical after the demote gather -> host -> promote scatter
+        round trip (bf16/float rows AND int8 payloads + scale planes)."""
+        import numpy as np
+
+        import jax.numpy as jnp
+        from deepspeed_tpu.inference.v2.kv_quant import pool_parts
+        cfg, kv, pc, sm, box = _hier_fixture(dtype=kv_dtype)
+        bs = cfg.block_size
+        s0 = sm.put_tokens(0, [1, 2, 3, 4, 5])
+        sm.match_prefix(s0)
+        _prefill(sm, s0)
+        blk = s0.kv_blocks[0]
+        # stamp recognizable KV content into the block's rows
+        data, scales = pool_parts(box["pool"])
+        rows = np.arange(bs * 4, dtype=np.float32).reshape(bs, 4)
+        sl = slice(blk * bs, (blk + 1) * bs)
+        if scales is not None:
+            data = data.at[:, :, sl].set(
+                jnp.asarray(rows % 127, jnp.int8))
+            scales = scales.at[:, :, :, sl].set(0.5)
+            from deepspeed_tpu.inference.v2.kv_quant import KVPool
+            box["pool"] = KVPool(data, scales)
+        else:
+            data = data.at[:, :, sl].set(jnp.asarray(rows))
+            box["pool"] = data
+        want_rows = np.asarray(pool_parts(box["pool"])[0][:, :, sl])
+        sm.register_prefix(s0)
+        sm.flush(0)
+        held = kv.reserve(cfg.num_blocks)       # force the demotion
+        assert pc.host_cached_blocks >= 1
+        kv.finalize_demotions()                 # D2H materialize path
+        kv.free(held)
+        s1 = sm.put_tokens(1, [1, 2, 3, 4, 5])
+        plan = sm.match_prefix(s1)
+        assert len(plan.promotes) == 1
+        buf, dst = plan.promotes[0]
+        box["pool"] = kv.promote_block(box["pool"], buf, dst)
+        got_data, got_scales = pool_parts(box["pool"])
+        got = np.asarray(got_data[:, :, dst * bs:(dst + 1) * bs])
+        assert np.array_equal(got, want_rows)
+        if got_scales is not None:
+            assert np.all(np.asarray(
+                got_scales[:, :, :, dst * bs:(dst + 1) * bs]) == 0.5)
+
+    def test_pending_device_promotion_no_materialize(self):
+        """A chain matched BEFORE the demotion gather materializes is
+        promoted straight off the in-flight device slice — the zero-
+        host-round-trip fast path."""
+        cfg, kv, pc, sm, box = _hier_fixture()
+        s0 = sm.put_tokens(0, [1, 2, 3, 4, 5])
+        sm.match_prefix(s0)
+        _prefill(sm, s0)
+        sm.register_prefix(s0)
+        sm.flush(0)
+        held = kv.reserve(cfg.num_blocks)
+        kv.free(held)
+        assert kv._pending_host                # gather NOT materialized
+        s1 = sm.put_tokens(1, [1, 2, 3, 4, 5])
+        plan = sm.match_prefix(s1)
+        assert len(plan.promotes) == 1
+        buf, dst = plan.promotes[0]
+        box["pool"] = kv.promote_block(box["pool"], buf, dst)
+        pc.check_invariants()
+
+    def test_host_cap_evicts_lru_leaf_first(self):
+        cfg, kv, pc, sm, box = _hier_fixture(num_blocks=16, host_blocks=2)
+        # three independent cold chains of 2 blocks, released in order
+        for uid, base in ((0, 10), (1, 20), (2, 30)):
+            s = sm.put_tokens(uid, [base + i for i in range(9)])
+            sm.match_prefix(s)
+            _prefill(sm, s)
+            sm.register_prefix(s)
+        for uid in (0, 1, 2):
+            sm.flush(uid)
+        held = kv.reserve(cfg.num_blocks)       # demote all 6
+        kv.free(held)
+        # cap 2: only the two COLDEST-demoted survive... demotion is
+        # leaf-first LRU over release stamps, so the survivors are the
+        # newest demotions and 4 were destroyed for real
+        assert pc.host_cached_blocks == 2
+        assert pc.stats["demoted"] == 6
+        assert pc.stats["host_evicted"] == 4
+        pc.check_invariants()
+
+    def test_fifo_host_parent_repush_after_child_leaves(self):
+        """FIFO host ranks order parents BEFORE their children (born
+        first); the cap sweep must skip-and-repush so a parent is
+        destroyed only after its last host child."""
+        cfg, kv, pc, sm, box = _hier_fixture(num_blocks=16,
+                                             host_blocks=3,
+                                             policy="fifo")
+        s = sm.put_tokens(0, [i + 1 for i in range(13)])   # 3-block chain
+        sm.match_prefix(s)
+        _prefill(sm, s)
+        sm.register_prefix(s)
+        sm.flush(0)
+        held = kv.reserve(cfg.num_blocks)
+        kv.free(held)
+        assert pc.host_cached_blocks == 3
+        # shrink the cap by demoting more: a fresh 2-block chain
+        s2 = sm.put_tokens(1, [100 + i for i in range(9)])
+        sm.match_prefix(s2)
+        _prefill(sm, s2)
+        sm.register_prefix(s2)
+        sm.flush(1)
+        held = kv.reserve(cfg.num_blocks)
+        kv.free(held)
+        # 5 host-resident, cap 3 -> 2 destroyed; the structural
+        # invariants (host children only under host parents, heap
+        # coverage) are the real assertion here
+        assert pc.host_cached_blocks == 3
+        pc.check_invariants()
+
+    def test_cow_killed_mid_promotion_is_skipped(self):
+        """Review regression: the promotion loop's own reserves can
+        host-cap-evict the (host-tier) CoW candidate the match walk
+        returned — the cow branch must re-read the tier and SKIP a dead
+        entry instead of acquiring it (which crashed the serve path)."""
+        cfg, kv, pc, sm, box = _hier_fixture(num_blocks=8)
+        s0 = sm.put_tokens(0, [1, 2, 3, 4, 5, 6, 7, 8, 9])
+        sm.match_prefix(s0)
+        _prefill(sm, s0)
+        sm.register_prefix(s0)
+        sm.flush(0)
+        held = kv.reserve(cfg.num_blocks)       # demote the whole chain
+        kv.free(held)
+        assert pc.host_cached_blocks == 2
+        # s1 fully matches the root block; the second chain link is the
+        # longest-agreeing COW candidate for tokens [5, 6, 7]
+        real_reserve = kv.reserve
+        cow_entry = next(e for r in pc._roots.values()
+                         for e in r.children.values())
+
+        def reserve_killing_cow(n):
+            out = real_reserve(n)
+            if cow_entry.tier == "host":
+                # simulate the host-cap sweep claiming the cow while
+                # this reserve's demotions overflowed the tier
+                pc._unlink(cow_entry)
+                cow_entry.tier = "dead"
+                pc._drop_host_ref(cow_entry)
+                pc._host_count -= 1
+                pc.stats["host_evicted"] += 1
+            return out
+
+        kv.reserve = reserve_killing_cow
+        s1 = sm.put_tokens(1, [1, 2, 3, 4, 5, 6, 7, 10, 11])
+        plan = sm.match_prefix(s1)              # must not raise
+        kv.reserve = real_reserve
+        assert plan.promoted_blocks == 1        # the root block promoted
+        assert s1.seen_tokens == 4              # cow span NOT matched
+        pc.check_invariants()
+        pc.assert_exact_refs([s1])
+
+    def test_acquire_on_host_entry_raises(self):
+        cfg, kv, pc, sm, box = _hier_fixture()
+        s0 = sm.put_tokens(0, [1, 2, 3, 4, 5])
+        sm.match_prefix(s0)
+        _prefill(sm, s0)
+        sm.register_prefix(s0)
+        sm.flush(0)
+        held = kv.reserve(cfg.num_blocks)
+        kv.free(held)
+        entry = next(iter(pc._roots.values()))
+        assert entry.tier == "host"
+        with pytest.raises(RuntimeError, match="promote it first"):
+            pc.acquire(entry)
+
+    def test_churn_split_tier_off(self):
+        """The ISSUE-13 bugfix: cap-pressure inserts and reserve-
+        pressure evictions are separately attributable (they used to
+        conflate into one 'evicted' count)."""
+        pc = PrefixCache(4, max_blocks=2)
+        pc.insert(None, (1,) * 4, 0)
+        pc.insert(None, (2,) * 4, 1)
+        pc.release_block(0)
+        pc.release_block(1)
+        # cap-pressure: the third insert evicts one cold block
+        assert pc.insert(None, (3,) * 4, 2) is not None
+        assert pc.stats["evicted_cap"] == 1
+        assert pc.stats["evicted_pressure"] == 0
+        # reserve-pressure: an explicit evict() call (what
+        # BlockedKVCache.reserve does tier-off)
+        pc.release_block(2)
+        assert len(pc.evict(1)) == 1
+        assert pc.stats["evicted_pressure"] == 1
+        assert pc.stats["evicted_cap"] == 1
+        assert pc.stats["evicted"] == 2         # back-compat total
+
+
 class TestRandomizedRefcountModel:
     """The satellite model checker: random interleavings of the full
     block lifecycle against a shadow ownership model."""
@@ -386,3 +644,297 @@ class TestRandomizedRefcountModel:
         kv.allocator.free(pc.evict(num_blocks))
         assert pc.cached_blocks == 0
         assert kv.allocator.free_blocks == num_blocks
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_stress_hierarchical_two_tier(self, seed):
+        """The ISSUE-13 extension: the same shadow-model stress with the
+        HOST TIER armed — random interleavings now include reserve-
+        pressure demotion (through the real ``BlockedKVCache.reserve``
+        path), promotion on re-match, host-cap eviction and the
+        pending-gather materialize, on top of the existing alloc/match/
+        decref/trim/spec lifecycle. Oracles: ``check_invariants`` (tier
+        ordering, dev_kids, host cap, heap coverage),
+        ``assert_exact_refs`` across BOTH tiers, block conservation, no
+        freed-block aliasing, and full allocator recovery at drain."""
+        import jax.numpy as jnp
+        rng = np.random.default_rng(seed)
+        bs, num_blocks, host_cap = 4, 24, 10
+        cfg = RaggedInferenceConfig(
+            max_seqs=4, chunk_size=8, block_size=bs,
+            num_blocks=num_blocks, max_blocks_per_seq=8,
+            dtype="float32", prefix_cache=True,
+            prefix_cache_host_blocks=host_cap)
+        kv = BlockedKVCache(cfg, 1, 1, 4, jnp.float32)
+        pc = PrefixCache(bs, policy=rng.choice(["lru", "fifo"]),
+                         host_blocks=host_cap)
+        kv.attach_prefix_cache(pc)
+        box = {"pool": kv.pool}
+        kv.attach_pool_source(lambda: box["pool"])
+        sm = StateManager(cfg, kv)
+        sm.prefix = pc
+
+        vocab, next_uid = 3, [0]
+        live = {}
+
+        def dispatch_plan(plan):
+            # the engine's half of a match: promote scatters + CoW
+            # copies ride the functional pool thread
+            for buf, dst in plan.promotes:
+                box["pool"] = kv.promote_block(box["pool"], buf, dst)
+            for src, dst in plan.copies:
+                box["pool"] = kv.copy_block(box["pool"], src, dst)
+
+        def new_seq():
+            uid = next_uid[0]
+            next_uid[0] += 1
+            n = int(rng.integers(2, 21))
+            toks = rng.integers(0, vocab, n).tolist()
+            try:
+                seq = sm.put_tokens(uid, toks)
+            except ValueError:
+                return
+            dispatch_plan(sm.match_prefix(seq))
+            while seq.in_flight:
+                c = min(int(rng.integers(1, 9)), seq.in_flight)
+                try:
+                    sm.ensure_blocks(seq, c)
+                except OutOfBlocksError:
+                    sm.flush(uid)
+                    return
+                del seq.pending_tokens[:c]
+                seq.seen_tokens += c
+            sm.register_prefix(seq)
+            live[uid] = seq
+
+        def pressure(uid=None):
+            # reserve-then-free a random slab: drives the REAL demote
+            # path (batched gather dispatch, host-cap sweep) without
+            # retaining blocks
+            want = int(rng.integers(1, num_blocks))
+            try:
+                held = kv.reserve(want)
+            except OutOfBlocksError:
+                return
+            kv.free(held)
+
+        def spec_round(uid):
+            seq = live[uid]
+            L = int(rng.integers(2, 8))
+            try:
+                sm.ensure_blocks(seq, L)
+            except OutOfBlocksError:
+                return
+            seen0 = seq.seen_tokens
+            seq.seen_tokens = seen0 + int(rng.integers(1, L + 1))
+            sm.trim_blocks(seq)
+
+        def materialize():
+            kv.finalize_demotions()
+
+        def check():
+            alloc = kv.allocator
+            free = set(alloc._free)
+            assert len(free) == alloc.free_blocks
+            pc.check_invariants()
+            pc.assert_exact_refs(live.values())
+            cached = set(pc._by_block)
+            assert not free & cached, "freed block still cached"
+            for seq in live.values():
+                tabs = set(seq.kv_blocks)
+                assert len(tabs) == len(seq.kv_blocks)
+                assert not any(alloc.is_free(b) for b in tabs), \
+                    "freed block aliased into a live block table"
+            private = {b for s in live.values() for b in s.kv_blocks
+                       if b not in s.shared}
+            # conservation over DEVICE blocks: host-tier entries own no
+            # pool block, so the partition is free/cached/private alone
+            assert len(free) + len(cached) + len(private) == num_blocks
+            assert pc.host_cached_blocks <= host_cap
+
+        for _ in range(300):
+            op = rng.integers(0, 6)
+            if op == 0 or not live:
+                new_seq()
+            elif op == 1:
+                pressure()
+            elif op == 2:
+                spec_round(int(rng.choice(list(live))))
+            elif op == 3:
+                materialize()
+            elif op == 4:
+                uid = int(rng.choice(list(live)))
+                sm.flush(uid)
+                del live[uid]
+            else:
+                # decode growth
+                seq = live[int(rng.choice(list(live)))]
+                try:
+                    sm.ensure_blocks(seq, int(rng.integers(1, 9)))
+                except OutOfBlocksError:
+                    pass
+                else:
+                    seq.seen_tokens += 0   # blocks reserved ahead only
+                    sm.trim_blocks(seq)
+            check()
+
+        for uid in list(live):
+            sm.flush(uid)
+        live.clear()
+        check()
+        # drain: destroy-evict the device tier (host descendants die
+        # with their chains) — FULL allocator recovery, empty tiers
+        kv.allocator.free(pc.evict(num_blocks))
+        assert pc.cached_blocks == 0
+        assert kv.allocator.free_blocks == num_blocks
+
+
+class TestHierKVServing:
+    """Hierarchical KV end-to-end through the v2 engine: the tier must
+    be token-INVISIBLE (streams identical tier on / tier off / cache
+    off) while actually demoting and promoting, survive drain->replay
+    with tier-resident chains, and compose with the pipelined +
+    speculative serve paths."""
+
+    def _engine(self, mcfg, params, **kw):
+        from deepspeed_tpu.inference.v2 import InferenceEngineV2
+        base = dict(max_seqs=4, chunk_size=16, block_size=8,
+                    num_blocks=10, max_blocks_per_seq=8,
+                    dtype="float32", attention_impl="dense",
+                    decode_loop_steps=0, serve_pipeline_depth=2)
+        base.update(kw)
+        return InferenceEngineV2(mcfg, params,
+                                 RaggedInferenceConfig(**base))
+
+    def _model(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+        mcfg = GPT2Config(vocab_size=96, max_seq_len=256, num_layers=2,
+                          num_heads=2, hidden_size=32,
+                          dtype=jnp.float32)
+        params = GPT2(mcfg).init(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))["params"]
+        return mcfg, params
+
+    def _workload(self, groups=6, rounds=3, tail=5, pre=24, seed=0):
+        # a shared-prefix working set larger than the 10-block pool:
+        # `groups` preambles of 3 blocks each, revisited cyclically —
+        # tier-off destroys exactly the chain the next revisit needs
+        rng = np.random.RandomState(seed)
+        pres = [rng.randint(1, 96, size=pre).tolist()
+                for _ in range(groups)]
+        return [(i, pres[i % groups]
+                 + rng.randint(1, 96, size=tail).tolist())
+                for i in range(rounds * groups)]
+
+    def _run(self, eng, reqs, gen=6):
+        out = {}
+        for uid, p in reqs:
+            first = eng.put([uid], [p], _greedy=True)
+            toks = eng.decode_pipelined([uid], [first[uid]], gen)
+            out[uid] = [first[uid]] + toks[uid]
+            eng.flush(uid)
+            if eng._prefix is not None:
+                eng._prefix.check_invariants()
+                eng._prefix.assert_exact_refs(
+                    eng.state.sequences.values())
+        return out
+
+    def test_tier_token_parity_and_hits(self):
+        mcfg, params = self._model()
+        reqs = self._workload()
+        off = self._run(self._engine(mcfg, params, prefix_cache=False),
+                        reqs)
+        dev = self._run(self._engine(mcfg, params, prefix_cache=True),
+                        reqs)
+        hier_eng = self._engine(mcfg, params, prefix_cache=True,
+                                prefix_cache_host_blocks=64)
+        hier = self._run(hier_eng, reqs)
+        assert dev == off
+        assert hier == off
+        st = hier_eng.prefix_stats
+        # the tier genuinely worked: demotions happened, revisits were
+        # served by promotion, and the skipped-prefill fraction beat
+        # the destroy-on-pressure cache on the SAME workload
+        assert st["demoted"] > 0 and st["promoted"] > 0
+        assert st["host_hit_blocks"] > 0
+        assert st["host_matched_tokens"] > 0
+        assert st["prefill_chunks_skipped_frac"] > 0.3
+        assert st["evicted_pressure"] == 0      # nothing destroyed
+
+    def test_tier_parity_with_spec_decode(self):
+        mcfg, params = self._model()
+        reqs = self._workload(groups=4, rounds=2)
+        off = self._run(self._engine(mcfg, params, prefix_cache=False,
+                                     spec_decode="ngram", spec_k=3),
+                        reqs, gen=8)
+        hier_eng = self._engine(mcfg, params, prefix_cache=True,
+                                prefix_cache_host_blocks=48,
+                                spec_decode="ngram", spec_k=3)
+        hier = self._run(hier_eng, reqs, gen=8)
+        assert hier == off
+        st = hier_eng.prefix_stats
+        assert st["demoted"] > 0 and st["promoted"] > 0
+        hier_eng._prefix.assert_exact_refs(
+            hier_eng.state.sequences.values())
+
+    def test_drain_replay_with_tier_resident_chain(self):
+        """Kill an engine whose cache is mostly HOST-resident mid-
+        workload: the drain manifest must replay token-identically on a
+        fresh engine AND on the same engine (whose host tier then
+        serves the replayed prefills as promotions)."""
+        mcfg, params = self._model()
+        reqs = self._workload(groups=5, rounds=2)
+        # oracle: uninterrupted run
+        want = self._run(self._engine(mcfg, params, prefix_cache=False),
+                         reqs, gen=6)
+        eng = self._engine(mcfg, params, prefix_cache=True,
+                           prefix_cache_host_blocks=64)
+        got = {}
+        cut = len(reqs) // 2
+        for uid, p in reqs[:cut]:
+            first = eng.put([uid], [p], _greedy=True)
+            toks = eng.decode_pipelined([uid], [first[uid]], 3)
+            got[uid] = [first[uid]] + toks[uid]
+            # no flush: keep them live so the drain has work to carry
+        assert eng._prefix.host_cached_blocks > 0 \
+            or eng.prefix_stats["demoted"] > 0
+        manifest = eng.drain()
+        assert manifest["pool"]["fully_recovered"]
+        # the survivor: same engine object post-drain is not allowed to
+        # replay (draining) — build the restarted twin, replay, finish
+        surv = self._engine(mcfg, params, prefix_cache=True,
+                            prefix_cache_host_blocks=64)
+        next_tok = surv.replay(manifest)
+        for uid, p in reqs[:cut]:
+            done = len(got[uid])
+            toks = surv.decode_pipelined([uid], [next_tok[uid]],
+                                         6 - done)
+            got[uid].extend([next_tok[uid]] + toks[uid])
+            surv.flush(uid)
+        for uid, p in reqs[cut:]:
+            first = surv.put([uid], [p], _greedy=True)
+            toks = surv.decode_pipelined([uid], [first[uid]], 6)
+            got[uid] = [first[uid]] + toks[uid]
+            surv.flush(uid)
+        assert got == want
+        surv._prefix.check_invariants()
+
+    @pytest.mark.slow
+    def test_tier_parity_tp2_pipelined(self):
+        """tp=2 + depth-2 pipeline + hierarchical KV: the promotion
+        scatter is head-local under the sharded pool (lane dim
+        untouched) — streams must still be identical tier on/off."""
+        import jax
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+        mcfg, params = self._model()
+        reqs = self._workload(groups=4, rounds=2)
+        off = self._run(self._engine(mcfg, params, prefix_cache=False,
+                                     tp_size=2), reqs)
+        hier_eng = self._engine(mcfg, params, prefix_cache=True,
+                                prefix_cache_host_blocks=48, tp_size=2)
+        hier = self._run(hier_eng, reqs)
+        assert hier == off
+        assert hier_eng.prefix_stats["promoted"] > 0

@@ -48,7 +48,41 @@ class FakeReplica:
         return self._headroom
 
 
+class TieredFakeReplica(FakeReplica):
+    """A replica whose overlap splits device/host — the hierarchical-KV
+    scoring surface."""
+
+    def __init__(self, rid, dev=0, host=0, **kw):
+        super().__init__(rid, overlap=dev + host, **kw)
+        self._dev, self._host = dev, host
+
+    def prefix_overlap_tiered(self, tokens):
+        return self._dev, self._host
+
+
 class TestRouterPolicy:
+    def test_demoted_overlap_scored_at_discount(self):
+        """Hierarchical KV routing: equal total overlap, but one
+        replica holds the chain on DEVICE and the other would have to
+        PROMOTE it — the device holder must win; yet a host-resident
+        chain still beats no chain at all."""
+        from deepspeed_tpu.serving.router import Router
+        r = Router(policy="prefix_aware", seed=3)
+        prompt = list(range(64))
+        dev_holder = TieredFakeReplica("a", dev=48, host=0)
+        host_holder = TieredFakeReplica("b", dev=0, host=48)
+        cold = TieredFakeReplica("c")
+        assert r.score(dev_holder, prompt) > r.score(host_holder, prompt)
+        assert r.score(host_holder, prompt) > r.score(cold, prompt)
+        # with the discount at 1.0 the tiers are indistinguishable
+        flat = Router(policy="prefix_aware", seed=3, w_demoted=1.0)
+        assert flat.score(dev_holder, prompt) == \
+            flat.score(host_holder, prompt)
+        # plain (un-tiered) replicas keep working through the fallback
+        legacy = FakeReplica("d", overlap=48)
+        assert r.score(legacy, prompt) == r.score(dev_holder, prompt)
+        assert "w_demoted" in r.describe()
+
     def test_prefix_overlap_wins_over_mild_load(self):
         cold = FakeReplica("cold", overlap=0, queue=0.0)
         warm = FakeReplica("warm", overlap=32, queue=0.5)

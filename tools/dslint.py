@@ -90,11 +90,25 @@ HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
     # the prefix-cache match/hash path runs inside put()'s plan-ahead
     # window (before and between _drive_pipeline fills): pure host dict
     # walks plus non-blocking CoW dispatch — a blocking readback here
-    # would serialize the pipeline exactly like one in _plan_step
+    # would serialize the pipeline exactly like one in _plan_step. The
+    # hierarchical-KV halves (pop_demotable/demote/promote/evict_host)
+    # run inside reserve on the same window: demotion gathers must stay
+    # batched, dispatch-only deferred work (materialize happens at the
+    # commit boundary), never a blocking host sync
     "deepspeed_tpu/inference/v2/prefix_cache.py":
-        ("match", "acquire", "release_block", "insert", "evict"),
+        ("match", "acquire", "release_block", "insert", "evict",
+         "pop_demotable", "demote", "promote", "evict_host"),
     "deepspeed_tpu/inference/v2/state_manager.py":
         ("match_prefix", "register_prefix", "release_blocks"),
+    # reserve is called by ensure_blocks inside every plan; with the
+    # host tier armed it dispatches the batched demotion gather and the
+    # promotion path dispatches restore scatters — enqueue-only device
+    # work, the D2H device_get lives in finalize_demotions at the
+    # commit boundary (deliberately NOT registered: it is the one
+    # sanctioned blocking site, after a step readback already proved
+    # the gathers complete)
+    "deepspeed_tpu/inference/v2/kv_cache.py":
+        ("reserve", "_demote", "promote_block", "promote_blocks"),
     # the decomposed TP collective builders trace inside every runner
     # program build (and inside MoE training steps): a blocking host sync
     # here would stall every retrace of the serve/train hot path — these
@@ -113,7 +127,8 @@ HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
     "deepspeed_tpu/telemetry/serve.py":
         ("on_admit", "on_sched", "on_token_commit", "on_plan",
          "on_dispatch", "on_commit_block", "on_retry", "on_reject",
-         "on_abort", "on_flush", "on_spec", "phase", "_req_span"),
+         "on_abort", "on_flush", "on_spec", "on_promote", "phase",
+         "_req_span"),
     "deepspeed_tpu/telemetry/registry.py":
         ("inc", "set", "observe", "quantile", "sample",
          "maybe_sample"),
@@ -138,7 +153,8 @@ HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
     # put/decode grouping would serialize the whole fleet's round
     "deepspeed_tpu/serving/pool.py":
         ("put", "decode_pipelined", "_take_stash", "_run_groups",
-         "prefix_overlap", "queue_frac", "slo_headroom"),
+         "prefix_overlap", "prefix_overlap_tiered", "queue_frac",
+         "slo_headroom"),
 }
 
 #: roots scanned for DSTPU_* env reads (knob rules + gen_config_doc) —

@@ -1,0 +1,46 @@
+#!/bin/bash
+# Round-16 on-chip sequence: hierarchical KV — the host-RAM
+# prefix-cache tier with overlapped promotion (ISSUE 13). The CPU
+# story is proven in tier-1 (two-tier randomized model checker,
+# tier-on/off token parity incl. spec decode + pipelined paths,
+# drain->replay with tier-resident chains, exact-content promotion
+# round trip incl. int8 payloads+scales); on-chip this captures (a)
+# lint cleanliness (demote/promote DSL001 registry + the
+# DSTPU_PREFIX_HOST_* knob tables), (b) the tpu_smoke hier_kv row —
+# first Mosaic-adjacent compiles of the batched demotion gather and
+# the promotion restore scatter, tier on/off parity, host-hit
+# fraction, (c) the serve_hier bench on the big llama shape — a
+# preamble working set >= 3x the device pool, goodput + skipped-
+# prefill vs tier off, and the REAL async promote_exposed_frac (the
+# CPU harness serializes eager dispatches, so only this capture can
+# hold the 5% line), and (d) the loadgen working-set pattern driving
+# the tier under open-loop wall-clock load. Strictly sequential (one
+# process owns the chip), no timeouts around TPU clients (a killed
+# client wedges the grant).
+cd /root/repo || exit 1
+LOG=profiles/r16_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round16 start $(date -u +%FT%TZ)"
+
+echo "--- [1/4] dstpu_lint (demote/promote hot-path registry,"
+echo "    DSTPU_PREFIX_HOST_* + loadgen working-set knobs documented)"
+python bin/dstpu_lint deepspeed_tpu
+
+echo "--- [2/4] tpu_smoke: hier_kv row (demotion gather + promotion"
+echo "    scatter compiled on chip, tier on/off parity, host-hit"
+echo "    fraction) + the full kernel/audit sweep it rides with"
+python tools/tpu_smoke.py
+
+echo "--- [3/4] serve_hier: working set 3x the device pool on the"
+echo "    big llama shape — goodput + skipped-prefill vs tier off,"
+echo "    token parity, 0 fresh compiles, async promote_exposed_frac"
+python bench.py serve_hier > BENCH_HIER_r16.json
+tail -c 1600 BENCH_HIER_r16.json
+
+echo "--- [4/4] loadgen working-set pattern: open-loop wall-clock"
+echo "    traffic cycling a 3x working set over the tiny pool, tier"
+echo "    churn + host-hit fraction in the report"
+python bin/dstpu_loadgen --rate 30 --requests 90 --prompt-len 64 \
+    --gen-len 8 --num-blocks 24 --prefix-working-set-blocks 72 \
+    --host-blocks 144 --out profiles/r16_loadgen_hier.json
+echo "=== tpu_round16 done $(date -u +%FT%TZ)"

@@ -384,6 +384,40 @@ def main():
     else:
         print("SKIP tp_overlap (single chip)", flush=True)
 
+    # hierarchical KV (ISSUE 13): the host-RAM prefix-cache tier ON
+    # CHIP — a 4-group preamble working set over a pool that holds ~1
+    # group: revisits demote-then-promote through the real device
+    # gather/scatter paths (first Mosaic-adjacent compiles for both),
+    # and the streams must be token-identical to the tier-off engine
+    # while a meaningful fraction of hits comes off the host tier
+    rng_h = np.random.RandomState(29)
+    G_h = 4
+    pres_h = [rng_h.randint(1, 512, size=130).tolist() for _ in range(G_h)]
+    reqs_h = [pres_h[j % G_h] + rng_h.randint(1, 512, size=30).tolist()
+              for j in range(2 * G_h)]
+    base_h = dict(max_seqs=4, chunk_size=32, block_size=128, num_blocks=5,
+                  max_blocks_per_seq=3, dtype="bfloat16",
+                  attention_impl="paged_flash", decode_loop_steps=0)
+    eng_h0 = InferenceEngineV2(
+        mcfg_p, params_p,
+        RaggedInferenceConfig(**base_h, prefix_cache=True))
+    ref_h = [eng_h0.generate([p], max_new_tokens=8)[0] for p in reqs_h]
+    eng_h = InferenceEngineV2(
+        mcfg_p, params_p,
+        RaggedInferenceConfig(**base_h, prefix_cache=True,
+                              prefix_cache_host_blocks=16))
+    got_h = [eng_h.generate([p], max_new_tokens=8)[0] for p in reqs_h]
+    st_h = eng_h.prefix_stats
+    par_h = got_h == ref_h
+    hit_h = st_h["promoted"] > 0 and st_h["host_hit_frac"] > 0
+    ok &= par_h and hit_h
+    print(f"{'OK ' if par_h and hit_h else 'FAIL'} hier_kv: "
+          f"tier on/off token_parity={par_h} "
+          f"host_hit_frac={st_h['host_hit_frac']:.3f} "
+          f"demoted={st_h['demoted']} promoted={st_h['promoted']} "
+          f"skipped_frac={st_h['prefill_chunks_skipped_frac']:.3f}",
+          flush=True)
+
     # speculative decode (ISSUE 12): the draft-fed verify program ON
     # CHIP — ngram self-drafting over the fused decode_loop (feed=
     # "given" compiled through Mosaic, rollback trims live) must be
