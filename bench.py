@@ -1429,6 +1429,195 @@ def bench_serve_obs():
     return 0 if ok else 1
 
 
+def bench_serve_attrib():
+    """Step-time attribution benchmark (ISSUE 14): does the attribution
+    layer account for where the wall clock of a pipelined decode window
+    ACTUALLY went, without touching a token or a compiled program?
+
+      - ``closure_err_frac``: |externally measured window wall-clock −
+        Σ(plan + dispatch + device_execute + commit_apply + host_gap)| /
+        wall. The components are registry histogram-sum DELTAS over the
+        measured windows (warm-up excluded, the sibling-phase
+        discipline); the wall is a plain ``perf_counter`` bracket around
+        the same ``decode_pipelined`` calls. Gate: ≤ DSTPU_ATTRIB_TOL
+        (default 15% — the residual is the engine-call overhead outside
+        the serve loop, which the tolerance owns honestly).
+      - **Localization**: one extra window runs with a synthetic host
+        gap injected into the loop's UNBRACKETED region (a sleep wrapped
+        around ``_try_resume``, which runs once per pipeline fill —
+        the stand-in for resume scans / GC / any host work attribution
+        does not enumerate). The per-window component deltas must pin
+        the inflation on ``host_gap``: it must take the largest share of
+        the increase and at least half of the injected time must appear
+        there.
+      - **Zero-interference gates**: token streams identical with
+        DSTPU_ATTRIB on vs off (separate engine, same prompts), 0 fresh
+        compiles in every measured window, and the audited serve
+        programs carry 0 host callbacks with attribution armed.
+      - ``comm_share``: the audited-collective share of the steady
+        decode program — per-step collective hops vs trip-weighted
+        GEMMs straight from the program auditor (0 at tp=1; the tp>1
+        rounds capture the real schedule split).
+    """
+    import os
+
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.analysis import RecompileTripwire
+    from deepspeed_tpu.analysis.program_audit import audit_serve_programs
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceConfig)
+    from deepspeed_tpu.telemetry.attribution import (
+        STEP_WALL_COMPONENTS, attribution_report, comm_share,
+        component_totals)
+
+    on_tpu = jax.default_backend() == "tpu"
+    big = os.environ.get("DSTPU_ATTRIB_MODEL",
+                         "big" if on_tpu else "tiny") == "big"
+    model, mcfg = _serve_llama(big)
+    if big:
+        S, PROMPT, GEN, dtype = 64, 128, 64, "bfloat16"
+    else:
+        S, PROMPT, GEN, dtype = 8, 32, 48, "float32"
+    S = int(os.environ.get("DSTPU_ATTRIB_SEQS", str(S)))
+    GEN = int(os.environ.get("DSTPU_ATTRIB_GEN", str(GEN)))
+    REPS = int(os.environ.get("DSTPU_ATTRIB_REPS", "3"))
+    TOL = float(os.environ.get("DSTPU_ATTRIB_TOL", "0.15"))
+    inj_s = float(os.environ.get("DSTPU_ATTRIB_INJECT_MS", "2.0")) / 1e3
+    params = _pseudo_params(model, mcfg)
+    # capacity: warm tokens + REPS baseline windows + 1 injected window
+    # per sequence in one block (the serve_obs geometry)
+    bs = PROMPT + 3 + GEN * (REPS + 2) + 8
+    base = dict(max_seqs=S, chunk_size=PROMPT, block_size=bs,
+                num_blocks=S + 4, max_blocks_per_seq=1, dtype=dtype,
+                attention_impl="paged_flash" if on_tpu else "dense",
+                decode_loop_steps=0, serve_pipeline_depth=2)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, mcfg.vocab_size, size=PROMPT).tolist()
+               for _ in range(S)]
+    uids = list(range(S))
+
+    def build(attrib_on):
+        os.environ["DSTPU_ATTRIB"] = "1" if attrib_on else "0"
+        eng = InferenceEngineV2(mcfg, params,
+                                RaggedInferenceConfig(**base))
+        first = eng.put(uids, prompts, _greedy=True)
+        warm = eng.decode_pipelined(uids, [first[u] for u in uids], 3)
+        return eng, [warm[u][-1] for u in uids], {u: [] for u in uids}
+
+    prior = os.environ.get("DSTPU_ATTRIB")
+    try:
+        eng, last, stream = build(True)
+        tw = RecompileTripwire()
+        fresh = 0
+        window_snaps = [eng.metrics.snapshot()]
+        walls = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            with tw:
+                outs = eng.decode_pipelined(uids, last, GEN)
+            walls.append(time.perf_counter() - t0)
+            if tw.available:
+                fresh += tw.fresh_compiles
+            for u in uids:
+                stream[u].extend(outs[u])
+            last = [outs[u][-1] for u in uids]
+            window_snaps.append(eng.metrics.snapshot())
+        wall = sum(walls)
+        comps = component_totals(window_snaps[-1], window_snaps[0])
+        report = attribution_report(window_snaps[-1], window_snaps[0])
+        comp_sum = sum(comps[c] for c in STEP_WALL_COMPONENTS)
+        closure = abs(wall - comp_sum) / wall if wall > 0 else None
+
+        # ---- synthetic host-gap injection (localization gate) ----- #
+        orig_resume = eng._try_resume
+
+        def slow_resume():
+            time.sleep(inj_s)
+            orig_resume()
+
+        eng._try_resume = slow_resume
+        t0 = time.perf_counter()
+        with tw:
+            outs = eng.decode_pipelined(uids, last, GEN)
+        wall_inj = time.perf_counter() - t0
+        eng._try_resume = orig_resume
+        if tw.available:
+            fresh += tw.fresh_compiles
+        for u in uids:
+            stream[u].extend(outs[u])
+        snap_inj = eng.metrics.snapshot()
+        inj_comps = component_totals(snap_inj, window_snaps[-1])
+        # per-window baseline average vs the injected window
+        base_avg = {c: comps[c] / REPS for c in comps}
+        deltas = {c: inj_comps[c] - base_avg[c]
+                  for c in STEP_WALL_COMPONENTS}
+        pos = sum(v for v in deltas.values() if v > 0)
+        gap_delta = deltas["host_gap"]
+        localized = (max(deltas, key=deltas.get) == "host_gap"
+                     and pos > 0 and gap_delta >= 0.5 * pos
+                     and gap_delta >= 0.5 * (wall_inj - wall / REPS))
+
+        # ---- attribution off: token parity + untouched programs --- #
+        eng_off, last_off, stream_off = build(False)
+        for _ in range(REPS + 1):
+            outs = eng_off.decode_pipelined(uids, last_off, GEN)
+            for u in uids:
+                stream_off[u].extend(outs[u])
+            last_off = [outs[u][-1] for u in uids]
+        parity = all(stream[u] == stream_off[u] and stream[u]
+                     for u in uids)
+        audits = audit_serve_programs(
+            eng, programs=("step_greedy", "step_greedy_fb"))
+        callbacks = sum(r.host_callbacks for r in audits.values())
+        share = comm_share(eng)
+        for u in uids:
+            eng.flush(u)
+            eng_off.flush(u)
+    finally:
+        if prior is None:
+            os.environ.pop("DSTPU_ATTRIB", None)
+        else:
+            os.environ["DSTPU_ATTRIB"] = prior
+
+    row = {
+        "model": f"llama {mcfg.num_layers}L hidden={mcfg.hidden_size}",
+        "batch_seqs": S, "prompt_len": PROMPT, "gen_len": GEN,
+        "reps": REPS,
+        "window_wall_s": round(wall, 4),
+        "components_s": {c: round(v, 4) for c, v in comps.items()},
+        "components_sum_s": round(comp_sum, 4),
+        "closure_err_frac": round(closure, 4)
+        if closure is not None else None,
+        "fracs": report["fracs"],
+        "dominant": report["dominant"],
+        "decode_steps_per_sec": round(GEN * REPS / wall, 2)
+        if wall > 0 else None,
+        "injected": {
+            "inject_ms_per_fill": inj_s * 1e3,
+            "window_wall_s": round(wall_inj, 4),
+            "component_deltas_s": {c: round(v, 4)
+                                   for c, v in deltas.items()},
+            "localized_to_host_gap": localized,
+        },
+        "comm_share": share,
+        "token_parity": parity,
+        "fresh_compiles_measured": fresh,
+        "host_callbacks": callbacks,
+        "serve_config": {
+            "DSTPU_ATTRIB_MODEL": "big" if big else "tiny",
+            "DSTPU_ATTRIB_SEQS": S, "DSTPU_ATTRIB_GEN": GEN,
+            "DSTPU_ATTRIB_REPS": REPS, "DSTPU_ATTRIB_TOL": TOL,
+            "DSTPU_ATTRIB_INJECT_MS": inj_s * 1e3,
+        },
+    }
+    print(json.dumps(row))
+    ok = (parity and closure is not None and closure <= TOL
+          and localized and fresh == 0 and callbacks == 0)
+    return 0 if ok else 1
+
+
 def bench_serve_capacity():
     """Open-loop capacity search (ISSUE 10): sweep offered QPS with the
     wall-clock loadgen (telemetry/loadgen.py) and emit the
@@ -2654,6 +2843,8 @@ def main():
         return bench_serve_overlap()
     if sys.argv[1:] == ["serve_obs"]:
         return bench_serve_obs()
+    if sys.argv[1:] == ["serve_attrib"]:
+        return bench_serve_attrib()
     if sys.argv[1:] == ["serve_capacity"]:
         return bench_serve_capacity()
     if sys.argv[1:] == ["serve_fleet"]:
@@ -2700,8 +2891,8 @@ def main():
     for phase in ("train", "train_xl", "train_1p3b", "serve",
                   "serve_pipeline", "serve_prefix", "serve_hier",
                   "serve_drill", "serve_overlap", "serve_obs",
-                  "serve_capacity", "serve_fleet", "serve_spec",
-                  "fastgen", "moe", "moe_train"):
+                  "serve_attrib", "serve_capacity", "serve_fleet",
+                  "serve_spec", "fastgen", "moe", "moe_train"):
         if dead:
             out[phase] = {"error": "skipped_backend_dead"}
             continue
@@ -2773,6 +2964,7 @@ def main():
                    "serve_drill": out.get("serve_drill", {}),
                    "serve_overlap": out.get("serve_overlap", {}),
                    "serve_obs": out.get("serve_obs", {}),
+                   "serve_attrib": out.get("serve_attrib", {}),
                    "serve_capacity": out.get("serve_capacity", {}),
                    "serve_fleet": out.get("serve_fleet", {}),
                    "serve_spec": out.get("serve_spec", {}),

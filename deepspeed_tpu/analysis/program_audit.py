@@ -140,6 +140,12 @@ def _walk(jaxpr, counts: Dict[CollectiveSite, int], state: Dict[str, Any],
             counts[site] = counts.get(site, 0) + mult
         if prim in HOST_CALLBACK_PRIMS:
             state["host_callbacks"] += mult
+        if prim == "dot_general":
+            # trip-weighted GEMM count: together with the collective
+            # counts this gives an op-level comm-vs-compute split of a
+            # step program (telemetry/attribution.py derives its
+            # audited-collective share from exactly these two numbers)
+            state["dot_generals"] += mult
         sub_ring = ring_kind
         if prim == "pjit":
             sub_ring = _ring_kind_for(eqn.params.get("name")) or ring_kind
@@ -178,6 +184,9 @@ class ProgramReport:
     host_callbacks: int = 0
     donated_args: Tuple[int, ...] = ()
     dynamic_loops: int = 0
+    #: trip-weighted dot_general executions — the compute-op denominator
+    #: of the attribution layer's audited comm-op share
+    dot_generals: int = 0
 
     # ------------------------- accessors -------------------------- #
 
@@ -267,7 +276,7 @@ def audit_fn(fn: Callable, *args, name: Optional[str] = None,
         traced = fn
     jaxpr = jax.make_jaxpr(traced)(*args, **kwargs)
     counts: Dict[CollectiveSite, int] = {}
-    state = {"host_callbacks": 0, "dynamic_loops": 0}
+    state = {"host_callbacks": 0, "dynamic_loops": 0, "dot_generals": 0}
     _walk(jaxpr.jaxpr, counts, state, 1)
     donated: Tuple[int, ...] = ()
     if hasattr(fn, "lower"):
@@ -276,7 +285,8 @@ def audit_fn(fn: Callable, *args, name: Optional[str] = None,
     return ProgramReport(
         name=name or getattr(fn, "__name__", "program"),
         collectives=counts, host_callbacks=state["host_callbacks"],
-        donated_args=donated, dynamic_loops=state["dynamic_loops"])
+        donated_args=donated, dynamic_loops=state["dynamic_loops"],
+        dot_generals=state["dot_generals"])
 
 
 # ------------------------------------------------------------------ #

@@ -78,16 +78,20 @@ class ReplayJournal:
             os.fsync(self._f.fileno())
 
     def admit(self, uid: int, prompt: List[int],
-              sampling: Optional[Dict[str, Any]] = None) -> None:
+              sampling: Optional[Dict[str, Any]] = None,
+              trace: Optional[str] = None) -> None:
         """A (possibly re-)admitted sequence: the full prompt chain. A
         later ``admit`` for the same uid supersedes the earlier one (a
         replayed sequence's prompt is its whole resumed chain).
-        ``sampling`` (a SamplingParams dict) rides along so a
-        hard-crash replay keeps sampled streams deterministic."""
+        ``sampling`` (a SamplingParams dict) and ``trace`` (the fleet
+        trace context) ride along so a hard-crash replay keeps sampled
+        streams deterministic and the replayed spans on their track."""
         rec = {"e": "admit", "uid": int(uid),
                "prompt": [int(t) for t in prompt]}
         if sampling:
             rec["sampling"] = sampling
+        if trace:
+            rec["trace"] = trace
         self._write(rec)
 
     def tokens(self, per_uid: Dict[int, List[int]]) -> None:
@@ -126,7 +130,8 @@ def manifest_from_journal(path: str) -> Dict[str, Any]:
             if rec.get("e") == "admit":
                 seqs[int(rec["uid"])] = {"prompt": list(rec["prompt"]),
                                          "generated": [],
-                                         "sampling": rec.get("sampling")}
+                                         "sampling": rec.get("sampling"),
+                                         "trace": rec.get("trace")}
             elif rec.get("e") == "tokens":
                 for u, toks in rec.get("t", {}).items():
                     if int(u) in seqs:
@@ -139,7 +144,8 @@ def manifest_from_journal(path: str) -> Dict[str, Any]:
         "time": time.time(),
         "sequences": [
             {"uid": uid, "prompt": s["prompt"], "generated": s["generated"],
-             "sampling": s.get("sampling"), "scheduler": {}}
+             "sampling": s.get("sampling"), "trace": s.get("trace"),
+             "scheduler": {}}
             for uid, s in sorted(seqs.items())],
     }
 
@@ -164,6 +170,9 @@ def build_manifest(engine) -> Dict[str, Any]:
             # sampling identity restored (seed + position-folded keys)
             "sampling": seq.sampling.to_dict()
             if seq.sampling is not None else None,
+            # fleet trace context: the survivor's replay spans must join
+            # the same logical track (docs/observability.md)
+            "trace": seq.trace_id,
             "scheduler": engine.scheduler.describe(seq),
         })
     return {

@@ -339,7 +339,8 @@ class InferenceEngineV2:
             _greedy: bool = False,
             arrivals: Optional[Dict[int, float]] = None,
             deadlines: Optional[Dict[int, float]] = None,
-            sampling: Optional[Dict[int, SamplingParams]] = None
+            sampling: Optional[Dict[int, SamplingParams]] = None,
+            traces: Optional[Dict[int, str]] = None
             ) -> Dict[int, Any]:
         """Feed tokens, run scheduled steps until all fed work is consumed,
         return {uid: last-token logits} for sequences with no pending work
@@ -380,7 +381,14 @@ class InferenceEngineV2:
         across drain/replay). On the ``_greedy`` fast path a sampled
         sequence's last-chunk token is selected ON DEVICE by the
         per-slot sampler — temperature 0 reproduces greedy
-        token-for-token."""
+        token-for-token.
+
+        ``traces`` maps uid -> a fleet-wide trace id (minted by
+        ``ReplicaPool.put``, or any caller's correlation id): attached
+        at admission, tagged onto every request-lifecycle flight span,
+        and carried through the drain manifest so a replayed request's
+        survivor spans join the same logical track
+        (docs/observability.md "Distributed tracing")."""
         admitted: List[int] = []
         bs = self.config.block_size
         for uid, toks in zip(batch_uids, batch_tokens):
@@ -422,6 +430,11 @@ class InferenceEngineV2:
                 sp = sampling.get(uid) if sampling else None
                 if sp is not None:
                     seq.sampling = sp
+                tid = traces.get(uid) if traces else None
+                if tid is not None:
+                    # set BEFORE on_admit: the admit span must already
+                    # carry the trace context
+                    seq.trace_id = tid
                 arrived = arrivals.get(uid) if arrivals else None
                 if self._obs is not None:
                     self._obs.on_admit(
@@ -444,7 +457,8 @@ class InferenceEngineV2:
                     self.journal.admit(uid, seq.prompt_log,
                                        sampling=seq.sampling.to_dict()
                                        if seq.sampling is not None
-                                       else None)
+                                       else None,
+                                       trace=seq.trace_id)
             if self._prefix is not None:
                 self._match_prefix(seq)
         done: Dict[int, np.ndarray] = {}
@@ -550,6 +564,11 @@ class InferenceEngineV2:
         ring: deque = deque()
         wd = self._watchdog
         self._live_ring = ring
+        if self._obs is not None:
+            # step-time attribution window: everything between here and
+            # the loop exit is accounted — bracketed phases by their own
+            # histograms, the residual as host gap
+            self._obs.on_loop_enter()
         try:
             while ring or (work_left() and not self._draining()):
                 if wd is not None:
@@ -607,6 +626,8 @@ class InferenceEngineV2:
                         self._obs.phase("idle")
         finally:
             self._live_ring = None
+            if self._obs is not None:
+                self._obs.on_loop_exit()
 
     # ------------------------------------------------------------------ #
     # serve-side resilience: drain / replay / abort / shed / deadlines
@@ -647,7 +668,9 @@ class InferenceEngineV2:
         rec = {"uid": uid, "reason": reason, "time": time.time(), **fields}
         self.rejections[uid] = rec
         if self._obs is not None:
-            self._obs.on_reject(reason, uid)
+            seq = self.state.get(uid)
+            self._obs.on_reject(reason, uid,
+                                seq.trace_id if seq is not None else None)
         logger.warning(f"serve rejection uid={uid}: {reason} "
                        + (str(fields) if fields else ""))
 
@@ -856,14 +879,22 @@ class InferenceEngineV2:
         # token-identical, exactly like greedy replay
         sp_map = {int(r["uid"]): SamplingParams.from_dict(r["sampling"])
                   for r in recs if r.get("sampling")}
+        # the trace context survives the membership change: the replayed
+        # request's survivor spans join the SAME logical track the dead
+        # replica's spans started (set via put so even the replay
+        # admission span is trace-tagged)
+        tr_map = {int(r["uid"]): r["trace"]
+                  for r in recs if r.get("trace")}
         if self._obs is not None:
             with self._obs.flight.span("replay", step=self._step_counter,
                                        sequences=len(recs)):
                 out = self.put(uids, chains, _greedy=True,
-                               sampling=sp_map or None)
+                               sampling=sp_map or None,
+                               traces=tr_map or None)
         else:
             out = self.put(uids, chains, _greedy=True,
-                           sampling=sp_map or None)
+                           sampling=sp_map or None,
+                           traces=tr_map or None)
         for r in recs:
             seq = self.state.get(int(r["uid"]))
             if seq is not None:
@@ -1186,21 +1217,33 @@ class InferenceEngineV2:
             active[i] = 1
             tables[i, :len(seq.kv_blocks)] = seq.kv_blocks
         samp = self._stage_loop_sampling(seqs, S, sampling)
+        obs = self._obs
+        if obs is not None:
+            # attribution window for the fused path: one dispatch + one
+            # blocking readback cover n steps; the bookkeeping after is
+            # the commit apply, anything else in the window is host gap
+            obs.on_loop_enter()
+        t_d = time.perf_counter()
         toks, lps, self._kv_data, consumed = self.runner.decode_loop(
             self.params, self._kv_data, jax.numpy.asarray(tok0),
             jax.numpy.asarray(start), jax.numpy.asarray(active),
             jax.numpy.asarray(tables), n,
             eos_id=-1 if eos_token_id is None else int(eos_token_id),
             **samp)
+        if obs is not None:
+            obs.on_fused_dispatch(time.perf_counter() - t_d)
+        t_r = time.perf_counter()
         toks = np.asarray(toks)
         lps = np.asarray(lps) if lps is not None else None
         # consumed is None when EOS is disabled: every slot fed all n
         consumed = np.asarray(consumed) if consumed is not None else None
+        if obs is not None:
+            obs.on_commit_block(time.perf_counter() - t_r)
+        t_apply = time.perf_counter() if obs is not None else 0.0
         self.kv_cache.finalize_demotions()   # readback above proved them
         self._step_counter += n
         out: Dict[int, List[int]] = {}
         journal_toks: Dict[int, List[int]] = {}
-        obs = self._obs
         now = time.monotonic() if obs is not None else 0.0
         for i, (uid, seq) in enumerate(zip(batch_uids, seqs)):
             used = int(consumed[i]) if consumed is not None else n
@@ -1235,7 +1278,9 @@ class InferenceEngineV2:
         if self.journal is not None:
             self.journal.tokens(journal_toks)
         if obs is not None:
+            obs.on_commit_apply(time.perf_counter() - t_apply)
             obs.after_commit(self._step_counter)
+            obs.on_loop_exit()
         return out
 
     # ------------------------------------------------------------------ #
@@ -1442,6 +1487,7 @@ class InferenceEngineV2:
         now = time.monotonic() if obs is not None else 0.0
         if obs is not None:
             obs.on_commit_block(dt)
+        t_apply = time.perf_counter() if obs is not None else 0.0
         out: Dict[int, Any] = {}
         journal_toks: Dict[int, List[int]] = {}
         for i, item in enumerate(fl.sched):
@@ -1469,6 +1515,7 @@ class InferenceEngineV2:
             self.journal.tokens(journal_toks)
         self._finish_commit(fl)
         if obs is not None:
+            obs.on_commit_apply(time.perf_counter() - t_apply)
             obs.after_commit(self._step_counter)
         return len(fl.sched), out
 
@@ -1585,6 +1632,7 @@ class InferenceEngineV2:
             now = time.monotonic() if obs is not None else 0.0
             if obs is not None:
                 obs.on_commit_block(dt)
+            t_apply = time.perf_counter() if obs is not None else 0.0
             journal_toks: Dict[int, List[int]] = {}
             for i, item in enumerate(fl.sched):
                 seq = item.seq
@@ -1646,6 +1694,7 @@ class InferenceEngineV2:
                 self.journal.tokens(journal_toks)
             self._finish_commit(fl)
             if obs is not None:
+                obs.on_commit_apply(time.perf_counter() - t_apply)
                 obs.after_commit(self._step_counter)
 
         def speculate(plan, fl):
@@ -1789,6 +1838,11 @@ class InferenceEngineV2:
         active = np.zeros((S,), np.int32)
         tables = np.zeros((S, MAXB), np.int32)
         draft_arr = np.zeros((S, K + 1), np.int32)
+        if obs is not None:
+            # attribution window for the spec path: each round is one
+            # fused verify dispatch + one blocking readback; the
+            # accept/rollback bookkeeping is the commit apply
+            obs.on_loop_enter()
         while live:
             if self._draining():
                 # preemption mid-spec-decode: stop proposing, let the
@@ -1858,12 +1912,19 @@ class InferenceEngineV2:
                 draft_arr[i, 0] = last[u]
                 if n_draft:
                     draft_arr[i, 1:] = row
+            t_d = time.perf_counter()
             toks, _, self._kv_data, _ = self.runner.decode_loop(
                 self.params, self._kv_data, jnp.asarray(tok0),
                 jnp.asarray(start), jnp.asarray(active),
                 jnp.asarray(tables), L,
                 draft_toks=jnp.asarray(draft_arr), eos_id=-1)
+            if obs is not None:
+                obs.on_fused_dispatch(time.perf_counter() - t_d)
+            t_r = time.perf_counter()
             toks = np.asarray(toks)
+            if obs is not None:
+                obs.on_commit_block(time.perf_counter() - t_r)
+            t_apply = time.perf_counter() if obs is not None else 0.0
             self.kv_cache.finalize_demotions()
             self._step_counter += L
             now = time.monotonic() if obs is not None else 0.0
@@ -1922,6 +1983,9 @@ class InferenceEngineV2:
                     journal_toks[u] = hist
                 if obs is not None and a:
                     obs.on_token_commit(seq, now, n=a)
+                    # traced requests get a spec-round mark on their
+                    # fleet track (no-op for untraced sequences)
+                    obs.on_spec_commit(seq, acc_drafts, prop_eff)
                 if len(out[u]) >= budgets[u] or (
                         eos_token_id is not None
                         and acc[-1] == eos_token_id):
@@ -1929,8 +1993,11 @@ class InferenceEngineV2:
             if self.journal is not None:
                 self.journal.tokens(journal_toks)
             if obs is not None:
+                obs.on_commit_apply(time.perf_counter() - t_apply)
                 obs.on_spec(round_prop, round_acc)
                 obs.after_commit(self._step_counter)
+        if obs is not None:
+            obs.on_loop_exit()
         if live:
             # irreducible pressure / context cap: finish the stragglers
             # on the incremental pipelined path (which can shed)

@@ -107,6 +107,14 @@ class SequenceDescriptor:
     first_sched_at: Optional[float] = None
     first_token_at: Optional[float] = None
     last_token_at: Optional[float] = None
+    # fleet-wide trace context (docs/observability.md "Distributed
+    # tracing"): minted at ReplicaPool.put (or passed by any caller via
+    # put(..., traces=...)), carried for the request's whole life
+    # INCLUDING across drain/replay — the manifest serializes it, so a
+    # merged multi-replica flight dump reconstructs one gapless track
+    # per request even through a membership change. None = untraced
+    # (single-engine callers; spans then key on the uid alone).
+    trace_id: Optional[str] = None
 
     @property
     def in_flight(self) -> int:

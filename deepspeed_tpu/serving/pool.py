@@ -56,7 +56,12 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..inference.v2.blocked_allocator import OutOfBlocksError
-from ..telemetry.registry import Histogram, MetricsRegistry
+from ..telemetry.flight_recorder import (FlightRecorder,
+                                         atomic_json_dump,
+                                         merge_chrome_traces,
+                                         register_recorder)
+from ..telemetry.registry import Histogram, MetricsRegistry, \
+    telemetry_enabled
 from ..telemetry.serve import slo_report_from_registry
 from .router import NoServingReplicaError, Router
 
@@ -237,6 +242,21 @@ class ReplicaPool:
         #: engines' own rejection records merge in via :attr:`rejections`
         self._pool_rejections: Dict[int, Dict[str, Any]] = {}
         self._executor = None        # lazy per-replica worker threads
+        #: fleet-wide trace contexts (docs/observability.md "Distributed
+        #: tracing"): uid -> the trace id minted at admission. A monotone
+        #: counter disambiguates uid reuse, so a retried uid starts a
+        #: FRESH logical track instead of splicing onto the old one.
+        self._trace_ids: Dict[int, str] = {}
+        self._trace_n = 0
+        #: the pool's own flight ring — routing-decision spans
+        #: (``req_route`` with the per-replica scores) land here, on the
+        #: same clock discipline as the engines' rings, so a merged
+        #: fleet trace shows WHY a request went where it went. None when
+        #: telemetry is off (zero overhead, like the engines).
+        self.flight: Optional[FlightRecorder] = None
+        if telemetry_enabled():
+            self.flight = FlightRecorder()
+            register_recorder(self.flight)
         self.state = _FleetStateView(self)
         if ledger is None and os.environ.get("DSTPU_RESTART_LEDGER"):
             from ..resilience.ledger import RestartLedger
@@ -330,7 +350,11 @@ class ReplicaPool:
         try:
             for rec in recs:
                 chain = list(rec["prompt"]) + list(rec["generated"])
-                rep = self.router.select(self.replicas(), chain)
+                # the re-placement is itself a traced routing decision:
+                # the request's track shows the drain-time hop and the
+                # scores that picked its survivor
+                rep = self._route(int(rec["uid"]), chain,
+                                  replay_rec=rec)
                 rep.pending_routed += 1
                 groups.setdefault(rep.replica_id, []).append(rec)
         finally:
@@ -375,6 +399,69 @@ class ReplicaPool:
                 self._replayed.setdefault(uid, []).append(tok)
 
     # ------------------------------------------------------------------ #
+    # request tracing (docs/observability.md "Distributed tracing")
+    # ------------------------------------------------------------------ #
+
+    def _mint_trace(self, uid: int) -> str:
+        """Mint the fleet-wide trace context for one admitted request —
+        the id every lifecycle span (router decision, replica execution,
+        spec rounds, drain→replay continuation) carries so a merged
+        multi-replica flight dump reconstructs one gapless track per
+        request. Registered DSL001 hot path: a counter and two dict
+        stores."""
+        self._trace_n += 1
+        tid = f"{self.name}/{uid}#{self._trace_n}"
+        self._trace_ids[uid] = tid
+        return tid
+
+    def _route(self, uid: int, toks: Sequence[int],
+               replay_rec: Optional[Dict[str, Any]] = None):
+        """One routing decision, traced: select a replica and — with
+        telemetry on — record the ``req_route`` decision span carrying
+        the per-replica scores the router saw, tagged with the request's
+        trace context (minted here for fresh requests; a replayed
+        sequence keeps the trace its manifest carried). Registered
+        DSL001 hot path — pure host scoring plus one ring append."""
+        if self.flight is None:
+            return self.router.select(self.replicas(), toks)
+        ex: Dict[str, Any] = {}
+        t0 = time.perf_counter()
+        rep = self.router.select(self.replicas(), toks, explain=ex)
+        if replay_rec is not None:
+            trace = replay_rec.get("trace")
+            if trace is not None:
+                self._trace_ids[uid] = trace
+            ex["replay"] = True
+        else:
+            trace = self._mint_trace(uid)
+        args = {"uid": uid, **ex}
+        if trace is not None:
+            args["trace"] = trace
+        self.flight.record("req_route", t0, time.perf_counter(),
+                           args=args)
+        return rep
+
+    def dump_merged_trace(self, path: str) -> Optional[str]:
+        """Merge the pool's routing spans with EVERY member's engine
+        flight ring — dead replicas included: their pre-drain spans are
+        the first half of a drained request's track — into one fleet
+        Chrome trace (:func:`~..telemetry.flight_recorder.
+        merge_chrome_traces` namespaces tracks by source and stitches
+        trace-context spans), atomically published at ``path``. None
+        when telemetry is off."""
+        if self.flight is None:
+            return None
+        dumps = [self.flight.to_chrome_trace(reason="fleet")]
+        srcs = [f"{self.name}.router"]
+        for rid, rep in self._replicas.items():
+            fl = rep.engine.flight
+            if fl is not None:
+                dumps.append(fl.to_chrome_trace(reason="fleet"))
+                srcs.append(rid)
+        atomic_json_dump(path, merge_chrome_traces(dumps, srcs))
+        return path
+
+    # ------------------------------------------------------------------ #
     # the engine-shaped serving surface (DSL001-registered hot paths)
     # ------------------------------------------------------------------ #
 
@@ -412,7 +499,7 @@ class ReplicaPool:
                     # manifest; rerouting its tokens would re-admit
                     # them as a bogus new prompt elsewhere
                     try:
-                        rep = self.router.select(self.replicas(), toks)
+                        rep = self._route(uid, toks)
                     except NoServingReplicaError:
                         self._reject(uid, "no_serving_replica")
                         continue
@@ -436,9 +523,12 @@ class ReplicaPool:
 
         def run_one(rid: str) -> Dict[int, Any]:
             members = groups[rid]
+            tr = {u: self._trace_ids[u] for u in members
+                  if u in self._trace_ids}
             return self._replicas[rid].engine.put(
                 members, [toks_of[u] for u in members], _greedy=_greedy,
-                arrivals=arrivals, deadlines=deadlines, sampling=sampling)
+                arrivals=arrivals, deadlines=deadlines, sampling=sampling,
+                traces=tr or None)
 
         results = self._run_groups(run_one, groups)
         for res in results:
@@ -582,6 +672,7 @@ class ReplicaPool:
 
     def flush(self, uid: int) -> None:
         self._replayed.pop(uid, None)
+        self._trace_ids.pop(uid, None)
         rid = self._owner.pop(uid, None)
         rep = self._replicas.get(rid) if rid is not None else None
         if rep is not None and rep.engine.state.get(uid) is not None:
@@ -648,12 +739,7 @@ class ReplicaPool:
         """Atomic fleet-snapshot publish (tmp + rename) — same torn-read
         discipline as ``MetricsRegistry.export``; ``bin/dstpu_top``
         renders the file like any single-engine export."""
-        d = os.path.dirname(os.path.abspath(path))
-        os.makedirs(d, exist_ok=True)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(self.fleet_snapshot(), f)
-        os.replace(tmp, path)
+        atomic_json_dump(path, self.fleet_snapshot())
 
     def slo_report(self) -> Dict[str, Any]:
         """Fleet-wide SLO summary in the same shape as a single

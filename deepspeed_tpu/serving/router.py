@@ -117,12 +117,19 @@ class Router:
             s += self.w_headroom * replica.slo_headroom(self.slo_ttft_s)
         return s
 
-    def select(self, replicas: Sequence[Any], prompt: Sequence[int]):
+    def select(self, replicas: Sequence[Any], prompt: Sequence[int],
+               explain: Optional[Dict[str, Any]] = None):
         """Place ``prompt`` on one of ``replicas``. Only AVAILABLE
         replicas (serving and not draining) are candidates — a draining
         replica's live sequences ride its manifest, and handing it fresh
         work would just bounce off the engine's admission refusal.
         Raises :class:`NoServingReplicaError` when none are available.
+
+        ``explain`` (a dict the caller owns) is filled with the decision
+        evidence — the policy, every candidate's score under
+        ``prefix_aware``, the chosen replica id and whether a tie broke
+        — so the pool's routing-decision trace span can carry exactly
+        what the router saw (pure host bookkeeping; None skips it).
 
         Deterministic given (policy, seed, call history, replica
         states): exact-score ties break through the seeded RNG, so a
@@ -142,25 +149,42 @@ class Router:
         open_ = [r for r in avail if r.queue_frac() < 1.0]
         avail = open_ or avail
         self.stats["dispatched"] += 1
+        if explain is not None:
+            explain["policy"] = self.policy
         if self.policy == "round_robin":
             pick = avail[self._rr % len(avail)]
             self._rr += 1
+            if explain is not None:
+                explain["chosen"] = pick.replica_id
             return pick
         if self.policy == "random":
-            return avail[self._rng.randrange(len(avail))]
+            pick = avail[self._rng.randrange(len(avail))]
+            if explain is not None:
+                explain["chosen"] = pick.replica_id
+            return pick
         best_score = None
         ties: List[Any] = []
+        scores: Optional[Dict[str, float]] = \
+            {} if explain is not None else None
         for r in avail:
             s = self.score(r, prompt)
+            if scores is not None:
+                scores[r.replica_id] = round(s, 6)
             if best_score is None or s > best_score:
                 best_score = s
                 ties = [r]
             elif s == best_score:
                 ties.append(r)
-        if len(ties) == 1:
-            return ties[0]
-        self.stats["ties_broken"] += 1
-        return ties[self._rng.randrange(len(ties))]
+        if len(ties) > 1:
+            self.stats["ties_broken"] += 1
+            pick = ties[self._rng.randrange(len(ties))]
+        else:
+            pick = ties[0]
+        if explain is not None:
+            explain["scores"] = scores
+            explain["chosen"] = pick.replica_id
+            explain["tie_break"] = len(ties) > 1
+        return pick
 
     # ------------------------------------------------------------------ #
 

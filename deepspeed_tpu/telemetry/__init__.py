@@ -13,8 +13,11 @@ Kill switch: ``DSTPU_TELEMETRY=0`` — every registry call becomes a
 shared no-op and the serve engine skips instrumentation entirely.
 """
 
+from .attribution import (ATTRIBUTION_COMPONENTS, attribution_report,
+                          comm_share, component_totals)
 from .flight_recorder import (FlightRecorder, auto_dump, flight_dir,
-                              register_recorder)
+                              merge_chrome_traces, register_recorder,
+                              request_tracks)
 from .loadgen import (LoadResult, PoissonArrivals, Request,
                       TraceArrivals, UniformArrivals, WorkloadMix,
                       build_requests, run_open_loop, sweep_capacity)
@@ -28,13 +31,16 @@ from .serve import ServeObserver, serve_observer
 from .trace import annotate, maybe_trace, trace_dir
 
 __all__ = [
-    "COMM_CANONICAL_KINDS", "Counter", "FlightRecorder", "Gauge",
-    "Histogram", "LoadResult", "MetricsRegistry", "MonitorBridge",
-    "NullRegistry", "PoissonArrivals", "REGISTERED_METRICS", "Request",
-    "ServeObserver", "TraceArrivals", "UniformArrivals", "WorkloadMix",
-    "annotate", "attach_monitor", "auto_dump", "build_requests",
-    "comm_counter", "flight_dir", "get_registry", "maybe_trace",
+    "ATTRIBUTION_COMPONENTS", "COMM_CANONICAL_KINDS", "Counter",
+    "FlightRecorder", "Gauge", "Histogram", "LoadResult",
+    "MetricsRegistry", "MonitorBridge", "NullRegistry",
+    "PoissonArrivals", "REGISTERED_METRICS", "Request", "ServeObserver",
+    "TraceArrivals", "UniformArrivals", "WorkloadMix", "annotate",
+    "attach_monitor", "attribution_report", "auto_dump",
+    "build_requests", "comm_counter", "comm_share", "component_totals",
+    "flight_dir", "get_registry", "maybe_trace", "merge_chrome_traces",
     "merge_snapshots", "new_registry", "record_phase_tflops",
-    "register_recorder", "run_open_loop", "serve_observer",
-    "set_registry", "sweep_capacity", "telemetry_enabled", "trace_dir",
+    "register_recorder", "request_tracks", "run_open_loop",
+    "serve_observer", "set_registry", "sweep_capacity",
+    "telemetry_enabled", "trace_dir",
 ]

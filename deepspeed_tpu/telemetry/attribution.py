@@ -1,0 +1,162 @@
+"""Step-time attribution — where every millisecond of a serve step goes.
+
+The observatory can say a step was slow; this module says WHY. The
+serve observer (telemetry/serve.py) already brackets the pipeline's
+host-side boundaries; with ``DSTPU_ATTRIB=1`` (default) it additionally
+closes the books on every committed step, so a step's wall clock
+decomposes into:
+
+  * ``plan``            — scheduler + staged-buffer fill
+    (``serve_plan_s``);
+  * ``dispatch``        — compiled-step enqueue (``serve_dispatch_s``;
+    fused decode/verify dispatches land here too);
+  * ``device_execute``  — the exposed device wait at the commit's
+    blocking readback (``serve_commit_block_s``): device time the
+    pipeline failed to hide under host work;
+  * ``commit_apply``    — host-side commit application after the
+    readback: token bookkeeping, journal appends, rollbacks, deferred
+    flushes (``serve_commit_apply_s``);
+  * ``host_gap``        — the RESIDUAL: loop time inside the serve loop
+    but outside every bracket (resume scans, deadline sweeps, ring
+    bookkeeping, GC pauses — ``serve_host_gap_s``). This is the
+    component a "mysteriously slow" step usually hides in, which is
+    why it is measured as the closure of the sum rather than by
+    enumerating its causes;
+  * ``promote_wait``    — the hierarchical-KV promotion dispatch wait
+    the ADMISSION path pays (``prefix_promote_wait_s``; put()-side, so
+    it is reported as its own component, not part of the step sum).
+
+By construction ``plan + dispatch + device_execute + commit_apply +
+host_gap`` equals the serve loop's wall clock (each step's wall is the
+interval between commit boundaries; the loop exit closes the tail), so
+the components sum to externally measured step wall-clock within
+tolerance — ``bench.py serve_attrib`` gates exactly that. Everything is
+host-side ``perf_counter`` arithmetic at existing boundaries: traced
+programs gain 0 host callbacks and the warm path 0 fresh compiles with
+attribution on (same gates as the PR 8 observer).
+
+The **audited-collective share** rides along without any device timer:
+the program auditor's trip-weighted reports give the steady decode
+program's exact per-step collective hop count (ring-decomposed
+schedules included) and — new here — its trip-weighted ``dot_general``
+count, so :func:`comm_share` derives an op-level comm-vs-compute split
+of ``device_execute`` straight from the compiled schedule. It is a
+schedule-derived share (ops, not seconds): honest about what host-side
+observation can know, and exactly the per-knob evidence the autotuning
+item needs (a schedule with 4x the hops at the same device_execute is
+hiding its comm; one with rising device_execute AND rising hop share is
+comm-bound).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional
+
+#: component -> the histogram whose SUM carries its seconds. Order is
+#: the attribution bar's render order (dstpu_top); the first five are
+#: the step-wall partition, promote_wait is admission-side.
+ATTRIBUTION_COMPONENTS = (
+    ("plan", "serve_plan_s"),
+    ("dispatch", "serve_dispatch_s"),
+    ("device_execute", "serve_commit_block_s"),
+    ("commit_apply", "serve_commit_apply_s"),
+    ("host_gap", "serve_host_gap_s"),
+    ("promote_wait", "prefix_promote_wait_s"),
+)
+
+#: the components that partition one committed step's wall clock
+STEP_WALL_COMPONENTS = ("plan", "dispatch", "device_execute",
+                        "commit_apply", "host_gap")
+
+
+def _hist_sums(snap: Mapping[str, Any]) -> Dict[str, float]:
+    """{histogram name: sum seconds} from a registry snapshot (the
+    ``snapshot()`` dict or an exported JSON blob)."""
+    hists = snap.get("histograms", {})
+    out: Dict[str, float] = {}
+    for key, s in hists.items():
+        out[key.split("{", 1)[0]] = float(s.get("sum", 0.0))
+    return out
+
+
+def component_totals(snap: Mapping[str, Any],
+                     prev: Optional[Mapping[str, Any]] = None
+                     ) -> Dict[str, float]:
+    """Per-component attributed seconds from a snapshot — deltas against
+    ``prev`` when given (the measured-window discipline every bench
+    sibling uses: warm-up must not pollute the gated numbers)."""
+    cur = _hist_sums(snap)
+    old = _hist_sums(prev) if prev is not None else {}
+    return {comp: max(0.0, cur.get(h, 0.0) - old.get(h, 0.0))
+            for comp, h in ATTRIBUTION_COMPONENTS}
+
+
+def step_wall_total(snap: Mapping[str, Any],
+                    prev: Optional[Mapping[str, Any]] = None) -> float:
+    """Total step wall-clock seconds the observer accounted
+    (``serve_step_wall_s`` sum, optionally delta'd)."""
+    cur = _hist_sums(snap).get("serve_step_wall_s", 0.0)
+    old = _hist_sums(prev).get("serve_step_wall_s", 0.0) \
+        if prev is not None else 0.0
+    return max(0.0, cur - old)
+
+
+def attribution_report(snap: Mapping[str, Any],
+                       prev: Optional[Mapping[str, Any]] = None
+                       ) -> Dict[str, Any]:
+    """The attribution summary over a snapshot (or a window between two
+    snapshots): per-component seconds and fractions of the step wall,
+    the dominant component, and the closure error
+    (``|wall − Σ components| / wall`` — the quantity the serve_attrib
+    bench gates; a large residual means a new unbracketed code path
+    crept into the loop)."""
+    comps = component_totals(snap, prev)
+    wall = step_wall_total(snap, prev)
+    step_sum = sum(comps[c] for c in STEP_WALL_COMPONENTS)
+    denom = wall if wall > 0 else step_sum
+    out: Dict[str, Any] = {
+        "components_s": {c: round(v, 6) for c, v in comps.items()},
+        "step_wall_s": round(wall, 6),
+        "components_sum_s": round(step_sum, 6),
+        "closure_err_frac": round(abs(wall - step_sum) / denom, 6)
+        if denom > 0 else None,
+        "fracs": {c: round(comps[c] / denom, 4) if denom > 0 else None
+                  for c in STEP_WALL_COMPONENTS},
+    }
+    if denom > 0:
+        out["dominant"] = max(STEP_WALL_COMPONENTS,
+                              key=lambda c: comps[c])
+    else:
+        out["dominant"] = None
+    return out
+
+
+def comm_share(engine, program: str = "step_greedy_fb"
+               ) -> Optional[Dict[str, Any]]:
+    """The audited-collective share of one serve program's device work,
+    derived entirely from the program auditor's trip-weighted jaxpr
+    counts (0 host callbacks, 0 device timers): per-step collective
+    executions by kind, the trip-weighted GEMM count, and their
+    op-level ratio — the schedule-derived comm-vs-compute split of the
+    ``device_execute`` component. Report-time only (lowers the program;
+    never call on the hot path). None when the program is unavailable
+    on this runner."""
+    from ..analysis.program_audit import audit_serve_programs
+    try:
+        reports = audit_serve_programs(engine, programs=(program,))
+    except (AttributeError, NotImplementedError):
+        return None
+    rep = reports.get(program)
+    if rep is None:
+        return None
+    coll = rep.total_collectives
+    dots = rep.dot_generals
+    return {
+        "program": program,
+        "collectives_per_step": coll,
+        "by_kind": dict(sorted(rep.by_kind().items())),
+        "dot_generals_per_step": dots,
+        "comm_op_share": round(coll / (coll + dots), 4)
+        if coll + dots else 0.0,
+        "host_callbacks": rep.host_callbacks,
+    }

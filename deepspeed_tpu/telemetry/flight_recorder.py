@@ -198,9 +198,145 @@ class FlightRecorder:
 
     def dump(self, path: str, reason: Optional[str] = None) -> None:
         """Atomic Chrome-trace publish (tmp + rename)."""
-        d = os.path.dirname(os.path.abspath(path))
-        os.makedirs(d, exist_ok=True)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(self.to_chrome_trace(reason=reason), f)
-        os.replace(tmp, path)
+        atomic_json_dump(path, self.to_chrome_trace(reason=reason))
+
+
+def atomic_json_dump(path: str, obj: Any) -> None:
+    """The one copy of the atomic JSON publish (makedirs + tmp.{pid} +
+    rename) the trace/snapshot writers share — a reader never sees a
+    torn file."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------- #
+# fleet trace merge (docs/observability.md "Distributed tracing")
+# ---------------------------------------------------------------------- #
+
+
+def merge_chrome_traces(dumps: List[Dict[str, Any]],
+                        sources: List[str]) -> Dict[str, Any]:
+    """Merge N replicas' Chrome-trace flight dumps into ONE fleet
+    timeline, on one clock and with collision-free tracks.
+
+    * **Clock alignment**: each dump's timestamps are µs relative to its
+      own oldest span; its ``otherData.wall_time_base`` anchors that
+      origin on the wall clock. The merge rebases every event onto the
+      earliest dump's origin, so spans from different replicas land in
+      true fleet order.
+    * **Track namespacing** (the tid-collision fix): a single dump gives
+      each uid the track ``tid = uid + 1`` — concatenating dumps would
+      therefore fold DIFFERENT requests that happen to share a uid
+      number on two replicas onto one track. Here every uid track is
+      keyed by ``(source, uid)`` instead, and every engine phase lane by
+      its source, each getting a fresh merged tid plus a ``thread_name``
+      metadata row naming it.
+    * **Trace-context stitching**: spans carrying a ``trace`` arg (the
+      fleet trace context minted at ``ReplicaPool.put``) key their track
+      on the TRACE ID alone — so one request's spans from the router,
+      the replica that first served it, and the survivor that replayed
+      it after a drain all land on ONE gapless track, while untraced
+      same-uid requests stay apart.
+
+    ``sources`` names each dump (replica ids / file basenames); a short
+    list is refused rather than silently mislabelling."""
+    if len(sources) != len(dumps):
+        raise ValueError(
+            f"{len(sources)} sources for {len(dumps)} dumps — every "
+            f"dump needs its replica id (tracks are namespaced by it)")
+    bases = []
+    for d, src in zip(dumps, sources):
+        base = d.get("otherData", {}).get("wall_time_base")
+        if base is None:
+            # a foreign/hand-trimmed trace without the anchor would
+            # default to wall 0 and shift every REAL dump by ~50 years
+            # of microseconds — refuse instead of silently producing a
+            # garbage timeline
+            raise ValueError(
+                f"dump {src!r} has no otherData.wall_time_base — not a "
+                f"FlightRecorder dump; merge needs the wall anchor to "
+                f"align clocks")
+        bases.append(float(base))
+    base0 = min(bases) if bases else 0.0
+    tids: Dict[Tuple, int] = {}
+    names: Dict[int, str] = {}
+    # engine phase lanes first, in source order, so lane k is replica k
+    for i, src in enumerate(sources):
+        tids[("engine", src)] = i
+        names[i] = f"engine {src}"
+
+    def tid_of(key: Tuple, label: str) -> int:
+        t = tids.get(key)
+        if t is None:
+            t = len(tids)
+            tids[key] = t
+            names[t] = label
+        return t
+
+    events: List[Dict[str, Any]] = []
+    dropped = 0
+    for dump, src, wtb in zip(dumps, sources, bases):
+        off_us = (wtb - base0) * 1e6
+        dropped += int(dump.get("otherData", {}).get("spans_dropped", 0))
+        for ev in dump.get("traceEvents", []):
+            if ev.get("ph") == "M":
+                continue                      # re-derived below
+            args = ev.get("args") or {}
+            trace = args.get("trace")
+            uid = args.get("uid")
+            if trace is not None:
+                t = tid_of(("trace", trace), f"req {trace}")
+            elif uid is not None:
+                t = tid_of(("uid", src, uid), f"req {src}/uid{uid}")
+            elif ev.get("tid", 0) == 0:
+                t = tids[("engine", src)]
+            else:
+                t = tid_of(("t", src, ev["tid"]),
+                           f"{src} t{ev['tid']}")
+            out = dict(ev)
+            out["pid"] = 0
+            out["tid"] = t
+            out["ts"] = round(ev.get("ts", 0.0) + off_us, 1)
+            a = dict(args)
+            a["source"] = src
+            out["args"] = a
+            events.append(out)
+    events.sort(key=lambda e: (e["ts"], e["tid"]))
+    meta = [{"ph": "M", "pid": 0, "tid": t, "name": "thread_name",
+             "args": {"name": names[t]}} for t in sorted(names)]
+    return {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "dstpu.flight_recorder/merge",
+            "sources": list(sources),
+            "spans_dropped": dropped,
+            "wall_time_base": base0,
+        },
+    }
+
+
+def request_tracks(merged: Dict[str, Any]
+                   ) -> Dict[str, List[Dict[str, Any]]]:
+    """{track name: [events, ts-ordered]} for every request track of a
+    merged trace (``req ...`` thread names) — what the fleet tests and
+    the ``dstpu_top --merge-trace`` summary walk to assert a drained
+    request reconstructs gapless end-to-end."""
+    names: Dict[int, str] = {}
+    for ev in merged.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            names[ev["tid"]] = ev["args"]["name"]
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for ev in merged.get("traceEvents", []):
+        if ev.get("ph") == "M":
+            continue
+        name = names.get(ev.get("tid"))
+        if name is not None and name.startswith("req "):
+            out.setdefault(name, []).append(ev)
+    for evs in out.values():
+        evs.sort(key=lambda e: e["ts"])
+    return out

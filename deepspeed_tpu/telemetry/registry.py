@@ -67,6 +67,12 @@ REGISTERED_METRICS = {
     "serve_plan_s": "per-step plan (scheduler + staging) time",
     "serve_dispatch_s": "per-step dispatch (enqueue) time",
     "serve_commit_block_s": "per-commit blocking readback time",
+    # -- step-time attribution (histograms + one labelled counter) ----- #
+    "serve_commit_apply_s": "per-commit host-side apply (bookkeeping) time",
+    "serve_host_gap_s": "per-step residual host time between brackets",
+    "serve_step_wall_s": "per-committed-step wall-clock inside the loop",
+    "serve_attrib_seconds_total":
+        "cumulative attribution seconds (label: component)",
     # -- prefix cache (counters + gauges) ------------------------------ #
     "prefix_matched_tokens": "prompt tokens served from cached blocks",
     "prefix_prefill_tokens": "prompt tokens that ran a prefill chunk",

@@ -74,6 +74,20 @@ class ServeObserver:
         # request; DSTPU_FLIGHT_REQUESTS=0 keeps the ring phases-only.
         self.req_spans = os.environ.get("DSTPU_FLIGHT_REQUESTS", "1") \
             not in ("0", "false", "off")
+        # step-time attribution (telemetry/attribution.py,
+        # docs/observability.md "Step-time attribution"): when armed, the
+        # observer closes the books on every committed step — wall clock
+        # since the previous commit boundary, minus the bracketed
+        # plan/dispatch/readback/apply components, is the HOST GAP. All
+        # pure perf_counter arithmetic at the same host-side boundaries
+        # the SLO metrics already own; DSTPU_ATTRIB=0 restores the exact
+        # pre-attribution record path (the bench's parity control).
+        self.attrib = os.environ.get("DSTPU_ATTRIB", "1") \
+            not in ("0", "false", "off")
+        self._in_loop = False
+        self._anchor = 0.0
+        self._acc = 0.0
+        self._attrib_prev: Dict[str, float] = {}
         self._last_export_step = 0
         self._prefix_prev: Dict[str, float] = {}
         self._flight_dropped_prev = 0
@@ -97,6 +111,9 @@ class ServeObserver:
         self.h_plan = r.histogram("serve_plan_s")
         self.h_dispatch = r.histogram("serve_dispatch_s")
         self.h_commit = r.histogram("serve_commit_block_s")
+        self.h_apply = r.histogram("serve_commit_apply_s")
+        self.h_gap = r.histogram("serve_host_gap_s")
+        self.h_wall = r.histogram("serve_step_wall_s")
         self.h_promote = r.histogram("prefix_promote_wait_s")
         self.c_promoted = r.counter("prefix_promoted_blocks")
         self.c_flight_dropped = r.counter("flight_spans_dropped")
@@ -104,15 +121,28 @@ class ServeObserver:
             reason: r.counter(name)
             for reason, name in _REJECT_COUNTERS.items()}
 
-    def _req_span(self, name, t0_m, t1_m, uid, **args):
+    def _req_span(self, name, t0_m, t1_m, uid, trace=None, **args):
         """Record a request-lifecycle span from MONOTONIC endpoints
         (the per-seq SLO stamps) onto the flight ring's perf_counter
         axis — the clock offset is measured at record time, so the span
-        lands exactly where it happened. DSL001-registered hot path:
-        two clock reads + a ring append."""
+        lands exactly where it happened. ``trace`` is the fleet-wide
+        trace context (minted at ReplicaPool.put, carried on the
+        sequence descriptor) — merged multi-replica dumps key one
+        request's track on it. DSL001-registered hot path: two clock
+        reads + a ring append."""
         off = time.perf_counter() - time.monotonic()
+        if trace is not None:
+            args["trace"] = trace
         self.flight.record(name, t0_m + off, t1_m + off,
                            args={"uid": uid, **args})
+
+    def _req_event(self, name, uid, trace, **args):
+        """Instant request-lifecycle mark, trace-tagged when the request
+        carries a fleet trace context. DSL001-registered hot path — one
+        ring append."""
+        if trace is not None:
+            args["trace"] = trace
+        self.flight.event(name, uid=uid, **args)
 
     # ------------------- request lifecycle (hot) ---------------------- #
     # Registered DSL001 hot paths: these run inside the pipeline's
@@ -130,7 +160,8 @@ class ServeObserver:
             # anchored at the (possibly past) admission stamp so the
             # uid track reads admit -> queue -> ttft in order even when
             # admission lagged the arrival (the loadgen's regime)
-            self._req_span("req_admit", now, now, seq.uid)
+            self._req_span("req_admit", now, now, seq.uid,
+                           trace=seq.trace_id)
 
     def on_sched(self, sched, now):
         """First-schedule stamps for this plan's sequences -> queue
@@ -145,10 +176,11 @@ class ServeObserver:
                     self.h_queue.observe(now - seq.admitted_at)
                     if req:
                         self._req_span("req_queue_wait",
-                                       seq.admitted_at, now, seq.uid)
+                                       seq.admitted_at, now, seq.uid,
+                                       trace=seq.trace_id)
             if req and len(item.tokens) > 1:
-                self.flight.event("req_prefill_chunk", uid=seq.uid,
-                                  ntok=len(item.tokens))
+                self._req_event("req_prefill_chunk", seq.uid,
+                                seq.trace_id, ntok=len(item.tokens))
 
     def on_token_commit(self, seq, now, n=1):
         """``n`` output tokens of ``seq`` became host-visible at ``now``
@@ -164,7 +196,7 @@ class ServeObserver:
                 self.h_ttft.observe(now - seq.admitted_at)
                 if self.req_spans:
                     self._req_span("req_ttft", seq.admitted_at, now,
-                                   seq.uid)
+                                   seq.uid, trace=seq.trace_id)
         else:
             last = seq.last_token_at
             if last is not None and now > last:
@@ -173,15 +205,72 @@ class ServeObserver:
 
     def on_plan(self, dt):
         self.h_plan.observe(dt)
+        self._acc += dt
 
     def on_dispatch(self, dt, fed):
         self.c_steps.inc()
         if fed:
             self.c_fed.inc()
         self.h_dispatch.observe(dt)
+        self._acc += dt
+
+    def on_fused_dispatch(self, dt):
+        """One fused decode_batch / speculative-verify enqueue (n steps
+        in one dispatch): same dispatch histogram, no per-step counter
+        (``serve_steps`` counts pipelined dispatches; fused rounds are
+        already visible as spec_rounds / token commits). Registered
+        DSL001 hot path — one observe + one add."""
+        self.h_dispatch.observe(dt)
+        self._acc += dt
 
     def on_commit_block(self, dt):
         self.h_commit.observe(dt)
+        self._acc += dt
+
+    def on_commit_apply(self, dt):
+        """Host-side commit application — token bookkeeping, journal
+        appends, rollbacks and deferred flushes between the blocking
+        readback and the commit boundary. Registered DSL001 hot path."""
+        self.h_apply.observe(dt)
+        self._acc += dt
+
+    # ---------------- step-time attribution boundaries ----------------- #
+
+    def on_loop_enter(self):
+        """Serve-loop entry (the pipeline ring driver, the fused decode
+        loop, a speculative round loop): anchor the attribution clock.
+        Loops never genuinely nest (decode_spec exits its window BEFORE
+        falling back into the pipelined impl), so entry always
+        RE-ANCHORS unconditionally — a loop that unwound on an
+        exception without reaching its exit therefore cannot poison
+        later windows with a stale anchor (self-healing beats a leaked
+        flag). Registered DSL001 hot path — attribute stores only."""
+        self._in_loop = True
+        if self.attrib:
+            self._anchor = time.perf_counter()
+            self._acc = 0.0
+
+    def on_loop_exit(self):
+        """Serve-loop exit: close the residual tail since the last
+        commit boundary (loop-condition checks, ring teardown) so a
+        window's component sum equals its wall clock. Registered DSL001
+        hot path."""
+        self._in_loop = False
+        if self.attrib:
+            self._close_step(time.perf_counter())
+
+    def _close_step(self, now):
+        """The ONE copy of the attribution closure arithmetic (both the
+        per-commit boundary and the loop-exit tail call it): wall since
+        the anchor, the unbracketed residual into host_gap, re-anchor.
+        Registered DSL001 hot path — pure host arithmetic."""
+        wall = now - self._anchor
+        if wall > 0.0:
+            gap = wall - self._acc
+            self.h_wall.observe(wall)
+            self.h_gap.observe(gap if gap > 0.0 else 0.0)
+        self._anchor = now
+        self._acc = 0.0
 
     def on_retry(self):
         self.c_retries.inc()
@@ -198,6 +287,15 @@ class ServeObserver:
         if accepted:
             self.c_spec_accepted.inc(accepted)
 
+    def on_spec_commit(self, seq, accepted, drafted):
+        """One TRACED request's share of a speculative verify round —
+        the spec-round mark on its fleet trace track (untraced requests
+        skip the ring append entirely; the aggregate counters above
+        cover them). Registered DSL001 hot path."""
+        if self.req_spans and seq.trace_id is not None:
+            self._req_event("req_spec_round", seq.uid, seq.trace_id,
+                            accepted=accepted, drafted=drafted)
+
     def on_promote(self, blocks, wait_s):
         """One request's hierarchical-KV promotion dispatched:
         ``blocks`` host-tier blocks scattered back on device, paying
@@ -208,12 +306,12 @@ class ServeObserver:
         self.c_promoted.inc(blocks)
         self.h_promote.observe(wait_s)
 
-    def on_reject(self, reason, uid=None):
+    def on_reject(self, reason, uid=None, trace=None):
         c = self._reject_counters.get(reason)
         if c is not None:
             c.inc()
         if self.req_spans and uid is not None:
-            self.flight.event("req_reject", uid=uid, reason=reason)
+            self._req_event("req_reject", uid, trace, reason=reason)
 
     def on_abort(self, rejected):
         """engine.abort() on a live uid; shed/deadline aborts arrive
@@ -242,9 +340,10 @@ class ServeObserver:
         if self.req_spans:
             ft, lt = seq.first_token_at, seq.last_token_at
             if ft is not None and lt is not None and lt > ft:
-                self._req_span("req_decode", ft, lt, seq.uid)
-            self.flight.event("req_finish", uid=seq.uid,
-                              outcome=outcome)
+                self._req_span("req_decode", ft, lt, seq.uid,
+                               trace=seq.trace_id)
+            self._req_event("req_finish", seq.uid, seq.trace_id,
+                            outcome=outcome)
 
     def phase(self, name, step=None):
         self.flight.phase(name, step)
@@ -252,9 +351,13 @@ class ServeObserver:
     # --------------------- boundaries / exports ----------------------- #
 
     def after_commit(self, step: int) -> None:
-        """Periodic work at the commit boundary: time-series sampling
-        (throttled to DSTPU_SERIES_EVERY_S), then gauge refresh, export
-        publish, monitor-bridge tick — every ``export_every`` steps."""
+        """Periodic work at the commit boundary: close the attribution
+        step (wall since the previous boundary; the unbracketed residual
+        is the HOST GAP), then time-series sampling (throttled to
+        DSTPU_SERIES_EVERY_S), then gauge refresh, export publish,
+        monitor-bridge tick — every ``export_every`` steps."""
+        if self.attrib and self._in_loop:
+            self._close_step(time.perf_counter())
         self.registry.maybe_sample()
         if step - self._last_export_step < self.export_every:
             return
@@ -302,6 +405,24 @@ class ServeObserver:
             r.gauge("prefix_cached_blocks").set(st["cached_blocks"])
             r.gauge("prefix_evictable_blocks").set(st["evictable_blocks"])
             r.gauge("prefix_host_blocks").set(st["host_cached_blocks"])
+        # step-time attribution: mirror the component histograms' running
+        # SUMS into one labelled counter (delta-sync keeps it monotone) —
+        # the sampled counter series then yields per-window component
+        # deltas, which is what dstpu_top's "dominant component" line and
+        # the regression sentinel's phase rows read. Off the hot path by
+        # construction (export boundaries only).
+        for comp, hist in (("plan", self.h_plan),
+                           ("dispatch", self.h_dispatch),
+                           ("device_execute", self.h_commit),
+                           ("commit_apply", self.h_apply),
+                           ("host_gap", self.h_gap),
+                           ("promote_wait", self.h_promote)):
+            cur = hist.sum
+            prev = self._attrib_prev.get(comp, 0.0)
+            if cur > prev:
+                r.counter("serve_attrib_seconds_total",
+                          component=comp).inc(cur - prev)
+                self._attrib_prev[comp] = cur
         dropped = self.flight.dropped
         if dropped > self._flight_dropped_prev:
             self.c_flight_dropped.inc(dropped - self._flight_dropped_prev)

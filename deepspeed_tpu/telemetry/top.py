@@ -85,6 +85,77 @@ def _series_ratio(num: List[List[float]],
     return out
 
 
+#: attribution components in render order: (label, histogram, bar char,
+#: window-dominant initial, counter-label component name)
+_ATTRIB_ROWS = (
+    ("plan", "serve_plan_s", "█", "p", "plan"),
+    ("dispatch", "serve_dispatch_s", "▓", "d", "dispatch"),
+    ("execute", "serve_commit_block_s", "▒", "x", "device_execute"),
+    ("apply", "serve_commit_apply_s", "░", "c", "commit_apply"),
+    ("host gap", "serve_host_gap_s", "·", "g", "host_gap"),
+)
+
+
+def _attrib_fracs(hists: Dict[str, Any]):
+    """((label, frac), ...) + dominant label from the component
+    histograms' sums; None before any attributed step."""
+    sums = [(label, float(hists.get(name, {}).get("sum", 0.0)), ch)
+            for label, name, ch, _, _ in _ATTRIB_ROWS]
+    total = sum(s for _, s, _ in sums)
+    if total <= 0.0:
+        return None
+    fracs = [(label, s / total) for label, s, _ in sums]
+    dominant = max(sums, key=lambda r: r[1])[0]
+    return fracs, dominant
+
+
+def _attrib_bar(fracs, width: int = 44) -> str:
+    """One-line proportional bar over the step-wall components, each
+    component its own fill glyph (legend rides the fraction row)."""
+    chars = {label: ch for label, _, ch, _, _ in _ATTRIB_ROWS}
+    out = ""
+    for label, f in fracs:
+        out += chars[label] * max(1 if f > 0.005 else 0,
+                                  round(f * width))
+    return f"[{out[:width + len(fracs)]}]"
+
+
+def _attrib_window_dominants(series: Dict[str, Any],
+                             width: int = 32) -> str:
+    """Per-sample-window dominant component as a trail of initials (the
+    sampled ``serve_attrib_seconds_total{component=...}`` counter
+    series): one glyph per window, newest right — a drifting dominant
+    (say compute windows giving way to host-gap windows) reads at a
+    glance."""
+    per_comp = {}
+    for _, _, _, init, comp in _ATTRIB_ROWS:
+        key = f'serve_attrib_seconds_total{{component="{comp}"}}'
+        pairs = series.get(key, [])
+        if pairs:
+            # keyed by sample TIMESTAMP: one registry sample() stamps
+            # every live counter with the same clock value, so equal
+            # timestamps ARE the same window — while a late-created
+            # component (a fused-decode fleet plans nothing until it
+            # switches paths) simply has no entry for early windows
+            # instead of shifting everyone's alignment
+            per_comp[init] = dict(pairs)
+    if not per_comp:
+        return ""
+    times = sorted({t for m in per_comp.values() for t in m})
+    if len(times) < 2:
+        return ""
+    out = []
+    for t0, t1 in list(zip(times, times[1:]))[-width:]:
+        deltas = {init: m[t1] - m[t0] for init, m in per_comp.items()
+                  if t0 in m and t1 in m}
+        if not deltas:
+            out.append("-")
+            continue
+        best = max(deltas, key=deltas.get)
+        out.append(best if deltas[best] > 0 else "-")
+    return "".join(out)
+
+
 def render(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
            ) -> str:
     """The operator table for one snapshot; ``prev`` (an earlier
@@ -150,6 +221,23 @@ def render(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
         s = h.get(name, {})
         lines.append(f"  {label:<14}{_ms(s.get('p50'))} {_ms(s.get('p90'))}"
                      f" {_ms(s.get('p99'))} {s.get('count', 0):8d}")
+    # step-time attribution bar (docs/observability.md "Step-time
+    # attribution"): where the committed steps' wall clock went, from
+    # the component histograms' sums — plus the per-window dominant
+    # component off the sampled serve_attrib_seconds_total series
+    attrib = _attrib_fracs(h)
+    if attrib is not None:
+        fracs, dominant = attrib
+        lines.append("")
+        lines.append("step time      " + "  ".join(
+            f"{name} {_pct(f)}" for name, f in fracs) +
+            f"   dominant: {dominant}")
+        lines.append("  " + _attrib_bar(fracs))
+        doms = _attrib_window_dominants(series)
+        if doms:
+            lines.append(f"  dominant/window  {doms}  "
+                         f"(p=plan d=dispatch x=execute c=apply "
+                         f"g=host-gap)")
     lines.append("")
     hit = c.get("prefix_matched_tokens", 0.0)
     ran = c.get("prefix_prefill_tokens", 0.0)
@@ -271,6 +359,62 @@ def render_sources(per_source: List[Tuple[str, Dict[str, Any]]]) -> str:
     return "\n".join(lines)
 
 
+def merge_trace_files(paths: List[str], out_path: str) -> int:
+    """``--merge-trace``: merge flight-dump Chrome traces into one
+    fleet timeline and summarize the request tracks it reconstructs
+    (docs/observability.md "Distributed tracing")."""
+    from .flight_recorder import (atomic_json_dump, merge_chrome_traces,
+                                  request_tracks)
+    if len(paths) < 1:
+        print("dstpu_top --merge-trace: need at least one flight dump",
+              file=sys.stderr)
+        return 2
+    dumps = []
+    for p in paths:
+        try:
+            dumps.append(load_snapshot(p))
+        except (OSError, ValueError) as e:
+            print(f"dstpu_top: unreadable flight dump {p}: {e}",
+                  file=sys.stderr)
+            return 2
+    sources = [os.path.splitext(os.path.basename(p))[0] for p in paths]
+    if len(set(sources)) != len(sources):
+        # two replicas each writing flight_0.json into their own dir
+        # must NOT collapse onto one source — that would re-introduce
+        # the same-uid tid collision the merge exists to fix. Prefer
+        # dir/basename; suffix any residual duplicates.
+        sources = [os.path.join(os.path.basename(os.path.dirname(
+            os.path.abspath(p))), s) for p, s in zip(paths, sources)]
+        seen: Dict[str, int] = {}
+        for i, s in enumerate(sources):
+            n = seen.get(s, 0)
+            seen[s] = n + 1
+            if n:
+                sources[i] = f"{s}#{n}"
+    try:
+        merged = merge_chrome_traces(dumps, sources)
+    except ValueError as e:
+        print(f"dstpu_top: {e}", file=sys.stderr)
+        return 2
+    atomic_json_dump(out_path, merged)
+    tracks = request_tracks(merged)
+    n_ev = sum(1 for e in merged["traceEvents"] if e.get("ph") != "M")
+    print(f"merged {len(dumps)} flight dumps -> {out_path}: "
+          f"{n_ev} spans, {len(tracks)} request tracks, "
+          f"{merged['otherData']['spans_dropped']} dropped")
+    cross = 0
+    for name, evs in sorted(tracks.items()):
+        srcs = sorted({e.get('args', {}).get('source') for e in evs})
+        if len(srcs) > 1:
+            cross += 1
+        print(f"  {name:<32}{len(evs):4d} spans   "
+              f"sources: {', '.join(s for s in srcs if s)}")
+    if cross:
+        print(f"  ({cross} track(s) span multiple sources — "
+              f"drain/replay continuations stitched by trace context)")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="dstpu_top",
@@ -285,8 +429,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "$DSTPU_TELEMETRY_EXPORT)")
     ap.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
                     help="refresh every N seconds (0 = one-shot)")
+    ap.add_argument("--merge-trace", metavar="OUT", default=None,
+                    help="treat the paths as flight-recorder Chrome-"
+                         "trace dumps, merge them onto one fleet "
+                         "timeline (tracks namespaced by source, "
+                         "trace-context spans stitched across "
+                         "replicas) and write the merged trace to OUT")
     args = ap.parse_args(argv)
     paths = _resolve_paths(args.file, args.paths)
+    if args.merge_trace:
+        return merge_trace_files(paths, args.merge_trace)
     if not paths and os.environ.get("DSTPU_TELEMETRY_EXPORT"):
         paths = [os.environ["DSTPU_TELEMETRY_EXPORT"]]
     if not paths:

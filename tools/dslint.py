@@ -124,11 +124,20 @@ HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
     # counter/gauge/histogram arithmetic and ring appends over host
     # floats only — one device readback here would tax every committed
     # token (docs/observability.md "Overhead methodology")
+    # the step-time-attribution boundaries (on_loop_enter/exit, the
+    # commit-apply bracket, the fused-dispatch bracket) and the
+    # trace-context span taggers run on the same per-step/per-token
+    # windows: perf_counter reads + pre-bound histogram observes + ring
+    # appends only — a device sync here would inflate the very host-gap
+    # component the layer exists to measure
     "deepspeed_tpu/telemetry/serve.py":
         ("on_admit", "on_sched", "on_token_commit", "on_plan",
-         "on_dispatch", "on_commit_block", "on_retry", "on_reject",
-         "on_abort", "on_flush", "on_spec", "on_promote", "phase",
-         "_req_span"),
+         "on_dispatch", "on_fused_dispatch", "on_commit_block",
+         "on_commit_apply", "on_loop_enter", "on_loop_exit",
+         "_close_step", "on_retry",
+         "on_reject", "on_abort", "on_flush", "on_spec",
+         "on_spec_commit", "on_promote", "phase", "_req_span",
+         "_req_event"),
     "deepspeed_tpu/telemetry/registry.py":
         ("inc", "set", "observe", "quantile", "sample",
          "maybe_sample"),
@@ -151,10 +160,13 @@ HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
     # threads; its own bookkeeping (routing groups, stash splicing, the
     # replica scoring accessors) must stay pure host work — a sync in
     # put/decode grouping would serialize the whole fleet's round
+    # _mint_trace/_route run per admission between the engines'
+    # pipelines: trace minting is two dict stores, the routing-decision
+    # span is pure host scoring plus one ring append
     "deepspeed_tpu/serving/pool.py":
         ("put", "decode_pipelined", "_take_stash", "_run_groups",
-         "prefix_overlap", "prefix_overlap_tiered", "queue_frac",
-         "slo_headroom"),
+         "_mint_trace", "_route", "prefix_overlap",
+         "prefix_overlap_tiered", "queue_frac", "slo_headroom"),
 }
 
 #: roots scanned for DSTPU_* env reads (knob rules + gen_config_doc) —

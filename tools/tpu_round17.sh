@@ -1,0 +1,64 @@
+#!/bin/bash
+# Round-17 on-chip sequence: fleet-wide request tracing + step-time
+# attribution (ISSUE 14). The CPU story is proven in tier-1
+# (components-sum closure, attrib on/off parity, synthetic host-gap
+# localization, cross-replica trace reconstruction through a drain,
+# bench_compare goldens); on chip this captures (a) lint cleanliness
+# (the new trace/attribution DSL001 registry + DSTPU_ATTRIB_* knob
+# tables), (b) the tpu_smoke attribution row — on/off parity and
+# components-sum closure against REAL async dispatch/readback timing,
+# (c) the serve_attrib bench on the big llama shape — where the
+# milliseconds actually go at tp>1 (the audited comm-op share is only
+# non-zero here), (d) a fleet fault drill under DSTPU_FLIGHT_DIR whose
+# per-replica flight dumps merge into one fleet trace via
+# dstpu_top --merge-trace (drained requests must stitch across
+# sources), and (e) bench_compare gating this round's capture against
+# the previous one — the trajectory finally gates instead of merely
+# accumulating. Strictly sequential (one process owns the chip), no
+# timeouts around TPU clients (a killed client wedges the grant).
+cd /root/repo || exit 1
+LOG=profiles/r17_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round17 start $(date -u +%FT%TZ)"
+FAIL=0
+
+echo "--- [1/5] dstpu_lint (trace/attribution hot-path registry,"
+echo "    DSTPU_ATTRIB_* knob + metric catalog drift)"
+python bin/dstpu_lint deepspeed_tpu || FAIL=1
+
+echo "--- [2/5] tpu_smoke: attribution row (on-chip attrib on/off"
+echo "    parity + components-sum closure) + the full kernel sweep"
+python tools/tpu_smoke.py || FAIL=1
+
+echo "--- [3/5] serve_attrib: big llama shape — closure, host-gap"
+echo "    localization, audited comm-op share at the real schedule"
+python bench.py serve_attrib > BENCH_ATTRIB_r17.json || FAIL=1
+tail -c 1600 BENCH_ATTRIB_r17.json
+
+echo "--- [4/5] fleet fault drill under DSTPU_FLIGHT_DIR, then merge"
+echo "    the per-replica flight dumps into one fleet trace (drained"
+echo "    requests must reconstruct across sources)"
+rm -rf profiles/r17_flight && mkdir -p profiles/r17_flight
+DSTPU_FLIGHT_DIR=profiles/r17_flight \
+    python bin/dstpu_faultdrill --mode fleet || FAIL=1
+python bin/dstpu_top --merge-trace profiles/r17_fleet_trace.json \
+    'profiles/r17_flight/flight_*.json' || FAIL=1
+
+echo "--- [5/5] bench_compare: gate this round's serve_attrib capture"
+echo "    against the previous round's (tolerance bands; missing"
+echo "    phase = regression)"
+PREV=$(ls BENCH_ATTRIB_r*.json 2>/dev/null | sort | tail -2 | head -1)
+if [ -n "$PREV" ] && [ "$PREV" != "BENCH_ATTRIB_r17.json" ]; then
+    python tools/bench_compare.py "$PREV" BENCH_ATTRIB_r17.json || FAIL=1
+else
+    echo "no prior serve_attrib capture — baseline round, comparing"
+    echo "the last two full-round captures instead (informational)"
+    mapfile -t ROUNDS < <(ls BENCH_r*.json 2>/dev/null | sort | tail -2)
+    if [ "${#ROUNDS[@]}" = 2 ]; then
+        python tools/bench_compare.py "${ROUNDS[0]}" "${ROUNDS[1]}" \
+            --allow-missing || FAIL=1
+    fi
+fi
+
+echo "=== tpu_round17 done $(date -u +%FT%TZ) FAIL=$FAIL"
+exit $FAIL

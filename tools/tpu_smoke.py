@@ -453,6 +453,60 @@ def main():
           f"accept_rate={acc_s if acc_s is None else round(acc_s, 3)} "
           f"rounds={slo_s.get('spec', {}).get('rounds')}", flush=True)
 
+    # step-time attribution (ISSUE 14): ON CHIP, attribution on/off must
+    # be token-identical (the record path never touches a program) and
+    # the component sums must close against an externally measured
+    # pipelined decode window — the CPU harness proves the math, this
+    # row proves it against real async dispatch/readback timing.
+    import os as _os
+    import time as _time
+
+    from deepspeed_tpu.telemetry.attribution import (
+        STEP_WALL_COMPONENTS, component_totals)
+    rng_at = np.random.RandomState(29)
+    prompts_at = [rng_at.randint(1, 512, size=24).tolist()
+                  for _ in range(3)]
+    uids_at = [0, 1, 2]
+    # pin the knob for each engine and RESTORE the operator's value
+    # after (an exported DSTPU_ATTRIB=0 must not silently fail the row)
+    prior_at = _os.environ.get("DSTPU_ATTRIB")
+    try:
+        _os.environ["DSTPU_ATTRIB"] = "1"
+        eng_a1 = InferenceEngineV2(mcfg_a, params_a,
+                                   RaggedInferenceConfig(**base_a))
+        f_a1 = eng_a1.put(uids_at, prompts_at, _greedy=True)
+        warm_a = eng_a1.decode_pipelined(uids_at,
+                                         [f_a1[u] for u in uids_at], 4)
+        snap_a0 = eng_a1.metrics.snapshot()
+        t_a0 = _time.perf_counter()
+        got_a1 = eng_a1.decode_pipelined(
+            uids_at, [warm_a[u][-1] for u in uids_at], 16)
+        wall_a = _time.perf_counter() - t_a0
+        comps_a = component_totals(eng_a1.metrics.snapshot(), snap_a0)
+        sum_a = sum(comps_a[c] for c in STEP_WALL_COMPONENTS)
+        close_a = abs(wall_a - sum_a) / wall_a if wall_a > 0 else 1.0
+        _os.environ["DSTPU_ATTRIB"] = "0"
+        eng_a0 = InferenceEngineV2(mcfg_a, params_a,
+                                   RaggedInferenceConfig(**base_a))
+        f_a0 = eng_a0.put(uids_at, prompts_at, _greedy=True)
+        warm_a0 = eng_a0.decode_pipelined(uids_at,
+                                          [f_a0[u] for u in uids_at], 4)
+        got_a0 = eng_a0.decode_pipelined(
+            uids_at, [warm_a0[u][-1] for u in uids_at], 16)
+    finally:
+        if prior_at is None:
+            _os.environ.pop("DSTPU_ATTRIB", None)
+        else:
+            _os.environ["DSTPU_ATTRIB"] = prior_at
+    par_a = got_a1 == got_a0 and f_a1 == f_a0 and warm_a == warm_a0
+    sum_ok = close_a <= 0.25
+    ok &= par_a and sum_ok
+    print(f"{'OK ' if par_a and sum_ok else 'FAIL'} attribution: "
+          f"on/off token_parity={par_a} closure_err={close_a:.3f} "
+          f"dominant="
+          f"{max(STEP_WALL_COMPONENTS, key=lambda c: comps_a[c])} "
+          f"wall={wall_a:.3f}s sum={sum_a:.3f}s", flush=True)
+
     print("TPU_SMOKE " + ("PASS" if ok else "FAIL"), flush=True)
     return 0 if ok else 1
 
