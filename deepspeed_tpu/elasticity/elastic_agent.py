@@ -131,7 +131,7 @@ def run_elastic(
             proc = subprocess.Popen(list(cmd), env=child_env)
             proc_box["proc"] = proc
             ledger.record("launch", restarts=restarts, world=world or None,
-                          pid=proc.pid)
+                          pid=proc.pid, t_start=start)
             if stop_signal["num"] is not None:
                 # signal raced the launch: forward it now
                 try:
@@ -176,7 +176,8 @@ def run_elastic(
                 if manifest and not os.path.exists(manifest):
                     manifest = None        # drain never published it
                 ledger.record("drained", rc=rc, runtime_s=round(runtime, 3),
-                              serve_manifest=manifest)
+                              serve_manifest=manifest,
+                              t_start=start, t_end=start + runtime)
                 logger.warning(f"elastic agent: draining after signal; "
                                f"worker exit {rc}"
                                + (f", replay manifest {manifest}"
@@ -184,7 +185,8 @@ def run_elastic(
                 return 0 if rc in (0, MEMBERSHIP_CHANGE_EXIT) else rc
 
             if rc == 0:
-                ledger.record("success", runtime_s=round(runtime, 3))
+                ledger.record("success", runtime_s=round(runtime, 3),
+                              t_start=start, t_end=start + runtime)
                 return 0
 
             restarts += 1
@@ -200,7 +202,8 @@ def run_elastic(
                 logger.error(f"elastic agent: giving up after {restarts - 1} "
                              f"restarts (last exit {rc})")
                 ledger.record("giveup", reason="max_restarts", rc=rc,
-                              restarts=restarts - 1)
+                              restarts=restarts - 1,
+                              t_start=start, t_end=start + runtime)
                 return rc
             if consecutive_fast_failures >= crash_loop_budget:
                 logger.error(
@@ -208,7 +211,8 @@ def run_elastic(
                     f"consecutive failures inside {crash_loop_window_s}s; "
                     f"giving up (last exit {rc})")
                 ledger.record("giveup", reason="crash_loop", rc=rc,
-                              consecutive_fast_failures=consecutive_fast_failures)
+                              consecutive_fast_failures=consecutive_fast_failures,
+                              t_start=start, t_end=start + runtime)
                 return rc
 
             backoff = 0.0
@@ -230,7 +234,8 @@ def run_elastic(
             ledger.record("restart", rc=rc, restarts=restarts,
                           membership_change=membership,
                           backoff_s=round(wait_s, 3), world=world or None,
-                          runtime_s=round(runtime, 3))
+                          runtime_s=round(runtime, 3),
+                          t_start=start, t_end=start + runtime)
             if wait_s:
                 time.sleep(wait_s)
     finally:

@@ -78,11 +78,21 @@ class FlopsProfiler:
         self.results = result
         # publish the phase-labelled roofline gauges (telemetry/
         # registry.py): bench rows and monitor bridges read achieved
-        # TFLOPS from the registry instead of re-deriving it locally
+        # TFLOPS from the process-default registry — that contract
+        # stands. With the training observatory attached the gauges
+        # ADDITIONALLY land in its per-host registry, so ONE export
+        # file carries tflops + attribution + goodput + anomaly
+        # counters (dstpu_top --train renders it).
         from ..telemetry import record_phase_tflops
         record_phase_tflops("train", flops_per_step=flops,
                             latency_s=latency,
                             utilization=result["utilization"])
+        obs = getattr(self.engine, "_train_obs", None)
+        if obs is not None:
+            record_phase_tflops("train", flops_per_step=flops,
+                                latency_s=latency,
+                                utilization=result["utilization"],
+                                registry=obs.registry)
         self._print(result)
         if self.cfg.output_file:
             import json

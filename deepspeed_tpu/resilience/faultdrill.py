@@ -111,13 +111,30 @@ def _worker() -> int:
         mesh=engine.topology.mesh, in_specs=P("data"),
         out_specs=P("data"), check_vma=False)
 
+    # optional per-step wall-stamp log (the goodput drill's INDEPENDENT
+    # measurement path: the gate compares the ledger-derived buckets
+    # against arithmetic over these stamps) — JSONL append survives the
+    # injected crash
+    import time as _time
+    steplog = os.environ.get("DRILL_STEPLOG")
+
+    def _log(kind, step, t0, t1):
+        if steplog:
+            with open(steplog, "a") as f:
+                f.write(json.dumps({"kind": kind, "step": step,
+                                    "t0": t0, "t1": t1}) + "\n")
+
     while engine.global_steps < DRILL_STEPS:
         rng = np.random.RandomState(engine.global_steps)
         batch = {"tokens": jnp.asarray(
             rng.randint(0, 512, size=(engine.config.train_batch_size, 18)),
             jnp.int32)}
+        t0 = _time.time()
         engine.train_batch(batch)
+        t1 = _time.time()
+        _log("step", engine.global_steps, t0, t1)
         engine.save_checkpoint(save_dir)
+        _log("ckpt", engine.global_steps, t1, _time.time())
         comm_probe(jnp.ones((dp,), jnp.float32))
         with open(progress_file, "w") as f:
             json.dump({"global_steps": engine.global_steps}, f)
@@ -467,6 +484,152 @@ def drill_fleet(workdir: str, verbose: bool = True) -> dict:
     return result
 
 
+#: the goodput drill's pseudo-site (a real injected kill supervised by
+#: the REAL elastic agent; the gate is the goodput ledger's arithmetic)
+GOODPUT_SITE = "train_goodput"
+
+
+def drill_train_goodput(workdir: str, verbose: bool = True) -> dict:
+    """Goodput-ledger drill (ISSUE 15): run the training worker under
+    the REAL elastic agent with a hard ``os._exit`` injected inside a
+    checkpoint save mid-run, let the agent restart it, then integrate
+    the two ledgers (the agent's supervisor ledger + the engine
+    observer's train ledger) through ``telemetry.goodput`` and gate:
+
+      * buckets sum to the run's total wall EXACTLY;
+      * the kill actually cost something (``restart_lost`` > 0) and the
+        redo shows up (``replay_catchup`` > 0 — the crash lands between
+        a durable checkpoint and the next, so work IS discarded);
+      * ``train_goodput_frac`` matches an INDEPENDENT computation over
+        the worker's own per-step wall-stamp log within 5% — two
+        measurement paths, one number.
+    """
+    import time as _time
+
+    from ..elasticity.elastic_agent import run_elastic
+    from ..telemetry.goodput import goodput_report, load_ledger_events
+
+    site_dir = os.path.join(workdir, GOODPUT_SITE)
+    os.makedirs(site_dir, exist_ok=True)
+    save_dir = os.path.join(site_dir, "ckpt")
+    steplog = os.path.join(site_dir, "steps.jsonl")
+    agent_ledger = os.path.join(site_dir, "agent_ledger.json")
+    train_ledger = os.path.join(site_dir, "train_ledger.json")
+    marker = os.path.join(site_dir, "fired.marker")
+
+    env = dict(os.environ)
+    # run_elastic MERGES this dict over os.environ (child_env.update),
+    # so inherited keys must be OVERRIDDEN, not popped: an exported
+    # XLA_FLAGS (the test harness's 8-device mesh) or an operator's
+    # DSTPU_RESTART_LEDGER would otherwise leak into the worker
+    env.update({
+        "XLA_FLAGS": "",
+        "DSTPU_RESTART_LEDGER": "",
+        "JAX_PLATFORMS": "cpu",
+        "DRILL_SAVE_DIR": save_dir,
+        "DRILL_PROGRESS_FILE": os.path.join(site_dir, "progress.json"),
+        "DRILL_STEPLOG": steplog,
+        # crash INSIDE the 3rd checkpoint save: steps 1-2 are durable,
+        # step 3's compute is discarded (restart_lost) and redone
+        # (replay_catchup) after the agent restarts the worker
+        "DSTPU_FAULT_SITE": "pre_save",
+        "DSTPU_FAULT_MODE": "exit",
+        "DSTPU_FAULT_ONCE_FILE": marker,
+        "DSTPU_FAULT_SKIP": "2",
+        "DSTPU_TELEMETRY": "1",
+        "DSTPU_TRAIN_OBS": "1",
+        "DSTPU_TRAIN_LEDGER": train_ledger,
+        # per-step progress events: the catch-up high-water mark is
+        # exact instead of export_every-granular
+        "DSTPU_TRAIN_OBS_PROGRESS_EVERY": "1",
+    })
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-c",
+           "import sys; from deepspeed_tpu.resilience.faultdrill import "
+           "_worker; sys.exit(_worker())"]
+    t0 = _time.time()
+    rc = run_elastic(
+        cmd,
+        {"max_train_batch_size": 2000, "micro_batch_sizes": [2, 4, 6],
+         "min_gpus": 1, "max_gpus": 10000, "version": 0.1},
+        max_restarts=3, min_restart_interval_s=0.0,
+        backoff_base_s=0.0, crash_loop_budget=5,
+        ledger_path=agent_ledger, env=env)
+    t_end = _time.time()
+
+    result = {"site": GOODPUT_SITE, "mode": "train", "agent_rc": rc,
+              "fault_fired": os.path.exists(marker)}
+    events = load_ledger_events([agent_ledger, train_ledger])
+    rep = goodput_report(events, t0=t0, t_end=t_end)
+    result["goodput"] = {
+        "total_wall_s": round(rep["total_wall_s"], 3),
+        "buckets": {k: round(v, 3) for k, v in rep["buckets"].items()},
+        "train_goodput_frac": rep["train_goodput_frac"],
+        "worker_runs": rep["worker_runs"],
+    }
+    buckets_exact = abs(sum(rep["buckets"].values())
+                        - rep["total_wall_s"]) < 1e-6
+    result["buckets_sum_exact"] = buckets_exact
+
+    # ---- the independent arithmetic over the worker's step log ------ #
+    entries = []
+    if os.path.exists(steplog):
+        with open(steplog) as f:
+            entries = [json.loads(ln) for ln in f if ln.strip()]
+    runs = [(e.get("t_start"), e.get("t_end"))
+            for e in load_ledger_events([agent_ledger])
+            if e.get("event") in ("restart", "success", "drained",
+                                  "giveup")]
+    expected = None
+    if rc == 0 and len(runs) == 2 and entries and rep["total_wall_s"] > 0:
+        (s1, e1), (s2, e2) = runs
+        total = t_end - t0
+        lead = s1 - t0            # agent setup before the first launch
+        tail = t_end - e2
+        downtime = s2 - e1
+        r1 = [e for e in entries if e["t1"] <= e1]
+        r2 = [e for e in entries if e["t0"] >= s2]
+        ck_total = sum(e["t1"] - e["t0"] for e in entries
+                       if e["kind"] == "ckpt")
+        durable = [e["t1"] for e in r1 if e["kind"] == "ckpt"]
+        lost = e1 - (max(durable) if durable else s1)
+        hwm = max((e["step"] for e in r1 if e["kind"] == "step"),
+                  default=0)
+        caught = [e["t1"] for e in r2
+                  if e["kind"] == "step" and e["step"] >= hwm]
+        catch_end = min(caught) if caught else e2
+        catchup = max(0.0, catch_end - s2) - sum(
+            min(e["t1"], catch_end) - e["t0"] for e in r2
+            if e["kind"] == "ckpt" and e["t0"] < catch_end)
+        productive = (total - lead - tail - downtime - lost - catchup
+                      - ck_total)
+        expected = productive / total
+        result["expected"] = {
+            "frac": round(expected, 4), "lost_s": round(lost, 3),
+            "downtime_s": round(downtime, 3),
+            "catchup_s": round(catchup, 3),
+            "checkpoint_s": round(ck_total, 3),
+        }
+    frac = rep["train_goodput_frac"]
+    match = (expected is not None and frac is not None
+             and abs(frac - expected) <= 0.05)
+    result["frac_matches_drill"] = match
+    result["recovered"] = (
+        rc == 0 and result["fault_fired"] and buckets_exact and match
+        and rep["buckets"]["restart_lost"] > 0
+        and rep["buckets"]["replay_catchup"] > 0
+        and rep["buckets"]["checkpoint_save"] > 0)
+    if verbose:
+        print(f"[faultdrill:{GOODPUT_SITE}] rc={rc} "
+              f"frac={frac if frac is None else round(frac, 4)} "
+              f"expected={None if expected is None else round(expected, 4)} "
+              f"buckets={result['goodput']['buckets']} "
+              f"recovered={result['recovered']}", file=sys.stderr)
+    return result
+
+
 def _run_worker(env: dict, fn: str = "_worker") -> int:
     env = dict(env)
     repo_root = os.path.dirname(os.path.dirname(
@@ -678,12 +841,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "fault-injection site and verify recovery (exit "
                     "non-zero on any unrecovered failure)")
     ap.add_argument("--mode", default="train",
-                    choices=("train", "serve", "fleet", "all"),
+                    choices=("train", "serve", "fleet", "train_goodput",
+                             "all"),
                     help="train: checkpoint-recovery drill (PR 1 sites); "
                          "serve: drain/replay drill (serve sites + "
                          "sigterm); fleet: kill-one-of-N replica-pool "
                          "drill (SIGTERM under offered load, survivor "
-                         "replay + rollup exactness); all: every mode")
+                         "replay + rollup exactness); train_goodput: "
+                         "elastic-agent-supervised kill whose goodput "
+                         "ledger must match the drill's wall-clock "
+                         "arithmetic (ISSUE 15); all: every mode")
     ap.add_argument("--sites", default=None,
                     help="comma-separated site subset (default: every "
                          "site of the selected mode)")
@@ -694,7 +861,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     serve_sites = list(SERVE_FAULT_SITES) + [SIGTERM_SITE]
     if args.sites:
         sites = [s for s in args.sites.split(",") if s]
-        valid = set(FAULT_SITES) | {SIGTERM_SITE, FLEET_SITE}
+        valid = set(FAULT_SITES) | {SIGTERM_SITE, FLEET_SITE,
+                                    GOODPUT_SITE}
         unknown = set(sites) - valid
         if unknown:
             ap.error(f"unknown sites {sorted(unknown)}; valid: "
@@ -705,11 +873,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         sites = serve_sites
     elif args.mode == "fleet":
         sites = [FLEET_SITE]
+    elif args.mode == "train_goodput":
+        sites = [GOODPUT_SITE]
     else:
-        sites = list(TRAIN_FAULT_SITES) + serve_sites + [FLEET_SITE]
+        sites = (list(TRAIN_FAULT_SITES) + serve_sites
+                 + [FLEET_SITE, GOODPUT_SITE])
     workdir = args.workdir or tempfile.mkdtemp(prefix="dstpu_faultdrill_")
 
     results = [drill_fleet(workdir) if site == FLEET_SITE
+               else drill_train_goodput(workdir)
+               if site == GOODPUT_SITE
                else drill_serve_site(site, workdir)
                if site in serve_sites else drill_site(site, workdir)
                for site in sites]

@@ -43,6 +43,20 @@ class RestartLedger:
                 logger.warning(f"restart ledger write failed: {e}")
         return rec
 
+    def replace(self, old: Optional[Dict[str, Any]], event: str,
+                **fields) -> Dict[str, Any]:
+        """Record ``event`` after removing ``old`` (a record previously
+        returned by :meth:`record`/:meth:`replace`) by IDENTITY — the
+        bounded-collapse primitive for high-frequency markers whose
+        history only needs the latest entry (the train observer's
+        ``train_progress`` events)."""
+        if old is not None:
+            for i in range(len(self._events) - 1, -1, -1):
+                if self._events[i] is old:
+                    del self._events[i]
+                    break
+        return self.record(event, **fields)
+
     @property
     def events(self) -> List[Dict[str, Any]]:
         return list(self._events)

@@ -59,6 +59,11 @@ class StepMetrics(NamedTuple):
     lr: jnp.ndarray
     loss_scale: jnp.ndarray
     skipped: jnp.ndarray       # bool: overflow-skipped step (fp16)
+    # bool: loss/grad-norm went non-finite — reduced IN-PROGRAM (two
+    # isfinite ops on already-computed scalars, no callbacks) so the
+    # anomaly sentinel (telemetry/train.py) reads a ready flag instead
+    # of re-deriving it host-side; None on legacy metrics constructors
+    nonfinite: Any = None
 
 
 LossFn = Callable[..., Any]    # (params, batch, rng) -> loss | (loss, aux)
@@ -180,6 +185,13 @@ class Engine:
         if config.flops_profiler.enabled:
             from ..profiling.flops_profiler import FlopsProfiler
             self.flops_profiler = FlopsProfiler(self, config.flops_profiler)
+        # training observatory (telemetry/train.py, docs/observability.md
+        # "Training observatory"): step-time attribution + goodput ledger
+        # + anomaly sentinel at the existing host boundaries below.
+        # DSTPU_TRAIN_OBS=0 (or DSTPU_TELEMETRY=0) leaves this None and
+        # train_batch on its exact pre-observer path.
+        from ..telemetry.train import train_observer
+        self._train_obs = train_observer(self)
 
         # ZeRO-Offload mode: the optimizer STEP runs on the host CPU — fp32
         # master params + moments never enter HBM (reference stage_1_and_2
@@ -581,7 +593,9 @@ class Engine:
             metrics = StepMetrics(
                 loss=mean_loss, grad_norm=grad_norm, lr=lr,
                 loss_scale=state.scale_state.scale,
-                skipped=jnp.logical_not(finite))
+                skipped=jnp.logical_not(finite),
+                nonfinite=jnp.logical_not(
+                    jnp.isfinite(mean_loss) & jnp.isfinite(grad_norm)))
             new_state = TrainState(step=new_step, params=new_params,
                                    opt_state=new_opt_state,
                                    scale_state=new_scale, rng=new_rng,
@@ -786,26 +800,47 @@ class Engine:
 
     def train_batch(self, batch: Any) -> jnp.ndarray:
         """Run one full global step (micro_batch × GAS samples) and return the
-        mean loss. The one-call equivalent of forward+backward+step."""
-        self.tput_timer.start()
-        self.timers(TRAIN_BATCH_TIMER).start()
-        expected = self.config.train_batch_size
-        lead = jax.tree_util.tree_leaves(batch)[0].shape[0]
-        if lead != expected:
-            raise ConfigError(
-                f"train_batch expects leading dim == train_batch_size ({expected}), got {lead}")
+        mean loss. The one-call equivalent of forward+backward+step.
 
-        from ..resilience.fault_injection import get_fault_injector
-        get_fault_injector().maybe_fire("step", step=self.global_steps)
-        if self._watchdog is not None:
-            self._watchdog.step_start(self.global_steps)
+        With the training observatory attached (``self._train_obs``,
+        DSTPU_TRAIN_OBS) the step's wall clock decomposes at the
+        EXISTING host boundaries below into data_wait / stage /
+        dispatch / device_execute / commit_apply / host_gap
+        (docs/observability.md "Training observatory"); the kill switch
+        restores this exact path minus the observer calls."""
+        obs = self._train_obs
+        if obs is not None:
+            obs.on_step_enter()
+        try:
+            self.tput_timer.start()
+            self.timers(TRAIN_BATCH_TIMER).start()
+            expected = self.config.train_batch_size
+            lead = jax.tree_util.tree_leaves(batch)[0].shape[0]
+            if lead != expected:
+                raise ConfigError(
+                    f"train_batch expects leading dim == train_batch_size ({expected}), got {lead}")
 
-        if self.flops_profiler is not None:
-            self.flops_profiler.maybe_start(self.global_steps, batch)
-        self._ensure_opt_state_resident()
-        self._ensure_params_resident()
-        if self._watchdog is not None:
-            self._watchdog.phase("compiled_step")
+            from ..resilience.fault_injection import get_fault_injector
+            get_fault_injector().maybe_fire("step", step=self.global_steps)
+            if self._watchdog is not None:
+                self._watchdog.step_start(self.global_steps)
+
+            if self.flops_profiler is not None:
+                self.flops_profiler.maybe_start(self.global_steps, batch)
+            self._ensure_opt_state_resident()
+            self._ensure_params_resident()
+            if self._watchdog is not None:
+                self._watchdog.phase("compiled_step")
+        except BaseException:
+            # a pre-dispatch failure (validation, injector fire, swap-in
+            # error) aborts the observed step too: a leaked anchor would
+            # file the caller's whole recovery as the next step's
+            # data_wait — and could read as a bogus stall
+            if obs is not None:
+                obs.on_step_abort()
+            raise
+        if obs is not None:
+            obs.on_staged()
         try:
             self.state, metrics = self._train_step(self.state, batch)
         except BaseException:
@@ -814,47 +849,92 @@ class Engine:
             # process after the caller recovered)
             if self._watchdog is not None:
                 self._watchdog.step_abort()
+            if obs is not None:
+                obs.on_step_abort()
             raise
-        if self._stream_params:
-            # re-park streamed leaves in pinned_host (inferred out
-            # placements land them on device after the update)
-            self.state = self._place_state(self.state)
-        self._evict_opt_state()
-        self._last_metrics = metrics
+        if obs is not None:
+            obs.on_dispatched()
+            if obs.sync:
+                try:
+                    # the observer's ONE sanctioned blocking site: the
+                    # exposed device wait IS the device_execute
+                    # component (it subsumes the sync the watchdog/
+                    # _maybe_log pay below — their later blocks then
+                    # cost ~0). DSTPU_TRAIN_OBS_SYNC=0 skips it for
+                    # TPU loops that rely on dispatch-ahead overlap
+                    # (device_execute then reads ~0; the sentinel lags
+                    # one step)
+                    # dslint: allow(DSL001): the device_execute bracket
+                    # is the deliberate readback the attribution layer
+                    # measures
+                    jax.block_until_ready(metrics.loss)
+                except BaseException:
+                    if self._watchdog is not None:
+                        self._watchdog.step_abort()  # deferred XLA error
+                    obs.on_step_abort()
+                    raise
+            obs.on_device_done()
+        try:
+            if self._stream_params:
+                # re-park streamed leaves in pinned_host (inferred out
+                # placements land them on device after the update)
+                self.state = self._place_state(self.state)
+            self._evict_opt_state()
+            self._last_metrics = metrics
 
-        self.global_steps += 1
-        self.global_samples += expected
-        if self.compression_scheduler is not None and \
-                self.compression_scheduler.pending():
-            # state.step is the gate the compiled transform sees, but reading
-            # it would block on the device every step (and a technique whose
-            # offset is never reached would keep that sync alive for the whole
-            # run). global_steps is its host-side upper bound — they differ
-            # only by overflow-skipped steps (rare, fp16 warmup), so the
-            # announcement log may fire a few steps early; the compiled
-            # gating itself is unaffected.
-            self.compression_scheduler.check(self.global_steps)
-        self.timers(TRAIN_BATCH_TIMER).stop(barrier_value=metrics.loss)
-        self.tput_timer.stop(global_step=True, report_speed=True)
-        self._maybe_log(metrics)
-        if self.flops_profiler is not None:
-            # before param eviction: the profiler counts param elements
-            self.flops_profiler.maybe_stop(self.global_steps, metrics)
-        self._evict_params()
-        if self._watchdog is not None:
-            # step_end blocks on the loss so the recorded duration is the
-            # TRUE step time, not async dispatch time (and a hung step
-            # parks us here — exactly where the watchdog is watching)
-            try:
-                jax.block_until_ready(metrics.loss)
-            except BaseException:
-                self._watchdog.step_abort()   # deferred XLA error
-                raise
-            self._watchdog.step_end(self.global_steps)
+            self.global_steps += 1
+            self.global_samples += expected
+            if self.compression_scheduler is not None and \
+                    self.compression_scheduler.pending():
+                # state.step is the gate the compiled transform sees, but
+                # reading it would block on the device every step (and a
+                # technique whose offset is never reached would keep that
+                # sync alive for the whole run). global_steps is its
+                # host-side upper bound — they differ only by
+                # overflow-skipped steps (rare, fp16 warmup), so the
+                # announcement log may fire a few steps early; the
+                # compiled gating itself is unaffected.
+                self.compression_scheduler.check(self.global_steps)
+            self.timers(TRAIN_BATCH_TIMER).stop(barrier_value=metrics.loss)
+            self.tput_timer.stop(global_step=True, report_speed=True)
+            self._maybe_log(metrics)
+            if self.flops_profiler is not None:
+                # before param eviction: the profiler counts param elements
+                self.flops_profiler.maybe_stop(self.global_steps, metrics)
+            self._evict_params()
+            if self._watchdog is not None:
+                # step_end blocks on the loss so the recorded duration is
+                # the TRUE step time, not async dispatch time (and a hung
+                # step parks us here — exactly where the watchdog is
+                # watching)
+                try:
+                    # dslint: allow(DSL001): the watchdog's sanctioned
+                    # blocking site (free when the observer already
+                    # blocked)
+                    jax.block_until_ready(metrics.loss)
+                except BaseException:
+                    self._watchdog.step_abort()   # deferred XLA error
+                    raise
+                self._watchdog.step_end(self.global_steps)
+        except BaseException:
+            # commit-apply failures — a deferred XLA error surfacing at
+            # the blocking timer/watchdog/log reads (the FIRST blocking
+            # point when DSTPU_TRAIN_OBS_SYNC=0), monitor IO — abort
+            # the observed step too: same leaked-anchor rule as the
+            # pre-dispatch handler above
+            if obs is not None:
+                obs.on_step_abort()
+            raise
+        if obs is not None:
+            # closes the books: commit_apply tail + host_gap closure +
+            # the anomaly sentinel's readbacks (values ready)
+            obs.on_step_exit(self.global_steps, metrics,
+                             samples=expected)
         self._maybe_handle_preemption()
         return metrics.loss
 
     def eval_batch(self, batch: Any, rng: Optional[jax.Array] = None):
+        t0 = time.perf_counter()
         if rng is None:
             rng = jax.random.PRNGKey(0)
         self._ensure_params_resident()
@@ -864,6 +944,11 @@ class Engine:
                 if self._cpu_opt_mode else self.state.step)
         out = self._eval_step(params, batch, rng, step)
         self._evict_params()     # XLA keeps the buffers alive for `out`
+        if self._train_obs is not None:
+            # engine-bracketed between-step work: rides the next step's
+            # commit_apply instead of reading as data_wait (and a long
+            # validation sweep can never trip a bogus train_stall)
+            self._train_obs.on_between(time.perf_counter() - t0)
         return out
 
     # --- forward/backward/step trio (API parity) ----------------------- #
@@ -997,12 +1082,19 @@ class Engine:
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[dict] = None, save_latest: bool = True):
         from ..checkpoint.engine_checkpoint import save_checkpoint as _save
+        t0 = time.time()
         self._ensure_opt_state_resident()
         self._ensure_params_resident()
         out = _save(self, save_dir, tag=tag, client_state=client_state,
                     save_latest=save_latest)
         self._evict_params()
         self._evict_opt_state()
+        if self._train_obs is not None:
+            # stamped checkpoint_save interval: the goodput ledger's
+            # save-tax bucket, and the save rides the next step's
+            # commit_apply instead of reading as data_wait
+            self._train_obs.on_checkpoint(t0, time.time(),
+                                          self.global_steps, save_dir)
         return out
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
@@ -1010,6 +1102,7 @@ class Engine:
                         load_lr_scheduler_states: bool = True,
                         load_module_only: bool = False):
         from ..checkpoint.engine_checkpoint import load_checkpoint as _load
+        t0 = time.time()
         self._ensure_opt_state_resident()
         self._ensure_params_resident()
         out = _load(self, load_dir, tag=tag,
@@ -1026,4 +1119,9 @@ class Engine:
         self._evict_params()
         if self._cpu_opt_mode:
             self._refresh_device_params()
+        if self._train_obs is not None and out is not None:
+            # resume marker: with a step > 0 this opens the goodput
+            # ledger's replay_catchup span (closed by train_caught_up)
+            self._train_obs.on_resume(t0, time.time(),
+                                      self.global_steps, load_dir)
         return out

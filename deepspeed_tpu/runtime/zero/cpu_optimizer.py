@@ -161,7 +161,10 @@ def build_cpu_optimizer_step(engine):
         lr = jnp.asarray(engine.lr_schedule(state.step), jnp.float32)
         metrics = StepMetrics(loss=loss, grad_norm=grad_norm, lr=lr,
                               loss_scale=new_scale.scale,
-                              skipped=jnp.logical_not(finite))
+                              skipped=jnp.logical_not(finite),
+                              nonfinite=jnp.logical_not(
+                                  jnp.isfinite(loss)
+                                  & jnp.isfinite(grad_norm)))
         new_state = TrainState(step=new_step, params=new_master,
                                opt_state=new_opt, scale_state=new_scale,
                                rng=jax.device_put(new_rng, cpu),

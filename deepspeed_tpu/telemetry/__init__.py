@@ -13,11 +13,15 @@ Kill switch: ``DSTPU_TELEMETRY=0`` — every registry call becomes a
 shared no-op and the serve engine skips instrumentation entirely.
 """
 
-from .attribution import (ATTRIBUTION_COMPONENTS, attribution_report,
-                          comm_share, component_totals)
+from .attribution import (ATTRIBUTION_COMPONENTS,
+                          TRAIN_ATTRIBUTION_COMPONENTS,
+                          attribution_report, comm_share,
+                          component_totals, train_attribution_report)
 from .flight_recorder import (FlightRecorder, auto_dump, flight_dir,
                               merge_chrome_traces, register_recorder,
                               request_tracks)
+from .goodput import (goodput_from_ledgers, goodput_report,
+                      load_ledger_events)
 from .loadgen import (LoadResult, PoissonArrivals, Request,
                       TraceArrivals, UniformArrivals, WorkloadMix,
                       build_requests, run_open_loop, sweep_capacity)
@@ -29,18 +33,23 @@ from .registry import (COMM_CANONICAL_KINDS, REGISTERED_METRICS, Counter,
                        telemetry_enabled)
 from .serve import ServeObserver, serve_observer
 from .trace import annotate, maybe_trace, trace_dir
+from .train import (TrainObserver, train_comm_share, train_observer,
+                    train_skew_report)
 
 __all__ = [
     "ATTRIBUTION_COMPONENTS", "COMM_CANONICAL_KINDS", "Counter",
     "FlightRecorder", "Gauge", "Histogram", "LoadResult",
     "MetricsRegistry", "MonitorBridge", "NullRegistry",
     "PoissonArrivals", "REGISTERED_METRICS", "Request", "ServeObserver",
-    "TraceArrivals", "UniformArrivals", "WorkloadMix", "annotate",
+    "TRAIN_ATTRIBUTION_COMPONENTS", "TraceArrivals", "TrainObserver",
+    "UniformArrivals", "WorkloadMix", "annotate",
     "attach_monitor", "attribution_report", "auto_dump",
     "build_requests", "comm_counter", "comm_share", "component_totals",
-    "flight_dir", "get_registry", "maybe_trace", "merge_chrome_traces",
-    "merge_snapshots", "new_registry", "record_phase_tflops",
-    "register_recorder", "request_tracks", "run_open_loop",
-    "serve_observer", "set_registry", "sweep_capacity",
-    "telemetry_enabled", "trace_dir",
+    "flight_dir", "get_registry", "goodput_from_ledgers",
+    "goodput_report", "load_ledger_events", "maybe_trace",
+    "merge_chrome_traces", "merge_snapshots", "new_registry",
+    "record_phase_tflops", "register_recorder", "request_tracks",
+    "run_open_loop", "serve_observer", "set_registry", "sweep_capacity",
+    "telemetry_enabled", "trace_dir", "train_attribution_report",
+    "train_comm_share", "train_observer", "train_skew_report",
 ]

@@ -68,6 +68,26 @@ ATTRIBUTION_COMPONENTS = (
 STEP_WALL_COMPONENTS = ("plan", "dispatch", "device_execute",
                         "commit_apply", "host_gap")
 
+#: the TRAIN-side partition (telemetry/train.py,
+#: docs/observability.md "Training observatory"): one committed
+#: train_batch's wall clock — the interval between step-exit
+#: boundaries — decomposes into these six, host_gap again the closure
+#: of the sum. data_wait is the between-step span (the caller's data
+#: fetch), checkpoint saves between steps ride commit_apply.
+TRAIN_ATTRIBUTION_COMPONENTS = (
+    ("data_wait", "train_data_wait_s"),
+    ("stage", "train_stage_s"),
+    ("dispatch", "train_dispatch_s"),
+    ("device_execute", "train_device_execute_s"),
+    ("commit_apply", "train_commit_apply_s"),
+    ("host_gap", "train_host_gap_s"),
+)
+
+TRAIN_STEP_WALL_COMPONENTS = tuple(c for c, _ in
+                                   TRAIN_ATTRIBUTION_COMPONENTS)
+
+TRAIN_WALL_HIST = "train_step_wall_s"
+
 
 def _hist_sums(snap: Mapping[str, Any]) -> Dict[str, float]:
     """{histogram name: sum seconds} from a registry snapshot (the
@@ -80,39 +100,48 @@ def _hist_sums(snap: Mapping[str, Any]) -> Dict[str, float]:
 
 
 def component_totals(snap: Mapping[str, Any],
-                     prev: Optional[Mapping[str, Any]] = None
+                     prev: Optional[Mapping[str, Any]] = None,
+                     components: Any = ATTRIBUTION_COMPONENTS
                      ) -> Dict[str, float]:
     """Per-component attributed seconds from a snapshot — deltas against
     ``prev`` when given (the measured-window discipline every bench
-    sibling uses: warm-up must not pollute the gated numbers)."""
+    sibling uses: warm-up must not pollute the gated numbers).
+    ``components`` selects the partition (serve default;
+    :data:`TRAIN_ATTRIBUTION_COMPONENTS` for the train observer)."""
     cur = _hist_sums(snap)
     old = _hist_sums(prev) if prev is not None else {}
     return {comp: max(0.0, cur.get(h, 0.0) - old.get(h, 0.0))
-            for comp, h in ATTRIBUTION_COMPONENTS}
+            for comp, h in components}
 
 
 def step_wall_total(snap: Mapping[str, Any],
-                    prev: Optional[Mapping[str, Any]] = None) -> float:
+                    prev: Optional[Mapping[str, Any]] = None,
+                    wall_hist: str = "serve_step_wall_s") -> float:
     """Total step wall-clock seconds the observer accounted
-    (``serve_step_wall_s`` sum, optionally delta'd)."""
-    cur = _hist_sums(snap).get("serve_step_wall_s", 0.0)
-    old = _hist_sums(prev).get("serve_step_wall_s", 0.0) \
+    (``serve_step_wall_s`` / ``train_step_wall_s`` sum, optionally
+    delta'd)."""
+    cur = _hist_sums(snap).get(wall_hist, 0.0)
+    old = _hist_sums(prev).get(wall_hist, 0.0) \
         if prev is not None else 0.0
     return max(0.0, cur - old)
 
 
 def attribution_report(snap: Mapping[str, Any],
-                       prev: Optional[Mapping[str, Any]] = None
+                       prev: Optional[Mapping[str, Any]] = None,
+                       components: Any = ATTRIBUTION_COMPONENTS,
+                       wall_components: Any = STEP_WALL_COMPONENTS,
+                       wall_hist: str = "serve_step_wall_s"
                        ) -> Dict[str, Any]:
     """The attribution summary over a snapshot (or a window between two
     snapshots): per-component seconds and fractions of the step wall,
     the dominant component, and the closure error
-    (``|wall − Σ components| / wall`` — the quantity the serve_attrib
-    bench gates; a large residual means a new unbracketed code path
-    crept into the loop)."""
-    comps = component_totals(snap, prev)
-    wall = step_wall_total(snap, prev)
-    step_sum = sum(comps[c] for c in STEP_WALL_COMPONENTS)
+    (``|wall − Σ components| / wall`` — the quantity the serve_attrib /
+    train_obs benches gate; a large residual means a new unbracketed
+    code path crept into the loop). Defaults cover the serve partition;
+    pass the TRAIN_* tables for the train observer."""
+    comps = component_totals(snap, prev, components=components)
+    wall = step_wall_total(snap, prev, wall_hist=wall_hist)
+    step_sum = sum(comps[c] for c in wall_components)
     denom = wall if wall > 0 else step_sum
     out: Dict[str, Any] = {
         "components_s": {c: round(v, 6) for c, v in comps.items()},
@@ -121,14 +150,42 @@ def attribution_report(snap: Mapping[str, Any],
         "closure_err_frac": round(abs(wall - step_sum) / denom, 6)
         if denom > 0 else None,
         "fracs": {c: round(comps[c] / denom, 4) if denom > 0 else None
-                  for c in STEP_WALL_COMPONENTS},
+                  for c in wall_components},
     }
     if denom > 0:
-        out["dominant"] = max(STEP_WALL_COMPONENTS,
+        out["dominant"] = max(wall_components,
                               key=lambda c: comps[c])
     else:
         out["dominant"] = None
     return out
+
+
+def train_attribution_report(snap: Mapping[str, Any],
+                             prev: Optional[Mapping[str, Any]] = None
+                             ) -> Dict[str, Any]:
+    """:func:`attribution_report` over the train observer's partition."""
+    return attribution_report(
+        snap, prev, components=TRAIN_ATTRIBUTION_COMPONENTS,
+        wall_components=TRAIN_STEP_WALL_COMPONENTS,
+        wall_hist=TRAIN_WALL_HIST)
+
+
+def share_from_report(rep: Any, program: str) -> Dict[str, Any]:
+    """The comm-op share dict from one trip-weighted
+    :class:`~..analysis.program_audit.ProgramReport` — the ONE copy of
+    the arithmetic :func:`comm_share` (serve) and
+    ``telemetry.train.train_comm_share`` share."""
+    coll = rep.total_collectives
+    dots = rep.dot_generals
+    return {
+        "program": program,
+        "collectives_per_step": coll,
+        "by_kind": dict(sorted(rep.by_kind().items())),
+        "dot_generals_per_step": dots,
+        "comm_op_share": round(coll / (coll + dots), 4)
+        if coll + dots else 0.0,
+        "host_callbacks": rep.host_callbacks,
+    }
 
 
 def comm_share(engine, program: str = "step_greedy_fb"
@@ -149,14 +206,4 @@ def comm_share(engine, program: str = "step_greedy_fb"
     rep = reports.get(program)
     if rep is None:
         return None
-    coll = rep.total_collectives
-    dots = rep.dot_generals
-    return {
-        "program": program,
-        "collectives_per_step": coll,
-        "by_kind": dict(sorted(rep.by_kind().items())),
-        "dot_generals_per_step": dots,
-        "comm_op_share": round(coll / (coll + dots), 4)
-        if coll + dots else 0.0,
-        "host_callbacks": rep.host_callbacks,
-    }
+    return share_from_report(rep, program)

@@ -1,0 +1,303 @@
+"""Goodput ledger — where a training run's WALL CLOCK went.
+
+The restart ledger (``resilience/ledger.py``) records what happened
+(launches, crashes, checkpoints); this module integrates those events
+into an exact partition of the run's wall clock — the ETTR-style number
+("effective training time ratio") a fleet operator actually plans
+around. Every second of ``[t0, t_end]`` lands in exactly ONE bucket:
+
+  * ``productive``      — a worker was up and doing NEW work (steps the
+    run had never durably reached before);
+  * ``checkpoint_save`` — inside a ``checkpoint_save`` event's
+    ``[t_start, t_end]`` interval (the save tax);
+  * ``restart_lost``    — downtime between worker runs PLUS the tail of
+    a CRASHED run after its last durable checkpoint: that compute was
+    discarded, so it buys nothing (a cooperative drain writes an urgent
+    checkpoint first and loses ~nothing);
+  * ``replay_catchup``  — after a restart, the time spent re-running
+    steps the previous incarnation had already attempted (resume →
+    the ``train_caught_up`` marker the train observer records when the
+    step counter passes the prior incarnation's high-water mark);
+  * ``stall``           — inside an explicit ``train_stall`` event
+    interval (the observer records one when a step's wall blows past
+    its rolling median by ``DSTPU_TRAIN_OBS_STALL_FACTOR``).
+
+``buckets sum to total wall EXACTLY by construction`` — the partition is
+a boundary sweep over labelled intervals with a fixed priority
+(checkpoint_save > stall > replay_catchup > productive inside worker
+time; everything outside worker time is restart_lost), not five
+independent estimators. ``train_goodput_frac = productive / total``.
+
+Event sources merge freely (:func:`load_ledger_events`): the elastic
+agent's supervisor ledger (``DSTPU_RESTART_LEDGER`` — launch / restart /
+success / drained, now carrying ``t_start``/``t_end``) and the train
+observer's own ledger (``DSTPU_TRAIN_LEDGER`` — train_start /
+checkpoint_save / train_resume / train_progress / train_caught_up /
+train_stall). Old ledgers (pre-stamp events carrying only ``time`` and
+``runtime_s``) stay readable — stamps are reconstructed from those
+fields.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: events that OPEN a worker-up interval
+_OPENERS = ("launch", "train_start")
+#: events that CLOSE a worker-up interval (the agent records them at
+#: worker exit); ``crashed`` tells the sweep whether the tail after the
+#: last durable checkpoint was discarded
+_TERMINALS = {
+    "success": False,
+    "drained": False,          # cooperative: urgent checkpoint landed
+    "restart": True,           # crash OR membership change (flag below)
+    "giveup": True,
+}
+
+#: the bucket names, in report order
+BUCKETS = ("productive", "checkpoint_save", "restart_lost",
+           "replay_catchup", "stall")
+
+
+def _t_start(e: Dict[str, Any]) -> Optional[float]:
+    """Interval start of an event: explicit ``t_start``, else
+    reconstructed from the legacy ``time``/``runtime_s`` pair, else the
+    instant ``time``."""
+    if e.get("t_start") is not None:
+        return float(e["t_start"])
+    t = e.get("time")
+    if t is None:
+        return None
+    if e.get("runtime_s") is not None:
+        return float(t) - float(e["runtime_s"])
+    return float(t)
+
+
+def _t_end(e: Dict[str, Any]) -> Optional[float]:
+    if e.get("t_end") is not None:
+        return float(e["t_end"])
+    t = e.get("time")
+    return float(t) if t is not None else None
+
+
+def _is_crash(e: Dict[str, Any]) -> bool:
+    kind = e.get("event")
+    if kind == "restart":
+        # a membership-change exit checkpointed cooperatively first
+        return not bool(e.get("membership_change"))
+    return bool(_TERMINALS.get(kind, False))
+
+
+def load_ledger_events(paths: Sequence[Optional[str]]
+                       ) -> List[Dict[str, Any]]:
+    """Merge the events of several restart-ledger JSON files (missing /
+    unreadable paths are skipped), sorted by event time — the agent's
+    supervisor ledger and the train observer's ledger combine into one
+    timeline this way."""
+    events: List[Dict[str, Any]] = []
+    for path in paths:
+        if not path or not os.path.exists(path):
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                events.extend(json.load(f).get("events", []))
+        except (OSError, ValueError):
+            continue
+    events.sort(key=lambda e: e.get("time", _t_start(e) or 0.0))
+    return events
+
+
+def _worker_intervals(events: Sequence[Dict[str, Any]],
+                      t_end: float) -> List[Tuple[float, float, bool]]:
+    """(start, end, crashed) worker-up intervals from opener/terminal
+    events. An opener while another interval is still open (two
+    incarnations writing one observer ledger with no supervisor in
+    between) closes the previous interval at its last recorded
+    activity — a process that died silently must not count its
+    post-mortem gap as up-time."""
+    out: List[Tuple[float, float, bool]] = []
+    open_start: Optional[float] = None
+    open_kind: Optional[str] = None
+    last_activity: Optional[float] = None
+    for e in events:
+        kind = e.get("event")
+        ts = _t_start(e)
+        if ts is None:
+            continue
+        if kind in _OPENERS:
+            if open_start is not None:
+                if kind == "train_start" and open_kind == "launch":
+                    # the supervisor's launch already covers this
+                    # incarnation — the observer's own start marker is
+                    # activity inside it, not a second opener (a split
+                    # here would misfile the engine-build span between
+                    # launch and observer attach as downtime)
+                    last_activity = max(last_activity or ts, ts)
+                    continue
+                close = max(open_start, last_activity
+                            if last_activity is not None else open_start)
+                out.append((open_start, min(close, ts), True))
+            open_start = ts
+            open_kind = kind
+            last_activity = ts
+        elif kind in _TERMINALS:
+            te = _t_end(e)
+            start = open_start if open_start is not None else ts
+            if te is not None:
+                out.append((start, max(start, te), _is_crash(e)))
+            open_start = None
+            last_activity = None
+        else:
+            te = _t_end(e)
+            if te is not None:
+                last_activity = max(last_activity or te, te)
+    if open_start is not None:        # still running at report time
+        out.append((open_start, max(open_start, t_end), False))
+    return out
+
+
+def _clip(a0: float, a1: float, b0: float, b1: float
+          ) -> Optional[Tuple[float, float]]:
+    lo, hi = max(a0, b0), min(a1, b1)
+    return (lo, hi) if hi > lo else None
+
+
+def _coverage(segments: List[Tuple[float, float]],
+              intervals: List[Tuple[float, float]]) -> List[bool]:
+    """Per-segment "covered by any interval" via an active-count sweep
+    — O((n+m) log(n+m)) instead of per-segment interval scans, which
+    went quadratic on month-long checkpoint histories. Segments are
+    sorted and non-overlapping, and every interval endpoint is also a
+    segment boundary, so a segment midpoint never sits on an endpoint:
+    processing boundary events ``<= mid`` reproduces the half-open
+    ``s <= mid < e`` membership exactly."""
+    bounds: List[Tuple[float, int]] = []
+    for s, e in intervals:
+        bounds.append((s, 1))
+        bounds.append((e, -1))
+    bounds.sort()
+    out: List[bool] = []
+    i = 0
+    active = 0
+    for a, b in segments:
+        mid = (a + b) / 2.0
+        while i < len(bounds) and bounds[i][0] <= mid:
+            active += bounds[i][1]
+            i += 1
+        out.append(active > 0)
+    return out
+
+
+def goodput_report(events: Iterable[Dict[str, Any]],
+                   t0: Optional[float] = None,
+                   t_end: Optional[float] = None) -> Dict[str, Any]:
+    """Integrate ledger ``events`` into the exact wall-clock partition
+    described in the module docstring. ``t0``/``t_end`` default to the
+    earliest event start / latest event end; pass ``t_end=time.time()``
+    for a live run. Buckets sum to ``total_wall_s`` exactly."""
+    evs = [e for e in events if isinstance(e, dict) and e.get("event")]
+    # record time orders the opener/terminal state machine correctly
+    # (a terminal's t_start is its LAUNCH time — sorting on that would
+    # hoist it above the run's own checkpoint events)
+    evs.sort(key=lambda e: e["time"] if e.get("time") is not None
+             else (_t_start(e) or 0.0))
+    starts = [t for t in (_t_start(e) for e in evs) if t is not None]
+    ends = [t for t in (_t_end(e) for e in evs) if t is not None]
+    if not starts:
+        return {"total_wall_s": 0.0,
+                "buckets": {b: 0.0 for b in BUCKETS},
+                "train_goodput_frac": None, "worker_runs": 0,
+                "events": 0}
+    lo = min(starts) if t0 is None else float(t0)
+    hi = max(ends + starts) if t_end is None else float(t_end)
+    hi = max(hi, lo)
+    total = hi - lo
+
+    workers = [(max(w0, lo), min(w1, hi), crashed)
+               for w0, w1, crashed in _worker_intervals(evs, hi)
+               if min(w1, hi) > max(w0, lo)]
+
+    # labelled sub-intervals, clipped per worker during the sweep
+    ckpts = [(s, e) for s, e in
+             ((_t_start(ev), _t_end(ev)) for ev in evs
+              if ev.get("event") == "checkpoint_save")
+             if s is not None and e is not None and e > s]
+    stalls = [(s, e) for s, e in
+              ((_t_start(ev), _t_end(ev)) for ev in evs
+               if ev.get("event") in ("train_stall", "stall"))
+              if s is not None and e is not None and e > s]
+
+    buckets = {b: 0.0 for b in BUCKETS}
+    worker_time = sum(w1 - w0 for w0, w1, _ in workers)
+    buckets["restart_lost"] += total - worker_time
+
+    for w0, w1, crashed in workers:
+        # catchup span: worker start -> the caught_up marker (a resume
+        # that never caught up spends its whole incarnation replaying)
+        def _in_window(ev) -> bool:
+            ts = _t_start(ev)
+            # explicit None check: a legitimate stamp of exactly 0.0
+            # (relative-timestamp ledgers) must not read as missing
+            return ts is not None and w0 <= ts <= w1
+
+        caught = [_t_start(ev) for ev in evs
+                  if ev.get("event") == "train_caught_up"
+                  and _in_window(ev)]
+        resumed = any(ev.get("event") == "train_resume"
+                      and int(ev.get("step") or 0) > 0
+                      and _in_window(ev) for ev in evs)
+        catch_hi = min(caught) if caught else (w1 if resumed else w0)
+        # crashed incarnation: everything after the last durable
+        # checkpoint end was discarded — label it restart_lost
+        lost_lo = w1
+        if crashed:
+            durable = [e for s, e in ckpts if w0 <= e <= w1]
+            lost_lo = max(durable) if durable else w0
+        # boundary sweep with fixed priority (active-count coverage —
+        # linearithmic in events, not quadratic)
+        w_ckpts = [iv for iv in (_clip(s, e, w0, w1)
+                                 for s, e in ckpts) if iv]
+        w_stalls = [iv for iv in (_clip(s, e, w0, w1)
+                                  for s, e in stalls) if iv]
+        points = {w0, w1}
+        for s, e in w_ckpts + w_stalls:
+            points.update((s, e))
+        points.update(p for p in (catch_hi, lost_lo) if w0 <= p <= w1)
+        pts = sorted(points)
+        segs = list(zip(pts, pts[1:]))
+        in_ckpt = _coverage(segs, w_ckpts)
+        in_stall = _coverage(segs, w_stalls)
+        for (a, b), ck, st in zip(segs, in_ckpt, in_stall):
+            mid = (a + b) / 2.0
+            if ck:
+                buckets["checkpoint_save"] += b - a
+            elif st:
+                buckets["stall"] += b - a
+            elif crashed and mid >= lost_lo:
+                buckets["restart_lost"] += b - a
+            elif mid < catch_hi:
+                buckets["replay_catchup"] += b - a
+            else:
+                buckets["productive"] += b - a
+
+    return {
+        "t0": lo,
+        "t_end": hi,
+        "total_wall_s": total,
+        "buckets": buckets,
+        "train_goodput_frac": (buckets["productive"] / total)
+        if total > 0 else None,
+        "worker_runs": len(workers),
+        "events": len(evs),
+    }
+
+
+def goodput_from_ledgers(paths: Sequence[Optional[str]],
+                         t_end: Optional[float] = None
+                         ) -> Dict[str, Any]:
+    """:func:`goodput_report` over the merged events of several ledger
+    files — the one-call path the fault drill and ``dstpu_top --train``
+    use."""
+    return goodput_report(load_ledger_events(paths), t_end=t_end)

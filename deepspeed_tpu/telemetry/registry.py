@@ -106,6 +106,24 @@ REGISTERED_METRICS = {
     "achieved_tflops": "achieved TFLOPS for a phase (label: phase)",
     "flops_per_step": "model FLOPs per step for a phase (label: phase)",
     "mxu_utilization": "achieved/peak FLOPs fraction (label: phase)",
+    # -- training observatory (telemetry/train.py) --------------------- #
+    "train_steps": "committed train steps the observer closed",
+    "train_samples": "training samples consumed by committed steps",
+    "train_steps_skipped": "overflow-skipped (fp16) train steps",
+    "train_nonfinite_steps": "steps with non-finite loss/grad-norm",
+    "train_anomalies": "anomaly sentinel trips (nonfinite + z-score)",
+    "train_data_wait_s": "between-step span (caller's data fetch)",
+    "train_stage_s": "per-step staging (validation, arming, swap-in)",
+    "train_dispatch_s": "per-step compiled-step dispatch time",
+    "train_device_execute_s": "per-step exposed device wait at readback",
+    "train_commit_apply_s": "per-step host bookkeeping after readback",
+    "train_host_gap_s": "per-step residual host time between brackets",
+    "train_step_wall_s": "per-committed-step wall between exit boundaries",
+    "train_attrib_seconds_total":
+        "cumulative train attribution seconds (label: component)",
+    "train_loss": "last committed step's mean loss",
+    "train_grad_norm": "last committed step's global grad norm",
+    "train_goodput_frac": "productive fraction of the run's wall clock",
     # -- flight recorder (counter) -------------------------------------- #
     "flight_spans_dropped": "flight-recorder spans evicted by ring wrap",
 }

@@ -96,11 +96,12 @@ _ATTRIB_ROWS = (
 )
 
 
-def _attrib_fracs(hists: Dict[str, Any]):
+def _attrib_fracs(hists: Dict[str, Any], rows=_ATTRIB_ROWS):
     """((label, frac), ...) + dominant label from the component
-    histograms' sums; None before any attributed step."""
+    histograms' sums; None before any attributed step. ``rows`` selects
+    the partition (serve default; _TRAIN_ATTRIB_ROWS for --train)."""
     sums = [(label, float(hists.get(name, {}).get("sum", 0.0)), ch)
-            for label, name, ch, _, _ in _ATTRIB_ROWS]
+            for label, name, ch, _, _ in rows]
     total = sum(s for _, s, _ in sums)
     if total <= 0.0:
         return None
@@ -109,10 +110,10 @@ def _attrib_fracs(hists: Dict[str, Any]):
     return fracs, dominant
 
 
-def _attrib_bar(fracs, width: int = 44) -> str:
+def _attrib_bar(fracs, rows=_ATTRIB_ROWS, width: int = 44) -> str:
     """One-line proportional bar over the step-wall components, each
     component its own fill glyph (legend rides the fraction row)."""
-    chars = {label: ch for label, _, ch, _, _ in _ATTRIB_ROWS}
+    chars = {label: ch for label, _, ch, _, _ in rows}
     out = ""
     for label, f in fracs:
         out += chars[label] * max(1 if f > 0.005 else 0,
@@ -121,15 +122,17 @@ def _attrib_bar(fracs, width: int = 44) -> str:
 
 
 def _attrib_window_dominants(series: Dict[str, Any],
+                             rows=_ATTRIB_ROWS,
+                             counter: str = "serve_attrib_seconds_total",
                              width: int = 32) -> str:
     """Per-sample-window dominant component as a trail of initials (the
-    sampled ``serve_attrib_seconds_total{component=...}`` counter
-    series): one glyph per window, newest right — a drifting dominant
-    (say compute windows giving way to host-gap windows) reads at a
+    sampled ``*_attrib_seconds_total{component=...}`` counter series):
+    one glyph per window, newest right — a drifting dominant (say
+    compute windows giving way to host-gap windows) reads at a
     glance."""
     per_comp = {}
-    for _, _, _, init, comp in _ATTRIB_ROWS:
-        key = f'serve_attrib_seconds_total{{component="{comp}"}}'
+    for _, _, _, init, comp in rows:
+        key = f'{counter}{{component="{comp}"}}'
         pairs = series.get(key, [])
         if pairs:
             # keyed by sample TIMESTAMP: one registry sample() stamps
@@ -233,7 +236,7 @@ def render(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
             f"{name} {_pct(f)}" for name, f in fracs) +
             f"   dominant: {dominant}")
         lines.append("  " + _attrib_bar(fracs))
-        doms = _attrib_window_dominants(series)
+        doms = _attrib_window_dominants(series, _ATTRIB_ROWS)
         if doms:
             lines.append(f"  dominant/window  {doms}  "
                          f"(p=plan d=dispatch x=execute c=apply "
@@ -300,6 +303,149 @@ def render(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
     spark = _sparkline(accs)
     if spark:
         spark_rows.append(f"  {'accept rate':<14}{accs[-1]:9.2f}  {spark}")
+    if spark_rows:
+        lines.append("")
+        lines.append("rates (sampled series)   now  trend")
+        lines.extend(spark_rows)
+    return "\n".join(lines)
+
+
+#: train attribution components in render order (label, histogram,
+#: bar glyph, dominant-trail initial, counter-label component name)
+_TRAIN_ATTRIB_ROWS = (
+    ("data wait", "train_data_wait_s", "░", "w", "data_wait"),
+    ("stage", "train_stage_s", "█", "s", "stage"),
+    ("dispatch", "train_dispatch_s", "▓", "d", "dispatch"),
+    ("execute", "train_device_execute_s", "▒", "x", "device_execute"),
+    ("apply", "train_commit_apply_s", "·", "c", "commit_apply"),
+    ("host gap", "train_host_gap_s", "-", "g", "host_gap"),
+)
+
+
+def render_train(snap: Dict[str, Any],
+                 prev: Optional[Dict[str, Any]] = None,
+                 per_source: Optional[List[Tuple[str, Dict[str, Any]]]]
+                 = None) -> str:
+    """The training-observatory view (``--train``): step counts/rates,
+    loss + grad norm, the step-time attribution bar, roofline gauges,
+    goodput, anomaly counters — and, over several per-host exports, the
+    straggler table + laggard line (docs/observability.md "Training
+    observatory")."""
+    c = snap.get("counters", {})
+    g = snap.get("gauges", {})
+    h = snap.get("histograms", {})
+    series = snap.get("series", {})
+
+    def rate(name: str) -> str:
+        if prev is not None:
+            dt = snap.get("time", 0.0) - prev.get("time", 0.0)
+            if dt > 0:
+                d = c.get(name, 0.0) \
+                    - prev.get("counters", {}).get(name, 0.0)
+                return f"{d / dt:8.2f}"
+        rates = _series_rates(series.get(name, []))
+        return f"{rates[-1]:8.2f}" if rates else "       -"
+
+    def g_vals(name: str, contains: Optional[str] = None) -> List[float]:
+        # a fleet-merged snapshot carries gauges under per-replica
+        # source labels — collect every variant of the base name (the
+        # same scheme render()'s g_sum uses)
+        out = []
+        for k, v in g.items():
+            if k.split("{", 1)[0] != name:
+                continue
+            if contains is not None and "{" in k and contains not in k:
+                continue
+            out.append(v)
+        return out
+
+    def g_mean(name: str) -> Optional[float]:
+        vals = g_vals(name)
+        return sum(vals) / len(vals) if vals else None
+
+    lines: List[str] = []
+    when = time.strftime("%H:%M:%S",
+                         time.localtime(snap.get("time", time.time())))
+    lines.append(f"dstpu_top --train — registry "
+                 f"'{snap.get('registry', '?')}' @ {when}  "
+                 f"(uptime {snap.get('uptime_s', 0.0):.0f}s)")
+    lines.append("")
+    wall = h.get("train_step_wall_s", {})
+    lines.append(
+        f"steps {c.get('train_steps', 0):10.0f}   {rate('train_steps')}"
+        f" steps/s   samples {c.get('train_samples', 0):.0f}   "
+        f"{rate('train_samples')} samples/s")
+    lines.append(
+        f"step wall (ms)   p50 {_ms(wall.get('p50'))}   "
+        f"p99 {_ms(wall.get('p99'))}   max {_ms(wall.get('max'))}")
+    lines.append(
+        f"loss {g_mean('train_loss') or 0.0:14.4f}   grad norm "
+        f"{g_mean('train_grad_norm') or 0.0:.4f}   skipped "
+        f"{c.get('train_steps_skipped', 0):.0f}")
+    # roofline gauges (flops profiler publishes {phase="train"} into
+    # the SAME registry export, so one file carries the whole story;
+    # fleet view: TFLOPS sum across hosts, utilization averaged)
+    tfs = g_vals("achieved_tflops", contains='phase="train"')
+    if tfs:
+        mxus = g_vals("mxu_utilization", contains='phase="train"')
+        lines.append(
+            f"roofline       {sum(tfs):.2f} TFLOPS   mxu "
+            f"{_pct(sum(mxus) / len(mxus) if mxus else None)}")
+    # attribution bar + dominant-per-window trail (shared helpers,
+    # train partition)
+    attrib = _attrib_fracs(h, _TRAIN_ATTRIB_ROWS)
+    if attrib is not None:
+        fracs, dominant = attrib
+        lines.append("")
+        lines.append("step time      " + "  ".join(
+            f"{name} {_pct(f)}" for name, f in fracs)
+            + f"   dominant: {dominant}")
+        lines.append("  " + _attrib_bar(fracs, _TRAIN_ATTRIB_ROWS))
+        doms = _attrib_window_dominants(
+            series, _TRAIN_ATTRIB_ROWS, "train_attrib_seconds_total")
+        if doms:
+            lines.append(f"  dominant/window  {doms}  "
+                         f"(w=data-wait s=stage d=dispatch x=execute "
+                         f"c=apply g=host-gap)")
+    # goodput: fleet view shows the WORST host (the one to fix)
+    gps = g_vals("train_goodput_frac")
+    gp = min(gps) if gps else None
+    lines.append("")
+    lines.append(f"goodput        {_pct(gp)} of wall clock productive"
+                 if gp is not None else
+                 "goodput        - (no ledger events yet)")
+    anomalies = c.get("train_anomalies", 0.0)
+    nonfin = c.get("train_nonfinite_steps", 0.0)
+    if anomalies or nonfin:
+        lines.append(f"ANOMALIES      {anomalies:.0f} sentinel trips "
+                     f"({nonfin:.0f} non-finite steps) — flight dumps "
+                     f"under DSTPU_FLIGHT_DIR")
+    # straggler table over per-host exports
+    if per_source and len(per_source) > 1:
+        from .train import train_skew_report
+        skew = train_skew_report(per_source)
+        lines.append("")
+        lines.append("per-host            steps   step p50(ms)  "
+                     "data-wait p50(ms)  data-wait frac")
+        for src, row in sorted(skew["hosts"].items()):
+            lines.append(
+                f"  {src:<16}{row['steps']:9d}  "
+                f"{_ms(row['step_wall_p50_s'])}       "
+                f"{_ms(row['data_wait_p50_s'])}          "
+                f"{_pct(row['data_wait_frac'])}")
+        if skew["laggard"] is not None:
+            lines.append(
+                f"  straggler: {skew['laggard']} at "
+                f"{_ms(skew['max_step_p50_s'])} ms p50 "
+                f"({skew['step_time_skew']:.2f}x the median host)")
+    # sampled series sparklines
+    spark_rows = []
+    for label, name in (("steps/s", "train_steps"),
+                        ("samples/s", "train_samples")):
+        rates = _series_rates(series.get(name, []))
+        spark = _sparkline(rates)
+        if spark:
+            spark_rows.append(f"  {label:<14}{rates[-1]:9.2f}  {spark}")
     if spark_rows:
         lines.append("")
         lines.append("rates (sampled series)   now  trend")
@@ -429,6 +575,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "$DSTPU_TELEMETRY_EXPORT)")
     ap.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
                     help="refresh every N seconds (0 = one-shot)")
+    ap.add_argument("--train", action="store_true",
+                    help="render the training-observatory view (step "
+                         "rates, attribution bar, goodput, anomaly "
+                         "counters; several per-host exports add the "
+                         "straggler table)")
     ap.add_argument("--merge-trace", metavar="OUT", default=None,
                     help="treat the paths as flight-recorder Chrome-"
                          "trace dumps, merge them onto one fleet "
@@ -456,11 +607,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         try:
             if len(paths) == 1:
                 snap = load_snapshot(paths[0])
-                out = render(snap, prev)
+                out = render_train(snap, prev) if args.train \
+                    else render(snap, prev)
             else:
                 snap, per_source = load_fleet(paths)
-                out = render(snap, prev) + "\n" \
-                    + render_sources(per_source)
+                if args.train:
+                    out = render_train(snap, prev,
+                                       per_source=per_source)
+                else:
+                    out = render(snap, prev) + "\n" \
+                        + render_sources(per_source)
         except (OSError, ValueError) as e:
             print(f"dstpu_top: unreadable snapshot: {e}",
                   file=sys.stderr)

@@ -165,6 +165,45 @@ class TestElasticAgent:
         assert rc == 0
         assert marker.read_text() == "3"
 
+    def test_ledger_events_carry_interval_stamps(self, tmp_path):
+        """Every worker-lifecycle ledger event now carries t_start (and
+        terminal events t_end) so the goodput ledger can integrate
+        intervals, not reconstruct them from runtime_s (ISSUE 15)."""
+        from deepspeed_tpu.elasticity import run_elastic
+        from deepspeed_tpu.telemetry.goodput import goodput_from_ledgers
+        marker = tmp_path / "attempts"
+        ledger = tmp_path / "ledger.json"
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os, sys, time\n"
+            f"p = {str(marker)!r}\n"
+            "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+            "open(p, 'w').write(str(n + 1))\n"
+            "time.sleep(0.05)\n"
+            "sys.exit(0 if n >= 1 else 1)\n")
+        rc = run_elastic([sys.executable, str(script)],
+                         BASE["elasticity"], max_restarts=3,
+                         min_restart_interval_s=0.0, backoff_base_s=0.0,
+                         ledger_path=str(ledger))
+        assert rc == 0
+        events = json.load(open(ledger))["events"]
+        by_kind = {}
+        for e in events:
+            by_kind.setdefault(e["event"], []).append(e)
+        for e in by_kind["launch"]:
+            assert e["t_start"] <= e["time"]
+        for kind in ("restart", "success"):
+            for e in by_kind[kind]:
+                assert e["t_end"] > e["t_start"]
+                assert e["t_end"] - e["t_start"] == pytest.approx(
+                    e["runtime_s"], abs=0.05)
+        # and the goodput ledger integrates them into an exact partition
+        rep = goodput_from_ledgers([str(ledger)])
+        assert rep["worker_runs"] == 2
+        assert abs(sum(rep["buckets"].values())
+                   - rep["total_wall_s"]) < 1e-9
+        assert rep["buckets"]["restart_lost"] > 0   # the crashed run
+
     def test_gives_up_after_max_restarts(self, tmp_path):
         from deepspeed_tpu.elasticity import run_elastic
         script = tmp_path / "worker.py"

@@ -49,6 +49,13 @@ DIRECTIONS: Tuple[Tuple[str, str], ...] = (
     ("*steps_per_sec*", "higher"),
     ("*requests_per_sec*", "higher"),
     ("*tflops*", "higher"),
+    # goodput-ledger BUCKETS (seconds lost) must classify before the
+    # generic "*goodput*" rule below — their dotted paths live under
+    # goodput_drill.* and first-match-wins would invert the gate
+    ("*restart_lost*", "lower"),
+    ("*replay_catchup*", "lower"),
+    ("*stall*", "lower"),
+    ("*checkpoint_save*", "lower"),
     ("*goodput*", "higher"),
     ("*knee*", "higher"),
     ("*speedup*", "higher"),
@@ -61,6 +68,10 @@ DIRECTIONS: Tuple[Tuple[str, str], ...] = (
     ("*overhead*", "lower"),
     ("*exposed*", "lower"),
     ("*closure_err*", "lower"),
+    # training observatory (bench.py train_obs): the data-wait share
+    # and host skew must not creep up
+    ("*data_wait*", "lower"),
+    ("*step_time_skew*", "lower"),
     ("*ttft*", "lower"),
     ("*tpot*", "lower"),
     ("*queue_wait*", "lower"),
@@ -88,6 +99,12 @@ BANDS: Tuple[Tuple[str, float], ...] = (
     ("*queue_wait*", 0.30),
     ("*recovery_s*", 0.50),
     ("*drain_s*", 0.50),
+    # goodput through an injected kill depends on subprocess startup
+    # wall clock — band it like the other drill timings
+    ("*goodput_frac*", 0.25),
+    ("*restart_lost*", 0.50),
+    ("*replay_catchup*", 0.50),
+    ("*checkpoint_save*", 0.50),
 )
 
 DEFAULT_TOLERANCE = 0.10
@@ -98,9 +115,14 @@ DEFAULT_TOLERANCE = 0.10
 #: at least one side clears the floor. ``--min-abs`` overrides.
 DEFAULT_MIN_ABS = 0.02
 
-#: detail keys that are configuration echoes, not metrics
+#: detail keys that are configuration echoes, not metrics.
+#: component_deltas_s is the injection experiments' per-component
+#: diagnostic breakdown — its magnitudes scale with the injection KNOB
+#: (DSTPU_ATTRIB_INJECT_MS / DSTPU_TRAINOBS_STALL_MS), so gating them
+#: would flag deliberate knob changes; the boolean localization gates
+#: (localized_to_*) still gate.
 _SKIP_SUBTREES = ("serve_config", "train_config", "config", "probe",
-                  "detail_flags", "schedule")
+                  "detail_flags", "schedule", "component_deltas_s")
 
 
 def _direction(path: str) -> Optional[str]:

@@ -138,6 +138,22 @@ HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
          "on_reject", "on_abort", "on_flush", "on_spec",
          "on_spec_commit", "on_promote", "phase", "_req_span",
          "_req_event"),
+    # the TRAIN observer's step brackets run inside every train_batch
+    # (ISSUE 15): perf_counter reads, attribute stores and pre-bound
+    # histogram observes only — a device sync here would inflate the
+    # very components the attribution layer measures. The sanctioned
+    # readbacks (the device_execute bracket in engine.train_batch, the
+    # post-block scalar reads in on_step_exit) carry explicit allow
+    # comments naming why they are deliberate.
+    "deepspeed_tpu/telemetry/train.py":
+        ("on_step_enter", "on_staged", "on_dispatched",
+         "on_device_done", "on_step_abort", "on_between",
+         "on_step_exit", "_sentinel", "_finish_step"),
+    # train_batch itself is the engine bracket site: the two
+    # block_until_ready calls (observer device_execute bracket,
+    # watchdog step_end) are the sanctioned blocking sites and carry
+    # allow comments; everything else must stay pure host work
+    "deepspeed_tpu/runtime/engine.py": ("train_batch",),
     "deepspeed_tpu/telemetry/registry.py":
         ("inc", "set", "observe", "quantile", "sample",
          "maybe_sample"),

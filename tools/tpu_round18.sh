@@ -1,0 +1,58 @@
+#!/bin/bash
+# Round-18 on-chip sequence: the training observatory (ISSUE 15). The
+# CPU story is proven in tier-1 (six-component closure vs an external
+# wall, observer on/off bit-identical train state, data-stall
+# localization, goodput-ledger arithmetic + a real agent-supervised
+# kill, straggler merge, anomaly sentinel forensics); on chip this
+# captures (a) lint cleanliness (the TrainObserver DSL001 registry +
+# DSTPU_TRAIN_OBS* knob tables + DSL006 train metric rows), (b) the
+# tpu_smoke train_attrib row — obs on/off loss parity and closure
+# against REAL async dispatch, where device_execute is finally
+# non-zero instead of folded into dispatch, (c) the train_obs bench
+# (overhead/closure/localization/goodput gates at real step times),
+# (d) the elastic-agent goodput drill on its own — the ledger number
+# vs the drill's independent wall-stamp arithmetic, and (e)
+# bench_compare gating this round's capture against the previous one.
+# Strictly sequential (one process owns the chip), no timeouts around
+# TPU clients (a killed client wedges the grant).
+cd /root/repo || exit 1
+LOG=profiles/r18_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round18 start $(date -u +%FT%TZ)"
+FAIL=0
+
+echo "--- [1/5] dstpu_lint (TrainObserver hot-path registry,"
+echo "    DSTPU_TRAIN_OBS* knob + train metric catalog drift)"
+python bin/dstpu_lint deepspeed_tpu || FAIL=1
+
+echo "--- [2/5] tpu_smoke: train_attrib row (on-chip obs on/off loss"
+echo "    parity + six-component closure) + the full kernel sweep"
+python tools/tpu_smoke.py || FAIL=1
+
+echo "--- [3/5] train_obs bench: overhead/closure/data-stall/goodput"
+echo "    gates at real step times"
+python bench.py train_obs > BENCH_TRAINOBS_r18.json || FAIL=1
+tail -c 1600 BENCH_TRAINOBS_r18.json
+
+echo "--- [4/5] elastic-agent goodput drill: a real injected kill,"
+echo "    ledger buckets vs the drill's independent wall arithmetic"
+python bin/dstpu_faultdrill --mode train_goodput || FAIL=1
+
+echo "--- [5/5] bench_compare: gate this round's train_obs capture"
+echo "    against the previous one (tolerance bands; missing phase ="
+echo "    regression)"
+PREV=$(ls BENCH_TRAINOBS_r*.json 2>/dev/null | sort | tail -2 | head -1)
+if [ -n "$PREV" ] && [ "$PREV" != "BENCH_TRAINOBS_r18.json" ]; then
+    python tools/bench_compare.py "$PREV" BENCH_TRAINOBS_r18.json || FAIL=1
+else
+    echo "no prior train_obs capture — baseline round, comparing the"
+    echo "last two serve_attrib captures instead (informational)"
+    mapfile -t ROUNDS < <(ls BENCH_ATTRIB_r*.json 2>/dev/null | sort | tail -2)
+    if [ "${#ROUNDS[@]}" = 2 ]; then
+        python tools/bench_compare.py "${ROUNDS[0]}" "${ROUNDS[1]}" \
+            --allow-missing || FAIL=1
+    fi
+fi
+
+echo "=== tpu_round18 done $(date -u +%FT%TZ) FAIL=$FAIL"
+exit $FAIL

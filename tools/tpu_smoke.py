@@ -507,6 +507,70 @@ def main():
           f"{max(STEP_WALL_COMPONENTS, key=lambda c: comps_a[c])} "
           f"wall={wall_a:.3f}s sum={sum_a:.3f}s", flush=True)
 
+    # TRAIN attribution (ISSUE 15): ON CHIP, the train observer on/off
+    # must be loss-identical over the same batch stream and the six
+    # train components must close against an externally measured window
+    # — against REAL async dispatch (device_execute is only non-zero
+    # here; the CPU harness folds it into dispatch).
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config as _TGC
+    from deepspeed_tpu.models.gpt2 import make_model as _make_model
+    from deepspeed_tpu.telemetry.attribution import (
+        TRAIN_ATTRIBUTION_COMPONENTS, TRAIN_STEP_WALL_COMPONENTS)
+    from deepspeed_tpu.telemetry.attribution import \
+        component_totals as _ct
+
+    tcfg = _TGC(vocab_size=512, max_seq_len=64, num_layers=4,
+                num_heads=4, hidden_size=128, dtype=jnp.bfloat16)
+    _, t_init, t_loss = _make_model(tcfg)
+    rng_t = np.random.RandomState(31)
+    t_batches = [{"tokens": jnp.asarray(
+        rng_t.randint(0, 512, size=(4, 34)), jnp.int32)}
+        for _ in range(16)]
+
+    def _t_engine(obs_on):
+        _os.environ["DSTPU_TRAIN_OBS"] = "1" if obs_on else "0"
+        eng, _, _, _ = dstpu.initialize(
+            loss_fn=t_loss,
+            params=t_init(jax.random.PRNGKey(0), batch_size=4,
+                          seq_len=33),
+            config={"train_micro_batch_size_per_gpu": 4,
+                    "optimizer": {"type": "AdamW",
+                                  "params": {"lr": 1e-3}},
+                    "steps_per_print": 100000})
+        return eng
+
+    prior_t = _os.environ.get("DSTPU_TRAIN_OBS")
+    try:
+        eng_t1 = _t_engine(True)
+        eng_t0 = _t_engine(False)
+        l1 = [float(eng_t1.train_batch(b)) for b in t_batches[:4]]
+        l0 = [float(eng_t0.train_batch(b)) for b in t_batches[:4]]
+        eng_t1._train_obs.reset_anchor()
+        snap_t0 = eng_t1._train_obs.registry.snapshot()
+        t_t0 = _time.perf_counter()
+        for b in t_batches[4:]:
+            tl = eng_t1.train_batch(b)
+        jax.block_until_ready(tl)
+        wall_t = _time.perf_counter() - t_t0
+        comps_t = _ct(eng_t1._train_obs.registry.snapshot(), snap_t0,
+                      components=TRAIN_ATTRIBUTION_COMPONENTS)
+    finally:
+        if prior_t is None:
+            _os.environ.pop("DSTPU_TRAIN_OBS", None)
+        else:
+            _os.environ["DSTPU_TRAIN_OBS"] = prior_t
+    sum_t = sum(comps_t[c] for c in TRAIN_STEP_WALL_COMPONENTS)
+    close_t = abs(wall_t - sum_t) / wall_t if wall_t > 0 else 1.0
+    par_t = l1 == l0 and eng_t0._train_obs is None
+    tsum_ok = close_t <= 0.25
+    ok &= par_t and tsum_ok
+    print(f"{'OK ' if par_t and tsum_ok else 'FAIL'} train_attrib: "
+          f"obs on/off loss_parity={par_t} closure_err={close_t:.3f} "
+          f"dominant="
+          f"{max(TRAIN_STEP_WALL_COMPONENTS, key=lambda c: comps_t[c])}"
+          f" wall={wall_t:.3f}s sum={sum_t:.3f}s", flush=True)
+
     print("TPU_SMOKE " + ("PASS" if ok else "FAIL"), flush=True)
     return 0 if ok else 1
 
