@@ -2028,6 +2028,195 @@ def bench_serve_capacity():
     return 0 if ok else 1
 
 
+def bench_serve_admission():
+    """Overload-robust serving (ISSUE 16): the admission controller on
+    the open-loop door — steady-state cost, kill-switch parity, and a
+    knee-relative spike comparison.
+
+    Phases: (1) warmup + capacity calibration C (saturating arrivals,
+    ``max_live``-pinned so oversubscription churn does not depress the
+    measured ceiling); (2) steady-state A/B at 0.4*C, interleaved
+    unarmed/armed pairs — per-request token streams must be identical
+    with the controller armed vs ``admission=None`` (the
+    DSTPU_ADMISSION=0 path), the armed run must show 0 brownout
+    transitions and 0 fresh compiles (RecompileTripwire), and the
+    armed completed rate must be within 3% of unarmed (best-of-2 per
+    arm, squeezing out scheduler noise); (3) knee sweep, then a 2.5*C
+    spike offered once uncontrolled (max_live hold) and once through
+    the armed door with client retries — the controller must visibly
+    engage and hold goodput at or above the uncontrolled run. The hard
+    absolute spike gates (>= 0.95x knee goodput ON, < 0.85x OFF) live
+    in ``dstpu_faultdrill --mode overload``; this row records the same
+    quantities round-over-round for bench_compare."""
+    import os
+
+    import jax
+
+    from deepspeed_tpu.analysis import RecompileTripwire
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceConfig)
+    from deepspeed_tpu.serving import AdmissionController
+    from deepspeed_tpu.telemetry.loadgen import (PoissonArrivals,
+                                                 SpikeArrivals,
+                                                 WorkloadMix,
+                                                 build_requests,
+                                                 run_open_loop,
+                                                 sweep_capacity)
+
+    on_tpu = jax.default_backend() == "tpu"
+    big = os.environ.get("DSTPU_ADM_MODEL",
+                         "big" if on_tpu else "tiny") == "big"
+    model, mcfg = _serve_llama(big)
+    params = _pseudo_params(model, mcfg)
+    if big:
+        S, PROMPT, GEN, dtype = 64, 128, 48, "bfloat16"
+    else:
+        S, PROMPT, GEN, dtype = 8, 24, 12, "float32"
+    S = int(os.environ.get("DSTPU_ADM_SEQS", str(S)))
+    N_REQ = int(os.environ.get("DSTPU_ADM_REQS", "48"))
+    BURST = int(os.environ.get("DSTPU_ADM_BURST", "6"))
+    bs = 32
+    per_seq = -(-(PROMPT + GEN + 8) // bs)
+    cfg = RaggedInferenceConfig(
+        max_seqs=S, chunk_size=PROMPT, block_size=bs,
+        num_blocks=S * per_seq + 8, max_blocks_per_seq=per_seq + 1,
+        dtype=dtype, attention_impl="paged_flash" if on_tpu else "dense",
+        decode_loop_steps=0, serve_pipeline_depth=2, prefix_cache=True)
+    eng = InferenceEngineV2(mcfg, params, cfg)
+    mix = WorkloadMix(
+        prompt_lens=(PROMPT,), prompt_probs=(1.0,),
+        gen_lens=(GEN,), gen_probs=(1.0,),
+        vocab_size=mcfg.vocab_size)
+
+    # (1) warmup (compiles) + the warm completion ceiling C
+    run_open_loop(eng, build_requests(PoissonArrivals(1e4, seed=80),
+                                      mix, min(N_REQ, 16), seed=80,
+                                      uid_base=80_000_000),
+                  decode_burst=BURST, max_live=S)
+    cal = run_open_loop(eng, build_requests(PoissonArrivals(1e4, seed=81),
+                                            mix, N_REQ, seed=81,
+                                            uid_base=81_000_000),
+                        decode_burst=BURST, max_live=S)
+    cap_rps = cal.report["rates_rps"]["completed"] or 1.0
+
+    # (2) steady-state A/B at 0.4*C: unarmed (admission=None, the
+    # DSTPU_ADMISSION=0 door) vs armed-and-idle. Deadline-free mix so
+    # streams are not truncated at timing-dependent instants; a
+    # generous retry budget lets the rare burst-filled-window
+    # rejection recover, keeping streams comparable
+    def steady(seed, armed, ctrl):
+        reqs = build_requests(PoissonArrivals(0.4 * cap_rps, seed=seed),
+                              mix, N_REQ, seed=82,
+                              uid_base=82_000_000)
+        return run_open_loop(
+            eng, reqs, decode_burst=BURST,
+            max_live=None if armed else S,
+            admission=ctrl if armed else None,
+            retry_budget=8 if armed else 0, retry_base_s=0.02)
+
+    ctrl = AdmissionController(eng, window_s=0.5, tick_s=0.05)
+    tw = RecompileTripwire()
+    runs = {"off": [], "on": []}
+    for i in range(2):
+        runs["off"].append(steady(60 + i, False, None))
+        ctrl.prime()
+        with tw:
+            runs["on"].append(steady(60 + i, True, ctrl))
+    fresh = tw.fresh_compiles if tw.available else 0
+    parity = all(a.streams == b.streams and all(a.streams.values())
+                 for a, b in zip(runs["on"], runs["off"]))
+    trans_steady = sum(r.report.get("admission", {}).get(
+        "transitions", 0) for r in runs["on"])
+    best = {k: max(r.report["rates_rps"]["completed"] or 0.0
+                   for r in v) for k, v in runs.items()}
+    overhead = max(0.0, 1.0 - best["on"] / best["off"]) \
+        if best["off"] else 1.0
+
+    # (3) knee, then the 2.5*C spike off/on. Deadline from the steady
+    # unarmed latency (3x light-load completion estimate), as in
+    # serve_capacity
+    lat = runs["off"][0].report["latency"]["ttft_s"]
+    l99 = (lat.get("p99") or 0.1) + GEN * (
+        runs["off"][0].report["decode"]["step_lat"].get("p50") or 0.01)
+    deadline_s = max(0.2, 3.0 * l99)
+    dmix = WorkloadMix(
+        prompt_lens=(PROMPT,), prompt_probs=(1.0,),
+        gen_lens=(GEN,), gen_probs=(1.0,),
+        deadline_frac=1.0, deadline_s=deadline_s,
+        vocab_size=mcfg.vocab_size)
+    sweep = sweep_capacity(
+        eng, [round(f * cap_rps, 3) for f in (0.5, 0.7, 0.9)], N_REQ,
+        dmix, seed=7, goodput_slo_frac=0.9, decode_burst=BURST,
+        max_live=S)
+    knee_rps = sweep["knee_rps"] or 0.7 * cap_rps
+    knee_goodput_rps = sweep["knee_goodput_rps"] or knee_rps
+    spike_rps = 2.5 * cap_rps
+    start_s, dur_s = 0.5, max(1.0, 3.0 * deadline_s)
+    n_spike = int(knee_rps * (start_s + 0.5) + spike_rps * dur_s)
+    proc = SpikeArrivals(knee_rps, spike_rps / knee_rps, start_s,
+                         dur_s, seed=9)
+    off_res = run_open_loop(
+        eng, build_requests(proc, dmix, n_spike, seed=9,
+                            uid_base=83_000_000),
+        decode_burst=BURST, max_live=S).report
+    sctrl = AdmissionController(eng, window_s=0.5,
+                                qw_slo_s=deadline_s / 4, tick_s=0.05,
+                                hysteresis_s=0.5,
+                                retry_cap_s=deadline_s)
+    for lvl in (3, 0):       # pre-warm the browned-out program shapes
+        sctrl.apply_level(lvl)
+        run_open_loop(eng, build_requests(
+            PoissonArrivals(0.5 * cap_rps, seed=84 + lvl), mix, 8,
+            seed=84 + lvl, uid_base=84_000_000 + lvl * 1000),
+            decode_burst=BURST, max_live=S)
+    sctrl.prime()
+    on_res = run_open_loop(
+        eng, build_requests(proc, dmix, n_spike, seed=9,
+                            uid_base=85_000_000),
+        decode_burst=BURST, admission=sctrl, retry_budget=2,
+        retry_base_s=0.05).report
+    on_g = on_res["rates_rps"]["goodput"] or 0.0
+    off_g = off_res["rates_rps"]["goodput"] or 0.0
+    engaged = (on_res.get("admission", {}).get("transitions", 0) > 0
+               or on_res["requests"]["rejected_admission"] > 0)
+
+    row = {
+        "model": f"llama {mcfg.num_layers}L hidden={mcfg.hidden_size}",
+        "capacity_rps_measured": round(cap_rps, 3),
+        "slo_deadline_s": round(deadline_s, 4),
+        "knee_rps": round(knee_rps, 3),
+        "knee_goodput_rps": round(knee_goodput_rps, 3),
+        "steady_overhead_frac": round(overhead, 4),
+        "steady_transitions": trans_steady,
+        "token_parity_armed_vs_off": parity,
+        "fresh_compiles_armed": fresh,
+        "spike_mult_of_capacity": 2.5,
+        "spike_goodput_rps_on": round(on_g, 3),
+        "spike_goodput_rps_off": round(off_g, 3),
+        "spike_on_frac_of_knee": round(on_g / knee_goodput_rps, 3)
+        if knee_goodput_rps else None,
+        "spike_off_frac_of_knee": round(off_g / knee_goodput_rps, 3)
+        if knee_goodput_rps else None,
+        "spike_rejected_admission":
+            on_res["requests"]["rejected_admission"],
+        "spike_retries": on_res.get("retries", {}),
+        "controller_engaged_spike": engaged,
+        "balance_ok_on": on_res["requests"]["balance_ok"],
+        "balance_ok_off": off_res["requests"]["balance_ok"],
+        "serve_config": {
+            "DSTPU_ADM_MODEL": "big" if big else "tiny",
+            "DSTPU_ADM_SEQS": S, "DSTPU_ADM_REQS": N_REQ,
+            "DSTPU_ADM_BURST": BURST,
+        },
+    }
+    print(json.dumps(row))
+    ok = (parity and trans_steady == 0 and fresh == 0
+          and overhead <= 0.03 and engaged and on_g >= off_g
+          and on_res["requests"]["balance_ok"]
+          and off_res["requests"]["balance_ok"])
+    return 0 if ok else 1
+
+
 def bench_serve_fleet():
     """Replica-pool fleet capacity (ISSUE 11): prove the routing policy
     earns its keep and the fleet scales.
@@ -3106,6 +3295,8 @@ def main():
         return bench_train_obs()
     if sys.argv[1:] == ["serve_capacity"]:
         return bench_serve_capacity()
+    if sys.argv[1:] == ["serve_admission"]:
+        return bench_serve_admission()
     if sys.argv[1:] == ["serve_fleet"]:
         return bench_serve_fleet()
     if sys.argv[1:] == ["serve_spec"]:
@@ -3151,8 +3342,8 @@ def main():
                   "serve_pipeline", "serve_prefix", "serve_hier",
                   "serve_drill", "serve_overlap", "serve_obs",
                   "serve_attrib", "train_obs", "serve_capacity",
-                  "serve_fleet", "serve_spec", "fastgen", "moe",
-                  "moe_train"):
+                  "serve_admission", "serve_fleet", "serve_spec",
+                  "fastgen", "moe", "moe_train"):
         if dead:
             out[phase] = {"error": "skipped_backend_dead"}
             continue
@@ -3227,6 +3418,7 @@ def main():
                    "serve_attrib": out.get("serve_attrib", {}),
                    "train_obs": out.get("train_obs", {}),
                    "serve_capacity": out.get("serve_capacity", {}),
+                   "serve_admission": out.get("serve_admission", {}),
                    "serve_fleet": out.get("serve_fleet", {}),
                    "serve_spec": out.get("serve_spec", {}),
                    "fastgen": out.get("fastgen", {}),

@@ -665,7 +665,13 @@ class InferenceEngineV2:
         """Record a structured rejection (load shed / deadline / drain
         refusal) — the crash-free failure path the serving layer turns
         into a retriable response. Pure host bookkeeping."""
-        rec = {"uid": uid, "reason": reason, "time": time.time(), **fields}
+        # retry_after_s is first-class in the record shape (usually
+        # None; the admission controller's door rejections set it) so
+        # clients can honor a backoff hint without a reason-specific
+        # schema and report readers stay uniform
+        rec = {"uid": uid, "reason": reason, "time": time.time(),
+               "retry_after_s": fields.pop("retry_after_s", None),
+               **fields}
         self.rejections[uid] = rec
         if self._obs is not None:
             seq = self.state.get(uid)

@@ -63,6 +63,13 @@ class StateManager:
         #: the content-addressed block index (None = prefix caching off);
         #: set by the engine, which also attaches it to the kv cache
         self.prefix: Optional[PrefixCache] = None
+        #: scheduler ticks of head start a host->device prefix
+        #: promotion gets before its sequence's next prefill chunk
+        #: (scheduler.py promote-ahead). 1 = the steady-state overlap;
+        #: the admission controller's brownout L1 (defer_promote)
+        #: stretches it so promotions yield ticks to decode chunks —
+        #: token-stream-invariant, it changes only WHEN a chunk runs
+        self.promote_defer_ticks: int = 1
         #: skipped-vs-run prefill accounting for the serve_prefix bench /
         #: smoke rows: matched_tokens never ran a prefill chunk,
         #: prefill_tokens did (scheduler-counted, prompt positions only)
@@ -257,9 +264,10 @@ class StateManager:
             del seq.pending_tokens[:matched]
             self.prefix_stats["matched_tokens"] += matched
         if plan.promotes:
-            # promote-ahead (scheduler.py): give the H2D scatters one
-            # scheduler tick of head start under other sequences' chunks
-            seq.promote_defer = 1
+            # promote-ahead (scheduler.py): give the H2D scatters a
+            # head start under other sequences' chunks (brownout L1
+            # stretches promote_defer_ticks beyond the default 1)
+            seq.promote_defer = self.promote_defer_ticks
         return plan
 
     def register_prefix(self, seq: SequenceDescriptor) -> None:

@@ -30,6 +30,15 @@ site, then prove it recovers.
      every sequence to the full budget, and assert the streams are
      TOKEN-IDENTICAL to the oracle with the block pool fully recovered.
 
+``--mode overload`` (ISSUE 16) drills the admission controller instead
+of a crash site: calibrate this host's capacity rate-relatively, find
+the knee (highest offered rate holding the goodput SLO), then throw a
+2.5x-capacity spike at the engine twice — controller off (must
+collapse below 0.85x knee goodput) and controller on with rational
+retrying clients (must hold >=0.95x, queue-wait p99 inside SLO, retry
+balance closed, ladder engaged, steady state silent). See
+docs/serving.md "Overload control".
+
 Exit 0 only when every site both crashed and recovered. This is the CI
 guard (``bin/dstpu_faultdrill``) that keeps the recovery paths in
 ``checkpoint/``, ``runtime/engine.py`` and ``inference/v2/drain.py``
@@ -69,6 +78,10 @@ FLEET_REQS = 6
 FLEET_LATE_REQS = 2
 FLEET_TOKENS = 8
 FLEET_SITE = "fleet_sigterm"
+
+#: the overload drill's pseudo-site (``--mode overload``): a
+#: 2.5x-capacity traffic spike, admission controller on vs off
+OVERLOAD_SITE = "serve_overload"
 
 
 def _worker() -> int:
@@ -835,6 +848,195 @@ def drill_serve_site(site: str, workdir: str, verbose: bool = True) -> dict:
     return result
 
 
+def _overload_worker() -> int:
+    """The overload drill's worker (subprocess; configured by env): the
+    same engine serves a 2.5x-capacity traffic spike twice — admission
+    controller OFF, then ON — and the gates reproduce ISSUE 16's
+    acceptance criteria:
+
+      1. CAPACITY: a saturating deadline-free burst; the completed rate
+         IS the service capacity C.
+      2. KNEE: ``sweep_capacity`` over 0.5/0.7/0.9 x C on the deadline
+         workload locates the knee (highest offered rate whose goodput
+         fraction still meets the SLO) and its goodput RATE.
+      3. SPIKE x2: the SAME seeded :class:`SpikeArrivals` schedule —
+         knee-rate steady state with a 2.5 x C window — offered once
+         uncontrolled and once through an armed
+         :class:`AdmissionController` with client retries.
+      4. GATES (written to DRILL_RESULT_FILE): controller-on goodput
+         rate >= 0.95 x the knee goodput rate; controller-off collapses
+         below 0.85 x; completed-request queue-wait p99 stays within
+         the deadline on the controlled run; the controller visibly
+         engaged (ladder transitions or door rejections); both reports'
+         outcome breakdowns balance exactly.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ..serving.admission import AdmissionController
+    from ..telemetry.loadgen import (PoissonArrivals, SpikeArrivals,
+                                     WorkloadMix, _tiny_engine,
+                                     build_requests, run_open_loop,
+                                     sweep_capacity)
+
+    eng, mcfg = _tiny_engine(max_seqs=8, num_blocks=96)
+
+    def mk_mix(deadline_s: float = 0.0) -> WorkloadMix:
+        return WorkloadMix(
+            prompt_lens=(16,), prompt_probs=(1.0,),
+            gen_lens=(8,), gen_probs=(1.0,),
+            deadline_frac=1.0 if deadline_s else 0.0,
+            deadline_s=deadline_s, vocab_size=mcfg.vocab_size)
+
+    # 0) warmup: pay the XLA compiles OUTSIDE every timed phase — a
+    # cold capacity pass would measure compile time, not service rate
+    run_open_loop(eng, build_requests(PoissonArrivals(500.0, seed=0),
+                                      mk_mix(), 10, seed=0,
+                                      uid_base=6_000_000))
+
+    # 1) capacity: a saturating 1-second burst, no deadlines — the
+    # completed rate is what the engine can actually serve. Two
+    # passes: the first sizes the second (everything downstream is
+    # rate-RELATIVE, so the drill means the same thing on any host)
+    slots = eng.config.max_seqs
+    est = run_open_loop(eng, build_requests(
+        PoissonArrivals(500.0, seed=1), mk_mix(), 32, seed=1,
+        uid_base=7_000_000), max_live=slots
+    ).report["rates_rps"]["completed"] or 1.0
+    n_cap = max(32, int(2.0 * est))
+    # max_live pins the engine at exactly its slot count: saturated
+    # WITHOUT oversubscription churn, i.e. the peak service rate
+    cap_rps = run_open_loop(eng, build_requests(
+        PoissonArrivals(4.0 * est, seed=11), mk_mix(), n_cap, seed=11,
+        uid_base=7_500_000), max_live=slots
+    ).report["rates_rps"]["completed"] or est
+    # deadline ~8 requests' worth of service time (floored above OS
+    # scheduling noise): generous at the knee, unmeetable once an
+    # uncontrolled queue builds
+    deadline_s = max(0.25, 8.0 / cap_rps)
+    mix = mk_mix(deadline_s)
+
+    # 2) locate the knee on the deadline workload — ~2 s of steady
+    # state at the highest probed rate
+    n_sweep = max(48, int(1.8 * cap_rps))
+    sweep = sweep_capacity(
+        eng, [0.5 * cap_rps, 0.7 * cap_rps, 0.9 * cap_rps], n_sweep,
+        mix, seed=2, goodput_slo_frac=0.9)
+    knee_rps = sweep["knee_rps"]
+    knee_goodput_rps = sweep["knee_goodput_rps"]
+    if knee_rps is None:
+        # no sweep row met the SLO (a very noisy host) — steer by the
+        # best goodput rate observed so the spike still compares on/off
+        best = max(sweep["curve"], key=lambda r: r["goodput_rps"] or 0.0)
+        knee_rps = best["offered_rps"]
+        knee_goodput_rps = best["goodput_rps"] or 1.0
+
+    # 3) the spike: steady state AT the knee, then a 2.5 x capacity
+    # window long enough that the uncontrolled backlog (~1.5 x C x dur
+    # requests, several deadlines deep) cannot hide inside the deadline
+    spike_rps = 2.5 * cap_rps
+    dur_s = max(1.0, 3.0 * deadline_s)
+    start_s = 1.0
+    mult = spike_rps / knee_rps
+    n = int(knee_rps * (start_s + 1.0) + spike_rps * dur_s)
+    proc = SpikeArrivals(knee_rps, mult, start_s, dur_s, seed=3)
+
+    off = run_open_loop(
+        eng, build_requests(proc, mix, n, seed=3, uid_base=8_000_000)
+    ).report
+
+    ctrl = AdmissionController(eng, window_s=0.5,
+                               qw_slo_s=deadline_s / 4, tick_s=0.05,
+                               hysteresis_s=0.5,
+                               retry_cap_s=deadline_s)
+    # pre-warm the browned-out program shapes (halved prefill chunk,
+    # spec off): without this the ladder's first engagement pays a
+    # fresh XLA compile mid-spike, and the compile stall feeds back
+    # into the controller's own queue-wait evidence as phantom overload
+    for lvl in (3, 0):
+        ctrl.apply_level(lvl)
+        run_open_loop(
+            eng,
+            build_requests(PoissonArrivals(est), mk_mix(), 12,
+                           seed=40 + lvl, uid_base=9_900_000 + lvl),
+            max_live=slots)
+    # snapshot past the OFF run's cumulative history: the controller
+    # must steer on ITS run's evidence, not the preceding collapse
+    ctrl.prime()
+    on = run_open_loop(
+        eng, build_requests(proc, mix, n, seed=3, uid_base=9_000_000),
+        admission=ctrl, retry_budget=2, retry_base_s=0.05).report
+
+    on_g = on["rates_rps"]["goodput"] or 0.0
+    off_g = off["rates_rps"]["goodput"] or 0.0
+    qw_p99 = on["latency"]["queue_wait_s"].get("p99")
+    gates = {
+        "on_holds_knee": on_g >= 0.95 * knee_goodput_rps,
+        "off_collapses": off_g < 0.85 * knee_goodput_rps,
+        "qw_p99_within_slo": qw_p99 is not None
+        and qw_p99 <= deadline_s,
+        "controller_engaged": on["admission"]["transitions"] >= 1
+        or on["requests"]["rejected_admission"] > 0,
+        "balance_ok_off": off["requests"]["balance_ok"],
+        "balance_ok_on": on["requests"]["balance_ok"],
+    }
+    result = {
+        "capacity_rps": round(cap_rps, 3),
+        "deadline_s": round(deadline_s, 4),
+        "knee_rps": round(knee_rps, 3),
+        "knee_goodput_rps": round(knee_goodput_rps, 3),
+        "spike": {"base_rps": round(knee_rps, 3),
+                  "spike_rps": round(spike_rps, 3),
+                  "start_s": start_s, "dur_s": round(dur_s, 3),
+                  "requests": n},
+        "off": {"goodput_rps": round(off_g, 3),
+                "requests": off["requests"],
+                "queue_wait_p99_s":
+                off["latency"]["queue_wait_s"].get("p99")},
+        "on": {"goodput_rps": round(on_g, 3),
+               "requests": on["requests"],
+               "queue_wait_p99_s": qw_p99,
+               "retries": on.get("retries"),
+               "admission": on["admission"]},
+        "gates": gates,
+    }
+    with open(os.environ["DRILL_RESULT_FILE"], "w") as f:
+        json.dump(result, f)
+    return 0 if all(gates.values()) else 1
+
+
+def drill_overload(workdir: str, verbose: bool = True) -> dict:
+    """Overload drill: a 2.5x-capacity traffic spike served by the same
+    engine with the admission controller off (must collapse below
+    0.85 x the knee goodput rate) and on (must hold >= 0.95 x with
+    queue-wait p99 inside the deadline) — the ISSUE 16 robustness
+    gate."""
+    site_dir = os.path.join(workdir, "overload")
+    os.makedirs(site_dir, exist_ok=True)
+    result_file = os.path.join(site_dir, "result.json")
+    env = _serve_env(site_dir, "overload", DRILL_RESULT_FILE=result_file)
+    # the worker arms/disarms the controller itself — a caller's kill
+    # switch or tuning knobs must not skew the on-vs-off comparison
+    for k in list(env):
+        if k.startswith("DSTPU_ADMISSION") \
+                and k != "DSTPU_ADMISSION_DEBUG":
+            env.pop(k)
+    rc = _run_worker(env, fn="_overload_worker")
+    result = {"site": OVERLOAD_SITE, "mode": "overload", "rc": rc}
+    if os.path.exists(result_file):
+        with open(result_file) as f:
+            result.update(json.load(f))
+    gates = result.get("gates") or {}
+    result["recovered"] = rc == 0 and bool(gates) \
+        and all(gates.values())
+    if verbose:
+        print(f"[faultdrill:{OVERLOAD_SITE}] rc={rc} "
+              f"knee={result.get('knee_goodput_rps')}rps "
+              f"on={result.get('on', {}).get('goodput_rps')}rps "
+              f"off={result.get('off', {}).get('goodput_rps')}rps "
+              f"gates={gates} recovered={result['recovered']}",
+              file=sys.stderr)
+    return result
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="crash a short CPU train or serve loop at each "
@@ -842,7 +1044,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "non-zero on any unrecovered failure)")
     ap.add_argument("--mode", default="train",
                     choices=("train", "serve", "fleet", "train_goodput",
-                             "all"),
+                             "overload", "all"),
                     help="train: checkpoint-recovery drill (PR 1 sites); "
                          "serve: drain/replay drill (serve sites + "
                          "sigterm); fleet: kill-one-of-N replica-pool "
@@ -850,7 +1052,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "replay + rollup exactness); train_goodput: "
                          "elastic-agent-supervised kill whose goodput "
                          "ledger must match the drill's wall-clock "
-                         "arithmetic (ISSUE 15); all: every mode")
+                         "arithmetic (ISSUE 15); overload: "
+                         "2.5x-capacity spike, admission controller on "
+                         "vs off (ISSUE 16); all: every mode")
     ap.add_argument("--sites", default=None,
                     help="comma-separated site subset (default: every "
                          "site of the selected mode)")
@@ -862,7 +1066,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.sites:
         sites = [s for s in args.sites.split(",") if s]
         valid = set(FAULT_SITES) | {SIGTERM_SITE, FLEET_SITE,
-                                    GOODPUT_SITE}
+                                    GOODPUT_SITE, OVERLOAD_SITE}
         unknown = set(sites) - valid
         if unknown:
             ap.error(f"unknown sites {sorted(unknown)}; valid: "
@@ -875,14 +1079,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         sites = [FLEET_SITE]
     elif args.mode == "train_goodput":
         sites = [GOODPUT_SITE]
+    elif args.mode == "overload":
+        sites = [OVERLOAD_SITE]
     else:
         sites = (list(TRAIN_FAULT_SITES) + serve_sites
-                 + [FLEET_SITE, GOODPUT_SITE])
+                 + [FLEET_SITE, GOODPUT_SITE, OVERLOAD_SITE])
     workdir = args.workdir or tempfile.mkdtemp(prefix="dstpu_faultdrill_")
 
     results = [drill_fleet(workdir) if site == FLEET_SITE
                else drill_train_goodput(workdir)
                if site == GOODPUT_SITE
+               else drill_overload(workdir)
+               if site == OVERLOAD_SITE
                else drill_serve_site(site, workdir)
                if site in serve_sites else drill_site(site, workdir)
                for site in sites]

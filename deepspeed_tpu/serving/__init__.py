@@ -9,16 +9,26 @@ elastic membership drains preempted replicas through the PR 7 manifest
 onto survivors whose warm prefix caches absorb the re-prefill. Fleet
 telemetry rolls up through the exact histogram merge with stable
 ``source=<replica id>`` labels.
+
+Overload robustness (docs/serving.md "Overload control"): an
+:class:`AdmissionController` holds offered load at the capacity knee —
+AIMD over the door's admission window on windowed queue-wait p99
+evidence — and degrades quality-of-service through the ordered
+brownout ladder instead of collapsing. Build one through
+:func:`build_admission` (None when ``DSTPU_ADMISSION=0``).
 """
 
+from .admission import (BROWNOUT_LEVELS, AdmissionController,
+                        admission_enabled, build_admission)
 from .pool import (Replica, ReplicaPool, build_replica_engines,
                    fleet_prefix_stats, single_stream_oracle,
                    slo_report_from_registry)
 from .router import ROUTING_POLICIES, NoServingReplicaError, Router
 
 __all__ = [
-    "NoServingReplicaError", "ROUTING_POLICIES", "Replica",
-    "ReplicaPool", "Router", "build_replica_engines",
+    "AdmissionController", "BROWNOUT_LEVELS", "NoServingReplicaError",
+    "ROUTING_POLICIES", "Replica", "ReplicaPool", "Router",
+    "admission_enabled", "build_admission", "build_replica_engines",
     "fleet_prefix_stats", "single_stream_oracle",
     "slo_report_from_registry",
 ]

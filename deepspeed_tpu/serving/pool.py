@@ -78,13 +78,23 @@ class Replica:
     (DSL001-registered)."""
 
     __slots__ = ("replica_id", "engine", "state", "joined_at", "manifest",
-                 "pending_routed")
+                 "pending_routed", "slot_frac", "admission_headroom")
 
     def __init__(self, replica_id: str, engine):
         self.replica_id = replica_id
         self.engine = engine
         self.state = REPLICA_SERVING
         self.joined_at = time.time()
+        #: advertised-slots scale in (0, 1] — the AdmissionController
+        #: shrinks it while this replica is browned out, so
+        #: :meth:`queue_frac`'s denominator contracts and the router's
+        #: full-replica gate trips earlier (1.0 = full slots)
+        self.slot_frac = 1.0
+        #: 1 - windowed queue-wait p99 / SLO, written by the admission
+        #: controller's tick (None = controller off or no evidence) —
+        #: an additive routing-score term steering toward replicas with
+        #: door headroom
+        self.admission_headroom: Optional[float] = None
         #: requests routed here in the CURRENT admission batch but not
         #: yet admitted by the engine — counted into :meth:`queue_frac`
         #: so consecutive placements in one batch see each other (a
@@ -144,12 +154,14 @@ class Replica:
         return dev, host
 
     def queue_frac(self) -> float:
-        """(Live + batch-routed) sequences over slots — the load half
-        of the routing score (can exceed 1.0 when the engine
+        """(Live + batch-routed) sequences over ADVERTISED slots — the
+        load half of the routing score (can exceed 1.0 when the engine
         oversubscribes its pool with paused/queued sequences, which is
-        exactly when the replica should repel traffic)."""
-        ms = self.engine.config.max_seqs
-        if not ms:
+        exactly when the replica should repel traffic). Browned-out
+        replicas advertise ``slot_frac`` of their physical slots, so
+        pressure here rises and the router's full gate trips earlier."""
+        ms = self.engine.config.max_seqs * self.slot_frac
+        if ms <= 0:
             return 0.0
         return (len(self.engine.state.sequences)
                 + self.pending_routed) / ms
@@ -679,8 +691,13 @@ class ReplicaPool:
             rep.engine.flush(uid)
 
     def _reject(self, uid: int, reason: str, **fields) -> None:
+        # same record shape as the engine's _reject — retry_after_s is
+        # a first-class (if usually None) field so door rejections can
+        # carry the admission controller's backoff hint and report
+        # readers never need a reason-specific schema
         self._pool_rejections[uid] = {
-            "uid": uid, "reason": reason, "time": time.time(), **fields}
+            "uid": uid, "reason": reason, "time": time.time(),
+            "retry_after_s": fields.pop("retry_after_s", None), **fields}
 
     @property
     def rejections(self) -> Dict[int, Dict[str, Any]]:

@@ -65,7 +65,7 @@ class Router:
     def __init__(self, policy: str = "prefix_aware", seed: int = 0,
                  slo_ttft_s: float = 0.0, w_prefix: float = 1.0,
                  w_queue: float = 1.0, w_headroom: float = 0.25,
-                 w_demoted: float = 0.5):
+                 w_demoted: float = 0.5, w_admission: float = 0.25):
         # w_queue >= w_prefix on purpose: overlap_frac < 1 always, so a
         # SATURATED replica (queue_frac -> 1) loses to an idle one even
         # on a perfect cache hit — affinity concentrates traffic only
@@ -86,6 +86,12 @@ class Router:
         # given the choice, the request belongs on the replica that
         # holds the chain on device
         self.w_demoted = float(w_demoted)
+        # admission-controller headroom (1 - windowed queue-wait p99 /
+        # SLO, written onto the replica by the controller's tick):
+        # steers toward replicas whose DOOR has slack, complementing
+        # queue_frac's instantaneous occupancy with windowed evidence.
+        # Free when no controller runs — the attribute stays None
+        self.w_admission = float(w_admission)
         self._rng = random.Random(self.seed)
         self._rr = 0
         self.stats = {"dispatched": 0, "ties_broken": 0}
@@ -115,6 +121,9 @@ class Router:
         s = self.w_prefix * overlap - self.w_queue * replica.queue_frac()
         if self.slo_ttft_s > 0:
             s += self.w_headroom * replica.slo_headroom(self.slo_ttft_s)
+        ah = getattr(replica, "admission_headroom", None)
+        if ah is not None:
+            s += self.w_admission * ah
         return s
 
     def select(self, replicas: Sequence[Any], prompt: Sequence[int],
@@ -195,5 +204,6 @@ class Router:
             out.update(w_prefix=self.w_prefix, w_queue=self.w_queue,
                        w_headroom=self.w_headroom,
                        w_demoted=self.w_demoted,
+                       w_admission=self.w_admission,
                        slo_ttft_s=self.slo_ttft_s or None)
         return out

@@ -44,11 +44,13 @@ would serialize the very hot path whose capacity is being measured.
 
 from __future__ import annotations
 
+import heapq
 import json
+import random
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -113,6 +115,51 @@ class UniformArrivals(ArrivalProcess):
         return {"process": self.kind, "rate_rps": self.rate_rps}
 
 
+class SpikeArrivals(ArrivalProcess):
+    """Piecewise-constant-rate arrivals: ``base_rps`` everywhere except
+    a ``[start_s, start_s + dur_s)`` window offered at ``mult x
+    base_rps`` — the overload-drill traffic spike. Seeded exponential
+    unit-rate gaps are mapped through the closed-form inverse of the
+    integrated rate, so the spike's edges are exact and the same seed
+    always yields the identical schedule (the controller on-vs-off
+    comparison sees the same offered stream)."""
+
+    kind = "spike"
+
+    def __init__(self, base_rps: float, mult: float, start_s: float,
+                 dur_s: float, seed: int = 0):
+        if base_rps <= 0 or mult <= 0:
+            raise ValueError(
+                f"base_rps and mult must be > 0, got {base_rps}/{mult}")
+        if start_s < 0 or dur_s <= 0:
+            raise ValueError(
+                f"need start_s >= 0 and dur_s > 0, got "
+                f"{start_s}/{dur_s}")
+        self.base_rps = float(base_rps)
+        self.mult = float(mult)
+        self.start_s = float(start_s)
+        self.dur_s = float(dur_s)
+        self.seed = int(seed)
+
+    def schedule(self, n: int) -> np.ndarray:
+        rng = np.random.RandomState(self.seed)
+        # cumulative unit-rate exponentials, inverted through the
+        # integrated rate L(t): L = base*t up to the spike, slope
+        # base*mult inside it, base again past it
+        u = np.cumsum(rng.exponential(1.0, size=n))
+        a = self.base_rps * self.start_s            # L at spike start
+        b = a + self.base_rps * self.mult * self.dur_s   # L at spike end
+        t_pre = u / self.base_rps
+        t_in = self.start_s + (u - a) / (self.base_rps * self.mult)
+        t_post = self.start_s + self.dur_s + (u - b) / self.base_rps
+        return np.where(u <= a, t_pre, np.where(u <= b, t_in, t_post))
+
+    def describe(self) -> Dict[str, Any]:
+        return {"process": self.kind, "base_rps": self.base_rps,
+                "mult": self.mult, "start_s": self.start_s,
+                "dur_s": self.dur_s, "seed": self.seed}
+
+
 class TraceArrivals(ArrivalProcess):
     """Recorded-trace replay: arrival offsets from a captured workload
     (a JSON list of seconds, absolute or already-relative — the
@@ -170,6 +217,9 @@ class Request:
     gen_len: int
     deadline_s: Optional[float] = None
     group: Optional[int] = None
+    #: traffic class for brownout shedding: 0 = interactive (protected),
+    #: 1 = batch/background (shed first at ladder level L4)
+    klass: int = 0
 
 
 @dataclass
@@ -207,6 +257,11 @@ class WorkloadMix:
     prefix_block_tokens: int = 16
     deadline_frac: float = 0.0
     deadline_s: float = 0.0
+    #: fraction of requests tagged class-1 (batch/background) — the
+    #: traffic the brownout ladder sheds FIRST under overload. Drawn
+    #: from an independent seeded stream, so arming it never perturbs
+    #: the prompts/budgets existing (mix, seed) pairs produce.
+    batch_frac: float = 0.0
     vocab_size: int = 32000
     #: fixed prompt pool (recorded-prompt replay): when set, each
     #: request draws its prompt from this pool (seeded choice) instead
@@ -229,6 +284,7 @@ class WorkloadMix:
             "prefix_working_set_blocks": self.prefix_working_set_blocks,
             "deadline_frac": self.deadline_frac,
             "deadline_s": self.deadline_s,
+            "batch_frac": self.batch_frac,
         }
 
 
@@ -287,6 +343,15 @@ def build_requests(process: ArrivalProcess, mix: WorkloadMix, n: int,
         group_of = np.zeros(n, np.int64)
     pool = list(mix.prompt_pool) if mix.prompt_pool else None
     pool_pick = rng.randint(0, len(pool), size=n) if pool else None
+    # traffic classes from an INDEPENDENT seeded stream: arming
+    # batch_frac must not shift the main RNG's draw sequence, so every
+    # pre-existing (mix, seed) pair keeps byte-identical request
+    # identity (prompts, budgets, deadlines)
+    if mix.batch_frac > 0:
+        krng = np.random.RandomState(seed + 7919)
+        klasses = (krng.random_sample(n) < mix.batch_frac).astype(int)
+    else:
+        klasses = np.zeros(n, np.int64)
     out: List[Request] = []
     for i in range(n):
         plen = int(plens[i])
@@ -310,7 +375,7 @@ def build_requests(process: ArrivalProcess, mix: WorkloadMix, n: int,
             prompt=prompt, gen_len=int(glens[i]),
             deadline_s=mix.deadline_s
             if deadlined[i] and mix.deadline_s > 0 else None,
-            group=group))
+            group=group, klass=int(klasses[i])))
     return out
 
 
@@ -336,7 +401,9 @@ class _OpenLoopDriver:
     def __init__(self, engine, requests: Sequence[Request],
                  decode_burst: int, shed_after_s: float,
                  poll_s: float, max_live: Optional[int] = None,
-                 sampling: Any = None):
+                 sampling: Any = None, admission: Any = None,
+                 retry_budget: int = 0, retry_base_s: float = 0.05,
+                 retry_seed: int = 0):
         self.engine = engine
         self.requests = sorted(requests, key=lambda r: r.arrival_s)
         self.decode_burst = max(1, int(decode_burst))
@@ -348,6 +415,28 @@ class _OpenLoopDriver:
         self.sampling = sampling
         self.max_live = max(1, int(max_live)) \
             if max_live is not None else None
+        #: AdmissionController (serving/admission.py) or None. Armed,
+        #: the door REJECTS offers beyond the controller's window
+        #: (typed records with retry_after_s hints) instead of holding
+        #: them; None keeps the exact pre-controller hold-at-door path
+        #: (``max_live`` is the controller's responsibility when armed)
+        self.admission = admission
+        # client retry discipline: jittered exponential backoff
+        # honoring the rejection's retry_after_s hint, bounded by
+        # retry_budget attempts per request; retried requests keep
+        # their ORIGINAL identity (uid + arrival stamp)
+        self.retry_budget = max(0, int(retry_budget))
+        self.retry_base_s = float(retry_base_s)
+        self._retry_rng = random.Random(retry_seed)
+        self.retryq: List[Tuple[float, int, int, Request]] = []
+        self._retry_n = 0
+        self._retried_uids: set = set()
+        self.retry_stats = {"attempts": 0, "exhausted": 0,
+                            "abandoned": 0, "succeeded_after_retry": 0}
+        #: EWMA of observed admit->complete service time — the client's
+        #: estimate of the minimum useful deadline remainder: retrying
+        #: with less budget than this left only wastes an engine slot
+        self._serv_ewma: Optional[float] = None
         self.pending: deque = deque(self.requests)
         self.live: Dict[int, Dict[str, Any]] = {}
         self.streams: Dict[int, List[int]] = {}
@@ -355,6 +444,11 @@ class _OpenLoopDriver:
         # outcome bookkeeping
         self.completed: Dict[int, float] = {}    # uid -> completion offset
         self.shed_late: List[int] = []
+        #: driver-side structured rejections, SAME record shape as the
+        #: engine's (uid/reason/time/retry_after_s) — the report
+        #: classifies both through one merged view, so driver sheds and
+        #: engine sheds can never be double- or un-counted
+        self.rejected_driver: Dict[int, Dict[str, Any]] = {}
         self.offer_lags: List[float] = []
         self.first_seen: Dict[int, float] = {}   # driver-side fallback
         self._stamp_cache: Dict[int, Dict[str, float]] = {}
@@ -375,10 +469,27 @@ class _OpenLoopDriver:
         whether the offered request is admitted, held at the door
         (``max_live`` concurrency bound — held requests keep their
         ORIGINAL arrival stamp, so door wait lands in queue-wait/TTFT),
-        queued into this batch late, or shed (``shed_after_s``)."""
+        queued into this batch late, or shed (``shed_after_s``).
+
+        With an :class:`~deepspeed_tpu.serving.AdmissionController`
+        armed the door changes semantics: offers beyond the
+        controller's window (or class-shed by the brownout ladder) are
+        REJECTED with typed retriable records instead of held — holding
+        past the knee is exactly the collapse the controller exists to
+        prevent. Due retries re-offer through the same door."""
+        adm = self.admission
+        if adm is not None:
+            adm.poll(self.t0 + now)
         due: List[Request] = []
+        while self.retryq and self.retryq[0][0] <= now:
+            _, _, attempt, r = heapq.heappop(self.retryq)
+            if adm is not None \
+                    and not adm.door(len(self.live) + len(due), r.klass):
+                self._door_reject(r, now, attempt)
+                continue
+            due.append(r)
         while self.pending and self.pending[0].arrival_s <= now:
-            if self.max_live is not None \
+            if adm is None and self.max_live is not None \
                     and len(self.live) + len(due) >= self.max_live:
                 break
             r = self.pending.popleft()
@@ -386,13 +497,35 @@ class _OpenLoopDriver:
             self.offer_lags.append(lag)
             if self.shed_after_s > 0 and lag > self.shed_after_s:
                 self.shed_late.append(r.uid)
+                self.rejected_driver[r.uid] = {
+                    "uid": r.uid, "reason": "shed_late",
+                    "time": time.time(), "retry_after_s": None,
+                    "lag_s": round(lag, 4)}
+                continue
+            if adm is not None \
+                    and not adm.door(len(self.live) + len(due), r.klass):
+                self._door_reject(r, now, 0)
                 continue
             due.append(r)
         if not due:
             return
-        arrivals = {r.uid: self.t0 + r.arrival_s for r in due}
-        deadlines = {r.uid: r.deadline_s for r in due
-                     if r.deadline_s is not None}
+        arrivals: Dict[int, float] = {}
+        deadlines: Dict[int, float] = {}
+        for r in due:
+            t_arr, dl = r.arrival_s, r.deadline_s
+            if r.uid in self._retried_uids:
+                # a re-offer restarts the ENGINE clock: stamping the
+                # original arrival would book the client's backoff as
+                # engine queue wait and feed it back into the
+                # controller's evidence (a retry storm indistinguishable
+                # from real overload). The deadline stays anchored at
+                # the original arrival — only the remainder is granted.
+                if dl is not None:
+                    dl = max(0.0, t_arr + dl - now)
+                t_arr = now
+            arrivals[r.uid] = self.t0 + t_arr
+            if dl is not None:
+                deadlines[r.uid] = dl
         sampling = {r.uid: self.sampling for r in due} \
             if self.sampling is not None else None
         res = self.engine.put([r.uid for r in due],
@@ -405,6 +538,9 @@ class _OpenLoopDriver:
                 tok = res[r.uid]
                 self.streams[r.uid] = [tok]
                 self.first_seen[r.uid] = t_seen
+                if r.uid in self._retried_uids:
+                    self._retried_uids.discard(r.uid)
+                    self.retry_stats["succeeded_after_retry"] += 1
                 if r.gen_len <= 1:
                     self._finish(r.uid, "completed")
                 else:
@@ -413,6 +549,43 @@ class _OpenLoopDriver:
             # admitted-then-rejected (deadline/shed inside put) and
             # refused requests both carry engine.rejections records —
             # the report's breakdown reads them after the pass
+
+    def _door_reject(self, r: Request, now: float, attempt: int) -> None:
+        """One typed door rejection plus the client's retry half of the
+        contract: re-offer after max(the controller's ``retry_after_s``
+        hint, jittered exponential backoff), up to ``retry_budget``
+        attempts. A retried request keeps its ORIGINAL uid, and its
+        deadline/goodput stay anchored at the first offer — retries
+        never launder SLO outcomes. Only the ENGINE clock (queue
+        wait/TTFT) restarts at the re-offer, so client backoff is not
+        booked as engine queue time (see :meth:`_admit_due`).
+        Registered DSL001 hot path: dict/heap stores and host
+        arithmetic only."""
+        rec = self.admission.reject(r.uid, klass=r.klass)
+        if attempt >= self.retry_budget:
+            self.retry_stats["exhausted"] += 1
+            self._retried_uids.discard(r.uid)
+            return
+        hint = rec.get("retry_after_s") or 0.0
+        back = self.retry_base_s * (2.0 ** attempt) \
+            * (0.5 + self._retry_rng.random())
+        t_next = now + max(hint, back)
+        if r.deadline_s is not None \
+                and t_next + (self._serv_ewma or 0.0) \
+                >= r.arrival_s + r.deadline_s:
+            # the deadline remainder at retry time would not even cover
+            # the observed service time — a rational client abandons
+            # rather than burn a slot on a request the engine must
+            # expire anyway (a zombie that produces no goodput but
+            # still displaces requests that could have met their SLO)
+            self.retry_stats["abandoned"] += 1
+            self._retried_uids.discard(r.uid)
+            return
+        self.retry_stats["attempts"] += 1
+        self._retried_uids.add(r.uid)
+        self._retry_n += 1
+        heapq.heappush(self.retryq,
+                       (t_next, self._retry_n, attempt + 1, r))
 
     def _decode_burst(self) -> None:
         """One short pipelined decode burst over the live set — short so
@@ -432,7 +605,14 @@ class _OpenLoopDriver:
                 self.live.pop(u)            # shed/expired mid-flight
         if not uids:
             return
-        budgets = [min(self.decode_burst, self.live[u]["remaining"])
+        burst = self.decode_burst
+        adm = self.admission
+        if adm is not None and adm.decode_burst_cap < burst:
+            # brownout L3 (throughput_cap): shorter bursts return to the
+            # admission poll sooner, trading batch throughput for
+            # arrival-clock fidelity exactly when the door must act
+            burst = max(1, adm.decode_burst_cap)
+        budgets = [min(burst, self.live[u]["remaining"])
                    for u in uids]
         ctx = 0
         for u in uids:
@@ -487,6 +667,10 @@ class _OpenLoopDriver:
         st = {"arrival_s": r.arrival_s}
         if seq.admitted_at is not None:
             adm = seq.admitted_at - self.t0
+            serv = (time.monotonic() - self.t0) - adm
+            if serv > 0:
+                self._serv_ewma = serv if self._serv_ewma is None \
+                    else 0.8 * self._serv_ewma + 0.2 * serv
             if seq.first_sched_at is not None:
                 st["queue_wait_s"] = seq.first_sched_at - seq.admitted_at
             if seq.first_token_at is not None:
@@ -500,14 +684,19 @@ class _OpenLoopDriver:
 
     def run(self) -> LoadResult:
         self.t0 = time.monotonic()
-        while self.pending or self.live:
+        while self.pending or self.live or self.retryq:
             now = time.monotonic() - self.t0
             self._admit_due(now)
             if self.live:
                 self._decode_burst()
-            elif self.pending:
-                wait = self.t0 + self.pending[0].arrival_s \
-                    - time.monotonic()
+            elif self.pending or self.retryq:
+                # idle until the earlier of the next scheduled arrival
+                # and the next due retry (poll_s-capped so the
+                # admission controller keeps ticking while idle)
+                nxt = [r[0] for r in self.retryq[:1]]
+                if self.pending:
+                    nxt.append(self.pending[0].arrival_s)
+                wait = self.t0 + min(nxt) - time.monotonic()
                 if wait > 0:
                     time.sleep(min(wait, self.poll_s))
         duration = time.monotonic() - self.t0
@@ -537,19 +726,35 @@ class _OpenLoopDriver:
             elif uid in self.first_seen:
                 h["ttft_s"].observe(self.first_seen[uid]
                                     - self.by_uid[uid].arrival_s)
-        # shed/deadline breakdown from the engine's structured records
-        shed = deadline = drained = other = 0
+        # outcome breakdown over ONE merged record view: driver-side
+        # records (shed_late) and engine records (shed/deadline/drain/
+        # door) share a shape, and every offered uid is classified
+        # exactly once — so the rows sum to offered - completed in
+        # every mode, by construction (balance_ok asserts it)
+        merged = dict(self.rejected_driver)
         for uid, rec in eng.rejections.items():
-            if uid not in self.by_uid:
+            if uid in self.by_uid:
+                merged[uid] = rec
+        shed = deadline = drained = adm_rej = other = 0
+        shed_late_n = 0
+        for uid in self.by_uid:
+            if uid in self.completed:
                 continue
-            reason = rec.get("reason")
+            rec = merged.get(uid)
+            reason = rec.get("reason") if rec else None
             if reason == "kv_pool_exhausted":
                 shed += 1
             elif reason == "deadline_exceeded":
                 deadline += 1
             elif reason == "draining":
                 drained += 1
+            elif reason == "shed_late":
+                shed_late_n += 1
+            elif reason == "admission_overload":
+                adm_rej += 1
             else:
+                # recordless non-completion should be impossible; fold
+                # it into "other" so the balance stays a hard invariant
                 other += 1
         completed = len(self.completed)
         # goodput: completed AND met its deadline (deadline-free
@@ -563,19 +768,23 @@ class _OpenLoopDriver:
                 goodput += 1
         offered_rate = n / span if span > 0 else None
         lags = self.offer_lags
-        refused = sum(1 for uid in eng.rejections
-                      if uid in self.by_uid and uid not in self.streams)
+        refused = sum(1 for uid, rec in merged.items()
+                      if uid not in self.streams
+                      and rec.get("reason") != "shed_late")
         report = {
             "requests": {
                 "offered": n,
-                "admitted": n - len(self.shed_late) - refused,
+                "admitted": n - shed_late_n - refused,
                 "completed": completed,
                 "goodput": goodput,
                 "shed": shed,
                 "deadline_expired": deadline,
-                "shed_late": len(self.shed_late),
+                "shed_late": shed_late_n,
                 "rejected_draining": drained,
+                "rejected_admission": adm_rej,
                 "rejected_other": other,
+                "balance_ok": completed + shed + deadline + drained
+                + shed_late_n + adm_rej + other == n,
             },
             "rates_rps": {
                 "offered": round(offered_rate, 3)
@@ -608,6 +817,11 @@ class _OpenLoopDriver:
         if duration > 0:
             report["output_tokens_per_sec"] = round(
                 report["output_tokens"] / duration, 2)
+        if self.retry_budget > 0 or self.retry_stats["attempts"]:
+            report["retries"] = dict(self.retry_stats,
+                                     budget=self.retry_budget)
+        if self.admission is not None:
+            report["admission"] = self.admission.state()
         return report
 
 
@@ -615,7 +829,10 @@ def run_open_loop(engine, requests: Sequence[Request],
                   decode_burst: int = 8, shed_after_s: float = 0.0,
                   poll_s: float = 0.02,
                   max_live: Optional[int] = None,
-                  sampling: Any = None) -> LoadResult:
+                  sampling: Any = None,
+                  admission: Any = None,
+                  retry_budget: int = 0,
+                  retry_base_s: float = 0.05) -> LoadResult:
     """Drive one open-loop pass of ``requests`` against ``engine``.
 
     The arrival clock is the precomputed schedule against
@@ -637,12 +854,24 @@ def run_open_loop(engine, requests: Sequence[Request],
     because ``decode_pipelined`` routes greedy batches through it
     transparently.
 
+    ``admission`` (an :class:`~deepspeed_tpu.serving.
+    AdmissionController`, usually from
+    :func:`~deepspeed_tpu.serving.build_admission`) changes the door's
+    semantics: offers beyond the controller's window are REJECTED with
+    typed retriable records instead of held, and the driver plays the
+    client half of the retry contract — up to ``retry_budget``
+    re-offers per request after max(the record's ``retry_after_s``
+    hint, jittered exponential backoff from ``retry_base_s``), with
+    the ORIGINAL arrival identity so goodput accounting stays honest.
+
     Leaves the engine empty (every request completed, aborted or
     flushed) and accumulates rejection records in
     ``engine.rejections``."""
     return _OpenLoopDriver(engine, requests, decode_burst, shed_after_s,
                            poll_s, max_live=max_live,
-                           sampling=sampling).run()
+                           sampling=sampling, admission=admission,
+                           retry_budget=retry_budget,
+                           retry_base_s=retry_base_s).run()
 
 
 # ---------------------------------------------------------------------- #
@@ -656,7 +885,10 @@ def sweep_capacity(engine, rates: Sequence[float], n_per_rate: int,
                    process: str = "poisson",
                    decode_burst: int = 8, shed_after_s: float = 0.0,
                    max_live: Optional[int] = None,
-                   sampling: Any = None) -> Dict[str, Any]:
+                   sampling: Any = None,
+                   admission: Any = None,
+                   retry_budget: int = 0,
+                   retry_base_s: float = 0.05) -> Dict[str, Any]:
     """Sweep offered QPS and locate the knee: the highest offered rate
     whose goodput fraction still meets ``goodput_slo_frac``. Each rate
     runs an independent seeded pass (disjoint uid ranges; the engine's
@@ -678,7 +910,9 @@ def sweep_capacity(engine, rates: Sequence[float], n_per_rate: int,
                               uid_base=(i + 1) * 1_000_000)
         res = run_open_loop(engine, reqs, decode_burst=decode_burst,
                             shed_after_s=shed_after_s, max_live=max_live,
-                            sampling=sampling)
+                            sampling=sampling, admission=admission,
+                            retry_budget=retry_budget,
+                            retry_base_s=retry_base_s)
         rep = res.report
         lat = rep["latency"]
         curve.append({
@@ -693,6 +927,7 @@ def sweep_capacity(engine, rates: Sequence[float], n_per_rate: int,
             "shed": rep["requests"]["shed"],
             "deadline_expired": rep["requests"]["deadline_expired"],
             "shed_late": rep["requests"]["shed_late"],
+            "rejected_admission": rep["requests"]["rejected_admission"],
         })
     knee = None
     for row in curve:
@@ -825,6 +1060,33 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "host tier")
     ap.add_argument("--deadline-s", type=float, default=0.0)
     ap.add_argument("--deadline-frac", type=float, default=0.0)
+    ap.add_argument("--batch-frac", type=float, default=float(
+        os.environ.get("DSTPU_LOADGEN_BATCH_FRAC", "0") or "0"),
+        help="fraction of requests tagged lowest-class (klass=1, "
+             "batch) — the brownout ladder's shed_lowclass level "
+             "sheds these first")
+    ap.add_argument("--admission", default=os.environ.get(
+        "DSTPU_LOADGEN_ADMISSION", "off"), choices=("on", "off"),
+        help="arm the knee-seeking AdmissionController at the door "
+             "(docs/serving.md Overload control; DSTPU_ADMISSION=0 "
+             "still kills it)")
+    ap.add_argument("--retry-budget", type=int, default=int(
+        os.environ.get("DSTPU_LOADGEN_RETRY_BUDGET", "0") or "0"),
+        help="client retries per door-rejected request (jittered "
+             "exponential backoff honoring retry_after_s)")
+    ap.add_argument("--retry-base", type=float, default=float(
+        os.environ.get("DSTPU_LOADGEN_RETRY_BASE_S", "0.05") or "0.05"),
+        help="base backoff seconds for the retry schedule")
+    ap.add_argument("--spike-mult", type=float, default=float(
+        os.environ.get("DSTPU_LOADGEN_SPIKE_MULT", "0") or "0"),
+        help="overlay a rate spike of this multiple on --rate "
+             "(0 = steady; poisson process only)")
+    ap.add_argument("--spike-start", type=float, default=float(
+        os.environ.get("DSTPU_LOADGEN_SPIKE_START_S", "1") or "1"),
+        help="spike onset, seconds into the run")
+    ap.add_argument("--spike-dur", type=float, default=float(
+        os.environ.get("DSTPU_LOADGEN_SPIKE_DUR_S", "2") or "2"),
+        help="spike duration in seconds")
     ap.add_argument("--replicas", type=int, default=int(os.environ.get(
         "DSTPU_FLEET_REPLICAS", "1")),
         help="serve through a ReplicaPool of N tiny engines instead of "
@@ -892,18 +1154,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         prefix_working_set_blocks=max(0, args.prefix_working_set_blocks),
         prefix_block_tokens=16,
         deadline_frac=args.deadline_frac, deadline_s=args.deadline_s,
+        batch_frac=args.batch_frac,
         vocab_size=mcfg.vocab_size)
+    adm = None
+    if args.admission == "on":
+        # explicit opt-in arms the controller; DSTPU_ADMISSION=0 (or
+        # telemetry off) still wins inside build_admission
+        from ..serving import build_admission
+        adm = build_admission(eng)
     rates = [float(r) for r in str(args.rate).split(",") if r]
     if len(rates) > 1:
         if args.process == "trace":
             ap.error("--process trace replays a recorded schedule and "
                      "cannot sweep offered rates; give one --rate or "
                      "use poisson/uniform")
+        if args.spike_mult > 0:
+            ap.error("--spike-mult overlays a spike on ONE --rate; a "
+                     "sweep already varies the offered load")
         out = sweep_capacity(
             eng, rates, args.requests, mix, seed=args.seed,
             goodput_slo_frac=args.slo_goodput, process=args.process,
             decode_burst=args.burst, shed_after_s=args.shed_after,
-            sampling=sampling)
+            sampling=sampling, admission=adm,
+            retry_budget=args.retry_budget,
+            retry_base_s=args.retry_base)
     else:
         if args.process == "trace":
             if not args.trace:
@@ -911,12 +1185,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             proc: ArrivalProcess = TraceArrivals.from_file(args.trace)
         elif args.process == "uniform":
             proc = UniformArrivals(rates[0])
+        elif args.spike_mult > 0:
+            proc = SpikeArrivals(rates[0], args.spike_mult,
+                                 args.spike_start, args.spike_dur,
+                                 seed=args.seed)
         else:
             proc = PoissonArrivals(rates[0], seed=args.seed)
         reqs = build_requests(proc, mix, args.requests, seed=args.seed)
         res = run_open_loop(eng, reqs, decode_burst=args.burst,
                             shed_after_s=args.shed_after,
-                            sampling=sampling)
+                            sampling=sampling, admission=adm,
+                            retry_budget=args.retry_budget,
+                            retry_base_s=args.retry_base)
         out = {"arrival": proc.describe(), "workload": mix.describe(),
                **res.report}
         slo = eng.slo_report()

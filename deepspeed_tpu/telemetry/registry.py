@@ -51,6 +51,8 @@ REGISTERED_METRICS = {
     "serve_requests_deadline_expired": "requests aborted past deadline",
     "serve_requests_aborted": "requests cancelled via engine.abort()",
     "serve_requests_rejected_draining": "fresh requests refused mid-drain",
+    "serve_requests_rejected_admission":
+        "offers rejected at the admission door (typed, retriable)",
     "serve_requests_drained": "live requests manifested by drain()",
     "serve_tokens_committed": "output tokens committed (host-visible)",
     "serve_steps": "engine steps dispatched",
@@ -124,6 +126,13 @@ REGISTERED_METRICS = {
     "train_loss": "last committed step's mean loss",
     "train_grad_norm": "last committed step's global grad norm",
     "train_goodput_frac": "productive fraction of the run's wall clock",
+    # -- admission control (serving/admission.py) ----------------------- #
+    "admission_window": "admission door's current AIMD concurrency bound",
+    "admission_level": "current brownout ladder level (0 = normal)",
+    "admission_rejected": "door rejections the controller issued",
+    "admission_retry_after_s": "retry hints carried by door rejections",
+    "brownout_transitions":
+        "brownout ladder moves (label: direction=enter|exit)",
     # -- flight recorder (counter) -------------------------------------- #
     "flight_spans_dropped": "flight-recorder spans evicted by ring wrap",
 }

@@ -45,6 +45,7 @@ _REJECT_COUNTERS = {
     "kv_pool_exhausted": "serve_requests_shed",
     "deadline_exceeded": "serve_requests_deadline_expired",
     "draining": "serve_requests_rejected_draining",
+    "admission_overload": "serve_requests_rejected_admission",
 }
 
 
@@ -460,6 +461,7 @@ def slo_report_from_registry(registry) -> Dict[str, Any]:
     bad = (c("serve_requests_shed")
            + c("serve_requests_deadline_expired")
            + c("serve_requests_rejected_draining")
+           + c("serve_requests_rejected_admission")
            + c("serve_requests_aborted"))
     good = c("serve_requests_completed")
     done = good + bad
@@ -482,6 +484,7 @@ def slo_report_from_registry(registry) -> Dict[str, Any]:
             "shed": c("serve_requests_shed"),
             "deadline_expired": c("serve_requests_deadline_expired"),
             "rejected_draining": c("serve_requests_rejected_draining"),
+            "rejected_admission": c("serve_requests_rejected_admission"),
             "aborted": c("serve_requests_aborted"),
             "drained": c("serve_requests_drained"),
         },

@@ -194,6 +194,7 @@ def render(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
                         ("completed", "serve_requests_completed"),
                         ("shed", "serve_requests_shed"),
                         ("deadline", "serve_requests_deadline_expired"),
+                        ("adm-reject", "serve_requests_rejected_admission"),
                         ("aborted", "serve_requests_aborted"),
                         ("drained", "serve_requests_drained")):
         lines.append(f"  {label:<14}{c.get(name, 0):9.0f} {rate(name)}")
@@ -201,6 +202,7 @@ def render(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
     bad = (c.get("serve_requests_shed", 0.0)
            + c.get("serve_requests_deadline_expired", 0.0)
            + c.get("serve_requests_rejected_draining", 0.0)
+           + c.get("serve_requests_rejected_admission", 0.0)
            + c.get("serve_requests_aborted", 0.0))
     lines.append(f"  goodput        {_pct(_frac(good, good + bad))}")
     lines.append("")
@@ -281,6 +283,24 @@ def render(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
                  f"{_pct(_frac(total - free, total))}   "
                  f"{free:.0f}/{total:.0f} blocks free   "
                  f"{max(per_chip, default=0.0) / 1e6:.1f} MB/chip")
+    lvls = [v for k, v in g.items()
+            if k.split("{", 1)[0] == "admission_level"]
+    if lvls or "admission_window" in g:
+        # overload-control status (docs/serving.md "Overload control"):
+        # which brownout level the fleet is in and why. Window sums
+        # across replicas (door concurrency is additive); level takes
+        # the WORST replica — a fleet is as browned out as its most
+        # pressured member
+        from ..serving.admission import BROWNOUT_LEVELS
+        lvl = int(max(lvls, default=0.0))
+        lvl = min(lvl, len(BROWNOUT_LEVELS) - 1)
+        trans = sum(v for k, v in c.items()
+                    if k.split("{", 1)[0] == "brownout_transitions")
+        lines.append(
+            f"admission      window {g_sum('admission_window'):.0f}   "
+            f"level {lvl} ({BROWNOUT_LEVELS[lvl]})   "
+            f"door rejects {c.get('admission_rejected', 0):.0f}   "
+            f"brownout moves {trans:.0f}")
     dropped = c.get("flight_spans_dropped", 0.0)
     if dropped:
         lines.append(f"flight ring    {dropped:.0f} spans dropped "

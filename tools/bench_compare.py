@@ -58,6 +58,14 @@ DIRECTIONS: Tuple[Tuple[str, str], ...] = (
     ("*checkpoint_save*", "lower"),
     ("*goodput*", "higher"),
     ("*knee*", "higher"),
+    # overload-control bench (bench.py serve_admission): brownout
+    # transitions during the steady A/B pass are a bug, not jitter —
+    # the controller must stay silent at 0.4x capacity. The boolean
+    # gates (token_parity_armed_vs_off, controller_engaged_spike,
+    # balance_ok_*) ride the generic true->false rule; the spike
+    # rejection/retry counts are mechanism, not cost, and stay
+    # informational on purpose
+    ("*steady_transitions*", "lower"),
     ("*speedup*", "higher"),
     ("*accept_rate*", "higher"),
     ("*hit_frac*", "higher"),
@@ -102,6 +110,11 @@ BANDS: Tuple[Tuple[str, float], ...] = (
     # goodput through an injected kill depends on subprocess startup
     # wall clock — band it like the other drill timings
     ("*goodput_frac*", 0.25),
+    # spike-pass goodput RATES are wall-clock measurements under a
+    # deliberately saturating arrival schedule — band them like the
+    # knee sweep; steady brownout transitions get zero slack
+    ("*spike_goodput_rps*", 0.25),
+    ("*steady_transitions*", 0.0),
     ("*restart_lost*", 0.50),
     ("*replay_catchup*", 0.50),
     ("*checkpoint_save*", 0.50),

@@ -165,7 +165,15 @@ HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
     # path whose capacity the bench is measuring, and stall the arrival
     # clock the open-loop invariant protects
     "deepspeed_tpu/telemetry/loadgen.py":
-        ("_admit_due", "_decode_burst"),
+        ("_admit_due", "_decode_burst", "_door_reject"),
+    # the admission controller's poll/door/reject hooks run per driver
+    # iteration and per offered request BETWEEN the engine's overlapped
+    # pipeline fills: windowed-quantile deltas, AIMD arithmetic and
+    # typed-rejection minting are pure host work over pre-bound metric
+    # handles — one device readback here would serialize the very door
+    # that exists to keep the engine's pipeline full under overload
+    "deepspeed_tpu/serving/admission.py": ("poll", "tick", "door",
+                                           "reject"),
     # the replica-pool router's score/select run on the fleet admission
     # path between the engines' overlapped pipelines: scoring reads
     # host-side metadata only (prefix-trie walk, dict sizes, streaming-

@@ -1,0 +1,61 @@
+#!/bin/bash
+# Round-19 on-chip sequence: overload-robust serving (ISSUE 16). The
+# CPU story is proven in tier-1 (AIMD knee hold / one-cut-per-evidence-
+# window / hysteresis, brownout ladder actuation + exact restore, typed
+# rejections with retry_after_s back-compat, retry budget exhaustion,
+# class shed, an in-process spike gate) and in the overload fault drill
+# (six gates: controller-on holds >=0.95x knee goodput under a 2.5x
+# spike, controller-off collapses <0.85x, queue-wait p99 inside SLO,
+# retry balance closes, ladder engages, steady state stays silent); on
+# chip this captures (a) lint cleanliness (the admission DSL001
+# hot-path registry + DSTPU_ADMISSION* knob tables + DSL006 admission
+# metric rows), (b) the tpu_smoke sweep — no serve-path regression with
+# the controller compiled in but disarmed, (c) the serve_admission
+# bench at real step times (steady A/B parity + <=3% overhead + zero
+# brownout transitions + zero fresh compiles, knee sweep, 2.5x spike
+# on/off contrast with ladder pre-warm), (d) the overload drill on its
+# own — rate-relative capacity calibration against the real chip's
+# knee, and (e) bench_compare gating this round's capture against the
+# previous one. Strictly sequential (one process owns the chip), no
+# timeouts around TPU clients (a killed client wedges the grant).
+cd /root/repo || exit 1
+LOG=profiles/r19_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round19 start $(date -u +%FT%TZ)"
+FAIL=0
+
+echo "--- [1/5] dstpu_lint (admission hot-path registry, DSTPU_ADMISSION*"
+echo "    knob + admission metric catalog drift)"
+python bin/dstpu_lint deepspeed_tpu || FAIL=1
+
+echo "--- [2/5] tpu_smoke: full kernel + serve sweep (controller"
+echo "    compiled in, disarmed by default — no serve-path regression)"
+python tools/tpu_smoke.py || FAIL=1
+
+echo "--- [3/5] serve_admission bench: steady parity/overhead gates,"
+echo "    knee sweep, 2.5x spike on/off contrast at real step times"
+python bench.py serve_admission > BENCH_ADMISSION_r19.json || FAIL=1
+tail -c 1600 BENCH_ADMISSION_r19.json
+
+echo "--- [4/5] overload fault drill: rate-relative knee calibration"
+echo "    on the real chip, all six gates"
+python bin/dstpu_faultdrill --mode overload || FAIL=1
+
+echo "--- [5/5] bench_compare: gate this round's serve_admission"
+echo "    capture against the previous one (tolerance bands; missing"
+echo "    phase = regression)"
+PREV=$(ls BENCH_ADMISSION_r*.json 2>/dev/null | sort | tail -2 | head -1)
+if [ -n "$PREV" ] && [ "$PREV" != "BENCH_ADMISSION_r19.json" ]; then
+    python tools/bench_compare.py "$PREV" BENCH_ADMISSION_r19.json || FAIL=1
+else
+    echo "no prior serve_admission capture — baseline round, comparing"
+    echo "the last two train_obs captures instead (informational)"
+    mapfile -t ROUNDS < <(ls BENCH_TRAINOBS_r*.json 2>/dev/null | sort | tail -2)
+    if [ "${#ROUNDS[@]}" = 2 ]; then
+        python tools/bench_compare.py "${ROUNDS[0]}" "${ROUNDS[1]}" \
+            --allow-missing || FAIL=1
+    fi
+fi
+
+echo "=== tpu_round19 done $(date -u +%FT%TZ) FAIL=$FAIL"
+exit $FAIL
