@@ -1073,6 +1073,159 @@ class InferenceEngineV2:
         seq.paused_blocks = 0
         seq.status = SequenceStatus.WAITING
 
+    # ------------------ disaggregated serving handoff ----------------- #
+    # docs/serving.md "Disaggregated serving": a prefill specialist hands
+    # a freshly prefilled sequence — KV block chain + replay identity —
+    # to a decode specialist. handoff_out is the source half (one batched
+    # non-blocking gather per sequence, drain-shaped manifest record,
+    # exact state release); handoff_in is the destination half (reserve,
+    # ONE batched restore scatter per sequence, descriptor rebuilt
+    # without re-prefill). The manifest records are a superset of the
+    # drain manifest's per-sequence shape, so a failed handoff falls
+    # back to token-identical replay from the same records.
+
+    def handoff_out(self, batch_uids: Sequence[int]) -> Dict[str, Any]:
+        """Snapshot + release sequences for migration to another replica.
+
+        For each uid with fully-consumed pending work, dispatches a
+        non-blocking exact-length gather of its KV block chain (int8
+        payload + scale planes ride as-is for quantized pools — content-
+        exact, half the bytes) and builds a handoff record carrying the
+        full replay identity: prompt/generated split, sampling params,
+        trace context, SLO stamps and deadline. Source state is then
+        released through the one release path (journal finish, proposer
+        drop, shared-block decref via ``state.flush``) WITHOUT counting
+        a terminal outcome — the request is still in flight, on the
+        destination. The returned manifest's ``kv`` entries are lazy
+        device slices; the caller materializes them (one batched
+        device_get) where the wait can hide under other replicas'
+        compute. Registered DSL001 hot path — dispatch only."""
+        recs: List[Dict[str, Any]] = []
+        blocks_moved = 0
+        bytes_moved = 0
+        for uid in batch_uids:
+            seq = self.state.get(uid)
+            if seq is None or not seq.kv_blocks or seq.in_flight \
+                    or seq.status in (SequenceStatus.PAUSED,
+                                      SequenceStatus.FINISHED):
+                continue
+            get_fault_injector().maybe_fire("during_handoff_gather")
+            kv = self.kv_cache.gather_blocks(self._kv_data, seq.kv_blocks)
+            rows = kv[0] if isinstance(kv, tuple) else kv
+            recs.append({
+                "uid": seq.uid,
+                "prompt": list(seq.prompt_log),
+                "generated": list(seq.gen_log),
+                "sampling": seq.sampling.to_dict()
+                if seq.sampling is not None else None,
+                "trace": seq.trace_id,
+                "seen_tokens": seq.seen_tokens,
+                "blocks": len(seq.kv_blocks),
+                "kv": kv,
+                "logprobs": list(seq.logprob_log),
+                "deadline_at": seq.deadline_at,
+                "deadline_s": seq.deadline_s,
+                "stamps": (seq.admitted_at, seq.first_sched_at,
+                           seq.first_token_at, seq.last_token_at),
+            })
+            blocks_moved += len(seq.kv_blocks)
+            bytes_moved += rows.size * rows.dtype.itemsize
+            if isinstance(kv, tuple):
+                bytes_moved += kv[1].size * kv[1].dtype.itemsize
+        # TRANSACTIONAL release, after every record is built: the
+        # gather loop above mutates nothing (pure reads + dispatch), so
+        # a fault mid-gather — the during_handoff_gather drill site, or
+        # a SIGTERM landing in the loop — leaves EVERY sequence live on
+        # this replica: nothing migrated, nothing lost (the caller
+        # retries, decodes colocated, or the drain manifest carries
+        # them). Released WITHOUT an outcome: the request migrates, it
+        # does not finish here (goodput counts it once, at the
+        # destination); journal finish so a journal replay of THIS
+        # replica no longer claims it
+        for rec in recs:
+            uid = rec["uid"]
+            if self.journal is not None:
+                self.journal.finish(uid)
+            if self._proposer is not None:
+                self._proposer.drop(uid)
+            self.state.flush(uid)
+        if recs and self._obs is not None:
+            self._obs.on_handoff_out(len(recs), blocks_moved, bytes_moved)
+        return {"version": 1, "source": "handoff", "time": time.time(),
+                "sequences": recs}
+
+    def handoff_in(self, manifest: Dict[str, Any],
+                   exposed_s: float = 0.0) -> Dict[str, List[int]]:
+        """Adopt migrated sequences from :meth:`handoff_out`'s manifest:
+        reserve each record's block count, scatter its KV payload with
+        ONE batched restore per sequence, and rebuild the descriptor —
+        prompt/generated split, ``seen_tokens``, sampling identity,
+        trace context and SLO stamps — so the very next decode step
+        continues the stream token-identically, with no re-prefill.
+        Blocks arrive private (never cache-shared): ``assert_exact_refs``
+        holds on both replicas immediately after migration. Records the
+        pool cannot cover (OutOfBlocksError on reserve) are returned in
+        ``spilled`` — the caller replays those from the same records'
+        prompt+generated chains instead. ``exposed_s`` is the caller-
+        measured non-overlapped transfer wall, observed into
+        ``serve_handoff_exposed_s``. Registered DSL001 hot path —
+        dispatch only."""
+        if self._draining():
+            raise EngineDrainingError(
+                "handoff_in() on a draining engine — migrate to a "
+                "serving replica")
+        accepted: List[int] = []
+        spilled: List[int] = []
+        blocks_in = 0
+        for rec in manifest.get("sequences", []):
+            # manifest fields are host ints (json-shaped record), not
+            # device scalars — no sync behind these coercions
+            uid = int(rec["uid"])     # dslint: allow(DSL001): host int
+            if self.state.get(uid) is not None:
+                raise ValueError(
+                    f"handoff_in: sequence {uid} already live on this "
+                    f"engine")
+            nblocks = int(rec["blocks"])  # dslint: allow(DSL001): host int
+            try:
+                blocks = self.kv_cache.reserve(nblocks)
+            except OutOfBlocksError:
+                spilled.append(uid)
+                continue
+            self._kv_data = self.kv_cache.restore(self._kv_data,
+                                                  rec["kv"], blocks)
+            seq = self.state.get_or_create(uid)
+            seq.kv_blocks = list(blocks)
+            seq.prompt_log = list(rec["prompt"])
+            seq.gen_log = list(rec["generated"])
+            seq.prompt_len = len(seq.prompt_log)
+            seq.seen_tokens = int(  # dslint: allow(DSL001): host int
+                rec["seen_tokens"])
+            seq.prefix_tokens = None     # never registered here: private
+            seq.status = SequenceStatus.WAITING
+            if rec.get("sampling"):
+                seq.sampling = SamplingParams.from_dict(rec["sampling"])
+            seq.trace_id = rec.get("trace")
+            seq.logprob_log = list(rec.get("logprobs") or [])
+            (seq.admitted_at, seq.first_sched_at, seq.first_token_at,
+             seq.last_token_at) = rec.get("stamps") or (None,) * 4
+            if rec.get("deadline_at") is not None:
+                seq.deadline_at = rec["deadline_at"]
+                seq.deadline_s = rec.get("deadline_s")
+                self._has_deadlines = True
+            if self.journal is not None:
+                # journal the FULL chain as the admitted prompt (exactly
+                # what a drain-replay admission would journal): a
+                # journal replay of this replica re-prefills the chain
+                # and continues token-identically
+                self.journal.admit(
+                    uid, seq.prompt_log + seq.gen_log,
+                    sampling=rec.get("sampling"), trace=seq.trace_id)
+            accepted.append(uid)
+            blocks_in += nblocks
+        if accepted and self._obs is not None:
+            self._obs.on_handoff_in(len(accepted), blocks_in, exposed_s)
+        return {"accepted": accepted, "spilled": spilled}
+
     @property
     def free_blocks(self) -> int:
         return self.kv_cache.free_blocks

@@ -263,6 +263,25 @@ class BlockedKVCache:
         sc = None if scales is None else scales[:, :, :, idx]
         return rows, sc
 
+    def gather_blocks(self, kv_data, blocks):
+        """Non-blocking exact-length gather of ``blocks``' rows (and int8
+        scales) for the disaggregated-serving KV handoff
+        (docs/serving.md "Disaggregated serving"): the same batched
+        device-side slice demotion uses (:meth:`_gather_rows`, so steady
+        handoff traffic shares demotion's few compiled pow2 gather
+        shapes), trimmed back to exactly ``len(blocks) * block_size``
+        rows so the result is directly :meth:`restore`-shaped on the
+        receiving replica. Dispatch only — the caller materializes (or
+        ships) the slice when the transfer must land, letting the D2H
+        copy hide under neighboring sequences' compute. Registered
+        DSL001 hot path."""
+        rows, sc = self._gather_rows(kv_data, blocks)
+        n = len(blocks) * self.cfg.block_size
+        rows = rows[:, :, :n]
+        if sc is not None:
+            return rows, sc[:, :, :, :n]
+        return rows
+
     def finalize_demotions(self) -> None:
         """Materialize pending demotion gathers to host numpy — called
         at commit boundaries (the blocking step readback just proved the

@@ -260,7 +260,7 @@ def flash_attention_sparse(q, k, v, block_mask, *, sm_scale=None,
 
 
 def _fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len, causal_offset,
-         interpret):
+         interpret, group=1):
     b, h, tq, d = q.shape
     tk = k.shape[2]
     nq, nk = tq // block_q, tk // block_k
@@ -278,8 +278,12 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len, causal_offset,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
+            # GQA: K/V stay (b, h//group, t, d); the index map broadcasts a
+            # KV head across its q-head group — no materialized repeat
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, i, j: (b, h // group, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, i, j: (b, h // group, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
@@ -355,12 +359,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
                     *, sm_scale, causal, block_q, block_k, kv_len,
-                    causal_offset):
+                    causal_offset, nq):
+    # GQA grouped accumulation: the grid's innermost dim fuses (q-head in
+    # group, q block) as gq = qh * nq + qi, so ONE kv head's dk/dv
+    # accumulates over every q head it serves before the block is written
+    # (init at the first step, finish at the last). group == 1 reduces to
+    # the ungrouped order exactly.
     ki = pl.program_id(2)
-    qi = pl.program_id(3)
-    nq = pl.num_programs(3)
+    gq = pl.program_id(3)
+    ng = pl.num_programs(3)
+    qi = gq % nq
 
-    @pl.when(qi == 0)
+    @pl.when(gq == 0)
     def _init():
         dk_scr[:] = jnp.zeros(dk_scr.shape, dk_scr.dtype)
         dv_scr[:] = jnp.zeros(dv_scr.shape, dv_scr.dtype)
@@ -399,17 +409,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
-    @pl.when(qi == nq - 1)
+    @pl.when(gq == ng - 1)
     def _finish():
         dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset, interpret,
-         res, g, dlse=None):
+         res, g, dlse=None, group=1):
     q, k, v, o, lse = res
     do = g[0]
     b, h, tq, d = q.shape
+    hk = k.shape[1]
     tk = k.shape[2]
     nq, nk = tq // block_q, tk // block_k
 
@@ -422,9 +433,6 @@ def _bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset, interpret,
         # same kernels run with delta' = delta - dlse.
         delta = delta - dlse.astype(jnp.float32)
 
-    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, j, 0))
-    kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, i, 0))
-    row_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, j, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, kv_len=kv_len,
@@ -432,8 +440,10 @@ def _bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset, interpret,
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, i, j: (b, h // group, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, i, j: (b, h // group, j, 0)),
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
@@ -448,17 +458,31 @@ def _bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset, interpret,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
+    # dk/dv: grid walks KV heads (hk = h // group); the innermost dim fuses
+    # (q-head in group, q block) so each kv head's cotangent sums its whole
+    # q-head group in-scratch — the index maps pick the q-side head as
+    # hh * group + gq // nq and the q block as gq % nq.
+    q_spec = pl.BlockSpec(
+        (1, 1, block_q, d),
+        lambda b, hh, i, gq: (b, hh * group + gq // nq, gq % nq, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, d),
+                           lambda b, hh, i, gq: (b, hh, i, 0))
+    row_spec = pl.BlockSpec(
+        (1, 1, block_q, 1),
+        lambda b, hh, i, gq: (b, hh * group + gq // nq, gq % nq, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, kv_len=kv_len,
-                          causal_offset=causal_offset),
-        grid=(b, h, nk, nq),
+                          causal_offset=causal_offset, nq=nq),
+        grid=(b, hk, nk, group * nq),
         in_specs=[
             q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, hh, i, gq: (b, hh, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, hh, i, gq: (b, hh, i, 0)),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
@@ -472,50 +496,50 @@ def _bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset, interpret,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _flash(q, k, v, causal, sm_scale, block_q, block_k, kv_len, causal_offset,
-           interpret):
+           interpret, group):
     o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len,
-                causal_offset, interpret)
+                causal_offset, interpret, group)
     return o
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len,
-               causal_offset, interpret):
+               causal_offset, interpret, group):
     o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len,
-                  causal_offset, interpret)
+                  causal_offset, interpret, group)
     return o, (q, k, v, o, lse)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset,
-               interpret, res, g):
+               interpret, group, res, g):
     return _bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset,
-                interpret, res, (g,))
+                interpret, res, (g,), group=group)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _flash_lse(q, k, v, causal, sm_scale, block_q, block_k, kv_len,
-               causal_offset, interpret):
+               causal_offset, interpret, group):
     """(o, lse) with lse a differentiable output (used by ring attention)."""
     return _fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len,
-                causal_offset, interpret)
+                causal_offset, interpret, group)
 
 
 def _flash_lse_fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len,
-                   causal_offset, interpret):
+                   causal_offset, interpret, group):
     o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_len,
-                  causal_offset, interpret)
+                  causal_offset, interpret, group)
     return (o, lse), (q, k, v, o, lse)
 
 
 def _flash_lse_bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset,
-                   interpret, res, cts):
+                   interpret, group, res, cts):
     do, dlse = cts
     return _bwd(causal, sm_scale, block_q, block_k, kv_len, causal_offset,
-                interpret, res, (do,), dlse=dlse)
+                interpret, res, (do,), dlse=dlse, group=group)
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -560,11 +584,11 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     if hk != h:
         if h % hk:
             raise ValueError(f"GQA requires q_heads % kv_heads == 0 ({h}/{hk})")
-        # TODO(perf): broadcast via a h -> h // group BlockSpec index map
-        # instead of materializing repeated K/V (needs a grouped dk/dv
-        # accumulation order in the backward kernel).
-        k = jnp.repeat(k, h // hk, axis=1)
-        v = jnp.repeat(v, h // hk, axis=1)
+    # GQA KV heads are broadcast inside the kernels via h -> h // group
+    # BlockSpec index maps (dk/dv use a grouped accumulation grid), so K/V
+    # are never materialized per q-head — hk-headed tiles stream straight
+    # from HBM and the cotangents come back hk-headed.
+    group = h // hk
     tk = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
@@ -583,7 +607,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     # and jax.nn.dot_product_attention): decode-style tq < tk attends the
     # whole prefix.
     args = (q, k, v, causal, float(sm_scale), block_q, block_k, tk,
-            tk - tq, interpret)
+            tk - tq, interpret, group)
     if return_lse:
         o, lse = _flash_lse(*args)
         lse = lse[..., 0]                                  # (b, h, tq_p)

@@ -3,6 +3,7 @@ restart ledger. See docs/resilience.md for the failure model and the
 recovery guarantees each piece provides."""
 
 from .fault_injection import (
+    DISAGG_FAULT_SITE,
     FAULT_SITES,
     SERVE_FAULT_SITES,
     TRAIN_FAULT_SITES,
@@ -16,6 +17,7 @@ from .preemption import PreemptionHandler
 from .watchdog import StepWatchdog
 
 __all__ = [
+    "DISAGG_FAULT_SITE",
     "FAULT_SITES",
     "SERVE_FAULT_SITES",
     "TRAIN_FAULT_SITES",

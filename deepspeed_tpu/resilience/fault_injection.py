@@ -64,12 +64,21 @@ FAULT_SITES = ("pre_save", "mid_save", "post_save_pre_latest",
                "collective", "step",
                # serve-side sites (InferenceEngineV2's pipeline)
                "pre_dispatch", "mid_commit", "during_prefill_chunk",
-               "during_cow_copy")
+               "during_cow_copy",
+               # disaggregated-serving site (docs/serving.md): inside a
+               # prefill specialist's handoff_out gather loop, BEFORE
+               # any source state is released — the drill proves an
+               # aborted handoff loses nothing (bin/dstpu_faultdrill
+               # --mode disagg)
+               "during_handoff_gather")
 
 #: the serve-loop subset (bin/dstpu_faultdrill --mode serve drills these;
-#: the train drill keeps its original five)
+#: the train drill keeps its original five). The disagg site is drilled
+#: by its own fleet-shaped mode, not the single-engine serve loop —
+#: a lone engine never hands off.
 TRAIN_FAULT_SITES = FAULT_SITES[:5]
-SERVE_FAULT_SITES = FAULT_SITES[5:]
+SERVE_FAULT_SITES = FAULT_SITES[5:9]
+DISAGG_FAULT_SITE = FAULT_SITES[9]
 
 
 class InjectedFault(RuntimeError):

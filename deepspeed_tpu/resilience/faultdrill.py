@@ -57,8 +57,8 @@ import sys
 import tempfile
 from typing import List, Optional
 
-from .fault_injection import (FAULT_SITES, SERVE_FAULT_SITES,
-                              TRAIN_FAULT_SITES)
+from .fault_injection import (DISAGG_FAULT_SITE, FAULT_SITES,
+                              SERVE_FAULT_SITES, TRAIN_FAULT_SITES)
 
 #: steps the drill worker trains for; the fault fires at DRILL_FAULT_STEP
 DRILL_STEPS = 5
@@ -82,6 +82,14 @@ FLEET_SITE = "fleet_sigterm"
 #: the overload drill's pseudo-site (``--mode overload``): a
 #: 2.5x-capacity traffic spike, admission controller on vs off
 OVERLOAD_SITE = "serve_overload"
+
+#: disaggregated-serving drill (``--mode disagg``): a prefill+decode
+#: specialist pair; one clean handoff wave, one wave whose handoff is
+#: killed mid-gather followed by a SIGTERM on the prefill specialist,
+#: one post-kill wave — token parity vs a colocated oracle throughout
+DISAGG_SITE = DISAGG_FAULT_SITE
+DISAGG_WAVE = 3
+DISAGG_TOKENS = 6
 
 
 def _worker() -> int:
@@ -493,6 +501,205 @@ def drill_fleet(workdir: str, verbose: bool = True) -> dict:
               f"parity={result.get('token_parity')} "
               f"rollup_exact={result.get('rollup_quantiles_exact')} "
               f"joiner={result.get('joiner_requests')} "
+              f"recovered={result['recovered']}", file=sys.stderr)
+    return result
+
+
+def _disagg_worker() -> int:
+    """The disagg drill's worker (subprocess; configured by env): a
+    prefill specialist + decode specialist pair must survive BOTH ways
+    a handoff can die, token-identical to a colocated oracle.
+
+      wave A  clean: requests land on the prefill specialist, hand off,
+              and decode to completion on the decode specialist
+      wave B  an injected ``during_handoff_gather`` fault aborts the
+              handoff mid-gather — nothing may be lost (the sequences
+              stay live on the source); then the prefill specialist
+              takes a real SIGTERM mid-decode and the pool absorbs the
+              drain (manifest replay onto the decode specialist)
+      wave C  fresh post-kill traffic: the phase filter degrades
+              gracefully and the survivor takes it
+
+    Gates (written to DRILL_RESULT_FILE): token parity vs a one-replica
+    oracle for every wave; the fault fired exactly once; wave A was
+    adopted via handoff (``serve_handoff_seqs_in`` on the destination);
+    wave B stayed on the source after the abort; the victim's manifest
+    reports full pool recovery; wave C landed on the survivor."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import signal
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..inference.v2 import InferenceEngineV2, RaggedInferenceConfig
+    from ..models.gpt2 import GPT2, GPT2Config
+    from ..serving import ReplicaPool
+    from .fault_injection import FaultInjector, set_fault_injector
+    from .preemption import PreemptionHandler
+
+    n_tok = DISAGG_TOKENS
+    mcfg = GPT2Config(vocab_size=96, max_seq_len=256, num_layers=2,
+                      num_heads=2, hidden_size=32, dtype=jnp.float32)
+    params = GPT2(mcfg).init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def engine():
+        cfg = RaggedInferenceConfig(
+            max_seqs=4, chunk_size=8, block_size=4, num_blocks=64,
+            max_blocks_per_seq=32, dtype="float32",
+            attention_impl="dense", decode_loop_steps=0,
+            serve_pipeline_depth=2, prefix_cache=True)
+        return InferenceEngineV2(mcfg, params, cfg)
+
+    rng = np.random.default_rng(11)
+    waves = [{w * 10 + i: rng.integers(1, 96, 10 + i).tolist()
+              for i in range(DISAGG_WAVE)} for w in range(3)]
+
+    def serve_wave(pool, batch, sigterm_victim=None):
+        """Admit one wave, decode every uid to n_tok, flush; returns
+        ({uid: tokens}, {uid: final owner id}). ``sigterm_victim``: a
+        replica id that takes a PreemptionHandler + a real SIGTERM
+        after the first decode round."""
+        toks, owners = {}, {}
+        out = pool.put(list(batch), [batch[u] for u in batch],
+                       _greedy=True)
+        for u in batch:
+            if u in out:
+                toks[u] = [int(out[u])]
+        rounds = 0
+        while True:
+            live = [u for u in toks if len(toks[u]) < n_tok
+                    and u in pool.state.sequences]
+            if not live:
+                break
+            if rounds == 1 and sigterm_victim is not None:
+                victim = pool.replica(sigterm_victim)
+                victim.engine.attach_preemption(PreemptionHandler())
+                os.kill(os.getpid(), signal.SIGTERM)
+                sigterm_victim = None
+            outs = pool.decode_pipelined(
+                live, [toks[u][-1] for u in live], 2)
+            for u in live:
+                toks[u].extend(outs[u][:n_tok - len(toks[u])])
+            rounds += 1
+        for u in list(toks):
+            rep = pool.owner_of(u)
+            owners[u] = rep.replica_id if rep is not None else None
+            if pool.state.get(u) is not None:
+                pool.flush(u)
+        return toks, owners
+
+    # oracle: one colocated mixed replica, same waves in the same order
+    oracle_pool = ReplicaPool([engine()], policy="prefix_aware", seed=0)
+    oracle = {}
+    for batch in waves:
+        t, _ = serve_wave(oracle_pool, batch)
+        oracle.update(t)
+
+    pool = ReplicaPool([engine(), engine()], policy="prefix_aware",
+                       seed=0, replica_ids=["pre", "dec"],
+                       roles=["prefill", "decode"])
+    toks = {}
+
+    # wave A: clean disagg path — prefill on "pre", adopt on "dec"
+    t, owners_a = serve_wave(pool, waves[0])
+    toks.update(t)
+    dec_m = pool.replica("dec").engine.metrics
+    adopted = int(dec_m.counter("serve_handoff_seqs_in").value)
+
+    # wave B: abort the handoff mid-gather, then kill the source.
+    # mode=raise — the pool's migration loop must catch it and leave
+    # every sequence live on the prefill source (nothing released).
+    inj = FaultInjector(site=DISAGG_SITE, mode="raise", times=1)
+    set_fault_injector(inj)
+    out_b = pool.put(list(waves[1]), [waves[1][u] for u in waves[1]],
+                     _greedy=True)
+    fault_fired = inj._fired == 1
+    set_fault_injector(None)
+    owners_b0 = {u: pool.owner_of(u).replica_id for u in waves[1]
+                 if pool.owner_of(u) is not None}
+    abort_safe = bool(owners_b0) and all(
+        rid == "pre" for rid in owners_b0.values())
+    for u, tk in out_b.items():
+        toks[u] = [int(tk)]
+    rounds = 0
+    while True:
+        live = [u for u in toks if len(toks[u]) < n_tok
+                and u in pool.state.sequences]
+        if not live:
+            break
+        if rounds == 1:
+            victim = pool.replica("pre")
+            victim.engine.attach_preemption(PreemptionHandler())
+            os.kill(os.getpid(), signal.SIGTERM)
+        outs = pool.decode_pipelined(live, [toks[u][-1] for u in live], 2)
+        for u in live:
+            toks[u].extend(outs[u][:n_tok - len(toks[u])])
+        rounds += 1
+    victim = pool.replica("pre")
+    pool_recovered = bool(
+        victim.manifest["pool"]["fully_recovered"]) \
+        if victim.manifest else False
+    for u in waves[1]:
+        if pool.state.get(u) is not None:
+            pool.flush(u)
+
+    # wave C: fresh post-kill traffic — the phase filter has no serving
+    # prefill candidate left, so placement degrades to the survivor
+    t, owners_c = serve_wave(pool, waves[2])
+    toks.update(t)
+
+    result = {
+        "fault_fired": fault_fired,
+        "handoff_adopted": adopted,
+        "handoff_wave_on_dest": all(
+            rid == "dec" for rid in owners_a.values()),
+        "abort_safe": abort_safe,
+        "pool_recovered": pool_recovered,
+        "post_kill_on_survivor": all(
+            rid == "dec" for rid in owners_c.values()),
+        "token_parity": toks == oracle and len(toks) == len(oracle),
+    }
+    with open(os.environ["DRILL_RESULT_FILE"], "w") as f:
+        json.dump(result, f)
+    ok = (result["fault_fired"] and result["token_parity"]
+          and result["abort_safe"] and result["pool_recovered"]
+          and result["handoff_adopted"] >= DISAGG_WAVE
+          and result["handoff_wave_on_dest"]
+          and result["post_kill_on_survivor"])
+    return 0 if ok else 1
+
+
+def drill_disagg(workdir: str, verbose: bool = True) -> dict:
+    """Disaggregated-serving drill: abort a KV handoff mid-gather with
+    an injected fault (nothing may be lost), then SIGTERM the prefill
+    specialist mid-decode (drain replay onto the decode specialist),
+    gating on token parity vs a colocated oracle throughout."""
+    site_dir = os.path.join(workdir, "disagg")
+    os.makedirs(site_dir, exist_ok=True)
+    result_file = os.path.join(site_dir, "result.json")
+    env = _serve_env(site_dir, "disagg", DRILL_RESULT_FILE=result_file)
+    # the drill builds its own role assignment; ambient disagg knobs
+    # must not leak into the worker
+    env.pop("DSTPU_FLEET_ROLES", None)
+    env.pop("DSTPU_DISAGG", None)
+    rc = _run_worker(env, fn="_disagg_worker")
+    result = {"site": DISAGG_SITE, "mode": "disagg", "worker_rc": rc}
+    if os.path.exists(result_file):
+        with open(result_file) as f:
+            result.update(json.load(f))
+    result["recovered"] = (
+        rc == 0 and result.get("fault_fired") is True
+        and result.get("token_parity") is True
+        and result.get("abort_safe") is True
+        and result.get("pool_recovered") is True)
+    if verbose:
+        print(f"[faultdrill:disagg] rc={rc} "
+              f"adopted={result.get('handoff_adopted')} "
+              f"abort_safe={result.get('abort_safe')} "
+              f"parity={result.get('token_parity')} "
+              f"survivor={result.get('post_kill_on_survivor')} "
               f"recovered={result['recovered']}", file=sys.stderr)
     return result
 
@@ -1044,7 +1251,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "non-zero on any unrecovered failure)")
     ap.add_argument("--mode", default="train",
                     choices=("train", "serve", "fleet", "train_goodput",
-                             "overload", "all"),
+                             "overload", "disagg", "all"),
                     help="train: checkpoint-recovery drill (PR 1 sites); "
                          "serve: drain/replay drill (serve sites + "
                          "sigterm); fleet: kill-one-of-N replica-pool "
@@ -1054,7 +1261,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "ledger must match the drill's wall-clock "
                          "arithmetic (ISSUE 15); overload: "
                          "2.5x-capacity spike, admission controller on "
-                         "vs off (ISSUE 16); all: every mode")
+                         "vs off (ISSUE 16); disagg: aborted-handoff + "
+                         "prefill-specialist-kill drill (ISSUE 17); "
+                         "all: every mode")
     ap.add_argument("--sites", default=None,
                     help="comma-separated site subset (default: every "
                          "site of the selected mode)")
@@ -1081,9 +1290,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         sites = [GOODPUT_SITE]
     elif args.mode == "overload":
         sites = [OVERLOAD_SITE]
+    elif args.mode == "disagg":
+        sites = [DISAGG_SITE]
     else:
         sites = (list(TRAIN_FAULT_SITES) + serve_sites
-                 + [FLEET_SITE, GOODPUT_SITE, OVERLOAD_SITE])
+                 + [FLEET_SITE, GOODPUT_SITE, OVERLOAD_SITE,
+                    DISAGG_SITE])
     workdir = args.workdir or tempfile.mkdtemp(prefix="dstpu_faultdrill_")
 
     results = [drill_fleet(workdir) if site == FLEET_SITE
@@ -1091,6 +1303,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                if site == GOODPUT_SITE
                else drill_overload(workdir)
                if site == OVERLOAD_SITE
+               else drill_disagg(workdir)
+               if site == DISAGG_SITE
                else drill_serve_site(site, workdir)
                if site in serve_sites else drill_site(site, workdir)
                for site in sites]

@@ -465,8 +465,20 @@ class AdmissionController:
         host attributes the engines re-read per plan/decode call —
         SHRINK-only where compiled shapes are concerned (the scheduler
         already emits every chunk length the shrunken cap produces), so
-        no brownout level can trigger a fresh compile."""
+        no brownout level can trigger a fresh compile.
+
+        Phase-specialist fleets (docs/serving.md "Disaggregated
+        serving") actuate per ROLE: decode-side knobs (L2 spec
+        brownout, the L3 decode-burst cap) are meaningless on a replica
+        that never decodes, and the L3 prefill-chunk halving is
+        meaningless on one that never prefills — skipping them keeps a
+        specialist's baseline config untouched (and its compiled shapes
+        warm) while the knobs that DO apply still bite. ``mixed``
+        replicas (the default, and every replica under
+        ``DSTPU_DISAGG=0``) actuate everything, exactly as before."""
         for _, eng, rep in self._engines():
+            role = getattr(rep, "role", "mixed") if rep is not None \
+                else "mixed"
             base = self._base.get(id(eng))
             if base is None:
                 base = self._base[id(eng)] = {
@@ -483,16 +495,20 @@ class AdmissionController:
                 else base["promote_defer_ticks"]
             # L2: bypass speculation (spec is token-identical to greedy,
             # so parity holds) and shrink the draft depth for when it
-            # comes back partway through recovery
-            if level >= 2:
+            # comes back partway through recovery. Prefill specialists
+            # never run verify rounds — leave their spec config alone
+            if level >= 2 and role != "prefill":
                 eng.spec_mode = "off"
                 eng.spec_k = max(1, min(base["spec_k"], 2))
             else:
                 eng.spec_mode = base["spec_mode"]
                 eng.spec_k = base["spec_k"]
             # L3: halve the prefill chunk depth (decode latency wins
-            # over prefill throughput under pressure); shrink-only
-            if level >= 3:
+            # over prefill throughput under pressure); shrink-only.
+            # Decode specialists run no prefill chunks — and on a
+            # PREFILL specialist there is no colocated decode to
+            # protect, so halving would only cut its throughput
+            if level >= 3 and role == "mixed":
                 cs = eng.config.chunk_size
                 cap = base["prefill_chunk_cap"] or cs
                 eng.config.prefill_chunk_cap = max(1, min(cap, cs) // 2)

@@ -52,10 +52,12 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..inference.v2.blocked_allocator import OutOfBlocksError
+from ..inference.v2.drain import EngineDrainingError
 from ..telemetry.flight_recorder import (FlightRecorder,
                                          atomic_json_dump,
                                          merge_chrome_traces,
@@ -70,6 +72,13 @@ REPLICA_SERVING = "serving"
 REPLICA_DRAINING = "draining"
 REPLICA_DEAD = "dead"
 
+#: phase-specialist roles (docs/serving.md "Disaggregated serving"):
+#: ``mixed`` replicas serve both phases (the pre-disagg behavior and
+#: the default), ``prefill`` specialists take fresh admissions and hand
+#: each sequence off after its first token, ``decode`` specialists
+#: adopt the handoffs and run the decode stream
+REPLICA_ROLES = ("prefill", "decode", "mixed")
+
 
 class Replica:
     """One pool member: an ``InferenceEngineV2`` plus its fleet
@@ -78,13 +87,22 @@ class Replica:
     (DSL001-registered)."""
 
     __slots__ = ("replica_id", "engine", "state", "joined_at", "manifest",
-                 "pending_routed", "slot_frac", "admission_headroom")
+                 "pending_routed", "slot_frac", "admission_headroom",
+                 "role", "lock")
 
-    def __init__(self, replica_id: str, engine):
+    def __init__(self, replica_id: str, engine, role: str = "mixed"):
+        if role not in REPLICA_ROLES:
+            raise ValueError(
+                f"replica role must be one of {REPLICA_ROLES}, "
+                f"got {role!r}")
         self.replica_id = replica_id
         self.engine = engine
         self.state = REPLICA_SERVING
         self.joined_at = time.time()
+        #: phase specialism (docs/serving.md "Disaggregated serving");
+        #: the router's ``phase`` filter reads it, the pool's post-put
+        #: migration moves fresh sequences OFF ``prefill`` replicas
+        self.role = role
         #: advertised-slots scale in (0, 1] — the AdmissionController
         #: shrinks it while this replica is browned out, so
         #: :meth:`queue_frac`'s denominator contracts and the router's
@@ -102,6 +120,14 @@ class Replica:
         #: all score the same stale pre-batch state and pile onto one
         #: replica past its slots)
         self.pending_routed = 0
+        #: serializes every engine call on this replica — the pool's
+        #: concurrency contract (docs/serving.md "Disaggregated
+        #: serving"): independent driver threads may call ``put`` and
+        #: ``decode_pipelined`` concurrently; each engine is
+        #: single-threaded, so the pool takes this lock around every
+        #: engine entry point. Reentrant because drain/replay paths
+        #: nest engine calls under one holder.
+        self.lock = threading.RLock()
         #: the drain manifest once this replica died (None while alive);
         #: ``manifest["pool"]["fully_recovered"]`` is the leak oracle the
         #: fleet drill asserts on
@@ -183,6 +209,7 @@ class Replica:
     def describe(self) -> Dict[str, Any]:
         return {
             "state": self.state,
+            "role": self.role,
             "live_sequences": len(self.engine.state.sequences),
             "queue_frac": round(self.queue_frac(), 4),
             "free_blocks": self.engine.kv_cache.free_blocks,
@@ -222,12 +249,18 @@ class ReplicaPool:
                  seed: Optional[int] = None,
                  slo_ttft_s: Optional[float] = None,
                  ledger: Any = None, name: str = "fleet",
-                 replica_ids: Optional[Sequence[str]] = None):
+                 replica_ids: Optional[Sequence[str]] = None,
+                 roles: Optional[Sequence[str]] = None):
         # env knobs read with LITERAL names (dslint DSL004/5 scan):
         # DSTPU_FLEET_POLICY is the operational routing kill-switch
         # (prefix_aware -> round_robin/random without a rebuild),
         # DSTPU_FLEET_SEED pins tie-break reproducibility,
-        # DSTPU_FLEET_SLO_TTFT_S arms the router's headroom term
+        # DSTPU_FLEET_SLO_TTFT_S arms the router's headroom term,
+        # DSTPU_FLEET_ROLES assigns per-replica phase specialisms
+        # (comma list, e.g. "prefill,decode" — docs/serving.md
+        # "Disaggregated serving"), DSTPU_DISAGG=0 is the kill switch
+        # that forces every replica mixed (the exact pre-disagg pool
+        # path: no phase filter, no migration)
         if policy is None:
             policy = os.environ.get("DSTPU_FLEET_POLICY") \
                 or "prefix_aware"
@@ -236,6 +269,13 @@ class ReplicaPool:
         if slo_ttft_s is None:
             slo_ttft_s = float(
                 os.environ.get("DSTPU_FLEET_SLO_TTFT_S") or "0")
+        if roles is None:
+            rv = os.environ.get("DSTPU_FLEET_ROLES")
+            if rv:
+                roles = [r.strip() for r in rv.split(",")]
+        self._disagg = os.environ.get("DSTPU_DISAGG", "1") != "0"
+        if not self._disagg:
+            roles = None
         self.name = name
         self.router = Router(policy=policy, seed=seed,
                              slo_ttft_s=slo_ttft_s)
@@ -254,6 +294,11 @@ class ReplicaPool:
         #: engines' own rejection records merge in via :attr:`rejections`
         self._pool_rejections: Dict[int, Dict[str, Any]] = {}
         self._executor = None        # lazy per-replica worker threads
+        self._exec_lock = threading.Lock()
+        #: serializes :meth:`absorb_draining` across concurrent driver
+        #: threads — exactly one caller runs the drain→replay sweep;
+        #: the loser sees the flags already cleared and returns
+        self._absorb_lock = threading.Lock()
         #: fleet-wide trace contexts (docs/observability.md "Distributed
         #: tracing"): uid -> the trace id minted at admission. A monotone
         #: counter disambiguates uid reuse, so a retried uid starts a
@@ -279,8 +324,13 @@ class ReplicaPool:
         if len(ids) != len(engines):
             raise ValueError(
                 f"{len(ids)} replica_ids for {len(engines)} engines")
-        for rid, eng in zip(ids, engines):
-            self.add_replica(eng, replica_id=rid)
+        rls = list(roles) if roles is not None \
+            else ["mixed"] * len(engines)
+        if len(rls) != len(engines):
+            raise ValueError(
+                f"{len(rls)} roles for {len(engines)} engines")
+        for rid, eng, role in zip(ids, engines, rls):
+            self.add_replica(eng, replica_id=rid, role=role)
 
     # ------------------------------------------------------------------ #
     # membership
@@ -301,21 +351,35 @@ class ReplicaPool:
     def serving_count(self) -> int:
         return sum(1 for r in self._replicas.values() if r.available)
 
-    def add_replica(self, engine, replica_id: Optional[str] = None
-                    ) -> Replica:
+    @property
+    def _phase_routing(self) -> bool:
+        """Disaggregated placement is live: the kill switch is on AND at
+        least one member declares a specialism. An all-``mixed`` fleet
+        (or ``DSTPU_DISAGG=0``) short-circuits to the exact pre-disagg
+        path — no phase filter, no post-put migration."""
+        return self._disagg and any(
+            r.role != "mixed" for r in self._replicas.values())
+
+    def add_replica(self, engine, replica_id: Optional[str] = None,
+                    role: str = "mixed") -> Replica:
         """Register a (late-)joining replica: it becomes a routing
         candidate immediately — a fresh joiner has an empty queue, so
         the score's load term starts steering traffic its way on the
-        very next placement."""
+        very next placement. ``role`` declares a phase specialism
+        (docs/serving.md "Disaggregated serving"); with
+        ``DSTPU_DISAGG=0`` it is forced to ``mixed`` so the pool runs
+        the exact pre-disagg path."""
         if replica_id is None:
             replica_id = f"r{len(self._replicas)}"
         if replica_id in self._replicas:
             raise ValueError(f"replica id {replica_id!r} already joined")
-        rep = Replica(replica_id, engine)
+        if not self._disagg:
+            role = "mixed"
+        rep = Replica(replica_id, engine, role=role)
         self._replicas[replica_id] = rep
         if self._ledger is not None:
             self._ledger.record("fleet_join", replica=replica_id,
-                                pool=self.name,
+                                pool=self.name, role=role,
                                 serving=self.serving_count)
         return rep
 
@@ -332,8 +396,9 @@ class ReplicaPool:
         if rep.state == REPLICA_DEAD:
             return rep.manifest or {}
         rep.state = REPLICA_DRAINING
-        rep.engine.request_drain()
-        manifest = rep.engine.drain(path)
+        with rep.lock:
+            rep.engine.request_drain()
+            manifest = rep.engine.drain(path)
         rep.manifest = manifest
         rep.state = REPLICA_DEAD
         if self._ledger is not None:
@@ -377,7 +442,8 @@ class ReplicaPool:
             rep = self._replicas[rid]
             sub = {"version": manifest.get("version", 1),
                    "source": "fleet_replay", "sequences": rs}
-            res = rep.engine.replay(sub)
+            with rep.lock:
+                res = rep.engine.replay(sub)
             for rec in rs:
                 uid = int(rec["uid"])
                 self._owner[uid] = rid
@@ -397,18 +463,22 @@ class ReplicaPool:
         its result. With NO survivor the manifests wait as orphans —
         published to disk by the drain as usual — and replay onto the
         first joiner. Called automatically at every pool entry point;
-        cheap (one flag read per replica) when nothing is draining."""
-        for rep in list(self._replicas.values()):
-            if rep.state == REPLICA_SERVING and rep.engine.draining:
-                self._orphans.append(
-                    self.drain_replica(rep.replica_id))
-        if not self._orphans \
-                or not any(r.available for r in self._replicas.values()):
-            return
-        orphans, self._orphans = self._orphans, []
-        for manifest in orphans:
-            for uid, tok in self.replay_manifest(manifest).items():
-                self._replayed.setdefault(uid, []).append(tok)
+        cheap (one flag read per replica) when nothing is draining.
+        Serialized pool-wide (``_absorb_lock``) so concurrent driver
+        threads cannot double-drain one victim."""
+        with self._absorb_lock:
+            for rep in list(self._replicas.values()):
+                if rep.state == REPLICA_SERVING and rep.engine.draining:
+                    self._orphans.append(
+                        self.drain_replica(rep.replica_id))
+            if not self._orphans \
+                    or not any(r.available
+                               for r in self._replicas.values()):
+                return
+            orphans, self._orphans = self._orphans, []
+            for manifest in orphans:
+                for uid, tok in self.replay_manifest(manifest).items():
+                    self._replayed.setdefault(uid, []).append(tok)
 
     # ------------------------------------------------------------------ #
     # request tracing (docs/observability.md "Distributed tracing")
@@ -427,23 +497,29 @@ class ReplicaPool:
         return tid
 
     def _route(self, uid: int, toks: Sequence[int],
-               replay_rec: Optional[Dict[str, Any]] = None):
+               replay_rec: Optional[Dict[str, Any]] = None,
+               phase: Optional[str] = None):
         """One routing decision, traced: select a replica and — with
         telemetry on — record the ``req_route`` decision span carrying
         the per-replica scores the router saw, tagged with the request's
-        trace context (minted here for fresh requests; a replayed
-        sequence keeps the trace its manifest carried). Registered
-        DSL001 hot path — pure host scoring plus one ring append."""
+        trace context (minted here for fresh requests; a replayed or
+        handed-off sequence keeps the trace its record carried).
+        ``phase`` applies the router's role filter (disaggregated
+        serving — fresh work to prefill-capable replicas, migrations to
+        decode-capable ones). Registered DSL001 hot path — pure host
+        scoring plus one ring append."""
         if self.flight is None:
-            return self.router.select(self.replicas(), toks)
+            return self.router.select(self.replicas(), toks,
+                                      phase=phase)
         ex: Dict[str, Any] = {}
         t0 = time.perf_counter()
-        rep = self.router.select(self.replicas(), toks, explain=ex)
+        rep = self.router.select(self.replicas(), toks, explain=ex,
+                                 phase=phase)
         if replay_rec is not None:
             trace = replay_rec.get("trace")
             if trace is not None:
                 self._trace_ids[uid] = trace
-            ex["replay"] = True
+            ex["handoff" if phase == "decode" else "replay"] = True
         else:
             trace = self._mint_trace(uid)
         args = {"uid": uid, **ex}
@@ -498,7 +574,14 @@ class ReplicaPool:
         self.absorb_draining()
         done: Dict[int, Any] = {}
         groups: Dict[str, List[int]] = {}
+        fresh: Dict[str, List[int]] = {}
         toks_of: Dict[int, Sequence[int]] = {}
+        # disaggregated placement (docs/serving.md): fresh requests go
+        # to prefill-capable replicas; after the batch prefills, the
+        # migration step below moves each sequence that landed on a
+        # prefill SPECIALIST onto a decode-capable replica, invisibly
+        # to the caller (results are computed before the move)
+        phase = "prefill" if self._phase_routing else None
         try:
             for uid, toks in zip(batch_uids, batch_tokens):
                 rep = self.owner_of(uid)
@@ -511,12 +594,13 @@ class ReplicaPool:
                     # manifest; rerouting its tokens would re-admit
                     # them as a bogus new prompt elsewhere
                     try:
-                        rep = self._route(uid, toks)
+                        rep = self._route(uid, toks, phase=phase)
                     except NoServingReplicaError:
                         self._reject(uid, "no_serving_replica")
                         continue
                     self._owner[uid] = rep.replica_id
                     rep.pending_routed += 1
+                    fresh.setdefault(rep.replica_id, []).append(uid)
                     # a uid retried after an earlier refusal sheds its
                     # stale records EVERYWHERE — a present record must
                     # only ever mean THIS admission failed. The engine
@@ -534,17 +618,22 @@ class ReplicaPool:
                 rep.pending_routed = 0
 
         def run_one(rid: str) -> Dict[int, Any]:
+            rep = self._replicas[rid]
             members = groups[rid]
             tr = {u: self._trace_ids[u] for u in members
                   if u in self._trace_ids}
-            return self._replicas[rid].engine.put(
-                members, [toks_of[u] for u in members], _greedy=_greedy,
-                arrivals=arrivals, deadlines=deadlines, sampling=sampling,
-                traces=tr or None)
+            with rep.lock:
+                return rep.engine.put(
+                    members, [toks_of[u] for u in members],
+                    _greedy=_greedy, arrivals=arrivals,
+                    deadlines=deadlines, sampling=sampling,
+                    traces=tr or None)
 
         results = self._run_groups(run_one, groups)
         for res in results:
             done.update(res)
+        if phase is not None and fresh:
+            self._migrate_prefill(fresh)
         return done
 
     def _run_groups(self, fn, groups: Dict[str, Any]) -> List[Any]:
@@ -555,15 +644,141 @@ class ReplicaPool:
         inline for a single group."""
         if len(groups) <= 1:
             return [fn(rid) for rid in groups]
-        if self._executor is None \
-                or self._executor._max_workers < len(groups):
-            from concurrent.futures import ThreadPoolExecutor
-            if self._executor is not None:
-                self._executor.shutdown(wait=False)
-            self._executor = ThreadPoolExecutor(
-                max_workers=max(len(groups), len(self._replicas)),
-                thread_name_prefix=f"{self.name}-replica")
-        return list(self._executor.map(fn, groups))
+        with self._exec_lock:
+            # creation is serialized (concurrent driver threads must
+            # not race two executors into existence); the map itself
+            # runs unlocked — the workers serialize per replica on the
+            # replica locks, which is the intended contention surface
+            if self._executor is None \
+                    or self._executor._max_workers < len(groups):
+                from concurrent.futures import ThreadPoolExecutor
+                if self._executor is not None:
+                    self._executor.shutdown(wait=False)
+                self._executor = ThreadPoolExecutor(
+                    max_workers=max(len(groups), len(self._replicas)),
+                    thread_name_prefix=f"{self.name}-replica")
+            ex = self._executor
+        return list(ex.map(fn, groups))
+
+    def _migrate_prefill(self, fresh: Dict[str, List[int]]) -> None:
+        """The disaggregated handoff splice (docs/serving.md
+        "Disaggregated serving"): every sequence the admission batch
+        landed on a PREFILL specialist migrates to a decode-capable
+        replica before the caller's next decode call. The move is
+        invisible — the caller's first tokens were computed before it,
+        ownership flips underneath, and the destination continues the
+        stream from the exact same KV content and committed token
+        chain, so per-uid streams stay byte-identical to colocated
+        serving.
+
+        Shape of the move: the source's :meth:`handoff_out` dispatches
+        one non-blocking exact-length gather per sequence and releases
+        its state; each record's destination is a traced routing
+        decision (``phase="decode"`` — prefix affinity and load still
+        score the candidates); ALL payloads then materialize in ONE
+        batched ``jax.device_get`` whose wall is the handoff's EXPOSED
+        transfer cost (the gathers themselves overlapped the batch's
+        remaining device work — observed into
+        ``serve_handoff_exposed_s``); the destination's
+        :meth:`handoff_in` scatters and adopts. Records the
+        destination cannot cover (block pressure) or that a dying
+        destination refuses fall back to drain-style replay from the
+        SAME records — token-identical, just paying a re-prefill
+        (counted in ``serve_handoff_fallback_replays``). Each adopted
+        sequence's ``req_handoff`` span lands on the pool ring tagged
+        with its trace context, joining the prefill- and decode-side
+        lanes in the merged fleet trace. Registered DSL001 hot path —
+        dispatch plus the one materialize wait."""
+        t0 = time.perf_counter()
+        routed: Dict[str, List[Dict[str, Any]]] = {}
+        src_of: Dict[int, str] = {}
+        fallback: List[Dict[str, Any]] = []
+        for rid, uids in fresh.items():
+            src = self._replicas[rid]
+            if src.role != "prefill" or src.state != REPLICA_SERVING:
+                continue
+            live = [u for u in uids
+                    if src.engine.state.get(u) is not None]
+            if not live:
+                continue
+            try:
+                with src.lock:
+                    manifest = src.engine.handoff_out(live)
+            except Exception:
+                # a fault mid-gather (the during_handoff_gather drill
+                # site, or a SIGTERM unwinding the source) aborts the
+                # whole handoff BEFORE any source state was released:
+                # every sequence is still live on the prefill replica —
+                # it decodes colocated, or rides the source's drain
+                # manifest onto a survivor token-identically
+                continue
+            for rec in manifest.get("sequences", ()):
+                # dslint: allow(DSL001): manifest uid is a host int
+                uid = int(rec["uid"])
+                src_of[uid] = rid
+                chain = list(rec["prompt"]) + list(rec["generated"])
+                dst = self._route(uid, chain, replay_rec=rec,
+                                  phase="decode")
+                routed.setdefault(dst.replica_id, []).append(rec)
+        if not routed:
+            return
+        import jax
+        recs_flat = [r for rs in routed.values() for r in rs]
+        tg = time.perf_counter()
+        # the ONE sanctioned blocking materialize of the handoff: every
+        # destination's payloads in a single batched transfer, timed as
+        # the migration's exposed cost (serve_handoff_exposed_s)
+        # dslint: allow(DSL001): the handoff's one batched materialize
+        host = jax.device_get([r["kv"] for r in recs_flat])
+        exposed_s = time.perf_counter() - tg
+        for r, h in zip(recs_flat, host):
+            r["kv"] = h
+        observed = False
+        for rid, rs in routed.items():
+            dst = self._replicas[rid]
+            try:
+                with dst.lock:
+                    res = dst.engine.handoff_in(
+                        {"version": 1, "source": "handoff",
+                         "sequences": rs},
+                        # the one batched materialize covered EVERY
+                        # destination's payloads: observe its wall once
+                        exposed_s=0.0 if observed else exposed_s)
+            except EngineDrainingError:
+                # destination flipped draining between the routing
+                # decision and the adopt (refused BEFORE any state
+                # change): replay these records on a survivor
+                fallback.extend(rs)
+                continue
+            observed = True
+            acc = set(res["accepted"])
+            t1 = time.perf_counter()
+            for rec in rs:
+                # dslint: allow(DSL001): manifest uid is a host int
+                uid = int(rec["uid"])
+                if uid not in acc:
+                    fallback.append(rec)
+                    continue
+                self._owner[uid] = rid
+                if self.flight is not None:
+                    args: Dict[str, Any] = {
+                        "uid": uid, "src": src_of.get(uid), "dst": rid,
+                        "blocks": rec.get("blocks"),
+                        "exposed_s": round(exposed_s, 6)}
+                    if rec.get("trace") is not None:
+                        args["trace"] = rec["trace"]
+                    self.flight.record("req_handoff", t0, t1,
+                                       args=args)
+        if fallback:
+            for rec in fallback:
+                rec.pop("kv", None)     # replay needs only the chain
+            replayed = self.replay_manifest(
+                {"version": 1, "sequences": fallback})
+            for uid, tok in replayed.items():
+                self._replayed.setdefault(uid, []).append(tok)
+                rep = self.owner_of(uid)
+                if rep is not None and rep.engine._obs is not None:
+                    rep.engine._obs.on_handoff_replay(1)
 
     def decode_pipelined(self, batch_uids: Sequence[int],
                          first_tokens: Sequence[int], n,
@@ -607,6 +822,10 @@ class ReplicaPool:
             groups.setdefault(rep.replica_id, []).append(u)
 
         def run_one(rid: str) -> Dict[int, List[int]]:
+            with self._replicas[rid].lock:
+                return run_locked(rid)
+
+        def run_locked(rid: str) -> Dict[int, List[int]]:
             eng = self._replicas[rid].engine
             members = groups[rid]
             if getattr(eng, "spec_enabled", False) or any(
@@ -687,8 +906,10 @@ class ReplicaPool:
         self._trace_ids.pop(uid, None)
         rid = self._owner.pop(uid, None)
         rep = self._replicas.get(rid) if rid is not None else None
-        if rep is not None and rep.engine.state.get(uid) is not None:
-            rep.engine.flush(uid)
+        if rep is not None:
+            with rep.lock:
+                if rep.engine.state.get(uid) is not None:
+                    rep.engine.flush(uid)
 
     def _reject(self, uid: int, reason: str, **fields) -> None:
         # same record shape as the engine's _reject — retry_after_s is

@@ -127,7 +127,8 @@ class Router:
         return s
 
     def select(self, replicas: Sequence[Any], prompt: Sequence[int],
-               explain: Optional[Dict[str, Any]] = None):
+               explain: Optional[Dict[str, Any]] = None,
+               phase: Optional[str] = None):
         """Place ``prompt`` on one of ``replicas``. Only AVAILABLE
         replicas (serving and not draining) are candidates — a draining
         replica's live sequences ride its manifest, and handing it fresh
@@ -144,6 +145,15 @@ class Router:
         states): exact-score ties break through the seeded RNG, so a
         cold fleet spreads reproducibly.
 
+        Role filter (disaggregated serving, docs/serving.md): ``phase``
+        names the work being placed — ``"prefill"`` keeps replicas whose
+        role is ``prefill`` or ``mixed``, ``"decode"`` keeps ``decode``
+        or ``mixed``, None skips the filter. When no capable specialist
+        of the needed kind is available the filter degrades to every
+        available replica rather than failing — an all-``mixed`` fleet
+        (DSTPU_DISAGG=0) therefore routes exactly as before, and a fleet
+        that lost its only prefill specialist still serves.
+
         Slot admission control, applied BEFORE any policy: a replica
         already at its slot capacity (``queue_frac() >= 1``) is only a
         candidate when every available replica is — placing fresh work
@@ -155,11 +165,17 @@ class Router:
             raise NoServingReplicaError(
                 f"no serving replica among {len(replicas)} "
                 f"(all draining, dead or not joined)")
+        if phase is not None:
+            capable = [r for r in avail
+                       if getattr(r, "role", "mixed") in (phase, "mixed")]
+            avail = capable or avail
         open_ = [r for r in avail if r.queue_frac() < 1.0]
         avail = open_ or avail
         self.stats["dispatched"] += 1
         if explain is not None:
             explain["policy"] = self.policy
+            if phase is not None:
+                explain["phase"] = phase
         if self.policy == "round_robin":
             pick = avail[self._rr % len(avail)]
             self._rr += 1

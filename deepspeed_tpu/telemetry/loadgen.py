@@ -272,6 +272,26 @@ class WorkloadMix:
     #: variable while prompt CONTENT stays the controlled one.
     prompt_pool: Optional[Sequence[Sequence[int]]] = None
 
+    @classmethod
+    def prefill_heavy(cls, vocab_size: int = 32000,
+                      **overrides) -> "WorkloadMix":
+        """The disaggregated-serving workload preset
+        (``bin/dstpu_loadgen --mix prefill_heavy``, docs/serving.md
+        "Disaggregated serving"): prompts an order of magnitude longer
+        than generations, so prefill FLOPs dominate the offered work
+        and a colocated replica keeps stalling its decode streams
+        behind arriving prompt chunks — the regime where splitting the
+        fleet into prefill and decode specialists wins on BOTH TTFT and
+        TPOT tails. Sized for the tiny CPU-harness engine (sequences
+        cap at 256 tokens); real deployments scale the lengths, not the
+        ratio. ``overrides`` pass through to the constructor."""
+        kw: Dict[str, Any] = dict(
+            prompt_lens=(96, 160), prompt_probs=(0.5, 0.5),
+            gen_lens=(4, 8), gen_probs=(0.5, 0.5),
+            vocab_size=vocab_size)
+        kw.update(overrides)
+        return cls(**kw)
+
     def describe(self) -> Dict[str, Any]:
         return {
             "prompt_mix": list(self.prompt_lens)
@@ -950,6 +970,54 @@ def _ms(v: Optional[float]) -> Optional[float]:
     return round(1e3 * v, 3) if v is not None else None
 
 
+def disagg_report(pool) -> Dict[str, Any]:
+    """The ``disagg`` report section for a phase-specialist fleet
+    (docs/serving.md "Disaggregated serving"): handoff volume (source-
+    counted), adoptions, fallback replays, the exposed-wait tail the
+    serve_disagg bench gates on, and per-role utilization rolled up
+    from the per-replica registries (``serve_tokens_committed`` /
+    ``serve_steps`` attribute each role's share of the work)."""
+    roles: Dict[str, Dict[str, Any]] = {}
+    handoffs = {"out": 0.0, "adopted": 0.0, "fallback_replays": 0.0,
+                "blocks": 0.0, "bytes": 0.0}
+    exposed = Histogram()
+    total_tokens = 0.0
+    for rep in pool.replicas():
+        if rep.state == "dead":
+            continue
+        r = roles.setdefault(rep.role, {
+            "replicas": 0, "requests_admitted": 0,
+            "tokens_committed": 0, "steps": 0, "live_sequences": 0})
+        r["replicas"] += 1
+        r["live_sequences"] += len(rep.engine.state.sequences)
+        m = rep.engine.metrics
+        if m is None:
+            continue
+        r["requests_admitted"] += int(
+            m.counter("serve_requests_admitted").value)
+        tok = m.counter("serve_tokens_committed").value
+        r["tokens_committed"] += int(tok)
+        total_tokens += tok
+        r["steps"] += int(m.counter("serve_steps").value)
+        handoffs["out"] += m.counter("serve_handoff_seqs").value
+        handoffs["adopted"] += m.counter("serve_handoff_seqs_in").value
+        handoffs["fallback_replays"] += m.counter(
+            "serve_handoff_fallback_replays").value
+        handoffs["blocks"] += m.counter("serve_handoff_blocks").value
+        handoffs["bytes"] += m.counter("serve_handoff_bytes").value
+        exposed.merge(m.histogram("serve_handoff_exposed_s"))
+    for r in roles.values():
+        r["token_share"] = round(
+            r["tokens_committed"] / total_tokens, 4) \
+            if total_tokens else None
+    return {
+        "roles": roles,
+        "handoffs": {k: int(v) if k != "bytes" else v
+                     for k, v in handoffs.items()},
+        "exposed_wait_s": exposed.summary(),
+    }
+
+
 # ---------------------------------------------------------------------- #
 # CLI (bin/dstpu_loadgen)
 # ---------------------------------------------------------------------- #
@@ -1035,6 +1103,12 @@ def main(argv: Optional[List[str]] = None) -> int:
              "report carries the acceptance rate")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft tokens per speculation round")
+    ap.add_argument("--mix", default=os.environ.get(
+        "DSTPU_LOADGEN_MIX", "custom"),
+        choices=("custom", "prefill_heavy"),
+        help="workload preset: prefill_heavy offers long prompts with "
+             "short generations (the disaggregated-serving regime, "
+             "docs/serving.md) and overrides --prompt-len/--gen-len")
     ap.add_argument("--prompt-len", type=int, default=24)
     ap.add_argument("--gen-len", type=int, default=16)
     ap.add_argument("--shared-prefix-frac", type=float, default=0.0)
@@ -1095,6 +1169,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         choices=("random", "round_robin", "prefix_aware"),
         help="fleet routing policy (default: DSTPU_FLEET_POLICY or "
              "prefix_aware)")
+    ap.add_argument("--roles", default=os.environ.get(
+        "DSTPU_FLEET_ROLES"),
+        help="comma list of per-replica phase roles (prefill/decode/"
+             "mixed) for --replicas N — arms disaggregated serving; "
+             "the report gains a 'disagg' section (DSTPU_DISAGG=0 "
+             "still forces everything mixed)")
     ap.add_argument("--slo-goodput", type=float, default=0.9,
                     help="goodput fraction the sweep's knee must meet")
     ap.add_argument("--out", default=None,
@@ -1123,7 +1203,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         engines = build_replica_engines(factory, args.replicas)
         mcfg = mcfg_box[0]
-        pool = ReplicaPool(engines, policy=args.policy)
+        roles = [r.strip() for r in args.roles.split(",")] \
+            if args.roles else None
+        pool = ReplicaPool(engines, policy=args.policy, roles=roles)
         eng = pool
     else:
         eng, mcfg = _tiny_engine(num_blocks=args.num_blocks,
@@ -1134,28 +1216,37 @@ def main(argv: Optional[List[str]] = None) -> int:
         from ..inference.v2 import SamplingParams
         sampling = SamplingParams(temperature=args.temperature,
                                   top_k=args.top_k, top_p=args.top_p)
-    mix = WorkloadMix(
-        prompt_lens=(args.prompt_len,), prompt_probs=(1.0,),
-        gen_lens=(args.gen_len,), gen_probs=(1.0,),
-        shared_prefix_frac=args.shared_prefix_frac,
-        # full 16-token blocks (the tiny engine's block size) so the
-        # shared span is actually cacheable; shorter prompts get no
-        # prefix rather than a sub-block span no match can ever hit.
-        # The working-set pattern always needs a preamble — it exists
-        # to cycle one — and takes the LONGEST block-aligned span the
-        # prompt affords (up to 3 blocks), so the group count is
-        # working-set/preamble-blocks and a realistic request count
-        # actually revisits each group.
-        shared_prefix_len=min(3, max(1, (args.prompt_len - 8) // 16)) * 16
-        if args.prefix_working_set_blocks > 0
-        else (16 if args.shared_prefix_frac > 0 and args.prompt_len >= 24
-              else 0),
-        prefix_group_count=max(1, args.prefix_groups),
-        prefix_working_set_blocks=max(0, args.prefix_working_set_blocks),
-        prefix_block_tokens=16,
-        deadline_frac=args.deadline_frac, deadline_s=args.deadline_s,
-        batch_frac=args.batch_frac,
-        vocab_size=mcfg.vocab_size)
+    if args.mix == "prefill_heavy":
+        mix = WorkloadMix.prefill_heavy(
+            vocab_size=mcfg.vocab_size,
+            deadline_frac=args.deadline_frac,
+            deadline_s=args.deadline_s,
+            batch_frac=args.batch_frac)
+    else:
+        mix = WorkloadMix(
+            prompt_lens=(args.prompt_len,), prompt_probs=(1.0,),
+            gen_lens=(args.gen_len,), gen_probs=(1.0,),
+            shared_prefix_frac=args.shared_prefix_frac,
+            # full 16-token blocks (the tiny engine's block size) so
+            # the shared span is actually cacheable; shorter prompts
+            # get no prefix rather than a sub-block span no match can
+            # ever hit. The working-set pattern always needs a preamble
+            # — it exists to cycle one — and takes the LONGEST
+            # block-aligned span the prompt affords (up to 3 blocks),
+            # so the group count is working-set/preamble-blocks and a
+            # realistic request count actually revisits each group.
+            shared_prefix_len=min(
+                3, max(1, (args.prompt_len - 8) // 16)) * 16
+            if args.prefix_working_set_blocks > 0
+            else (16 if args.shared_prefix_frac > 0
+                  and args.prompt_len >= 24 else 0),
+            prefix_group_count=max(1, args.prefix_groups),
+            prefix_working_set_blocks=max(
+                0, args.prefix_working_set_blocks),
+            prefix_block_tokens=16,
+            deadline_frac=args.deadline_frac, deadline_s=args.deadline_s,
+            batch_frac=args.batch_frac,
+            vocab_size=mcfg.vocab_size)
     adm = None
     if args.admission == "on":
         # explicit opt-in arms the controller; DSTPU_ADMISSION=0 (or
@@ -1235,6 +1326,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             "prefix": fleet_prefix_stats(pool),
             "slo_merged": bool(pool.fleet_registry() is not None),
         }
+        if any(r.role != "mixed" for r in pool.replicas()):
+            out["disagg"] = disagg_report(pool)
     blob = json.dumps(out)
     print(blob)
     if args.out:

@@ -133,6 +133,14 @@ REGISTERED_METRICS = {
     "admission_retry_after_s": "retry hints carried by door rejections",
     "brownout_transitions":
         "brownout ladder moves (label: direction=enter|exit)",
+    # -- disaggregated serving handoff (serving/pool.py) ---------------- #
+    "serve_handoff_seqs": "sequences handed prefill->decode (source side)",
+    "serve_handoff_blocks": "KV blocks moved by handoffs",
+    "serve_handoff_bytes": "KV payload bytes moved by handoffs",
+    "serve_handoff_seqs_in": "migrated sequences adopted (destination side)",
+    "serve_handoff_fallback_replays":
+        "handoffs that fell back to manifest replay",
+    "serve_handoff_exposed_s": "per-handoff exposed (non-overlapped) wall",
     # -- flight recorder (counter) -------------------------------------- #
     "flight_spans_dropped": "flight-recorder spans evicted by ring wrap",
 }

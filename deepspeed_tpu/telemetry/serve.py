@@ -118,6 +118,15 @@ class ServeObserver:
         self.h_promote = r.histogram("prefix_promote_wait_s")
         self.c_promoted = r.counter("prefix_promoted_blocks")
         self.c_flight_dropped = r.counter("flight_spans_dropped")
+        # disaggregated serving (docs/serving.md "Disaggregated
+        # serving"): handoff volume counted at the source replica,
+        # adoption + exposed transfer wall at the destination
+        self.c_handoff_seqs = r.counter("serve_handoff_seqs")
+        self.c_handoff_blocks = r.counter("serve_handoff_blocks")
+        self.c_handoff_bytes = r.counter("serve_handoff_bytes")
+        self.c_handoff_in = r.counter("serve_handoff_seqs_in")
+        self.c_handoff_replays = r.counter("serve_handoff_fallback_replays")
+        self.h_handoff_exposed = r.histogram("serve_handoff_exposed_s")
         self._reject_counters = {
             reason: r.counter(name)
             for reason, name in _REJECT_COUNTERS.items()}
@@ -306,6 +315,34 @@ class ServeObserver:
         Registered DSL001 hot path: a counter add + one observe."""
         self.c_promoted.inc(blocks)
         self.h_promote.observe(wait_s)
+
+    def on_handoff_out(self, seqs, blocks, nbytes):
+        """This replica handed ``seqs`` freshly prefilled sequences to a
+        decode specialist (``blocks`` KV blocks, ``nbytes`` payload —
+        int8 rows + scale planes for quantized pools). Counted at the
+        SOURCE so per-role registries attribute handoff traffic to the
+        prefill side. Registered DSL001 hot path — three counter adds."""
+        self.c_handoff_seqs.inc(seqs)
+        self.c_handoff_blocks.inc(blocks)
+        self.c_handoff_bytes.inc(nbytes)
+
+    def on_handoff_in(self, seqs, blocks, exposed_s):
+        """This replica adopted ``seqs`` migrated sequences
+        (``blocks`` KV blocks scattered in). ``exposed_s`` is the
+        caller-measured NON-overlapped transfer wall — the part of the
+        gather→materialize→scatter chain that did not hide under
+        neighboring compute; the serve_disagg bench gates on its share
+        of prefill time. Registered DSL001 hot path."""
+        self.c_handoff_in.inc(seqs)
+        self.h_handoff_exposed.observe(exposed_s)
+        del blocks  # volume counted once, at the source
+
+    def on_handoff_replay(self, seqs):
+        """Handoffs that fell back to manifest replay (destination
+        could not adopt, or the transfer died mid-flight): the request
+        re-prefills its chain token-identically instead. Registered
+        DSL001 hot path — one counter add."""
+        self.c_handoff_replays.inc(seqs)
 
     def on_reject(self, reason, uid=None, trace=None):
         c = self._reject_counters.get(reason)

@@ -217,6 +217,21 @@ def render(snap: Dict[str, Any], prev: Optional[Dict[str, Any]] = None
             f"speculation    proposed {prop:.0f}   accepted {acc:.0f}   "
             f"accept rate {_pct(_frac(acc, prop))}   "
             f"rounds {c.get('spec_rounds', 0):.0f}")
+    # disaggregated serving: only rendered once a prefill→decode handoff
+    # has actually happened (colocated fleets never pay for the line).
+    # A fleet-merged view sums both sides, so seqs counts the prefill
+    # exports and adopted the decode-side restores — they diverge only
+    # while migrations are in flight or falling back to replay.
+    hoff = c.get("serve_handoff_seqs", 0.0)
+    if hoff:
+        ex = h.get("serve_handoff_exposed_s", {})
+        lines.append(
+            f"handoff        seqs {hoff:.0f}   "
+            f"adopted {c.get('serve_handoff_seqs_in', 0):.0f}   "
+            f"replayed {c.get('serve_handoff_fallback_replays', 0):.0f}   "
+            f"blocks {c.get('serve_handoff_blocks', 0):.0f}   "
+            f"{c.get('serve_handoff_bytes', 0.0) / 1e6:.1f} MB   "
+            f"exposed p99 {_ms(ex.get('p99'))} ms")
     lines.append("")
     lines.append("latency (ms)          p50      p90      p99    count")
     for label, name in (("ttft", "serve_ttft_s"),

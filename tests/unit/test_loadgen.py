@@ -211,11 +211,12 @@ class TestOpenLoop:
         assert rep["goodput_frac"] < 1.0
         # the generator never waited on completions: every offer lags
         # its scheduled time by at most ONE admit/burst iteration on
-        # the warmed tiny engine (generously bounded at 1 s) — serving
-        # this workload to completion at 2-way concurrency takes many
-        # seconds, so a completion-gated (closed-loop) generator could
-        # not meet this bound
-        assert rep["open_loop"]["max_offer_lag_s"] < 1.0
+        # the warmed tiny engine (generously bounded at 2.5 s — a
+        # single burst can take over a second on a loaded single-core
+        # CI box) — serving this workload to completion at 2-way
+        # concurrency takes many seconds, so a completion-gated
+        # (closed-loop) generator could not meet this bound
+        assert rep["open_loop"]["max_offer_lag_s"] < 2.5
         # and the run's clock covered the whole offer schedule
         assert rep["duration_s"] >= reqs[-1].arrival_s
 

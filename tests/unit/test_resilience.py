@@ -584,7 +584,8 @@ class TestFaultDrill:
         assert rc == 0
 
     def test_sites_cover_the_documented_set(self):
-        from deepspeed_tpu.resilience import (SERVE_FAULT_SITES,
+        from deepspeed_tpu.resilience import (DISAGG_FAULT_SITE,
+                                              SERVE_FAULT_SITES,
                                               TRAIN_FAULT_SITES)
         assert TRAIN_FAULT_SITES == (
             "pre_save", "mid_save", "post_save_pre_latest", "collective",
@@ -592,4 +593,6 @@ class TestFaultDrill:
         assert SERVE_FAULT_SITES == (
             "pre_dispatch", "mid_commit", "during_prefill_chunk",
             "during_cow_copy")
-        assert FAULT_SITES == TRAIN_FAULT_SITES + SERVE_FAULT_SITES
+        assert DISAGG_FAULT_SITE == "during_handoff_gather"
+        assert FAULT_SITES == (TRAIN_FAULT_SITES + SERVE_FAULT_SITES
+                               + (DISAGG_FAULT_SITE,))

@@ -134,8 +134,12 @@ DEFAULT_MIN_ABS = 0.02
 #: (DSTPU_ATTRIB_INJECT_MS / DSTPU_TRAINOBS_STALL_MS), so gating them
 #: would flag deliberate knob changes; the boolean localization gates
 #: (localized_to_*) still gate.
+#: "mix" is the serve_disagg workload echo; "exposed_wait_s" is that
+#: bench's diagnostic histogram summary — its count/sum scale with the
+#: request knob, and the gated number is handoff_exposed_frac
 _SKIP_SUBTREES = ("serve_config", "train_config", "config", "probe",
-                  "detail_flags", "schedule", "component_deltas_s")
+                  "detail_flags", "schedule", "component_deltas_s",
+                  "mix", "exposed_wait_s")
 
 
 def _direction(path: str) -> Optional[str]:

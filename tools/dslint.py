@@ -64,11 +64,15 @@ HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
     # retry wrappers, shed/abort bookkeeping and the commit-side fault
     # hook must stay pure host work — one readback there re-serializes
     # the pipeline the drain layer is supposed to leave untouched
+    # handoff_out/handoff_in are the disagg migration halves (ISSUE
+    # 17): per-seq gathers and the restore scatter are enqueue-only
+    # device work — the ONE sanctioned blocking materialize is the
+    # pool's batched device_get in _migrate_prefill (allow-commented)
     "deepspeed_tpu/inference/v2/engine_v2.py":
         ("_drive_pipeline", "_plan_step", "_dispatch_step",
          "_staging_bufs", "_match_prefix", "_register_prefix",
          "_pre_commit", "_dispatch_with_retry", "_expire_deadlines",
-         "abort", "_shed_starved"),
+         "abort", "_shed_starved", "handoff_out", "handoff_in"),
     # the per-slot sampling stager fills pre-allocated numpy buffers
     # inside the plan phase (engine _plan_step calls it per slot):
     # host stores over ints/floats only
@@ -107,8 +111,13 @@ HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
     # commit boundary (deliberately NOT registered: it is the one
     # sanctioned blocking site, after a step readback already proved
     # the gathers complete)
+    # gather_blocks/restore are the handoff's device halves: exact-
+    # length gather dispatch and the batched restore scatter — both
+    # enqueue-only (the materialize lives in the pool's one batched
+    # device_get)
     "deepspeed_tpu/inference/v2/kv_cache.py":
-        ("reserve", "_demote", "promote_block", "promote_blocks"),
+        ("reserve", "_demote", "promote_block", "promote_blocks",
+         "gather_blocks", "restore"),
     # the decomposed TP collective builders trace inside every runner
     # program build (and inside MoE training steps): a blocking host sync
     # here would stall every retrace of the serve/train hot path — these
@@ -136,7 +145,8 @@ HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
          "on_commit_apply", "on_loop_enter", "on_loop_exit",
          "_close_step", "on_retry",
          "on_reject", "on_abort", "on_flush", "on_spec",
-         "on_spec_commit", "on_promote", "phase", "_req_span",
+         "on_spec_commit", "on_promote", "on_handoff_out",
+         "on_handoff_in", "on_handoff_replay", "phase", "_req_span",
          "_req_event"),
     # the TRAIN observer's step brackets run inside every train_batch
     # (ISSUE 15): perf_counter reads, attribute stores and pre-bound
@@ -187,10 +197,15 @@ HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
     # _mint_trace/_route run per admission between the engines'
     # pipelines: trace minting is two dict stores, the routing-decision
     # span is pure host scoring plus one ring append
+    # _migrate_prefill is the disagg handoff splice: routing walks and
+    # handoff dispatch are pure host work; its ONE batched device_get
+    # (the exposed-cost materialize) is the sanctioned blocking site
+    # and carries an allow comment
     "deepspeed_tpu/serving/pool.py":
         ("put", "decode_pipelined", "_take_stash", "_run_groups",
          "_mint_trace", "_route", "prefix_overlap",
-         "prefix_overlap_tiered", "queue_frac", "slo_headroom"),
+         "prefix_overlap_tiered", "queue_frac", "slo_headroom",
+         "_migrate_prefill"),
 }
 
 #: roots scanned for DSTPU_* env reads (knob rules + gen_config_doc) —

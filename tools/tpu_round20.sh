@@ -1,0 +1,62 @@
+#!/bin/bash
+# Round-20 on-chip sequence: disaggregated prefill/decode serving
+# (ISSUE 17). The CPU story is proven in tier-1 (handoff manifest
+# round-trip incl. int8 payload+scale exactness, greedy/sampled/spec
+# parity through the migration, aborted-handoff abort-safety, draining-
+# destination replay fallback, DSTPU_DISAGG=0 killswitch, role surface
+# validation) and in the disagg fault drill (aborted mid-gather handoff
+# loses nothing, SIGTERM on the prefill specialist drains onto the
+# decode survivor token-identically, post-kill degradation); on chip
+# this captures (a) lint cleanliness (handoff DSL001 hot-path registry
+# + DSTPU_DISAGG*/DSTPU_FLEET_ROLES knob tables + DSL006 handoff metric
+# rows), (b) the tpu_smoke sweep — no serve-path regression with the
+# handoff paths compiled in but roles defaulting to mixed, (c) the
+# serve_disagg bench at real step times (disagg beats colocated on BOTH
+# TTFT p99 and TPOT p99 at matched load, exposed handoff wall <10% of
+# prefill time, byte-identical streams, zero fresh compiles, killswitch
+# parity) — on real slices the handoff rides the ICI/DCN path, so the
+# exposed-wall gate is the one to watch, (d) the disagg drill on its
+# own, and (e) bench_compare gating this round's capture against the
+# previous one. Strictly sequential (one process owns the chip), no
+# timeouts around TPU clients (a killed client wedges the grant).
+cd /root/repo || exit 1
+LOG=profiles/r20_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round20 start $(date -u +%FT%TZ)"
+FAIL=0
+
+echo "--- [1/5] dstpu_lint (handoff hot-path registry, DSTPU_DISAGG*"
+echo "    knob + handoff metric catalog drift)"
+python bin/dstpu_lint deepspeed_tpu || FAIL=1
+
+echo "--- [2/5] tpu_smoke: full kernel + serve sweep (handoff paths"
+echo "    compiled in, roles default mixed — no serve-path regression)"
+python tools/tpu_smoke.py || FAIL=1
+
+echo "--- [3/5] serve_disagg bench: colocated-vs-disagg tails at"
+echo "    matched load, exposed-wall + parity + killswitch gates"
+python bench.py serve_disagg > BENCH_DISAGG_r20.json || FAIL=1
+tail -c 1600 BENCH_DISAGG_r20.json
+
+echo "--- [4/5] disagg fault drill: aborted handoff + prefill-"
+echo "    specialist kill, token parity vs colocated oracle"
+python bin/dstpu_faultdrill --mode disagg || FAIL=1
+
+echo "--- [5/5] bench_compare: gate this round's serve_disagg capture"
+echo "    against the previous one (tolerance bands; missing phase ="
+echo "    regression)"
+PREV=$(ls BENCH_DISAGG_r*.json 2>/dev/null | sort | tail -2 | head -1)
+if [ -n "$PREV" ] && [ "$PREV" != "BENCH_DISAGG_r20.json" ]; then
+    python tools/bench_compare.py "$PREV" BENCH_DISAGG_r20.json || FAIL=1
+else
+    echo "no prior serve_disagg capture — baseline round, comparing"
+    echo "the last two serve_admission captures instead (informational)"
+    mapfile -t ROUNDS < <(ls BENCH_ADMISSION_r*.json 2>/dev/null | sort | tail -2)
+    if [ "${#ROUNDS[@]}" = 2 ]; then
+        python tools/bench_compare.py "${ROUNDS[0]}" "${ROUNDS[1]}" \
+            --allow-missing || FAIL=1
+    fi
+fi
+
+echo "=== tpu_round20 done $(date -u +%FT%TZ) FAIL=$FAIL"
+exit $FAIL
