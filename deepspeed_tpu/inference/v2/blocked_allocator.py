@@ -10,11 +10,19 @@ cache and several sequences; the allocator stays refcount-oblivious — shared
 blocks are simply *allocated* until the cache evicts them — but it now
 detects a double free exactly (set membership, not just list overflow),
 which is what the refcounting stress tests assert against.
+
+Sequence-parallel serving (``seq_parallel.py``) shards the pool round-robin
+by block id: block ``b`` lives on chip ``b % num_homes``, and chain ordinal
+``o`` must land on home ``o % num_homes`` so every chip holds the same
+number of any chain's blocks. The allocator therefore keeps one free list
+PER HOME and ``allocate`` accepts the homes the caller needs. At
+``num_homes=1`` (the default, and every non-seq engine) the behavior —
+including pop order — is exactly the historical single-list allocator.
 """
 
 from __future__ import annotations
 
-from typing import List, Sequence, Set
+from typing import List, Optional, Sequence, Set
 
 
 class OutOfBlocksError(RuntimeError):
@@ -22,29 +30,104 @@ class OutOfBlocksError(RuntimeError):
 
 
 class BlockedAllocator:
-    def __init__(self, num_blocks: int):
+    def __init__(self, num_blocks: int, num_homes: int = 1):
         if num_blocks <= 0:
             raise ValueError(f"num_blocks must be positive, got {num_blocks}")
+        if num_homes < 1:
+            raise ValueError(f"num_homes must be >= 1, got {num_homes}")
+        if num_blocks % num_homes:
+            raise ValueError(
+                f"num_blocks ({num_blocks}) must divide by num_homes "
+                f"({num_homes}) — the pool shards round-robin by block id")
         self._num_blocks = num_blocks
-        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
-        self._free_set: Set[int] = set(self._free)
+        self._num_homes = num_homes
+        # per-home LIFO free lists; home of block b is b % num_homes. The
+        # single-home list is the historical descending stack (pop order
+        # 0, 1, 2, ...), and multi-home lists preserve the same ascending
+        # pop order WITHIN each home.
+        self._free: List[List[int]] = [
+            list(range(num_blocks - num_homes + h, -1, -num_homes))
+            for h in range(num_homes)]
+        self._free_set: Set[int] = set(range(num_blocks))
 
     @property
     def num_blocks(self) -> int:
         return self._num_blocks
 
     @property
+    def num_homes(self) -> int:
+        return self._num_homes
+
+    @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        return len(self._free_set)
+
+    def free_in_home(self, home: int) -> int:
+        return len(self._free[home])
+
+    def free_list(self) -> List[int]:
+        """Flat snapshot of every free block id across all homes —
+        introspection for the refcount stress oracles (a duplicate here,
+        or a length diverging from ``free_blocks``, is free-list
+        corruption)."""
+        return [b for home in self._free for b in home]
+
+    def home_of(self, block: int) -> int:
+        return block % self._num_homes
 
     def is_free(self, block: int) -> bool:
         return block in self._free_set
 
-    def allocate(self, n: int) -> List[int]:
-        if n > len(self._free):
-            raise OutOfBlocksError(
-                f"requested {n} blocks, only {len(self._free)} free")
-        out = [self._free.pop() for _ in range(n)]
+    def can_allocate(self, homes: Sequence[int]) -> bool:
+        """True when one block per requested home is available — the
+        per-home form of ``n <= free_blocks`` (which a seq-sharded pool
+        cannot use: the total can cover ``n`` while one home is dry)."""
+        need = [0] * self._num_homes
+        for h in homes:
+            need[h] += 1
+        return all(need[h] <= len(self._free[h])
+                   for h in range(self._num_homes))
+
+    def shortfall(self, homes: Sequence[int]) -> List[int]:
+        """Per-home deficit for a prospective ``allocate(homes=...)`` —
+        what ``reserve`` pressure must recover before the call can
+        succeed."""
+        need = [0] * self._num_homes
+        for h in homes:
+            need[h] += 1
+        return [max(0, need[h] - len(self._free[h]))
+                for h in range(self._num_homes)]
+
+    def allocate(self, n: int,
+                 homes: Optional[Sequence[int]] = None) -> List[int]:
+        """Allocate ``n`` blocks. With ``homes`` (one home id per block,
+        ``len(homes) == n``) block ``i`` of the result comes from home
+        ``homes[i]``; without, blocks come from the fullest homes first
+        (identical to the historical order at ``num_homes=1``)."""
+        if homes is not None:
+            if len(homes) != n:
+                raise ValueError(
+                    f"homes has {len(homes)} entries for n={n}")
+            deficit = self.shortfall(homes)
+            if any(deficit):
+                raise OutOfBlocksError(
+                    f"requested {n} blocks with per-home deficit "
+                    f"{deficit} (free={[len(f) for f in self._free]})")
+            out = [self._free[h].pop() for h in homes]
+        else:
+            if n > len(self._free_set):
+                raise OutOfBlocksError(
+                    f"requested {n} blocks, only {len(self._free_set)} "
+                    f"free")
+            if self._num_homes == 1:
+                free = self._free[0]
+                out = [free.pop() for _ in range(n)]
+            else:
+                out = []
+                for _ in range(n):
+                    h = max(range(self._num_homes),
+                            key=lambda i: len(self._free[i]))
+                    out.append(self._free[h].pop())
         self._free_set.difference_update(out)
         return out
 
@@ -56,5 +139,6 @@ class BlockedAllocator:
             if b in self._free_set or b in incoming:
                 raise RuntimeError(f"double free of block {b}")
             incoming.add(b)
-        self._free.extend(blocks)
+        for b in blocks:
+            self._free[b % self._num_homes].append(b)
         self._free_set.update(incoming)

@@ -38,6 +38,23 @@ class RaggedInferenceConfig(ConfigModel):
     # and each layer pays exactly two all-reduces plus one pre-sampling
     # logits gather. num_heads and kv_heads must divide by tp_size.
     tp_size: int = 1
+    # Sequence-parallel serving over the 'seq' mesh axis (inference/v2/
+    # seq_parallel.py, docs/serving.md "Long-context serving"): the KV
+    # pool is SEQUENCE-sharded — one sequence's blocks span chips
+    # round-robin by chain ordinal (block o lives on chip o % seq_size),
+    # so per-chip pool bytes stay FLAT as a request's context grows past
+    # what one chip's pool holds. Prefill chunks shard their query slice
+    # over the axis (context-parallel prefill: each chip attends its
+    # slice against the full paged history via a ring pass over the
+    # per-chip KV shards); decode broadcasts q and combines per-chip
+    # partial flash-softmax stats with one small all-gather per layer.
+    # Weights replicate over the axis. seq_size=1 traces the exact
+    # pre-seq programs; the env knob DSTPU_SEQ_PARALLEL overrides at
+    # engine construction (0 = killswitch, N>1 = force the axis open).
+    # Mutually exclusive with tp_size > 1 for now; requires the dense
+    # attention path and num_blocks / max_blocks_per_seq divisible by
+    # seq_size.
+    seq_size: int = 1
     # Route the TP all-reduces through int8 quantized comm (EQuARX-class
     # for bandwidth-bound decode). With tp_comm_overlap off this is the
     # legacy monolithic int8 all-gather; with overlap on, quant/dequant
@@ -193,6 +210,35 @@ class RaggedInferenceConfig(ConfigModel):
                 f"{self.kv_cache_dtype!r}")
         if self.tp_size < 1:
             raise ValueError(f"tp_size must be >= 1, got {self.tp_size}")
+        if self.seq_size < 1:
+            raise ValueError(
+                f"seq_size must be >= 1, got {self.seq_size}")
+        if self.seq_size > 1:
+            if self.tp_size > 1:
+                # composing the model and seq axes needs 2-D pool specs
+                # and a double logits reduction — future work; fail at
+                # config time rather than mis-shard silently
+                raise ValueError(
+                    "seq_size > 1 with tp_size > 1 is not supported yet "
+                    "— pick one sharding axis per engine")
+            if self.num_blocks % self.seq_size:
+                raise ValueError(
+                    f"num_blocks ({self.num_blocks}) must divide by "
+                    f"seq_size ({self.seq_size}) — the pool shards "
+                    f"round-robin by block index")
+            if self.max_blocks_per_seq % self.seq_size:
+                # the block-table gather takes chain ordinals o ≡ r
+                # (mod seq) per chip — a ragged table width would leave
+                # the last ordinals unreachable from their home chip
+                raise ValueError(
+                    f"max_blocks_per_seq ({self.max_blocks_per_seq}) "
+                    f"must divide by seq_size ({self.seq_size})")
+            if self.attention_impl not in ("dense", "auto"):
+                raise ValueError(
+                    f"seq_size > 1 requires the dense attention path "
+                    f"(the paged-flash kernel indexes a single-chip "
+                    f"pool layout), got attention_impl="
+                    f"{self.attention_impl!r}")
         from ...comm import TP_OVERLAP_MODES
         if self.tp_comm_overlap not in TP_OVERLAP_MODES:
             raise ValueError(
@@ -250,10 +296,20 @@ class RaggedInferenceConfig(ConfigModel):
     @property
     def effective_chunk(self) -> int:
         """Prefill chunk length the scheduler (and the compiled prefill
-        program's token dim) actually uses."""
-        if self.prefill_chunk_cap > 0:
-            return min(self.chunk_size, self.prefill_chunk_cap)
-        return self.chunk_size
+        program's token dim) actually uses.
+
+        With ``seq_size > 1`` the chunk is rounded UP to the next
+        multiple of the seq axis: the context-parallel prefill slices
+        the compiled token dim into ``seq_size`` equal query shards, so
+        a non-divisible chunk would either truncate tokens or hand one
+        chip a zero-width slice. Padding (the trailing slice carries
+        masked pad tokens on short chunks) keeps every shard's shape
+        static and nonzero."""
+        c = min(self.chunk_size, self.prefill_chunk_cap) \
+            if self.prefill_chunk_cap > 0 else self.chunk_size
+        if self.seq_size > 1:
+            c = -(-c // self.seq_size) * self.seq_size
+        return c
 
     @property
     def token_budget(self) -> int:

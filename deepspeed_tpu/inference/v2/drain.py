@@ -185,6 +185,10 @@ def build_manifest(engine) -> Dict[str, Any]:
             "prefix_cache": bool(engine.config.prefix_cache),
             "serve_pipeline_depth": engine.pipeline_depth,
             "tp_size": engine.config.tp_size,
+            # the seq shard map: chain ordinal o homes on chip
+            # o % seq_size. Replay re-prefills, so a restore engine may
+            # use ANY seq_size — recorded for audit, not a constraint
+            "seq_size": max(1, int(getattr(engine.config, "seq_size", 1))),
         },
         "sequences": seqs,
     }

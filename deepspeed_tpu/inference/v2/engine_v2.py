@@ -136,10 +136,13 @@ def _runner_for(model_cfg: Any, cfg: RaggedInferenceConfig):
 class InferenceEngineV2:
     def __init__(self, model_cfg: Any, params: Any,
                  config: Optional[RaggedInferenceConfig] = None,
-                 runner: Any = None):
+                 runner: Any = None, devices: Any = None):
         """``model_cfg``: a model config understood by a ragged runner
         (GPT2Config here; llama-family runners register the same interface).
-        ``params``: the matching param pytree."""
+        ``params``: the matching param pytree. ``devices``: optional
+        explicit device list for the sharding mesh (seq/tp) — a replica
+        pool hands each engine its DISJOINT slice
+        (serving/pool.py ``build_replica_engines``)."""
         self.config = config or RaggedInferenceConfig()
         # decomposed-collective env override (the operational kill-switch /
         # force-on, like DSTPU_SERVE_ASYNC below): DSTPU_TP_OVERLAP =
@@ -160,6 +163,20 @@ class InferenceEngineV2:
                 self.config, tp_comm_overlap=mode,
                 **({"tp_comm_chunks": chunks}
                    if mode == "rs_ag_chunked" else {}))
+        # sequence-parallel env override (the long-context kill-switch):
+        # DSTPU_SEQ_PARALLEL=0 forces seq_size=1 — exact pre-seq programs,
+        # the parity oracle for live traffic — and =N forces the axis on.
+        # Applied BEFORE the runner builds, like DSTPU_TP_OVERLAP above.
+        env_seq = os.environ.get("DSTPU_SEQ_PARALLEL")
+        if env_seq not in (None, ""):
+            import dataclasses as _dc
+            sz = int(env_seq)
+            if sz < 0:
+                raise ValueError(
+                    f"DSTPU_SEQ_PARALLEL must be >= 0, got {sz}")
+            # replace, never mutate (same contract as the TP overlap knob);
+            # 0 means "off" -> the single-chip layout, seq_size=1
+            self.config = _dc.replace(self.config, seq_size=max(1, sz))
         self.runner = runner or _runner_for(model_cfg, self.config)
         tp = self.config.tp_size
         if tp > 1:
@@ -172,8 +189,22 @@ class InferenceEngineV2:
                     f"tensor-parallel serving (no init_tp)")
             from .tp import build_tp_context
             tp_ctx, params = build_tp_context(self.config, self.runner,
-                                              params)
+                                              params, devices=devices)
             self.runner.init_tp(tp_ctx)
+        elif self.config.seq_size > 1:
+            # sequence-parallel serving (seq_parallel.py): the KV pool
+            # shards round-robin by block home over the 'seq' mesh and
+            # params REPLICATE — the axis shards context, not the model.
+            # Host-side scheduler/allocator stay single-program (the
+            # allocator grows per-home free lists, nothing else moves).
+            if not hasattr(self.runner, "init_seq"):
+                raise ValueError(
+                    f"runner {type(self.runner).__name__} does not support "
+                    f"sequence-parallel serving (no init_seq)")
+            from .seq_parallel import build_seq_context
+            seq_ctx, params = build_seq_context(self.config, self.runner,
+                                                params, devices=devices)
+            self.runner.init_seq(seq_ctx)
         self.params = params
         if self.config.kv_cache_dtype == "int8" \
                 and self.config.attention_impl in ("auto", "paged_flash") \
@@ -204,6 +235,10 @@ class InferenceEngineV2:
             # head-shard the pool at rest: per-chip KV bytes ∝ 1/tp — the
             # lever that lets a model's KV footprint span chips
             self.kv_cache.shard(self.runner.tp.mesh)
+        elif self.config.seq_size > 1:
+            # block-shard the pool at rest: per-chip KV bytes ∝ 1/seq as
+            # CONTEXT grows — the capacity lever for long prompts
+            self.kv_cache.shard_seq(self.runner.seqctx.mesh)
         self.state = StateManager(self.config, self.kv_cache)
         self._prefix = None
         if self.config.prefix_cache:
@@ -1065,7 +1100,10 @@ class InferenceEngineV2:
             raise KeyError(f"unknown sequence {uid}")
         if seq.status is not SequenceStatus.PAUSED:
             return
-        blocks = self.kv_cache.reserve(seq.paused_blocks)
+        blocks = self.kv_cache.reserve(
+            seq.paused_blocks,
+            homes=[i % self.kv_cache.seq for i in range(seq.paused_blocks)]
+            if self.kv_cache.seq > 1 else None)
         self._kv_data = self.kv_cache.restore(self._kv_data, seq.host_kv,
                                               blocks)
         seq.kv_blocks = list(blocks)
@@ -1151,7 +1189,13 @@ class InferenceEngineV2:
             self.state.flush(uid)
         if recs and self._obs is not None:
             self._obs.on_handoff_out(len(recs), blocks_moved, bytes_moved)
+        # seq_size IS the shard map: chain ordinal o lives on chip
+        # o % seq_size. The kv payloads themselves are geometry-free
+        # (gather_blocks returns block-chain-ordered rows), so a
+        # destination with ANY seq_size restores them exactly.
+        seq_size = self.config.seq_size     # host int (config field)
         return {"version": 1, "source": "handoff", "time": time.time(),
+                "seq_size": max(1, int(seq_size)),
                 "sequences": recs}
 
     def handoff_in(self, manifest: Dict[str, Any],
@@ -1187,7 +1231,13 @@ class InferenceEngineV2:
                     f"engine")
             nblocks = int(rec["blocks"])  # dslint: allow(DSL001): host int
             try:
-                blocks = self.kv_cache.reserve(nblocks)
+                # a migrated chain restarts at ordinal 0 — at seq > 1 its
+                # blocks must land on homes 0, 1, ... % seq so the
+                # destination's seq-sharded gathers see the same layout
+                blocks = self.kv_cache.reserve(
+                    nblocks,
+                    homes=[i % self.kv_cache.seq for i in range(nblocks)]
+                    if self.kv_cache.seq > 1 else None)
             except OutOfBlocksError:
                 spilled.append(uid)
                 continue

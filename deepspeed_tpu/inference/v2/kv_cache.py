@@ -8,6 +8,15 @@ blocks addressed through per-sequence block tables. Stored flat —
 one scatter and context gather is one take per step; block granularity
 exists only in the allocator and the block tables. Rows are lane-aligned
 ``kv_heads * head_dim`` flats: see the allocation comment below.
+
+Sequence-parallel serving (``seq_parallel.py``, ``cfg.seq_size > 1``)
+shards the SLOTS dim over the ``seq`` mesh axis: slots grow to
+``(num_blocks + seq) * block_size`` so each chip's contiguous shard ends
+with its OWN trash block, block ``b`` lives in rows
+``(b % seq) * shard_rows + (b // seq) * bs`` (chip ``b % seq``), and the
+allocator grows per-home free lists so chain ordinal ``o`` always lands
+on chip ``o % seq`` — per-chip pool bytes stay flat however long any one
+sequence grows. ``seq = 1`` reproduces the layout above bit-for-bit.
 """
 
 from __future__ import annotations
@@ -117,9 +126,14 @@ class BlockedKVCache:
         self.kv_heads = kv_heads
         self.head_dim = head_dim
         self.dtype = dtype or jnp.bfloat16
-        self.allocator = BlockedAllocator(cfg.num_blocks)
+        # seq-sharded homes: block b belongs to chip b % seq; at the
+        # default seq=1 the allocator is exactly the historical one
+        self.seq = int(getattr(cfg, "seq_size", 1) or 1)
+        self.allocator = BlockedAllocator(cfg.num_blocks,
+                                          num_homes=self.seq)
         self.prefix: Optional[PrefixCache] = None   # attach_prefix_cache
         self._mesh = None                           # set by shard()
+        self._seq_mesh = None                       # set by shard_seq()
         self._copy_jit = None                       # built on first CoW
         # hierarchical KV (docs/serving.md "Hierarchical KV"): the engine
         # provides the CURRENT functional pool value (its _kv_data) so a
@@ -136,7 +150,10 @@ class BlockedKVCache:
         # Rows are FLAT [KV*D]: a trailing (KV, D) pair would be stored
         # (8, 128)-tile padded in HBM (4x footprint and DMA traffic for the
         # common KV=4, D=64 layouts); lane-aligned flat rows pad nothing.
-        slots = (cfg.num_blocks + 1) * cfg.block_size
+        # seq>1: one trash block PER CHIP, at the end of each contiguous
+        # shard — inside a shard_map body data.shape[2]-1 stays the local
+        # trash row, same as the single-chip layout.
+        slots = (cfg.num_blocks + self.seq) * cfg.block_size
         self.quantized = cfg.kv_cache_dtype == "int8"
         if self.quantized:
             # int8 rows + per-(token, kv-head) f32 scales TRANSPOSED so a
@@ -203,21 +220,42 @@ class BlockedKVCache:
         destroying refcount-0 cached blocks."""
         self._pool_source = fn
 
-    def reserve(self, n: int):
+    def reserve(self, n: int, homes=None):
         """Allocate ``n`` blocks, reclaiming refcount-0 prefix-cached
         blocks on demand: with the host tier armed they are DEMOTED
         (one batched non-blocking device→host gather per reserve call —
         the cached chain survives, host-resident), otherwise destroyed.
         Registered DSL001 hot path: the gather is dispatch-only; the
-        D2H materialize happens at a commit boundary."""
+        D2H materialize happens at a commit boundary.
+
+        ``homes`` (seq-parallel, one home chip per block) makes the
+        pressure loop PER-HOME: eviction victims land back on whatever
+        home they came from, so the loop keeps reclaiming until every
+        needed home has supply or nothing more is evictable — the
+        allocator then fails loudly on a genuine per-home exhaustion."""
         self.collect_prefix_evictions()
-        short = n - self.allocator.free_blocks
-        if short > 0 and self.prefix is not None:
+        if homes is None:
+            short = n - self.allocator.free_blocks
+            if short > 0 and self.prefix is not None:
+                if self.prefix.host_tier and self._pool_source is not None:
+                    short -= self._demote(short)
+                if short > 0:
+                    self.allocator.free(self.prefix.evict(short))
+            return self.allocator.allocate(n)
+        while self.prefix is not None:
+            short = sum(self.allocator.shortfall(homes))
+            if not short:
+                break
+            recovered = 0
             if self.prefix.host_tier and self._pool_source is not None:
-                short -= self._demote(short)
-            if short > 0:
-                self.allocator.free(self.prefix.evict(short))
-        return self.allocator.allocate(n)
+                recovered += self._demote(short)
+            if recovered < short:
+                freed = self.prefix.evict(short - recovered)
+                self.allocator.free(freed)
+                recovered += len(freed)
+            if not recovered:
+                break
+        return self.allocator.allocate(n, homes=homes)
 
     def _demote(self, short: int) -> int:
         """Demote up to ``short`` refcount-0 cached blocks to the host
@@ -350,6 +388,11 @@ class BlockedKVCache:
         the pool's lane (head) dim is untouched, so the program is
         head-local with ZERO collectives (audited:
         test_program_audit.py::TestPrefixCacheBudgets)."""
+        if self.seq > 1 and src % self.seq != dst % self.seq:
+            raise ValueError(
+                f"seq CoW copy {src}->{dst} crosses homes "
+                f"({src % self.seq} -> {dst % self.seq}): a CoW dst must "
+                f"share its src's chain ordinal home")
         if self._copy_jit is None:
             self._copy_jit = self._build_copy()
         return self._copy_jit(kv_data, jnp.int32(src), jnp.int32(dst))
@@ -358,18 +401,49 @@ class BlockedKVCache:
         import jax
         from .kv_quant import pool_parts, repack
         bs = self.cfg.block_size
+        seq = self.seq
+        nb = self.cfg.num_blocks
+        seq_local = self._seq_mesh is not None   # body sees a LOCAL shard
 
         def _copy(kv_data, src, dst):
             data, scales = pool_parts(kv_data)
             rows = jnp.arange(bs, dtype=jnp.int32)
-            si = src * bs + rows
-            di = dst * bs + rows
+            if seq_local:
+                # CoW replaces a block at the SAME chain ordinal, so src
+                # and dst share a home chip — the copy is chip-LOCAL:
+                # the owner copies its local rows, every other chip does
+                # a trash self-copy (write of trash onto itself). Zero
+                # collectives, exactly like the TP head-local copy.
+                from jax import lax
+                from .seq_parallel import SEQ_AXIS
+                r = lax.axis_index(SEQ_AXIS)
+                own = (src % seq) == r
+                trash = (nb // seq) * bs + rows
+                si = jnp.where(own, (src // seq) * bs + rows, trash)
+                di = jnp.where(own, (dst // seq) * bs + rows, trash)
+            elif seq > 1:
+                # unsharded pool in the seq layout (CPU harness before
+                # shard_seq): global rows via the round-robin formula
+                shard_rows = (nb // seq + 1) * bs
+                si = (src % seq) * shard_rows + (src // seq) * bs + rows
+                di = (dst % seq) * shard_rows + (dst // seq) * bs + rows
+            else:
+                si = src * bs + rows
+                di = dst * bs + rows
             data = data.at[:, :, di].set(data[:, :, si])
             if scales is not None:
                 scales = scales.at[:, :, :, di].set(scales[:, :, :, si])
             return repack(kv_data, data, scales)
 
-        if self._mesh is not None:
+        if self._seq_mesh is not None:
+            from jax.sharding import PartitionSpec as P
+            from ...utils.jax_compat import shard_map
+            from .seq_parallel import seq_pool_specs
+            spec = seq_pool_specs(self.quantized)
+            _copy = shard_map(_copy, mesh=self._seq_mesh,
+                              in_specs=(spec, P(), P()), out_specs=spec,
+                              check_vma=False)
+        elif self._mesh is not None:
             from jax.sharding import PartitionSpec as P
             from ...utils.jax_compat import shard_map
             from .tp import pool_specs
@@ -397,6 +471,26 @@ class BlockedKVCache:
             self.scales = jax.device_put(
                 self.scales, NamedSharding(mesh, P(None, None, "model",
                                                    None)))
+        if self.prefix is not None:
+            self._warm_copy()       # recompile eagerly, off the serve loop
+
+    def shard_seq(self, mesh) -> None:
+        """Shard the pool at rest over the ``seq`` mesh axis: the slots
+        dim chunks contiguously, handing chip r its round-robin block
+        homes plus its own trailing trash block (per-chip KV bytes
+        ∝ 1/seq of the whole pool and FLAT in any one sequence's
+        length). Block tables stay host metadata; the allocator's
+        per-home free lists are already seq-aware."""
+        import jax
+        from jax.sharding import NamedSharding
+        from .seq_parallel import POOL_DATA_SPEC, POOL_SCALE_SPEC
+        self._seq_mesh = mesh
+        self._copy_jit = None       # rebuild under the mesh
+        self.data = jax.device_put(
+            self.data, NamedSharding(mesh, POOL_DATA_SPEC))
+        if self.scales is not None:
+            self.scales = jax.device_put(
+                self.scales, NamedSharding(mesh, POOL_SCALE_SPEC))
         if self.prefix is not None:
             self._warm_copy()       # recompile eagerly, off the serve loop
 
@@ -431,10 +525,14 @@ class BlockedKVCache:
     # (the block ids need not match: block tables are per-sequence).
 
     def _slot_indices(self, blocks):
-        import numpy as np
-        bs = self.cfg.block_size
-        blocks = np.asarray(list(blocks), np.int32)
-        return (blocks[:, None] * bs + np.arange(bs)[None, :]).reshape(-1)
+        # generalized to the seq-sharded layout; seq=1 reduces exactly to
+        # the classic contiguous b*bs rows. Rows come out BLOCK-ORDERED
+        # regardless of seq, so offload/gather_blocks buffers restore
+        # correctly onto a pool of a DIFFERENT seq size (cross-geometry
+        # disagg handoff).
+        from .seq_parallel import slot_rows
+        return slot_rows(blocks, self.cfg.block_size,
+                         self.cfg.num_blocks, self.seq)
 
     def offload(self, kv_data, blocks) -> "Any":
         """Gather ``blocks`` of a (functional) kv buffer to host memory.

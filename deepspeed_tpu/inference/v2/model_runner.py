@@ -25,10 +25,12 @@ from jax.sharding import PartitionSpec as P
 
 from ...models.gpt2 import GPT2Config
 from ...parallel.tp_rules import MODEL_AXIS
-from ...utils.jax_compat import manual_axes, shard_map
+from ...utils.jax_compat import axis_size, manual_axes, shard_map
 from .config import RaggedInferenceConfig
 from .kv_quant import KVPool, RingKV, pool_parts, quantize_rows, repack
 from .sampling import SAMPLE_CANDIDATES
+from .seq_parallel import (SEQ_AXIS, combine_decode_stats, ring_all_gather,
+                           seq_axis_active)
 
 
 # --------------------------------------------------------------------- #
@@ -257,6 +259,220 @@ def _dense_ring_attention(pool, ring, li, q, batch, cfg, settled_lens,
                                     alibi_slopes)
 
 
+def _seq_local_ctx(data, scales, li, tables, cfg, sz, r, dtype,
+                   dequant: bool):
+    """THIS chip's context slab under the seq-sharded pool: the rows of
+    its local blocks, ordered by local chain index — local column
+    ``j_loc`` holds chain ordinal ``(j_loc // bs) * sz + r``. Returns
+    ``(k_loc, v_loc, kv_scales_or_None, j_g)`` with ``j_g`` the global
+    context column of each local column. With ``dequant`` the int8 rows
+    come back dequantized to ``dtype`` (decode stats path); otherwise
+    raw, so the prefill ring can ship int8 + scale planes separately."""
+    bs = cfg.block_size
+    nb_loc = cfg.max_blocks_per_seq // sz
+    jl = jnp.arange(nb_loc * bs, dtype=jnp.int32)
+    o_cols = (jl // bs) * sz + r           # chain ordinal per local col
+    blk = tables[:, o_cols]                # [S, T_loc] global block ids
+    rows = (blk // sz) * bs + (jl % bs)[None, :]
+    k_loc = data[li, 0][rows]              # [S, T_loc, KV*D]
+    v_loc = data[li, 1][rows]
+    j_g = o_cols * bs + jl % bs
+    if scales is None:
+        return k_loc.astype(dtype), v_loc.astype(dtype), None, j_g
+    ks = scales[li, 0].T[rows]             # [S, T_loc, KV]
+    vs = scales[li, 1].T[rows]
+    if dequant:
+        # rows are flat [KV*D]; scales are per-kv-head — unflatten,
+        # scale, reflatten so callers keep the [S, T_loc, KV*D] shape
+        S, T = k_loc.shape[:2]
+        KV = ks.shape[-1]
+        k_loc = (k_loc.reshape(S, T, KV, -1).astype(jnp.float32)
+                 * ks[..., None]).reshape(S, T, -1).astype(dtype)
+        v_loc = (v_loc.reshape(S, T, KV, -1).astype(jnp.float32)
+                 * vs[..., None]).reshape(S, T, -1).astype(dtype)
+        return k_loc, v_loc, None, j_g
+    return k_loc, v_loc, jnp.concatenate([ks, vs], axis=-1), j_g
+
+
+def _seq_paged_attention(kv, li, q, k, v, batch, cfg, pos, scale, dtype,
+                         alibi_slopes, sliding_window):
+    """Context-parallel paged attention: the per-step program's attention
+    under the ``seq`` shard_map. ``q``/``k``/``v`` are THIS chip's query
+    slice (the step wrapper sliced the chunk chip-major), the pool is
+    this chip's round-robin block shard. Three moves, exactly budgeted:
+
+      1. fresh-KV exchange — ONE packed all-gather of ``[k|v]`` in the
+         compute dtype reassembles the whole chunk's K/V on every chip;
+         each chip then scatters ONLY the rows it owns (``blk % sz ==
+         r``) into its local shard, everything else to its local trash
+         row. Over an int8 pool every chip quantizes the full chunk
+         identically, so pool bytes are bit-identical to seq=1's.
+      2. full-context reconstruction — each chip gathers its local slab
+         and a ring of ``sz - 1`` ppermute hops (two per hop over int8:
+         data + scale planes) stacks every shard by origin; a static
+         reshape/transpose restores exact global position order, and
+         dequant happens after, so the reconstructed context is
+         bit-identical to the single-chip gather.
+      3. the EXACT existing dense grouped-GQA core over (local queries x
+         full context) — per-query-slice outputs are therefore bitwise
+         equal to the seq=1 program's corresponding columns.
+
+    Returns (kv, y[S, C_local, H*D])."""
+    S, C_loc, H, D = q.shape
+    KV = k.shape[2]
+    bs = cfg.block_size
+    sz = axis_size(SEQ_AXIS)
+    r = jax.lax.axis_index(SEQ_AXIS)
+    C = C_loc * sz
+    data, scales = pool_parts(kv)
+    # the step wrapper shifted start/n by r*C_loc; undo for global views
+    n_g = batch.n_tokens + r * C_loc
+    start_g = batch.start_pos - r * C_loc
+    # ---- 1. fresh-KV exchange + ownership-masked scatter ----
+    fresh = jnp.concatenate([k.reshape(S, C_loc, KV * D),
+                             v.reshape(S, C_loc, KV * D)], axis=-1)
+    allf = jax.lax.all_gather(fresh, SEQ_AXIS)     # [sz, S, C_loc, 2KVD]
+    allf = jnp.moveaxis(allf, 0, 1).reshape(S, C, 2 * KV * D)
+    k_all = allf[..., :KV * D]
+    v_all = allf[..., KV * D:]
+    jc = jnp.arange(C, dtype=jnp.int32)
+    pos_all = start_g[:, None] + jc[None, :]
+    valid_all = jc[None, :] < n_g[:, None]
+    blk = jnp.take_along_axis(
+        batch.block_tables,
+        jnp.minimum(pos_all // bs, cfg.max_blocks_per_seq - 1), axis=1)
+    own = (blk % sz) == r
+    trash = data.shape[2] - 1                      # LOCAL trash row
+    widx = jnp.where(valid_all & own, (blk // sz) * bs + pos_all % bs,
+                     trash).reshape(-1)
+    if scales is None:
+        data = data.at[li, 0, widx].set(
+            k_all.reshape(S * C, KV * D).astype(data.dtype))
+        data = data.at[li, 1, widx].set(
+            v_all.reshape(S * C, KV * D).astype(data.dtype))
+    else:
+        qk, sk = quantize_rows(k_all.reshape(S * C, KV * D), KV)
+        qv, sv = quantize_rows(v_all.reshape(S * C, KV * D), KV)
+        data = data.at[li, 0, widx].set(qk)
+        data = data.at[li, 1, widx].set(qv)
+        scales = scales.at[li, 0, :, widx].set(sk.T)
+        scales = scales.at[li, 1, :, widx].set(sv.T)
+    kv = repack(kv, data, scales)
+    # ---- 2. ring reconstruction of the full context ----
+    nb_loc = cfg.max_blocks_per_seq // sz
+    T = nb_loc * sz * bs
+    k_loc, v_loc, sc_loc, _ = _seq_local_ctx(
+        data, scales, li, batch.block_tables, cfg, sz, r, dtype,
+        dequant=False)
+    slab = jnp.concatenate([k_loc, v_loc], axis=-1)
+
+    def _reorder(st):                    # [sz, S, T_loc, X] -> [S, T, X]
+        X = st.shape[-1]
+        st = st.reshape(sz, S, nb_loc, bs, X)
+        # origin o's slab column (nb, off) IS global position
+        # (nb*sz + o)*bs + off — interleave shards block-round-robin
+        return jnp.moveaxis(st, 0, 2).reshape(S, T, X)
+
+    ctx = _reorder(ring_all_gather(slab))          # sz-1 ppermute hops
+    k_ctx = ctx[..., :KV * D].reshape(S, T, KV, D)
+    v_ctx = ctx[..., KV * D:].reshape(S, T, KV, D)
+    if scales is None:
+        k_ctx = k_ctx.astype(dtype)
+        v_ctx = v_ctx.astype(dtype)
+    else:
+        # int8 scale planes ride the ring as a second per-hop ppermute
+        # (the PR 6 quantized-collective shape); dequant AFTER
+        # reconstruction = the single-chip gather's exact math
+        sc = _reorder(ring_all_gather(sc_loc))     # [S, T, 2KV]
+        k_ctx = (k_ctx.astype(jnp.float32)
+                 * sc[..., :KV, None]).astype(dtype)
+        v_ctx = (v_ctx.astype(jnp.float32)
+                 * sc[..., KV:, None]).astype(dtype)
+    # ---- 3. the unchanged dense core over the local query slice ----
+    j = jnp.arange(T, dtype=jnp.int32)
+    dist = (pos[:, :, None] - j[None, None, :]).astype(jnp.float32)
+    mask = j[None, None, :] <= pos[:, :, None]
+    if sliding_window is not None:
+        mask = jnp.logical_and(mask, dist < sliding_window)
+    y = _grouped_dense_attention(q, k_ctx, v_ctx, mask, dist, scale,
+                                 dtype, alibi_slopes)
+    return kv, y
+
+
+def _seq_dense_ring_attention(pool, ring, li, q, batch, cfg, settled_lens,
+                              rcount, scale, dtype, alibi_slopes,
+                              sliding_window):
+    """Sequence-sharded decode attention for the fused loop: the decode
+    query is REPLICATED over ``seq`` (the whole batch is), each chip
+    computes partial flash-softmax stats (m, l, acc) over its LOCAL
+    settled blocks, and ONE small packed all-gather per layer
+    (``combine_decode_stats``) merges them exactly — the FlashDecoding
+    split-K identity with the seq shards as the split. The loop's ring
+    rows are replicated too (identical fresh K/V on every chip), so
+    their stats merge locally with zero extra collectives. Exact up to
+    float reassociation (the TP=2 precedent); token parity holds."""
+    S, C, H, D = q.shape
+    KV = ring.shape[4] // D
+    sz = axis_size(SEQ_AXIS)
+    r = jax.lax.axis_index(SEQ_AXIS)
+    data, scales = pool_parts(pool)
+    k_loc, v_loc, _, j_g = _seq_local_ctx(
+        data, scales, li, batch.block_tables, cfg, sz, r, dtype,
+        dequant=True)
+    T_loc = k_loc.shape[1]
+    k_loc = k_loc.reshape(S, T_loc, KV, D)
+    v_loc = v_loc.reshape(S, T_loc, KV, D)
+    g = H // KV
+    qg = q.reshape(S, C, KV, g, D)
+
+    def _stats(kk, vv, mask, dist):
+        """Partial flash stats over one context piece: kk/vv
+        [S, T', KV, D], mask/dist [S, T'] (broadcast over heads and C).
+        An empty mask yields (0, 0, -inf) — exactly nothing to merge."""
+        s_att = jnp.einsum("sckgd,stkd->skgct", qg, kk) * scale
+        s_att = s_att.astype(jnp.float32)
+        if alibi_slopes is not None:
+            s_att = s_att - alibi_slopes.reshape(KV, g)[
+                None, :, :, None, None] * dist[:, None, None, None, :]
+        s_att = jnp.where(mask[:, None, None, None, :], s_att, -jnp.inf)
+        m = jnp.max(s_att, axis=-1)                       # [S, KV, g, C]
+        p = jnp.exp(s_att - jnp.where(jnp.isinf(m), 0.0, m)[..., None])
+        l = jnp.sum(p, axis=-1)
+        acc = jnp.einsum("skgct,stkd->skgcd", p,
+                         vv.astype(jnp.float32))
+        return acc, l, m
+
+    dist_s = (batch.start_pos[:, None] - j_g[None, :]).astype(jnp.float32)
+    mask_s = j_g[None, :] < settled_lens[:, None]
+    if sliding_window is not None:
+        mask_s = jnp.logical_and(mask_s, dist_s < sliding_window)
+    num, den, m_c = combine_decode_stats(
+        *_stats(k_loc, v_loc, mask_s, dist_s))   # 1 all-gather per layer
+
+    R = ring.shape[0]
+    ring_k = jnp.moveaxis(ring[:, li, 0], 0, 1).reshape(S, R, KV, D)
+    ring_v = jnp.moveaxis(ring[:, li, 1], 0, 1).reshape(S, R, KV, D)
+    jr = jnp.arange(R, dtype=jnp.int32)
+    dist_r = jnp.broadcast_to((rcount - 1 - jr)[None, :].astype(
+        jnp.float32), (S, R))
+    mask_r = jnp.broadcast_to((jr < rcount)[None, :], (S, R))
+    if sliding_window is not None:
+        mask_r = jnp.logical_and(mask_r, dist_r < sliding_window)
+    acc_r, l_r, m_r = _stats(ring_k.astype(dtype), ring_v.astype(dtype),
+                             mask_r, dist_r)
+    # exact streaming-softmax merge of the (already cross-chip) settled
+    # partial with the local ring partial
+    m_t = jnp.maximum(m_c, m_r)
+    m_ts = jnp.where(jnp.isinf(m_t), 0.0, m_t)
+    wc = jnp.exp(m_c - m_ts)
+    wr = jnp.exp(m_r - m_ts)
+    num = num * wc[..., None] + acc_r * wr[..., None]
+    den = den * wc + l_r * wr
+    y = jnp.where(den[..., None] > 0,
+                  num / jnp.maximum(den, 1e-30)[..., None], 0.0)
+    return jnp.moveaxis(y, 3, 1).reshape(S, C, H * D).astype(dtype)
+
+
 def paged_attention(kv, li, q, k, v, batch: "RaggedBatch",
                     cfg: RaggedInferenceConfig, pos, valid_q, scale, dtype,
                     alibi_slopes=None, sliding_window=None):
@@ -289,6 +505,12 @@ def paged_attention(kv, li, q, k, v, batch: "RaggedBatch",
     impl = cfg.attention_impl
     if impl == "auto":
         impl = "paged_flash" if jax.default_backend() == "tpu" else "dense"
+    seq_on = seq_axis_active()
+    if seq_on:
+        # the Pallas kernel indexes a single-chip pool layout; under the
+        # seq shard the dense paths reconstruct/merge across chips
+        # (config validation already rejects an EXPLICIT paged_flash)
+        impl = "dense"
 
     ring_mode = isinstance(kv, RingKV)
     if ring_mode:
@@ -325,14 +547,24 @@ def paged_attention(kv, li, q, k, v, batch: "RaggedBatch",
                 scales_full=scales,
                 ring_count=rcount)
         elif impl == "dense":
-            y = _dense_ring_attention(
-                pool, ring, li, q, batch, cfg, settled_lens, rcount, scale,
-                dtype, alibi_slopes, sliding_window)
+            if seq_on:
+                y = _seq_dense_ring_attention(
+                    pool, ring, li, q, batch, cfg, settled_lens, rcount,
+                    scale, dtype, alibi_slopes, sliding_window)
+            else:
+                y = _dense_ring_attention(
+                    pool, ring, li, q, batch, cfg, settled_lens, rcount,
+                    scale, dtype, alibi_slopes, sliding_window)
         else:
             raise ValueError(
                 f"attention_impl must be 'auto', 'paged_flash' or 'dense', "
                 f"got {cfg.attention_impl!r}")
         return kv, y.reshape(S, C, H * D).astype(dtype)
+
+    if seq_on:
+        return _seq_paged_attention(kv, li, q, k, v, batch, cfg, pos,
+                                    scale, dtype, alibi_slopes,
+                                    sliding_window)
 
     data, scales = pool_parts(kv)
     trash = data.shape[2] - 1
@@ -439,6 +671,7 @@ class RaggedRunnerBase:
             model_cfg, "head_dim",
             model_cfg.hidden_size // model_cfg.num_heads)
         self.tp = None            # TPContext once init_tp runs
+        self.seqctx = None        # SeqContext once init_seq runs
         self._build_programs()
 
     # ---------------------------- TP wiring --------------------------- #
@@ -449,15 +682,29 @@ class RaggedRunnerBase:
         self.tp = tp_ctx
         self._build_programs()
 
+    def init_seq(self, seq_ctx) -> None:
+        """Adopt a ``seq_parallel.SeqContext`` (mutually exclusive with
+        TP) and rebuild every device program under its ``seq``-axis
+        shard_map: params replicate, the pool enters as its round-robin
+        block shard, and the step wrapper slices each chunk's queries
+        chip-major (context-parallel prefill)."""
+        if self.tp is not None:
+            raise ValueError("init_seq after init_tp: one sharding axis "
+                             "per runner")
+        self.seqctx = seq_ctx
+        self._build_programs()
+
     @property
     def local_kv_heads(self) -> int:
         return self.kv_heads // (self.tp.tp_size if self.tp else 1)
 
     def _wrap(self, fn, in_specs, out_specs):
-        """shard_map ``fn`` over the TP mesh (identity at tp_size 1)."""
-        if self.tp is None:
+        """shard_map ``fn`` over the TP or seq mesh (identity when
+        neither axis is active)."""
+        ctx = self.tp if self.tp is not None else self.seqctx
+        if ctx is None:
             return fn
-        return shard_map(fn, mesh=self.tp.mesh, in_specs=in_specs,
+        return shard_map(fn, mesh=ctx.mesh, in_specs=in_specs,
                          out_specs=out_specs, check_vma=False)
 
     def _local_params(self, params):
@@ -474,6 +721,8 @@ class RaggedRunnerBase:
         model_cfg, cfg = self.model_cfg, self.cfg
         dtype = self.compute_dtype
         tp = self.tp
+        seqc = self.seqctx if tp is None else None
+        mapped = tp is not None or seqc is not None
         mcfg_l = tp.localize_model_cfg(model_cfg) if tp else model_cfg
         vocab = getattr(model_cfg, "vocab_size", -1)
         quantized_pool = cfg.kv_cache_dtype == "int8"
@@ -482,16 +731,60 @@ class RaggedRunnerBase:
             pool_spec = tp.pool_spec(quantized_pool)
             ring_spec = tp.ring_spec
             batch_spec = RaggedBatch(P(), P(), P(), P())
+        elif seqc is not None:
+            pspecs = P()                        # weights replicate
+            pool_spec = seqc.pool_spec(quantized_pool)
+            ring_spec = seqc.ring_spec          # replicated decode ring
+            batch_spec = RaggedBatch(P(), P(), P(), P())
 
         def _step(params, kv_data, batch):
+            if seqc is not None:
+                # context-parallel prefill: chip r takes query slice
+                # [r*C/sz, (r+1)*C/sz) — start/n shift so the slice's
+                # positions/validity come out right in the step_fn
+                # (n_tokens goes UNCLIPPED negative/overlong for
+                # off-chip slots; valid_q and the clamped last-token
+                # take handle both, and the owner psum below discards
+                # non-owner logits). Widths the scheduler did not round
+                # (C=1 per-step decode slots, replay tails) pad with
+                # trash queries first: a pad position sits at
+                # pos >= start + n, so valid_q masks it everywhere —
+                # its KV write lands in the trash row, its logits are
+                # never the owner's
+                pad = (-batch.tokens.shape[1]) % seqc.seq_size
+                if pad:
+                    batch = batch._replace(tokens=jnp.pad(
+                        batch.tokens, ((0, 0), (0, pad))))
+                r = jax.lax.axis_index(SEQ_AXIS)
+                c_loc = batch.tokens.shape[1] // seqc.seq_size
+                gbatch = batch
+                batch = batch._replace(
+                    tokens=jax.lax.dynamic_slice_in_dim(
+                        batch.tokens, r * c_loc, c_loc, 1),
+                    start_pos=batch.start_pos + r * c_loc,
+                    n_tokens=batch.n_tokens - r * c_loc)
+            else:
+                gbatch = batch
             logits, kv_out = type(self).step_fn(
                 self._local_params(params), kv_data, batch,
                 model_cfg=mcfg_l, cfg=cfg, dtype=dtype)
             # vocab-sharded unembed -> ONE all-gather to full logits
             # (identity for tied/replicated unembeds and at tp_size 1)
-            return tp_gather_logits(logits, vocab), kv_out
+            logits = tp_gather_logits(logits, vocab)
+            if seqc is not None:
+                # each slot's true last token lives on ONE chip's query
+                # slice; a single masked psum hands its logits to all —
+                # the one per-program seq collective
+                c_loc = gbatch.tokens.shape[1] // seqc.seq_size
+                owner = jnp.clip((gbatch.n_tokens - 1) // c_loc, 0,
+                                 seqc.seq_size - 1)
+                logits = jax.lax.psum(
+                    jnp.where(owner[:, None]
+                              == jax.lax.axis_index(SEQ_AXIS),
+                              logits, 0.0), SEQ_AXIS)
+            return logits, kv_out
 
-        if tp is not None:
+        if mapped:
             _step = self._wrap(_step, (pspecs, pool_spec, batch_spec),
                                (P(), pool_spec))
         # every step program consumes the previous KV pool functionally
@@ -652,7 +945,7 @@ class RaggedRunnerBase:
             impl = functools.partial(
                 _decode_loop_impl, n=n, mode=mode, cand=cand,
                 eos_id=eos_id, feed=feed)
-            if tp is not None:
+            if mapped:
                 impl = self._wrap(
                     impl,
                     (pspecs, pool_spec, P(), P(), P(), P(), P(), P(),
@@ -714,8 +1007,20 @@ class RaggedRunnerBase:
             pos = start0[:, None] + jnp.arange(R, dtype=jnp.int32)[None, :]
             blk = jnp.take_along_axis(
                 tables, jnp.minimum(pos // bs, tables.shape[1] - 1), axis=1)
-            idx = jnp.where(active[:, None] > 0, blk * bs + pos % bs,
-                            slots - 1)
+            if seqc is not None:
+                # seq-sharded flush: every chip quantized/laid out the
+                # SAME ring rows (the loop is replicated); each scatters
+                # only the rows whose block it owns, the rest to its
+                # local trash row — zero collectives, pool bytes
+                # bit-identical to the seq=1 scatter
+                r_ax = jax.lax.axis_index(SEQ_AXIS)
+                szz = seqc.seq_size
+                ok = (active[:, None] > 0) & ((blk % szz) == r_ax)
+                idx = jnp.where(ok, (blk // szz) * bs + pos % bs,
+                                slots - 1)
+            else:
+                idx = jnp.where(active[:, None] > 0, blk * bs + pos % bs,
+                                slots - 1)
             data = data.at[:, :, idx.reshape(-1)].set(
                 ring_rows.reshape(L, 2, S * R, KVD))
             if sc_t is not None:
@@ -723,9 +1028,11 @@ class RaggedRunnerBase:
                     sc_t.reshape(L, 2, KV, S * R))
             return repack(kv_data, data, scales)
 
-        if tp is not None:
-            # all flush work is head-local (quantize_rows is per-kv-head,
-            # scatter indices live on the slots dim): zero collectives
+        if mapped:
+            # all flush work is chip-local (quantize_rows is per-kv-head,
+            # scatter indices live on the slots dim; under seq the
+            # ownership mask keeps foreign blocks in the trash row):
+            # zero collectives
             _flush_ring = self._wrap(_flush_ring,
                                      (pool_spec, ring_spec, P(), P(), P()),
                                      pool_spec)

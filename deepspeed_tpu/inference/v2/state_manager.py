@@ -141,6 +141,16 @@ class StateManager:
     # this sequence's full prompt blocks)
     # ------------------------------------------------------------------ #
 
+    def _reserve_next(self, seq: SequenceDescriptor) -> int:
+        """Reserve ONE block at ``seq``'s next chain ordinal — under
+        sequence parallelism ordinal ``o`` must land on home chip
+        ``o % seq`` so every chip holds the same share of the chain (the
+        flat-per-chip-bytes invariant). seq=1 takes the legacy path."""
+        kv = self.kv_cache
+        if kv.seq > 1:
+            return kv.reserve(1, homes=[len(seq.kv_blocks) % kv.seq])[0]
+        return kv.reserve(1)[0]
+
     def match_prefix(self, seq: SequenceDescriptor) -> MatchPlan:
         """Point a FRESH sequence's block table at the longest cached
         chain of its prompt and skip those tokens' prefill entirely
@@ -176,8 +186,16 @@ class StateManager:
         # promotion reserve below can go hunting for demotion victims —
         # a reserve must never demote the very chain being matched
         n_dev = 0
+        kvseq = self.kv_cache.seq
         for e in entries:
             if e.tier != "device":
+                break
+            if kvseq > 1 and e.block % kvseq \
+                    != len(seq.kv_blocks) % kvseq:
+                # chains are registered ordinal-aligned, so a cached
+                # block's home always matches its adopter's ordinal;
+                # this guards a (never-expected) misaligned entry from
+                # breaking the per-chip share invariant
                 break
             n_dev += 1
             pc.acquire(e)
@@ -195,7 +213,7 @@ class StateManager:
             # pool cannot cover: the rest stays host-resident for the
             # next request.
             try:
-                dst = self.kv_cache.reserve(1)[0]
+                dst = self._reserve_next(seq)
             except OutOfBlocksError:
                 break
             if e.host_ref is None or e.tier != "host":
@@ -234,7 +252,7 @@ class StateManager:
             if not host_cow:
                 pc.acquire(cow)
             try:
-                dst = self.kv_cache.reserve(1)[0]
+                dst = self._reserve_next(seq)
             except OutOfBlocksError:
                 dst = None
             finally:
@@ -336,8 +354,24 @@ class StateManager:
         if seq.status is SequenceStatus.PAUSED:
             return False
         need = seq.blocks_needed(n_tokens, self.cfg.block_size)
-        return (need <= self.kv_cache.free_blocks
-                and len(seq.kv_blocks) + need <= self.cfg.max_blocks_per_seq)
+        if not (need <= self.kv_cache.free_blocks
+                and len(seq.kv_blocks) + need
+                <= self.cfg.max_blocks_per_seq):
+            return False
+        kv = self.kv_cache
+        if need and kv.seq > 1:
+            # per-home form: the total can cover `need` while one home
+            # is dry. Free-list deficits must be coverable by evictable
+            # cached blocks (reserve's per-home pressure loop reclaims
+            # victims onto their own homes, so the total evictable count
+            # is the honest upper bound on what it can recover).
+            start = len(seq.kv_blocks)
+            homes = [(start + i) % kv.seq for i in range(need)]
+            deficit = sum(kv.allocator.shortfall(homes))
+            evictable = kv.prefix.evictable_blocks if kv.prefix else 0
+            if deficit > evictable:
+                return False
+        return True
 
     def ensure_blocks(self, seq: SequenceDescriptor, n_tokens: int) -> None:
         need = seq.blocks_needed(n_tokens, self.cfg.block_size)
@@ -346,7 +380,12 @@ class StateManager:
                 raise OutOfBlocksError(
                     f"sequence {seq.uid} exceeds max_blocks_per_seq "
                     f"({self.cfg.max_blocks_per_seq})")
-            seq.kv_blocks.extend(self.kv_cache.reserve(need))
+            kv = self.kv_cache
+            homes = None
+            if kv.seq > 1:
+                start = len(seq.kv_blocks)
+                homes = [(start + i) % kv.seq for i in range(need)]
+            seq.kv_blocks.extend(kv.reserve(need, homes=homes))
 
     def trim_blocks(self, seq: SequenceDescriptor) -> int:
         """Free KV blocks beyond what ``seq.seen_tokens`` needs — the
@@ -379,6 +418,7 @@ class StateManager:
             "kv_pool_bytes_total": self.kv_cache.memory_bytes(),
             "kv_pool_bytes_per_chip": self.kv_cache.memory_bytes_per_chip(),
             "tp_size": max(1, int(getattr(self.cfg, "tp_size", 1))),
+            "seq_size": max(1, int(getattr(self.cfg, "seq_size", 1))),
         }
 
     def flush(self, uid: int) -> None:

@@ -312,6 +312,10 @@ def build_tp_context(cfg, runner, params,
     tp = int(cfg.tp_size)
     if tp <= 1:
         raise ValueError("build_tp_context needs cfg.tp_size > 1")
+    if int(getattr(cfg, "seq_size", 1)) > 1:
+        raise ValueError(
+            "tp_size > 1 with seq_size > 1 is not supported yet — one "
+            "sharding axis per engine (seq_parallel.py mirrors this check)")
     devices = list(devices if devices is not None else jax.devices())
     if len(devices) < tp:
         raise ValueError(

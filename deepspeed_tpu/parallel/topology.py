@@ -45,6 +45,9 @@ AXIS_ROLES = {
     "data": "data-parallel / ZeRO grad+param comm",
     "data_inner": "ZeRO++ hpZ / MiCS shard-group comm",
     "expert": "MoE expert-parallel dispatch",
+    # serving reuses the same axis name for sequence-parallel inference
+    # (inference/v2/seq_parallel.py): ring prefill ppermutes + the
+    # per-layer decode stat-combine all-gather audit under this role
     "seq": "Ulysses/ring sequence-parallel comm",
     "model": "tensor-parallel partial-sum comm",
 }

@@ -210,6 +210,12 @@ class Replica:
         return {
             "state": self.state,
             "role": self.role,
+            # the replica's seq-parallel mesh width (1 = single-chip):
+            # long-context pools give prefill specialists a wider seq
+            # axis than decode ones (docs/serving.md "Long-context
+            # serving"), and the fleet drills assert the shape took
+            "seq_size": max(1, int(getattr(
+                self.engine.config, "seq_size", 1) or 1)),
             "live_sequences": len(self.engine.state.sequences),
             "queue_frac": round(self.queue_frac(), 4),
             "free_blocks": self.engine.kv_cache.free_blocks,
@@ -250,7 +256,8 @@ class ReplicaPool:
                  slo_ttft_s: Optional[float] = None,
                  ledger: Any = None, name: str = "fleet",
                  replica_ids: Optional[Sequence[str]] = None,
-                 roles: Optional[Sequence[str]] = None):
+                 roles: Optional[Sequence[str]] = None,
+                 role_mesh: Optional[Dict[str, int]] = None):
         # env knobs read with LITERAL names (dslint DSL004/5 scan):
         # DSTPU_FLEET_POLICY is the operational routing kill-switch
         # (prefix_aware -> round_robin/random without a rebuild),
@@ -273,6 +280,29 @@ class ReplicaPool:
             rv = os.environ.get("DSTPU_FLEET_ROLES")
             if rv:
                 roles = [r.strip() for r in rv.split(",")]
+        # per-role mesh shapes (docs/serving.md "Long-context serving"):
+        # DSTPU_FLEET_ROLE_MESH = "prefill=2,decode=1" gives each ROLE its
+        # seq-parallel width — prefill specialists take a wide seq axis
+        # for context-parallel prefill, decode ones stay narrow. Advisory
+        # to engine builders (build_replica_engines hands out matching
+        # device slices); the pool validates and publishes it.
+        if role_mesh is None:
+            rmv = os.environ.get("DSTPU_FLEET_ROLE_MESH")
+            if rmv:
+                role_mesh = {}
+                for part in rmv.split(","):
+                    rname, _, width = part.partition("=")
+                    role_mesh[rname.strip()] = int(width)
+        self.role_mesh: Dict[str, int] = dict(role_mesh or {})
+        for rname, width in self.role_mesh.items():
+            if rname not in REPLICA_ROLES:
+                raise ValueError(
+                    f"role_mesh role must be one of {REPLICA_ROLES}, "
+                    f"got {rname!r}")
+            if width < 1:
+                raise ValueError(
+                    f"role_mesh width for {rname!r} must be >= 1, "
+                    f"got {width}")
         self._disagg = os.environ.get("DSTPU_DISAGG", "1") != "0"
         if not self._disagg:
             roles = None
@@ -1010,8 +1040,9 @@ def fleet_prefix_stats(pool: ReplicaPool) -> Dict[str, Any]:
 
 
 def build_replica_engines(engine_factory, n: int,
-                          devices: Optional[Sequence[Any]] = None
-                          ) -> List[Any]:
+                          devices: Optional[Sequence[Any]] = None,
+                          devices_per_replica: Optional[
+                              Sequence[int]] = None) -> List[Any]:
     """Build ``n`` engines for a pool, each pinned to its OWN JAX
     device (cycling ``devices``, default ``jax.devices()``): arrays the
     factory creates under the ``jax.default_device`` scope — params it
@@ -1022,10 +1053,35 @@ def build_replica_engines(engine_factory, n: int,
     on the CPU harness the devices come from
     ``--xla_force_host_platform_device_count``, on real hardware from
     the ``data`` mesh axis. ``engine_factory(i, device)`` returns
-    replica ``i``'s engine."""
+    replica ``i``'s engine.
+
+    ``devices_per_replica`` (one int per replica, e.g. derived from
+    ``ReplicaPool.role_mesh``) hands replica ``i`` a DISJOINT slice of
+    that many devices instead of a single cycled one — the long-context
+    shape where a seq-parallel prefill specialist spans ``seq_size``
+    chips while decode replicas keep one each. The factory then
+    receives the device LIST (its engine builds the seq mesh from it);
+    slices never overlap, so replicas still step concurrently."""
     import jax
     devs = list(devices) if devices is not None else jax.devices()
     engines = []
+    if devices_per_replica is not None:
+        if len(devices_per_replica) != n:
+            raise ValueError(
+                f"{len(devices_per_replica)} devices_per_replica "
+                f"entries for {n} replicas")
+        if sum(devices_per_replica) > len(devs):
+            raise ValueError(
+                f"devices_per_replica wants "
+                f"{sum(devices_per_replica)} devices, only "
+                f"{len(devs)} available — slices must be disjoint")
+        off = 0
+        for i, k in enumerate(devices_per_replica):
+            sl = devs[off:off + k]
+            off += k
+            with jax.default_device(sl[0]):
+                engines.append(engine_factory(i, sl if k > 1 else sl[0]))
+        return engines
     for i in range(n):
         dev = devs[i % len(devs)]
         with jax.default_device(dev):

@@ -292,6 +292,34 @@ class WorkloadMix:
         kw.update(overrides)
         return cls(**kw)
 
+    @classmethod
+    def long_context(cls, pool_span_tokens: int = 256,
+                     vocab_size: int = 32000,
+                     **overrides) -> "WorkloadMix":
+        """The long-context serving preset (``bin/dstpu_loadgen --mix
+        long_context``, docs/serving.md "Long-context serving"):
+        log-spaced prompt lengths from short up to ``pool_span_tokens``
+        (the target engine's whole KV pool span — the longest prompts
+        push per-sequence context PAST what a single chip's pool shard
+        holds, the regime sequence-parallel serving exists for), drawn
+        uniformly so every decade of context length is represented, and
+        generations kept small (the long-context interactive shape:
+        huge document in, short answer out). Sized by the CALLER's pool
+        — pass ``pool_span_tokens = num_blocks_per_seq * block_size``
+        for the engine under test."""
+        span = max(64, int(pool_span_tokens))
+        # 4 log-spaced rungs: span/8, span/4, span/2, ~span (headroom
+        # for the generation so the chain never overflows its table)
+        lens = sorted({max(16, span // 8), max(32, span // 4),
+                       max(48, span // 2), max(56, span - 16)})
+        kw: Dict[str, Any] = dict(
+            prompt_lens=tuple(lens),
+            prompt_probs=tuple([1.0 / len(lens)] * len(lens)),
+            gen_lens=(4, 8), gen_probs=(0.5, 0.5),
+            vocab_size=vocab_size)
+        kw.update(overrides)
+        return cls(**kw)
+
     def describe(self) -> Dict[str, Any]:
         return {
             "prompt_mix": list(self.prompt_lens)
@@ -1026,13 +1054,15 @@ def disagg_report(pool) -> Dict[str, Any]:
 def _tiny_engine(max_seqs: int = 8, num_blocks: int = 96,
                  block_size: int = 16, vocab: int = 96,
                  spec: str = "off", spec_k: int = 4,
-                 host_blocks: int = 0):
+                 host_blocks: int = 0, seq_size: int = 1):
     """CPU-harness GPT-2 engine for the CLI's self-contained mode and
     the tier-1 capacity smoke — small enough that a decode step is a
     few ms. ``spec`` arms speculative decoding (``--spec`` /
     ``DSTPU_SPEC_MODE``); ``host_blocks`` arms the hierarchical-KV
     host-RAM tier (``--host-blocks``) so the working-set workload has a
-    second tier to hit."""
+    second tier to hit; ``seq_size`` opens the sequence-parallel axis
+    (``--seq``, docs/serving.md "Long-context serving") — the caller
+    provides the virtual devices."""
     import jax
     import jax.numpy as jnp
 
@@ -1049,7 +1079,7 @@ def _tiny_engine(max_seqs: int = 8, num_blocks: int = 96,
         attention_impl="dense", decode_loop_steps=0,
         serve_pipeline_depth=2, prefix_cache=True,
         prefix_cache_host_blocks=host_blocks,
-        spec_decode=spec, spec_k=spec_k)
+        spec_decode=spec, spec_k=spec_k, seq_size=max(1, seq_size))
     return InferenceEngineV2(mcfg, params, cfg), mcfg
 
 
@@ -1105,10 +1135,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="draft tokens per speculation round")
     ap.add_argument("--mix", default=os.environ.get(
         "DSTPU_LOADGEN_MIX", "custom"),
-        choices=("custom", "prefill_heavy"),
+        choices=("custom", "prefill_heavy", "long_context"),
         help="workload preset: prefill_heavy offers long prompts with "
              "short generations (the disaggregated-serving regime, "
-             "docs/serving.md) and overrides --prompt-len/--gen-len")
+             "docs/serving.md) and overrides --prompt-len/--gen-len; "
+             "long_context offers log-spaced prompts up to the engine's "
+             "whole per-sequence pool span with small generations (the "
+             "sequence-parallel regime — pair with --seq) and adds a "
+             "'longctx' report section")
+    ap.add_argument("--seq", type=int, default=int(os.environ.get(
+        "DSTPU_LOADGEN_SEQ", "1") or "1"),
+        help="sequence-parallel width for the tiny engine(s) — shards "
+             "the KV pool round-robin over that many virtual devices "
+             "(docs/serving.md Long-context serving)")
     ap.add_argument("--prompt-len", type=int, default=24)
     ap.add_argument("--gen-len", type=int, default=16)
     ap.add_argument("--shared-prefix-frac", type=float, default=0.0)
@@ -1182,6 +1221,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     pool = None
+    if args.seq > 1 and os.environ.get("JAX_PLATFORMS",
+                                       "").startswith("cpu"):
+        # seq-parallel tiny engines need their virtual devices BEFORE
+        # the backend initializes (same shim as the replica path below)
+        from ..utils.jax_compat import request_cpu_devices
+        request_cpu_devices(max(2, args.seq * max(1, args.replicas)))
     if args.replicas > 1:
         from ..serving import ReplicaPool, build_replica_engines
         if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
@@ -1197,7 +1242,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         def factory(i, dev):
             e, m = _tiny_engine(num_blocks=args.num_blocks,
                                 spec=args.spec, spec_k=args.spec_k,
-                                host_blocks=args.host_blocks)
+                                host_blocks=args.host_blocks,
+                                seq_size=args.seq)
             mcfg_box.append(m)
             return e
 
@@ -1210,7 +1256,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         eng, mcfg = _tiny_engine(num_blocks=args.num_blocks,
                                  spec=args.spec, spec_k=args.spec_k,
-                                 host_blocks=args.host_blocks)
+                                 host_blocks=args.host_blocks,
+                                 seq_size=args.seq)
     sampling = None
     if args.temperature > 0:
         from ..inference.v2 import SamplingParams
@@ -1218,6 +1265,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                                   top_k=args.top_k, top_p=args.top_p)
     if args.mix == "prefill_heavy":
         mix = WorkloadMix.prefill_heavy(
+            vocab_size=mcfg.vocab_size,
+            deadline_frac=args.deadline_frac,
+            deadline_s=args.deadline_s,
+            batch_frac=args.batch_frac)
+    elif args.mix == "long_context":
+        # span = the tiny engine's whole per-sequence table
+        # (max_blocks_per_seq=16 x block_size=16 -> 256 tokens)
+        mix = WorkloadMix.long_context(
+            pool_span_tokens=16 * 16,
             vocab_size=mcfg.vocab_size,
             deadline_frac=args.deadline_frac,
             deadline_s=args.deadline_s,
@@ -1317,6 +1373,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             "host_hit_frac": round(st.get("host_hit_frac", 0.0), 4),
             "skipped_prefill_frac": round(
                 st.get("prefill_chunks_skipped_frac", 0.0), 4),
+        }
+    if args.mix == "long_context":
+        # long-context evidence (docs/serving.md "Long-context
+        # serving"): the seq width, the per-chip vs total pool bytes
+        # (FLAT per chip is the whole point), and the longest rung
+        reps = [r.engine for r in pool.replicas()] if pool is not None \
+            else [eng]
+        kvrep = reps[0].state.kv_memory_report()
+        out["longctx"] = {
+            "seq_size": kvrep.get("seq_size", 1),
+            "prompt_rungs": list(mix.prompt_lens),
+            "longest_prompt": max(mix.prompt_lens),
+            "kv_pool_bytes_total": kvrep["kv_pool_bytes_total"],
+            "kv_pool_bytes_per_chip": kvrep["kv_pool_bytes_per_chip"],
         }
     if pool is not None:
         from ..serving import fleet_prefix_stats

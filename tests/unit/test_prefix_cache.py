@@ -589,7 +589,7 @@ class TestRandomizedRefcountModel:
 
         def check():
             alloc = kv.allocator
-            free = set(alloc._free)
+            free = set(alloc.free_list())
             assert len(free) == alloc.free_blocks          # list == set
             pc.check_invariants()
             pc.assert_exact_refs(live.values())
@@ -733,7 +733,7 @@ class TestRandomizedRefcountModel:
 
         def check():
             alloc = kv.allocator
-            free = set(alloc._free)
+            free = set(alloc.free_list())
             assert len(free) == alloc.free_blocks
             pc.check_invariants()
             pc.assert_exact_refs(live.values())

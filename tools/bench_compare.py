@@ -80,6 +80,13 @@ DIRECTIONS: Tuple[Tuple[str, str], ...] = (
     # and host skew must not creep up
     ("*data_wait*", "lower"),
     ("*step_time_skew*", "lower"),
+    # long-context bench (bench.py serve_longctx): per-chip pool bytes
+    # are the capacity lever — they must stay FLAT (or shrink) as the
+    # workload's context grows; the per-chip share of the longest chain
+    # likewise. Throughput/speedup/TTFT ride the generic rules above.
+    ("*kv_pool_bytes*per_chip*", "lower"),
+    ("*chain_tokens_per_chip*", "lower"),
+    ("*capacity_rps*", "higher"),
     ("*ttft*", "lower"),
     ("*tpot*", "lower"),
     ("*queue_wait*", "lower"),
@@ -104,6 +111,9 @@ BANDS: Tuple[Tuple[str, float], ...] = (
     ("*knee*", 0.25),
     ("*ttft*", 0.30),
     ("*tpot*", 0.30),
+    # single-prompt prefill wall clocks on a shared box (serve_longctx)
+    ("*prefill_speedup*", 0.25),
+    ("*capacity_rps*", 0.25),
     ("*queue_wait*", 0.30),
     ("*recovery_s*", 0.50),
     ("*drain_s*", 0.50),

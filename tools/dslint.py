@@ -90,7 +90,18 @@ HOT_PATHS: Mapping[str, Tuple[str, ...]] = {
     # sync here would gate every committed token on the journal
     "deepspeed_tpu/inference/v2/drain.py":
         ("_write", "admit", "tokens", "finish"),
-    "deepspeed_tpu/inference/v2/model_runner.py": ("_build_programs",),
+    # the seq-axis attention builders (ISSUE 18) trace inside every
+    # warm prefill/decode program build: ring reconstruction of the
+    # paged history and the split-K stat merge are pure trace-time code
+    # (lax.ppermute / lax.all_gather) — a host sync here would stall
+    # every retrace of the long-context serve path. slot_rows is
+    # deliberately NOT registered: it is the host-side gather-index
+    # helper (numpy over host ints, no device handles in reach).
+    "deepspeed_tpu/inference/v2/seq_parallel.py":
+        ("ring_all_gather", "combine_decode_stats"),
+    "deepspeed_tpu/inference/v2/model_runner.py":
+        ("_build_programs", "_seq_local_ctx", "_seq_paged_attention",
+         "_seq_dense_ring_attention"),
     # the prefix-cache match/hash path runs inside put()'s plan-ahead
     # window (before and between _drive_pipeline fills): pure host dict
     # walks plus non-blocking CoW dispatch — a blocking readback here

@@ -1,0 +1,61 @@
+#!/bin/bash
+# Round-21 on-chip sequence: long-context serving — context-parallel
+# prefill + sequence-sharded paged attention (ISSUE 18). The CPU story
+# is proven in tier-1 (token parity seq∈{1,2} across greedy/sampled/
+# spec/prefix-cache/int8, per-chip pool bytes flat at total/seq,
+# cross-geometry drain/handoff parity, the exact ring + stat-combine
+# hop budgets under the program auditor, warm-path zero fresh compiles,
+# DSTPU_SEQ_PARALLEL=0 killswitch to the zero-collective single-chip
+# programs); on chip this captures what the CPU harness CANNOT: (a)
+# lint cleanliness (seq hot-path DSL001 registry + DSTPU_SEQ_PARALLEL/
+# DSTPU_LONGCTX* knob tables), (b) the tpu_smoke sweep — no serve-path
+# regression with the seq paths compiled in but seq_size defaulting to
+# 1 (exact pre-seq programs), (c) the serve_longctx bench at real step
+# times — THE round's headline: prefill tokens/s at the longest
+# context >= 1.5x seq=1 at matched devices and TTFT p99 improves (on
+# real chips the ring hops ride the ICI and the per-chip FLOPs split
+# actually buys wall-clock, unlike the core-timesharing CPU harness),
+# per-chip KV pool bytes gauge-verified FLAT past the single-chip cap,
+# zero fresh compiles, seq-axis hop budget asserted — and (d)
+# bench_compare gating this round's capture against the previous one.
+# Strictly sequential (one process owns the chip), no timeouts around
+# TPU clients (a killed client wedges the grant).
+cd /root/repo || exit 1
+LOG=profiles/r21_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round21 start $(date -u +%FT%TZ)"
+FAIL=0
+
+echo "--- [1/4] dstpu_lint (seq hot-path registry, DSTPU_SEQ_PARALLEL/"
+echo "    DSTPU_LONGCTX*/DSTPU_FLEET_ROLE_MESH knob table drift)"
+python bin/dstpu_lint deepspeed_tpu || FAIL=1
+
+echo "--- [2/4] tpu_smoke: full kernel + serve sweep (seq paths"
+echo "    compiled in, seq_size defaults 1 — exact pre-seq programs,"
+echo "    no serve-path regression)"
+python tools/tpu_smoke.py || FAIL=1
+
+echo "--- [3/4] serve_longctx bench: seq=2 vs seq=1 at matched"
+echo "    devices on the long_context mix — prefill speedup + TTFT +"
+echo "    flat per-chip pool + hop budget + killswitch gates"
+python bench.py serve_longctx > BENCH_LONGCTX_r21.json || FAIL=1
+tail -c 1600 BENCH_LONGCTX_r21.json
+
+echo "--- [4/4] bench_compare: gate this round's serve_longctx capture"
+echo "    against the previous one (tolerance bands; missing phase ="
+echo "    regression)"
+PREV=$(ls BENCH_LONGCTX_r*.json 2>/dev/null | sort | tail -2 | head -1)
+if [ -n "$PREV" ] && [ "$PREV" != "BENCH_LONGCTX_r21.json" ]; then
+    python tools/bench_compare.py "$PREV" BENCH_LONGCTX_r21.json || FAIL=1
+else
+    echo "no prior serve_longctx capture — baseline round, comparing"
+    echo "the last two serve_disagg captures instead (informational)"
+    mapfile -t ROUNDS < <(ls BENCH_DISAGG_r*.json 2>/dev/null | sort | tail -2)
+    if [ "${#ROUNDS[@]}" = 2 ]; then
+        python tools/bench_compare.py "${ROUNDS[0]}" "${ROUNDS[1]}" \
+            --allow-missing || FAIL=1
+    fi
+fi
+
+echo "=== tpu_round21 done $(date -u +%FT%TZ) FAIL=$FAIL"
+exit $FAIL
