@@ -2940,7 +2940,8 @@ def bench_serve_longctx():
 
     from deepspeed_tpu.analysis import (CollectiveBudget,
                                         RecompileTripwire,
-                                        audit_serve_programs)
+                                        audit_serve_programs,
+                                        budget_args)
     from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
                                             RaggedInferenceConfig)
     from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
@@ -3139,21 +3140,23 @@ def bench_serve_longctx():
     reports = audit_serve_programs(
         engN, programs=("step", "step_greedy", "step_greedy_fb",
                         "decode_loop", "flush_ring"))
-    step_budget = CollectiveBudget(
-        "longctx-step", num_layers=L, axis="seq",
-        per_layer={"all_gather": 1, "ppermute": SEQ - 1},
-        per_program={"all_reduce": 1})
+    # budget specs come from the shared registry (analysis/budgets.py)
+    # — the same entries test_seq_parallel.py asserts and dslint DSL008
+    # cross-checks, resolved here at the bench's seq width
+    step_budget = CollectiveBudget(**budget_args(
+        "seq-step", num_layers=L, seq=SEQ, label="longctx-step"))
     trips = min(2, bs)            # auditor's trip count at loop_steps=0
     violations = []
     for name in ("step", "step_greedy", "step_greedy_fb"):
         violations += [f"{name}: {v}"
                        for v in step_budget.check(reports[name])]
     violations += [f"decode_loop: {v}" for v in CollectiveBudget(
-        "longctx-decode-loop", num_layers=L, steps=trips, axis="seq",
-        per_layer={"all_gather": 1}).check(reports["decode_loop"])]
+        **budget_args("seq-decode-loop", num_layers=L, seq=SEQ,
+                      steps=trips, label="longctx-decode-loop")
+        ).check(reports["decode_loop"])]
     violations += [f"flush_ring: {v}" for v in CollectiveBudget(
-        "longctx-flush", num_layers=L,
-        axis="seq").check(reports["flush_ring"])]
+        **budget_args("seq-flush", num_layers=L, seq=SEQ,
+                      label="longctx-flush")).check(reports["flush_ring"])]
     budget_ok = not violations
 
     # ---- kill switch: DSTPU_SEQ_PARALLEL=0 -------------------------- #

@@ -329,6 +329,14 @@ class ReplicaPool:
         #: threads — exactly one caller runs the drain→replay sweep;
         #: the loser sees the flags already cleared and returns
         self._absorb_lock = threading.Lock()
+        #: guards the shared routing maps (_owner, _replayed,
+        #: _trace_ids/_trace_n, _pool_rejections) — mutated from the
+        #: admission path (put), the absorb sweep and the decode driver
+        #: concurrently (dslint DSL007). Leaf lock by construction:
+        #: critical sections are dict/list splices only, NEVER an engine
+        #: call or another lock acquisition, so the only nesting is
+        #: _absorb_lock -> _route_lock (one direction, no inversion).
+        self._route_lock = threading.Lock()
         #: fleet-wide trace contexts (docs/observability.md "Distributed
         #: tracing"): uid -> the trace id minted at admission. A monotone
         #: counter disambiguates uid reuse, so a retried uid starts a
@@ -460,6 +468,9 @@ class ReplicaPool:
                 # the re-placement is itself a traced routing decision:
                 # the request's track shows the drain-time hop and the
                 # scores that picked its survivor
+                # dslint: allow(DSL007): manifest uid is a host int
+                # from the drain JSON — no device handle in reach, the
+                # coercion cannot sync under _absorb_lock
                 rep = self._route(int(rec["uid"]), chain,
                                   replay_rec=rec)
                 rep.pending_routed += 1
@@ -475,8 +486,12 @@ class ReplicaPool:
             with rep.lock:
                 res = rep.engine.replay(sub)
             for rec in rs:
+                # dslint: allow(DSL007): manifest uid is a host int
+                # from the drain JSON — no device handle in reach, the
+                # coercion cannot sync under _absorb_lock
                 uid = int(rec["uid"])
-                self._owner[uid] = rid
+                with self._route_lock:
+                    self._owner[uid] = rid
                 if uid in res:
                     out[uid] = res[uid]
         if self._ledger is not None:
@@ -508,7 +523,7 @@ class ReplicaPool:
             orphans, self._orphans = self._orphans, []
             for manifest in orphans:
                 for uid, tok in self.replay_manifest(manifest).items():
-                    self._replayed.setdefault(uid, []).append(tok)
+                    self._stash_replay(uid, tok)
 
     # ------------------------------------------------------------------ #
     # request tracing (docs/observability.md "Distributed tracing")
@@ -521,9 +536,10 @@ class ReplicaPool:
         multi-replica flight dump reconstructs one gapless track per
         request. Registered DSL001 hot path: a counter and two dict
         stores."""
-        self._trace_n += 1
-        tid = f"{self.name}/{uid}#{self._trace_n}"
-        self._trace_ids[uid] = tid
+        with self._route_lock:
+            self._trace_n += 1
+            tid = f"{self.name}/{uid}#{self._trace_n}"
+            self._trace_ids[uid] = tid
         return tid
 
     def _route(self, uid: int, toks: Sequence[int],
@@ -548,7 +564,8 @@ class ReplicaPool:
         if replay_rec is not None:
             trace = replay_rec.get("trace")
             if trace is not None:
-                self._trace_ids[uid] = trace
+                with self._route_lock:
+                    self._trace_ids[uid] = trace
             ex["handoff" if phase == "decode" else "replay"] = True
         else:
             trace = self._mint_trace(uid)
@@ -628,7 +645,8 @@ class ReplicaPool:
                     except NoServingReplicaError:
                         self._reject(uid, "no_serving_replica")
                         continue
-                    self._owner[uid] = rep.replica_id
+                    with self._route_lock:
+                        self._owner[uid] = rep.replica_id
                     rep.pending_routed += 1
                     fresh.setdefault(rep.replica_id, []).append(uid)
                     # a uid retried after an earlier refusal sheds its
@@ -638,7 +656,8 @@ class ReplicaPool:
                     # may land on a different replica while the old
                     # record (possibly on a now-dead replica) would
                     # keep polluting the merged :attr:`rejections` view
-                    self._pool_rejections.pop(uid, None)
+                    with self._route_lock:
+                        self._pool_rejections.pop(uid, None)
                     for other in self._replicas.values():
                         other.engine.rejections.pop(uid, None)
                 groups.setdefault(rep.replica_id, []).append(uid)
@@ -789,7 +808,8 @@ class ReplicaPool:
                 if uid not in acc:
                     fallback.append(rec)
                     continue
-                self._owner[uid] = rid
+                with self._route_lock:
+                    self._owner[uid] = rid
                 if self.flight is not None:
                     args: Dict[str, Any] = {
                         "uid": uid, "src": src_of.get(uid), "dst": rid,
@@ -805,7 +825,7 @@ class ReplicaPool:
             replayed = self.replay_manifest(
                 {"version": 1, "sequences": fallback})
             for uid, tok in replayed.items():
-                self._replayed.setdefault(uid, []).append(tok)
+                self._stash_replay(uid, tok)
                 rep = self.owner_of(uid)
                 if rep is not None and rep.engine._obs is not None:
                     rep.engine._obs.on_handoff_replay(1)
@@ -915,26 +935,40 @@ class ReplicaPool:
                 self._take_stash(u, rem[u], out)
         return out
 
+    def _stash_replay(self, uid: int, tok: int) -> None:
+        """Append one replayed token to the stash under ``_route_lock``
+        — the absorb sweep and the handoff fallback both feed the stash
+        while a decode driver may be splicing it out via
+        :meth:`_take_stash`; an unlocked setdefault().append() here
+        loses tokens to the pop/reinsert window (dslint DSL007)."""
+        with self._route_lock:
+            self._replayed.setdefault(uid, []).append(tok)
+
     def _take_stash(self, uid: int, budget: int,
                     out: Dict[int, List[int]]) -> int:
         """Move up to ``budget`` stashed replay tokens for ``uid`` into
-        ``out``; leftovers stay stashed. Pure host list work."""
-        stash = self._replayed.pop(uid, None)
-        if not stash:
-            return 0
-        if budget <= 0:
-            self._replayed[uid] = stash
-            return 0
-        take = stash[:budget]
+        ``out``; leftovers stay stashed. Pure host list work; the whole
+        pop/splice/reinsert is one ``_route_lock`` critical section so
+        a concurrent :meth:`_stash_replay` cannot land between the pop
+        and the reinsert and be lost."""
+        with self._route_lock:
+            stash = self._replayed.pop(uid, None)
+            if not stash:
+                return 0
+            if budget <= 0:
+                self._replayed[uid] = stash
+                return 0
+            take = stash[:budget]
+            if stash[budget:]:
+                self._replayed[uid] = stash[budget:]
         out[uid].extend(take)
-        if stash[budget:]:
-            self._replayed[uid] = stash[budget:]
         return len(take)
 
     def flush(self, uid: int) -> None:
-        self._replayed.pop(uid, None)
-        self._trace_ids.pop(uid, None)
-        rid = self._owner.pop(uid, None)
+        with self._route_lock:
+            self._replayed.pop(uid, None)
+            self._trace_ids.pop(uid, None)
+            rid = self._owner.pop(uid, None)
         rep = self._replicas.get(rid) if rid is not None else None
         if rep is not None:
             with rep.lock:
@@ -946,9 +980,11 @@ class ReplicaPool:
         # a first-class (if usually None) field so door rejections can
         # carry the admission controller's backoff hint and report
         # readers never need a reason-specific schema
-        self._pool_rejections[uid] = {
-            "uid": uid, "reason": reason, "time": time.time(),
-            "retry_after_s": fields.pop("retry_after_s", None), **fields}
+        with self._route_lock:
+            self._pool_rejections[uid] = {
+                "uid": uid, "reason": reason, "time": time.time(),
+                "retry_after_s": fields.pop("retry_after_s", None),
+                **fields}
 
     @property
     def rejections(self) -> Dict[int, Dict[str, Any]]:

@@ -27,7 +27,8 @@ import numpy as np
 import pytest
 
 from deepspeed_tpu.analysis import (CollectiveBudget, RecompileTripwire,
-                                    assert_budget, audit_serve_programs)
+                                    assert_budget, audit_serve_programs,
+                                    budget_args)
 from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
                                         RaggedInferenceConfig,
                                         SamplingParams)
@@ -349,11 +350,11 @@ class TestSeqHopBudget:
     def test_step_ring_budget(self, seq2_reports):
         # per layer: 1 fresh-KV all-gather + (seq-1)=1 ring ppermute;
         # per program: 1 owner-logits psum (GPT-2's tied unembed adds
-        # no logits gather)
-        budget = CollectiveBudget(
-            "seq2-step", num_layers=L, axis=SEQ_AXIS,
-            per_layer={"all_gather": 1, "ppermute": 1},
-            per_program={"all_reduce": 1})
+        # no logits gather) — the spec lives in the shared registry
+        # (analysis/budgets.py "seq-step"), the same one bench.py's
+        # serve_longctx asserts and dslint DSL008 cross-checks
+        budget = CollectiveBudget(**budget_args(
+            "seq-step", num_layers=L, seq=2, label="seq2-step"))
         for name in ("step", "step_greedy", "step_greedy_fb"):
             assert_budget(seq2_reports[name], budget)
 
@@ -362,34 +363,40 @@ class TestSeqHopBudget:
         # per step, zero per-program collectives (every chip computes
         # identical merged logits), scan trip-weighted over 4 steps
         assert_budget(seq2_reports["decode_loop"], CollectiveBudget(
-            "seq2-decode-loop", num_layers=L, steps=4, axis=SEQ_AXIS,
-            per_layer={"all_gather": 1}))
+            **budget_args("seq-decode-loop", num_layers=L, seq=2,
+                          steps=4, label="seq2-decode-loop")))
 
     def test_flush_ring_chip_local(self, seq2_reports):
         # the ownership-masked flush scatter is chip-local: zero comm
         assert_budget(seq2_reports["flush_ring"], CollectiveBudget(
-            "seq2-flush", num_layers=L, axis=SEQ_AXIS))
+            **budget_args("seq-flush", num_layers=L, seq=2,
+                          label="seq2-flush")))
 
     def test_int8_scale_planes_ride_the_ring(self, int8_seq2):
         # over an int8 pool the ring doubles: per hop one int8 data
         # ppermute + one f32 scale-plane ppermute (the PR 6 quantized-
         # collective shape), while the fresh-KV exchange stays ONE
-        # compute-dtype all-gather
+        # compute-dtype all-gather — expectations derive from the
+        # registry's dtype-pinned "seq-step-int8" entry
         rep = audit_serve_programs(int8_seq2, programs=("step",))["step"]
-        assert rep.count(kind="ppermute", dtype="int8") == L
-        assert rep.count(kind="ppermute", dtype="float32") == L
-        assert rep.count(kind="all_gather", dtype="float32") == L
+        exp = CollectiveBudget(**budget_args(
+            "seq-step-int8", num_layers=L, seq=2)).expected()
+        assert rep.count(kind="ppermute", dtype="int8") \
+            == exp["ppermute@int8"]
+        assert rep.count(kind="ppermute", dtype="float32") \
+            == exp["ppermute@float32"]
+        assert rep.count(kind="all_gather", dtype="float32") \
+            == exp["all_gather@float32"]
 
     def test_seq4_ring_hops_scale(self, base_pair):
-        # seq=4: (seq-1)=3 ring hops per layer, still 1 all-gather
+        # seq=4: (seq-1)=3 ring hops per layer, still 1 all-gather —
+        # the SAME registry entry as seq=2, resolved at a wider shard
         mcfg, params, base = base_pair
         rep = audit_serve_programs(
             InferenceEngineV2(mcfg, params, RaggedInferenceConfig(
                 **base, seq_size=4)), programs=("step",))["step"]
-        assert_budget(rep, CollectiveBudget(
-            "seq4-step", num_layers=L, axis=SEQ_AXIS,
-            per_layer={"all_gather": 1, "ppermute": 3},
-            per_program={"all_reduce": 1}))
+        assert_budget(rep, CollectiveBudget(**budget_args(
+            "seq-step", num_layers=L, seq=4, label="seq4-step")))
 
 
 class TestSeqWarmPath:

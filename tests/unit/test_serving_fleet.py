@@ -419,6 +419,102 @@ class TestRollupExactness:
 
 
 # ------------------------------------------------------------------ #
+# routing-map lock discipline (dslint DSL007 fix, ISSUE 19)
+# ------------------------------------------------------------------ #
+
+
+class TestRouteLockDiscipline:
+    """The pool's routing maps (_owner/_trace_ids/_trace_n/_replayed)
+    are written from the admit, absorb and decode-driver threads; the
+    _route_lock critical sections added for the DSL007 findings must
+    hold under a real interleaving hammer, and the serving layer must
+    stay statically race-free."""
+
+    def _pool(self):
+        return ReplicaPool()
+
+    def test_concurrent_trace_mint_never_drops_a_count(self):
+        import sys
+        import threading
+        pool = self._pool()
+        nthreads, per = 8, 200
+        start = threading.Barrier(nthreads)
+
+        def hammer(base):
+            start.wait()
+            for i in range(per):
+                pool._mint_trace(base + i)
+
+        old = sys.getswitchinterval()
+        sys.setswitchinterval(1e-6)   # force interleaving
+        try:
+            threads = [threading.Thread(target=hammer, args=(t * per,))
+                       for t in range(nthreads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            sys.setswitchinterval(old)
+        # an unlocked `self._trace_n += 1` loses increments under this
+        # hammer; the lock makes the counter exact and every id unique
+        assert pool._trace_n == nthreads * per
+        assert len(pool._trace_ids) == nthreads * per
+        assert len(set(pool._trace_ids.values())) == nthreads * per
+
+    def test_stash_vs_take_never_loses_a_token(self):
+        import sys
+        import threading
+        pool = self._pool()
+        uid, total = 7, 2000
+        out = {uid: []}
+        taken = []
+        done = threading.Event()
+
+        def stasher():
+            for tok in range(total):
+                pool._stash_replay(uid, tok)
+            done.set()
+
+        def taker():
+            # splice in small budgets while the stasher is appending —
+            # the pre-fix setdefault().append() raced the pop/reinsert
+            # window and lost tokens
+            while not done.is_set() or pool._replayed.get(uid):
+                taken.append(pool._take_stash(uid, 3, out))
+
+        old = sys.getswitchinterval()
+        sys.setswitchinterval(1e-6)
+        try:
+            ts = [threading.Thread(target=stasher),
+                  threading.Thread(target=taker)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        finally:
+            sys.setswitchinterval(old)
+        leftover = pool._replayed.get(uid, [])
+        assert sorted(out[uid] + leftover) == list(range(total))
+        assert sum(taken) == len(out[uid])
+
+    def test_serving_layer_lints_race_free(self):
+        import os
+        import sys
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        sys.path.insert(0, os.path.join(repo, "tools"))
+        try:
+            import dslint
+        finally:
+            sys.path.pop(0)
+        findings = [f for f in dslint.lint([], repo_root=repo,
+                                           knob_rules=False)
+                    if f.rule == "DSL007"]
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# ------------------------------------------------------------------ #
 # heavier fleets — slow tier
 # ------------------------------------------------------------------ #
 

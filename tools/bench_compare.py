@@ -95,6 +95,9 @@ DIRECTIONS: Tuple[Tuple[str, str], ...] = (
     ("*drain_s*", "lower"),
     ("*dispatches_per_token*", "lower"),
     ("*fresh_compiles*", "lower"),
+    # repo lint capture (tools/tpu_round22.sh writes bin/dstpu_lint
+    # --json's count): any finding is a regression, zero slack below
+    ("*lint_findings*", "lower"),
     ("*_p99*", "lower"),
     ("*_p90*", "lower"),
     ("*_p50*", "lower"),
@@ -105,6 +108,7 @@ DIRECTIONS: Tuple[Tuple[str, str], ...] = (
 #: throughputs/latencies on a shared box jitter far more than counters.
 BANDS: Tuple[Tuple[str, float], ...] = (
     ("*fresh_compiles*", 0.0),       # a fresh warm-path compile is a bug
+    ("*lint_findings*", 0.0),        # the repo lints clean, period
     ("*tokens_per_sec*", 0.20),
     ("*steps_per_sec*", 0.20),
     ("*tflops*", 0.20),

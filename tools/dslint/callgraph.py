@@ -1,0 +1,151 @@
+"""dslint call graph — function units and intra-repo call edges.
+
+A *unit* is a top-level function or a class-level method; nested defs
+(closures, fused-loop bodies) belong to their enclosing unit — the
+cross-module rules reason about what a unit's *execution* reaches, and
+a closure traced inside ``_build_programs`` executes as part of it.
+
+Edges are resolved conservatively by name: ``self.m(...)`` to the same
+class, bare names to same-file units or ``from mod import f`` targets,
+dotted chains through the file's import aliases (relative imports
+resolved). Bare ``Name`` *references* inside calls also create edges —
+``functools.partial(_ring_kernel, ...)`` and callback tables pass
+functions by value, and the collective auditor must follow them.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import FileIndex, RepoIndex, _dotted
+
+#: (repo-relative path, qualname) — the node key of the call graph
+UnitKey = Tuple[str, str]
+
+
+@dataclasses.dataclass
+class Unit:
+    relpath: str
+    qualname: str            # "fn" or "Class.fn"
+    cls: Optional[str]
+    node: ast.AST            # FunctionDef / AsyncFunctionDef
+
+    @property
+    def key(self) -> UnitKey:
+        return (self.relpath, self.qualname)
+
+
+def file_units(fi: FileIndex) -> Dict[str, Unit]:
+    """qualname -> Unit for every top-level def and class method."""
+    out: Dict[str, Unit] = {}
+    if fi.tree is None:
+        return out
+    for node in fi.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = Unit(fi.relpath, node.name, None, node)
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{node.name}.{sub.name}"
+                    out[q] = Unit(fi.relpath, q, node.name, sub)
+    return out
+
+
+def _walk_unit(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk over a unit's body, NOT descending into nested classes
+    (their methods are separate units) but following nested defs."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.ClassDef):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def unit_refs(fi: FileIndex, unit: Unit) -> List[Tuple[str, str, int]]:
+    """(kind, spec, line) references a unit makes to other code:
+    ``("self", name)`` for self-method use, ``("name", id)`` for bare
+    names, ``("dotted", a.b.c)`` for alias-resolved attribute chains.
+    Covers both call positions and bare function-value references."""
+    refs: List[Tuple[str, str, int]] = []
+    for n in _walk_unit(unit.node):
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in ("self", "cls"):
+                refs.append(("self", f.attr, n.lineno))
+                continue
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            refs.append(("name", n.id, n.lineno))
+        elif isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load):
+            d = _dotted(n, fi.mod_aliases)
+            if d:
+                refs.append(("dotted", d, n.lineno))
+            if isinstance(n.value, ast.Name) \
+                    and n.value.id in ("self", "cls"):
+                refs.append(("self", n.attr, n.lineno))
+    return refs
+
+
+def resolve_ref(index: RepoIndex, fi: FileIndex, unit: Unit,
+                kind: str, spec: str,
+                units_by_file: Dict[str, Dict[str, Unit]]
+                ) -> Optional[UnitKey]:
+    """Resolve one reference to a unit key, or None when it points
+    outside the indexed unit set."""
+    local = units_by_file.get(fi.relpath, {})
+    if kind == "self":
+        if unit.cls and f"{unit.cls}.{spec}" in local:
+            return (fi.relpath, f"{unit.cls}.{spec}")
+        return None
+    if kind == "name":
+        if spec in local and local[spec].cls is None:
+            return (fi.relpath, spec)
+        dotted = fi.mod_aliases.get(spec)
+        if dotted:
+            return _resolve_dotted(index, dotted, units_by_file)
+        return None
+    if kind == "dotted":
+        return _resolve_dotted(index, spec, units_by_file)
+    return None
+
+
+def _resolve_dotted(index: RepoIndex, dotted: str,
+                    units_by_file: Dict[str, Dict[str, Unit]]
+                    ) -> Optional[UnitKey]:
+    parts = dotted.split(".")
+    # longest module prefix first: pkg.mod.Class.method / pkg.mod.fn
+    for i in range(len(parts) - 1, 0, -1):
+        mod_rel = index.module_file(".".join(parts[:i]))
+        if mod_rel is None or mod_rel not in units_by_file:
+            continue
+        qual = ".".join(parts[i:])
+        if qual in units_by_file[mod_rel]:
+            return (mod_rel, qual)
+        return None
+    return None
+
+
+def reachable_units(index: RepoIndex, roots: List[UnitKey],
+                    units_by_file: Dict[str, Dict[str, Unit]],
+                    files: Dict[str, FileIndex]) -> Set[UnitKey]:
+    """Transitive closure of unit references from ``roots``, restricted
+    to the units in ``units_by_file``."""
+    seen: Set[UnitKey] = set()
+    stack = [k for k in roots if k[1] in units_by_file.get(k[0], {})]
+    while stack:
+        key = stack.pop()
+        if key in seen:
+            continue
+        seen.add(key)
+        fi = files[key[0]]
+        unit = units_by_file[key[0]][key[1]]
+        for kind, spec, _line in unit_refs(fi, unit):
+            tgt = resolve_ref(index, fi, unit, kind, spec, units_by_file)
+            if tgt is not None and tgt not in seen:
+                stack.append(tgt)
+    return seen

@@ -1,0 +1,205 @@
+"""dslint core — shared single-pass AST index and helpers.
+
+Every rule in the package consumes :class:`RepoIndex`: each file is
+read and ``ast.parse``d AT MOST ONCE per ``lint()`` call, no matter how
+many rules look at it (the cross-module rules pull the same cached
+entries the per-file rules already parsed). ``RepoIndex.parse_count``
+exists so tests can assert the one-pass property.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+#: repo root (tools/dslint/core.py -> tools/dslint -> tools -> repo)
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_ALLOW_RE = re.compile(r"#\s*dslint:\s*allow\(([A-Z0-9_,\s]+)\)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str       # repo-relative
+    line: int
+    message: str
+
+    def __str__(self):
+        return f"{self.rule} {self.path}:{self.line} {self.message}"
+
+
+# ------------------------------------------------------------------ #
+# shared AST helpers
+# ------------------------------------------------------------------ #
+
+
+def _import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Local name -> dotted module it refers to (``import numpy as np``
+    => {np: numpy}; ``from jax import numpy as jnp`` => {jnp:
+    jax.numpy}). Relative imports are skipped (see
+    :func:`_module_aliases` for the resolving variant)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _module_aliases(tree: ast.Module, relpath: str) -> Dict[str, str]:
+    """Like :func:`_import_aliases` but ALSO resolves relative imports
+    against the file's package path (``from ..comm import comm`` inside
+    ``deepspeed_tpu/parallel/ring_attention.py`` =>
+    {comm: deepspeed_tpu.comm.comm}) — the call graph needs absolute
+    targets to resolve cross-file edges."""
+    out: Dict[str, str] = {}
+    pkg = relpath.replace(os.sep, "/").split("/")[:-1]
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0 and node.module:
+                base = node.module.split(".")
+            elif node.level > 0:
+                up = node.level - 1
+                if up > len(pkg):
+                    continue
+                base = pkg[:len(pkg) - up] if up else list(pkg)
+                if node.module:
+                    base = base + node.module.split(".")
+            else:
+                continue
+            for a in node.names:
+                out[a.asname or a.name] = ".".join(base + [a.name])
+    return out
+
+
+def _dotted(node: ast.AST, aliases: Mapping[str, str]) -> Optional[str]:
+    """Resolve an attribute chain to a dotted name with the root import
+    alias expanded; None for non-name expressions."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    root = aliases.get(node.id, node.id)
+    return ".".join([root] + list(reversed(parts)))
+
+
+def _suppressed(finding_lines: Iterable[int], rule: str,
+                src_lines: Sequence[str]) -> bool:
+    """True when an allow-comment for ``rule`` sits on any of the
+    statement's lines or in the contiguous comment block directly above
+    it (multi-line justifications)."""
+    lines = sorted(set(finding_lines))
+    ln = lines[0] - 1 if lines else 0
+    while ln >= 1 and src_lines[ln - 1].strip().startswith("#"):
+        lines.append(ln)
+        ln -= 1
+    for ln in lines:
+        if 1 <= ln <= len(src_lines):
+            m = _ALLOW_RE.search(src_lines[ln - 1])
+            if m and rule in [r.strip() for r in m.group(1).split(",")]:
+                return True
+    return False
+
+
+def _node_lines(node: ast.AST) -> range:
+    end = getattr(node, "end_lineno", None) or node.lineno
+    return range(node.lineno, end + 1)
+
+
+def _py_files(root: str) -> Iterable[str]:
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            path = os.path.join(dirpath, fn)
+            if fn.endswith(".py") or os.sep + "bin" + os.sep in path:
+                yield path
+
+
+# ------------------------------------------------------------------ #
+# the single-pass index
+# ------------------------------------------------------------------ #
+
+
+@dataclasses.dataclass
+class FileIndex:
+    """Everything the rules need from one source file, parsed once."""
+    path: str                      # absolute
+    relpath: str                   # repo-relative, '/'-separated
+    src_lines: List[str]
+    tree: Optional[ast.Module]     # None on syntax error
+    aliases: Dict[str, str]        # absolute import aliases (legacy)
+    mod_aliases: Dict[str, str]    # + relative imports resolved
+    error: Optional[Finding]       # DSL000 syntax-error finding
+
+    def suppressed(self, lines: Iterable[int], rule: str) -> bool:
+        return _suppressed(lines, rule, self.src_lines)
+
+
+class RepoIndex:
+    """Parse-once cache of :class:`FileIndex` keyed by absolute path."""
+
+    def __init__(self, repo_root: str = REPO):
+        self.repo_root = repo_root
+        self._files: Dict[str, Optional[FileIndex]] = {}
+        self.parse_count = 0
+
+    def get(self, path: str) -> Optional[FileIndex]:
+        path = os.path.abspath(path)
+        if path in self._files:
+            return self._files[path]
+        fi: Optional[FileIndex] = None
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except (OSError, UnicodeDecodeError):
+            self._files[path] = None
+            return None
+        relpath = os.path.relpath(path, self.repo_root).replace(os.sep, "/")
+        self.parse_count += 1
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            fi = FileIndex(path, relpath, src.splitlines(), None, {}, {},
+                           Finding("DSL000", relpath, e.lineno or 0,
+                                   f"syntax error: {e.msg}"))
+        else:
+            fi = FileIndex(path, relpath, src.splitlines(), tree,
+                           _import_aliases(tree),
+                           _module_aliases(tree, relpath), None)
+        self._files[path] = fi
+        return fi
+
+    def get_rel(self, relpath: str) -> Optional[FileIndex]:
+        full = os.path.join(self.repo_root, relpath)
+        if not os.path.isfile(full):
+            return None
+        return self.get(full)
+
+    def module_file(self, dotted_module: str) -> Optional[str]:
+        """Repo-relative path for a dotted module name, if the file
+        exists under the repo root (``pkg.mod`` -> ``pkg/mod.py`` or
+        ``pkg/mod/__init__.py``)."""
+        base = dotted_module.replace(".", "/")
+        for cand in (base + ".py", base + "/__init__.py"):
+            if os.path.isfile(os.path.join(self.repo_root, cand)):
+                return cand
+        return None

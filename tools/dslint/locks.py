@@ -1,0 +1,392 @@
+"""dslint DSL007 — cross-module lock-discipline race detector.
+
+For every registered *thread root* (an entry point the serving stack
+calls from a distinct thread), the rule computes — transitively through
+the same-file call graph — which locks the root holds at every shared
+``self.*`` mutation, and flags:
+
+  (a) an attribute mutated from two different thread groups with no
+      common ``self.*`` lock across ALL mutation sites (a real data
+      race: two threads interleave read-modify-write),
+  (b) pairwise lock-order inversions — lock B acquired while holding A
+      on one path and A while holding B on another (deadlock hazard),
+  (c) the DSL001 blocking-sync predicate firing while ANY lock is held
+      (one readback under a lock stalls every other driver thread
+      queued on it).
+
+Roots in the same *group* share a thread (e.g. the open-loop driver
+calls admit/decode/reject sequentially), so accesses inside one group
+never race with each other. Only ``self.``-receiver locks count as
+common guards for ``self.*`` state — ``rep.lock`` protecting a replica
+does not serialize two pool methods. Lockset tracking is flow-through
+``with`` statements; closures are analyzed with the lockset at their
+*definition* site (a closure handed to an executor does NOT inherit the
+locks its creator held at call time — the conservative default).
+``__init__`` is never analyzed: it runs before any thread exists.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Mapping, Optional, Set, Tuple
+
+from .core import FileIndex, Finding, RepoIndex, _dotted, _node_lines
+from .intra import sync_call_msg
+
+#: registered thread roots: path suffix -> class -> {method: group}.
+#: Methods in the same group run on ONE thread (sequential callers);
+#: distinct groups are genuinely concurrent in the serving stack.
+THREAD_ROOTS: Mapping[str, Mapping[str, Mapping[str, str]]] = {
+    "deepspeed_tpu/serving/pool.py": {
+        # put() runs on the admission path, absorb_draining on the
+        # scale-down absorber, decode_pipelined/flush on the decode
+        # driver thread — three concurrent writers of the routing maps
+        "ReplicaPool": {"put": "admit", "absorb_draining": "absorb",
+                        "decode_pipelined": "exec", "flush": "exec"},
+    },
+    "deepspeed_tpu/serving/admission.py": {
+        # the tick loop (poll->tick) adjusts AIMD state while the
+        # driver thread consults door()/mints reject() records
+        "AdmissionController": {"poll": "tick", "tick": "tick",
+                               "door": "driver", "reject": "driver"},
+    },
+    "deepspeed_tpu/resilience/watchdog.py": {
+        # the watchdog heartbeat thread samples step state the engine
+        # thread writes via the step_*/phase brackets
+        "StepWatchdog": {"_run": "watchdog", "check_once": "watchdog",
+                         "step_start": "engine", "phase": "engine",
+                         "step_end": "engine", "step_abort": "engine"},
+    },
+    "deepspeed_tpu/telemetry/loadgen.py": {
+        # the open-loop driver calls all three sequentially from its
+        # single run() loop — one group, so no self-races by design
+        "_OpenLoopDriver": {"_admit_due": "loadgen-driver",
+                            "_decode_burst": "loadgen-driver",
+                            "_door_reject": "loadgen-driver"},
+    },
+}
+
+_LOCK_FACTORIES = ("threading.Lock", "threading.RLock")
+#: method calls that mutate the receiver in place
+_MUTATORS = ("append", "extend", "insert", "add", "discard", "remove",
+             "pop", "popitem", "popleft", "appendleft", "clear",
+             "update", "setdefault")
+
+LockSet = FrozenSet[str]
+
+
+@dataclasses.dataclass
+class _Write:
+    attr: str
+    line: int
+    held: LockSet          # locks acquired within the unit itself
+
+
+@dataclasses.dataclass
+class _Sync:
+    line: int
+    msg: str
+    held: LockSet
+    node_lines: range
+
+
+@dataclasses.dataclass
+class _UnitSummary:
+    qualname: str
+    writes: List[_Write] = dataclasses.field(default_factory=list)
+    syncs: List[_Sync] = dataclasses.field(default_factory=list)
+    #: every with-acquisition in the unit: (token, line)
+    acquires: List[Tuple[str, int]] = dataclasses.field(default_factory=list)
+    #: intra-unit nesting order pairs: (outer, inner, line)
+    pairs: List[Tuple[str, str, int]] = dataclasses.field(
+        default_factory=list)
+    #: same-file calls: (qualname-or-None target, held-at-call)
+    calls: List[Tuple[str, LockSet]] = dataclasses.field(
+        default_factory=list)
+
+
+def _class_lock_attrs(tree: ast.Module,
+                      aliases: Mapping[str, str]) -> Set[str]:
+    """Attribute names assigned a threading.Lock()/RLock() anywhere in
+    the file (``self.X = threading.Lock()`` and module-level too)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) \
+                or not isinstance(node.value, ast.Call):
+            continue
+        dotted = _dotted(node.value.func, aliases)
+        if dotted not in _LOCK_FACTORIES:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute):
+                out.add(tgt.attr)
+            elif isinstance(tgt, ast.Name):
+                out.add(tgt.id)
+    return out
+
+
+def _lock_token(expr: ast.AST, lock_attrs: Set[str]) -> Optional[str]:
+    """Printable token for a with-item that acquires a known lock:
+    ``self._absorb_lock`` for self locks, ``rep.lock`` (receiver name
+    kept) for locks on other objects; None for non-lock items."""
+    if isinstance(expr, ast.Attribute) and expr.attr in lock_attrs:
+        if isinstance(expr.value, ast.Name):
+            return f"{expr.value.id}.{expr.attr}"
+        return f"<expr>.{expr.attr}"
+    if isinstance(expr, ast.Name) and expr.id in lock_attrs:
+        return expr.id
+    return None
+
+
+def _is_self_lock(token: str) -> bool:
+    return token.startswith("self.")
+
+
+def _attr_write_targets(stmt: ast.AST) -> List[Tuple[str, int]]:
+    """self.<attr> names a statement mutates via assignment/del,
+    including subscript stores (``self.d[k] = v`` mutates ``d``)."""
+    out: List[Tuple[str, int]] = []
+
+    def _target(t: ast.AST) -> None:
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            out.append((t.attr, t.lineno))
+        elif isinstance(t, (ast.Subscript,)):
+            v = t.value
+            if isinstance(v, ast.Attribute) \
+                    and isinstance(v.value, ast.Name) and v.value.id == "self":
+                out.append((v.attr, t.lineno))
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                _target(e)
+        elif isinstance(t, ast.Starred):
+            _target(t.value)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            _target(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        if stmt.target is not None:
+            _target(stmt.target)
+    elif isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            _target(t)
+    return out
+
+
+def _mutator_call(node: ast.Call) -> Optional[Tuple[str, int]]:
+    """``self.<attr>.<mutator>(...)`` (incl. one-level subscript like
+    ``self.d[k].append(x)``) -> (attr, line)."""
+    f = node.func
+    if not isinstance(f, ast.Attribute) or f.attr not in _MUTATORS:
+        return None
+    recv = f.value
+    if isinstance(recv, ast.Subscript):
+        recv = recv.value
+    if isinstance(recv, ast.Attribute) and isinstance(recv.value, ast.Name) \
+            and recv.value.id == "self":
+        return recv.attr, node.lineno
+    # self.d.setdefault(k, []).append(v): receiver is a Call on self.d
+    if isinstance(recv, ast.Call):
+        rf = recv.func
+        if isinstance(rf, ast.Attribute) \
+                and isinstance(rf.value, ast.Attribute) \
+                and isinstance(rf.value.value, ast.Name) \
+                and rf.value.value.id == "self":
+            return rf.value.attr, node.lineno
+    return None
+
+
+def _summarize_unit(fi: FileIndex, qualname: str, fn: ast.AST,
+                    lock_attrs: Set[str],
+                    module_fns: Set[str]) -> _UnitSummary:
+    S = _UnitSummary(qualname)
+
+    def scan(node: ast.AST, held: LockSet) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            toks: List[str] = []
+            for item in node.items:
+                t = _lock_token(item.context_expr, lock_attrs)
+                if t is not None:
+                    toks.append(t)
+                    S.acquires.append((t, node.lineno))
+                else:
+                    scan(item.context_expr, held)
+            for h in held:
+                for t in toks:
+                    if h != t:
+                        S.pairs.append((h, t, node.lineno))
+            inner = held | frozenset(toks)
+            for sub in node.body:
+                scan(sub, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # closure: lockset at DEFINITION, not at some later call
+            body = node.body if isinstance(node.body, list) else [node.body]
+            for sub in body:
+                scan(sub, held)
+            return
+        if isinstance(node, ast.ClassDef):
+            return
+
+        for attr, line in _attr_write_targets(node):
+            S.writes.append(_Write(attr, line, held))
+        if isinstance(node, ast.Call):
+            hit = _mutator_call(node)
+            if hit is not None:
+                S.writes.append(_Write(hit[0], hit[1], held))
+            msg = sync_call_msg(node, fi.aliases)
+            if msg is not None:
+                S.syncs.append(_Sync(node.lineno, msg, held,
+                                     _node_lines(node)))
+            f = node.func
+            if isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in ("self", "cls"):
+                S.calls.append((f.attr, held))
+            elif isinstance(f, ast.Name) and f.id in module_fns:
+                S.calls.append((f.id, held))
+        for child in ast.iter_child_nodes(node):
+            scan(child, held)
+
+    body = getattr(fn, "body", [])
+    for stmt in body:
+        scan(stmt, frozenset())
+    return S
+
+
+def lock_findings(index: RepoIndex,
+                  thread_roots: Mapping[str, Mapping[str, Mapping[str, str]]]
+                  ) -> List[Finding]:
+    findings: List[Finding] = []
+    for suffix, classes in thread_roots.items():
+        fi = _find_file(index, suffix)
+        if fi is None or fi.tree is None:
+            continue
+        findings.extend(_file_lock_findings(fi, classes))
+    return findings
+
+
+def _find_file(index: RepoIndex, suffix: str) -> Optional[FileIndex]:
+    return index.get_rel(suffix)
+
+
+def _file_lock_findings(fi: FileIndex,
+                        classes: Mapping[str, Mapping[str, str]]
+                        ) -> List[Finding]:
+    assert fi.tree is not None
+    lock_attrs = _class_lock_attrs(fi.tree, fi.aliases)
+    module_fns: Set[str] = {
+        n.name for n in fi.tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    # summaries for every method of every registered class + module fns
+    summaries: Dict[str, _UnitSummary] = {}
+    methods_of: Dict[str, Set[str]] = {}
+    for node in fi.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name in classes:
+            methods_of[node.name] = set()
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    methods_of[node.name].add(sub.name)
+                    summaries[f"{node.name}.{sub.name}"] = _summarize_unit(
+                        fi, f"{node.name}.{sub.name}", sub, lock_attrs,
+                        module_fns)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            summaries[node.name] = _summarize_unit(
+                fi, node.name, node, lock_attrs, module_fns)
+
+    raw: List[Tuple[Finding, range]] = []
+    for cls, roots in classes.items():
+        if cls not in methods_of:
+            continue
+        # (attr) -> list of (group, line, effective lockset, root)
+        mutations: Dict[str, List[Tuple[str, int, LockSet, str]]] = {}
+        pair_sites: Dict[Tuple[str, str], int] = {}
+        sync_sites: Dict[int, Tuple[str, str, LockSet, range]] = {}
+
+        for root, group in roots.items():
+            key = f"{cls}.{root}"
+            if key not in summaries:
+                continue
+            seen: Set[Tuple[str, LockSet]] = set()
+            stack: List[Tuple[str, LockSet]] = [(key, frozenset())]
+            while stack:
+                cur, inherited = stack.pop()
+                if (cur, inherited) in seen or cur not in summaries:
+                    continue
+                seen.add((cur, inherited))
+                S = summaries[cur]
+                if cur.endswith(".__init__"):
+                    continue        # runs before any thread exists
+                for w in S.writes:
+                    mutations.setdefault(w.attr, []).append(
+                        (group, w.line, inherited | w.held, root))
+                for sy in S.syncs:
+                    eff = inherited | sy.held
+                    if eff and sy.line not in sync_sites:
+                        sync_sites[sy.line] = (S.qualname, sy.msg, eff,
+                                               sy.node_lines)
+                for (outer, inner, line) in S.pairs:
+                    pair_sites.setdefault((outer, inner), line)
+                for tok, line in S.acquires:
+                    for h in inherited:
+                        if h != tok:
+                            pair_sites.setdefault((h, tok), line)
+                for callee, held_at_call in S.calls:
+                    eff = inherited | held_at_call
+                    tgt = f"{cls}.{callee}" \
+                        if f"{cls}.{callee}" in summaries else callee
+                    if tgt in summaries:
+                        stack.append((tgt, eff))
+
+        # (a) cross-group mutations with no common self-lock
+        for attr, recs in sorted(mutations.items()):
+            groups = {g for g, _, _, _ in recs}
+            if len(groups) < 2:
+                continue
+            common = None
+            for _, _, held, _ in recs:
+                self_locks = {t for t in held if _is_self_lock(t)}
+                common = self_locks if common is None \
+                    else common & self_locks
+            if common:
+                continue
+            anchor = min(
+                (r for r in recs
+                 if not any(_is_self_lock(t) for t in r[2])),
+                key=lambda r: r[1], default=min(recs, key=lambda r: r[1]))
+            lines = sorted({ln for _, ln, _, _ in recs})
+            raw.append((Finding(
+                "DSL007", fi.relpath, anchor[1],
+                f"'{cls}.{attr}' is mutated from thread roots "
+                f"{sorted(groups)} with no common self.* lock "
+                f"(sites: {', '.join(map(str, lines))}) — two threads "
+                f"can interleave the read-modify-write"),
+                range(anchor[1], anchor[1] + 1)))
+
+        # (b) lock-order inversions
+        reported: Set[FrozenSet[str]] = set()
+        for (a, b), line in sorted(pair_sites.items(),
+                                   key=lambda kv: kv[1]):
+            if (b, a) in pair_sites and frozenset((a, b)) not in reported:
+                reported.add(frozenset((a, b)))
+                other = pair_sites[(b, a)]
+                raw.append((Finding(
+                    "DSL007", fi.relpath, max(line, other),
+                    f"lock-order inversion: {a} -> {b} (line {line}) "
+                    f"but {b} -> {a} (line {other}) — deadlock hazard"),
+                    range(max(line, other), max(line, other) + 1)))
+
+        # (c) blocking sync while holding a lock
+        for line, (qual, msg, held, node_lines) in sorted(
+                sync_sites.items()):
+            raw.append((Finding(
+                "DSL007", fi.relpath, line,
+                f"in '{qual}' while holding {', '.join(sorted(held))}: "
+                f"{msg} — a readback under a lock stalls every thread "
+                f"queued on it"), node_lines))
+
+    return [f for f, lines in raw if not fi.suppressed(lines, f.rule)]

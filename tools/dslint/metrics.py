@@ -1,0 +1,87 @@
+"""dslint telemetry-metric drift — DSL006 (REGISTERED_METRICS vs the
+docs/observability.md metric catalog, two-way). The registry is read
+from the AST so the rule never imports the package."""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Tuple
+
+from .core import Finding, RepoIndex
+
+#: where the REGISTERED_METRICS literal lives (scanned from the AST so
+#: the rule never imports the package)
+METRICS_TABLE_FILE = "deepspeed_tpu/telemetry/registry.py"
+OBSERVABILITY_DOC = "docs/observability.md"
+
+_METRIC_DOC_ROW_RE = re.compile(r"^\|\s*`([A-Za-z0-9_]+)`")
+
+
+def registered_metrics(registry_py: str) -> List[Tuple[str, int]]:
+    """(name, line) pairs of the ``REGISTERED_METRICS = {...}`` literal
+    dict keys in the telemetry registry source."""
+    with open(registry_py, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=registry_py)
+    return _metrics_from_tree(tree)
+
+
+def _metrics_from_tree(tree: ast.Module) -> List[Tuple[str, int]]:
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        names = {t.id for t in node.targets if isinstance(t, ast.Name)}
+        if "REGISTERED_METRICS" not in names \
+                or not isinstance(node.value, ast.Dict):
+            continue
+        for key in node.value.keys:
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                out.append((key.value, key.lineno))
+    return out
+
+
+def documented_metrics(obs_md: str) -> List[Tuple[str, int]]:
+    """(metric, line) rows of the "Metric catalog" table in
+    docs/observability.md."""
+    out: List[Tuple[str, int]] = []
+    in_section = False
+    for i, line in enumerate(obs_md.splitlines(), 1):
+        if line.startswith("## "):
+            in_section = "Metric catalog" in line
+        if in_section:
+            m = _METRIC_DOC_ROW_RE.match(line)
+            if m:
+                out.append((m.group(1), i))
+    return out
+
+
+def metric_findings(index: RepoIndex) -> List[Finding]:
+    fi = index.get_rel(METRICS_TABLE_FILE)
+    if fi is None or fi.tree is None:
+        return []                 # tree predates the telemetry layer
+    table = _metrics_from_tree(fi.tree)
+    doc_path = os.path.join(index.repo_root, OBSERVABILITY_DOC)
+    if not os.path.exists(doc_path):
+        return [Finding("DSL006", OBSERVABILITY_DOC,
+                        0, "missing — every REGISTERED_METRICS entry "
+                           "needs a metric-catalog row")]
+    with open(doc_path, encoding="utf-8") as f:
+        doc_rows = documented_metrics(f.read())
+    documented = {name for name, _ in doc_rows}
+    registered = {name for name, _ in table}
+    findings: List[Finding] = []
+    for name, line in table:
+        if name not in documented:
+            findings.append(Finding(
+                "DSL006", METRICS_TABLE_FILE, line,
+                f"metric {name} is registered but has no "
+                f"docs/observability.md catalog row"))
+    for name, line in doc_rows:
+        if name not in registered:
+            findings.append(Finding(
+                "DSL006", OBSERVABILITY_DOC, line,
+                f"documented metric {name} is not in "
+                f"telemetry.REGISTERED_METRICS"))
+    return findings

@@ -96,7 +96,7 @@ ENV_HEADER = """
 Every `DSTPU_*` environment variable the code reads — name, default and
 reading site — generated from an AST scan of `deepspeed_tpu/`,
 `bench.py`, `tools/`, `bin/` and `examples/`
-(`tools/dslint.py scan_env_knobs`). `bin/dstpu_lint`'s DSL004/DSL005
+(`tools/dslint scan_env_knobs`). `bin/dstpu_lint`'s DSL004/DSL005
 rules fail CI when this table and the code drift, so re-run
 `python tools/gen_config_doc.py` after adding or removing a knob.
 "(required)" means the knob is read with no default

@@ -1,0 +1,63 @@
+#!/bin/bash
+# Round-22 on-chip sequence: dslint v2 — cross-module lock-discipline
+# race detector (DSL007) + static collective-budget auditor (DSL008)
+# over the shared registry in deepspeed_tpu/analysis/budgets.py
+# (ISSUE 19). The CPU story is proven in tier-1 (golden positive/
+# negative fixtures per rule, the single-AST-pass property, the
+# serving-layer DSL007 findings fixed under a real thread-interleaving
+# hammer, bench/test hop budgets deduped against the registry); on
+# chip this captures what the CPU harness CANNOT: (a) the whole-repo
+# lint verdict as a MACHINE-READABLE artifact — bin/dstpu_lint --json
+# over every rule incl. the two cross-module analyses, captured to
+# profiles/BENCH_LINT_r22.json so bench_compare pins lint_findings at
+# 0 (zero slack) from round to round, (b) the tpu_smoke sweep — the
+# pool's new _route_lock critical sections sit on the admission/decode
+# driver path, so the serve rows prove the leaf lock costs nothing at
+# real step times, and (c) bench_compare gating the lint capture (and
+# the previous round's serve_longctx capture, informational) against
+# history. Strictly sequential (one process owns the chip), no
+# timeouts around TPU clients (a killed client wedges the grant).
+cd /root/repo || exit 1
+LOG=profiles/r22_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round22 start $(date -u +%FT%TZ)"
+FAIL=0
+
+echo "--- [1/3] dstpu_lint --json: whole-repo verdict (DSL001-008,"
+echo "    lock discipline + collective budgets) -> BENCH_LINT_r22.json"
+python bin/dstpu_lint deepspeed_tpu --json > profiles/lint_r22_raw.json
+LINT_RC=$?
+[ "$LINT_RC" -ne 0 ] && FAIL=1
+python - <<'PY' || FAIL=1
+import json
+raw = json.load(open("profiles/lint_r22_raw.json"))
+out = {"lint": {"lint_findings": raw["count"],
+                "lint_clean": raw["clean"]}}
+json.dump(out, open("profiles/BENCH_LINT_r22.json", "w"), indent=2)
+print(json.dumps(out))
+PY
+
+echo "--- [2/3] tpu_smoke: full kernel + serve sweep (the _route_lock"
+echo "    leaf sections ride the admission/decode driver path — serve"
+echo "    rows must not move)"
+python tools/tpu_smoke.py || FAIL=1
+
+echo "--- [3/3] bench_compare: pin lint_findings at 0 vs the previous"
+echo "    lint capture (zero-slack band; first round is the baseline)"
+PREV=$(ls profiles/BENCH_LINT_r*.json 2>/dev/null | sort | \
+       grep -v r22 | tail -1)
+if [ -n "$PREV" ]; then
+    python tools/bench_compare.py "$PREV" profiles/BENCH_LINT_r22.json \
+        || FAIL=1
+else
+    echo "no prior lint capture — r22 is the baseline; informational"
+    echo "serve_longctx history compare instead"
+    mapfile -t ROUNDS < <(ls BENCH_LONGCTX_r*.json 2>/dev/null | sort | tail -2)
+    if [ "${#ROUNDS[@]}" = 2 ]; then
+        python tools/bench_compare.py "${ROUNDS[0]}" "${ROUNDS[1]}" \
+            --allow-missing || FAIL=1
+    fi
+fi
+
+echo "=== tpu_round22 done $(date -u +%FT%TZ) FAIL=$FAIL"
+exit $FAIL
