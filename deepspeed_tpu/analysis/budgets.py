@@ -64,6 +64,29 @@ HOP_BUDGETS = {
                       "all_gather@float32": 1},
         "per_program": {"all_reduce": 1},
     },
+    # expert-parallel MoE serving (ISSUE 20): per MoE layer exactly TWO
+    # all_to_all hops — routed-row dispatch + weighted-output combine
+    # (sharded_moe.grouped_moe_ffn_ep_serve); attention/norms/lm_head
+    # replicate on the ep-only mesh, so those are the ONLY collectives
+    "ep-step": {
+        "axis": "expert",
+        "per_layer": {"all_to_all": 2},
+        "per_program": {},
+    },
+    # same pipeline chunked over ep_comm_chunks slices: each of the two
+    # logical hops splits into `chunks` runtime hops (chunk k's expert
+    # GEMMs run under chunk k+1's exchange) — still 2 call SITES
+    "ep-step-overlap": {
+        "axis": "expert",
+        "per_layer": {"all_to_all": "2*chunks"},
+        "per_program": {},
+    },
+    # fused decode loop: the scan body carries the same 2 hops/MoE layer,
+    # trip-weighted by the auditor (steps = n_steps)
+    "ep-decode-loop": {
+        "axis": "expert",
+        "per_layer": {"all_to_all": 2},
+    },
 }
 
 #: audited file -> builder qualname -> {collective kind: distinct
@@ -85,34 +108,50 @@ SITE_BUDGETS = {
         "combine_decode_stats": {"all_gather": 1},
     },
     "deepspeed_tpu/inference/v2/tp.py": {},
+    "deepspeed_tpu/inference/v2/expert_parallel.py": {},
+    "deepspeed_tpu/inference/v2/llama_runner.py": {
+        # reaches the serve dispatch/combine pair in sharded_moe.py; the
+        # Python chunk loop re-uses the SAME two sites at any chunks
+        "_moe_mlp": {"all_to_all": 2},
+    },
+    "deepspeed_tpu/moe/sharded_moe.py": {
+        # training EP layer: one shared a2a helper site (dispatch and
+        # combine both trace through it)
+        "grouped_moe_ffn_ep": {"all_to_all": 1},
+        # serving EP pipeline: distinct dispatch + combine sites
+        "grouped_moe_ffn_ep_serve": {"all_to_all": 2},
+    },
     "deepspeed_tpu/parallel/ring_attention.py": {
         "ring_attention": {"ppermute": 6},
     },
 }
 
 
-def _resolve(value: Any, seq: int) -> int:
+def _resolve(value: Any, seq: int, chunks: int = 1) -> int:
     if value == "seq-1":
         return seq - 1
     if value == "seq":
         return seq
+    if value == "2*chunks":
+        return 2 * chunks
     return int(value)
 
 
 def budget_args(name: str, *, num_layers: int, seq: int = 1,
-                steps: int = 1,
+                steps: int = 1, chunks: int = 1,
                 label: Optional[str] = None) -> Dict[str, Any]:
     """Kwargs for ``CollectiveBudget(**...)`` from a HOP_BUDGETS entry,
     with the symbolic ``"seq-1"``/``"seq"`` values resolved against the
-    live seq width. ``label`` overrides the budget's display name."""
+    live seq width and ``"2*chunks"`` against the EP overlap chunk
+    count. ``label`` overrides the budget's display name."""
     spec = HOP_BUDGETS[name]
     return {
         "name": label or name,
         "num_layers": num_layers,
         "steps": steps,
         "axis": spec.get("axis", "model"),
-        "per_layer": {k: _resolve(v, seq)
+        "per_layer": {k: _resolve(v, seq, chunks)
                       for k, v in spec.get("per_layer", {}).items()},
-        "per_program": {k: _resolve(v, seq)
+        "per_program": {k: _resolve(v, seq, chunks)
                         for k, v in spec.get("per_program", {}).items()},
     }

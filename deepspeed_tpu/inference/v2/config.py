@@ -55,6 +55,46 @@ class RaggedInferenceConfig(ConfigModel):
     # attention path and num_blocks / max_blocks_per_seq divisible by
     # seq_size.
     seq_size: int = 1
+    # Expert-parallel serving over the 'expert' mesh axis (inference/v2/
+    # expert_parallel.py, docs/serving.md "Expert-parallel MoE serving"):
+    # the stacked expert weights (layer_*/moe/{wi_gate,wi_up,wo}) shard
+    # block-wise over ep_size chips (expert e lives on chip
+    # e // (E/ep_size)) so per-chip expert bytes ∝ 1/ep — the capacity
+    # lever for sparse models whose FULL expert set outgrows one chip's
+    # HBM. _moe_mlp becomes a dispatch → grouped-GEMM → combine pipeline:
+    # router logits everywhere, ONE packed all-to-all routes token rows
+    # to their experts' home chips, each chip runs the grouped expert
+    # GEMM over only its resident experts' contiguous rows, and a second
+    # all-to-all returns the gate-weighted outputs — exactly 2 a2a per
+    # MoE layer, inside both the SplitFuse prefill step and the fused
+    # decode loop. Composes with tp_size > 1 (ep×tp mesh: attention
+    # shards over 'model', experts over 'expert'); mutually exclusive
+    # with seq_size > 1. num_experts must divide by ep_size. ep_size=1
+    # traces the exact pre-ep single-chip programs; the env knob
+    # DSTPU_EP_SIZE overrides at engine construction (0 = killswitch,
+    # N>1 = force the axis open).
+    ep_size: int = 1
+    # Overlapped expert dispatch/combine (the PR 6 decomposed-collective
+    # shape): "chunked" splits each a2a's capacity slots into
+    # ep_comm_chunks independent slices so chunk k's expert GEMM runs
+    # under chunk k+1's dispatch a2a. "off" is the single-a2a parity
+    # oracle — token streams are identical either way (per-row GEMM
+    # results and the slot-ordered combine don't depend on chunking).
+    # Env: DSTPU_EP_OVERLAP (off|chunked[:k]).
+    ep_comm_overlap: str = "off"
+    # Chunk count for ep_comm_overlap="chunked" (capacity slots per
+    # destination are rounded up to a multiple of this). Env:
+    # DSTPU_EP_OVERLAP_CHUNKS.
+    ep_comm_chunks: int = 2
+    # Dispatch capacity slack: each chip reserves
+    # ceil(rows * ep_capacity_factor / ep_size) slots per destination
+    # chip (rows = tokens * top_k), capped at rows. Rows routed past a
+    # destination's slots are DROPPED (their gate weight is lost), the
+    # standard fixed-capacity MoE trade; factor >= ep_size is provably
+    # dropless (every destination can absorb every row) — the default
+    # 2.0 makes the flagship ep=2 geometry exact, which the ep=1 vs
+    # ep=2 parity oracle relies on. Env: DSTPU_EP_CAPACITY.
+    ep_capacity_factor: float = 2.0
     # Route the TP all-reduces through int8 quantized comm (EQuARX-class
     # for bandwidth-bound decode). With tp_comm_overlap off this is the
     # legacy monolithic int8 all-gather; with overlap on, quant/dequant
@@ -239,6 +279,28 @@ class RaggedInferenceConfig(ConfigModel):
                     f"(the paged-flash kernel indexes a single-chip "
                     f"pool layout), got attention_impl="
                     f"{self.attention_impl!r}")
+        if self.ep_size < 1:
+            raise ValueError(f"ep_size must be >= 1, got {self.ep_size}")
+        if self.ep_size > 1 and self.seq_size > 1:
+            # the expert axis composes with tp (ep×tp mesh), not with
+            # the sequence axis: the seq pool sharding and the expert
+            # dispatch both want to own the token dim — fail at config
+            # time with the knob names rather than mis-shard silently
+            raise ValueError(
+                "ep_size > 1 with seq_size > 1 is not supported — the "
+                "expert axis composes with tp_size (ep×tp), not with "
+                "the sequence axis; pick ep_size or seq_size")
+        if self.ep_comm_overlap not in ("off", "chunked"):
+            raise ValueError(
+                f"ep_comm_overlap must be 'off' or 'chunked', got "
+                f"{self.ep_comm_overlap!r}")
+        if self.ep_comm_chunks < 1:
+            raise ValueError(
+                f"ep_comm_chunks must be >= 1, got {self.ep_comm_chunks}")
+        if self.ep_capacity_factor <= 0:
+            raise ValueError(
+                f"ep_capacity_factor must be > 0, got "
+                f"{self.ep_capacity_factor}")
         from ...comm import TP_OVERLAP_MODES
         if self.tp_comm_overlap not in TP_OVERLAP_MODES:
             raise ValueError(
@@ -288,6 +350,39 @@ class RaggedInferenceConfig(ConfigModel):
         if self.spec_ngram < 1:
             raise ValueError(
                 f"spec_ngram must be >= 1, got {self.spec_ngram}")
+
+    def validate(self, model_cfg=None) -> None:
+        """Config × model validation the field checks can't see — called
+        at ENGINE CONSTRUCTION (before any program traces) so an
+        unsupported combo fails with the knob names, not a
+        NotImplementedError from deep inside a trace. Safe to call with
+        ``model_cfg=None`` (pure-config use); ``__post_init__`` already
+        ran the field-local checks."""
+        if model_cfg is None:
+            return
+        from ...models.mixtral import MixtralConfig
+        is_moe = isinstance(model_cfg, MixtralConfig)
+        if is_moe and self.tp_size > 1 and self.ep_size == 1:
+            # tp alone would replicate the full expert set on every chip
+            # AND trip the dense-branch all-reduce accounting — for MoE
+            # runners tp requires the expert axis (attention shards over
+            # 'model', experts over 'expert')
+            raise ValueError(
+                f"MoE serving with tp_size={self.tp_size} requires the "
+                f"expert axis: set ep_size > 1 (ep×tp mesh — attention "
+                f"shards over tp, experts over ep) or serve at "
+                f"tp_size=1")
+        if self.ep_size > 1:
+            if not is_moe:
+                raise ValueError(
+                    f"ep_size={self.ep_size} shards stacked expert "
+                    f"weights, and {type(model_cfg).__name__} has none "
+                    f"— the expert axis is MoE-only (set ep_size=1)")
+            if model_cfg.num_experts % self.ep_size:
+                raise ValueError(
+                    f"num_experts ({model_cfg.num_experts}) must divide "
+                    f"by ep_size ({self.ep_size}) — experts shard "
+                    f"block-wise over their home chips")
 
     @property
     def max_context(self) -> int:

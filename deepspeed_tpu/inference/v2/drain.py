@@ -189,6 +189,10 @@ def build_manifest(engine) -> Dict[str, Any]:
             # o % seq_size. Replay re-prefills, so a restore engine may
             # use ANY seq_size — recorded for audit, not a constraint
             "seq_size": max(1, int(getattr(engine.config, "seq_size", 1))),
+            # likewise audit-only: expert placement never enters the
+            # manifest (token chains are geometry-free), so an ep=2
+            # drain replays on an ep=1 survivor and vice versa
+            "ep_size": max(1, int(getattr(engine.config, "ep_size", 1))),
         },
         "sequences": seqs,
     }

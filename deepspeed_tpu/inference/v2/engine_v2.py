@@ -177,9 +177,62 @@ class InferenceEngineV2:
             # replace, never mutate (same contract as the TP overlap knob);
             # 0 means "off" -> the single-chip layout, seq_size=1
             self.config = _dc.replace(self.config, seq_size=max(1, sz))
+        # expert-parallel env overrides (the MoE-serving kill-switch /
+        # force-on, same replace-never-mutate contract): DSTPU_EP_SIZE=0
+        # forces ep_size=1 — exact pre-EP single-chip programs, the
+        # parity oracle — and =N forces the expert axis on;
+        # DSTPU_EP_OVERLAP = off|chunked[:k] and DSTPU_EP_OVERLAP_CHUNKS
+        # pick the dispatch/combine a2a schedule; DSTPU_EP_CAPACITY sets
+        # the per-destination slot factor. Applied BEFORE the runner
+        # builds so the traced step functions close over the final knobs.
+        env_ep = os.environ.get("DSTPU_EP_SIZE")
+        if env_ep not in (None, ""):
+            import dataclasses as _dc
+            epz = int(env_ep)
+            if epz < 0:
+                raise ValueError(
+                    f"DSTPU_EP_SIZE must be >= 0, got {epz}")
+            self.config = _dc.replace(self.config, ep_size=max(1, epz))
+        env_epo = os.environ.get("DSTPU_EP_OVERLAP")
+        if env_epo not in (None, ""):
+            import dataclasses as _dc
+            head, _, kpart = env_epo.partition(":")
+            rep = {"ep_comm_overlap": head}
+            if kpart:
+                rep["ep_comm_chunks"] = int(kpart)
+            self.config = _dc.replace(self.config, **rep)
+        env_epc = os.environ.get("DSTPU_EP_OVERLAP_CHUNKS")
+        if env_epc not in (None, ""):
+            import dataclasses as _dc
+            self.config = _dc.replace(self.config,
+                                      ep_comm_chunks=int(env_epc))
+        env_cap = os.environ.get("DSTPU_EP_CAPACITY")
+        if env_cap not in (None, ""):
+            import dataclasses as _dc
+            self.config = _dc.replace(self.config,
+                                      ep_capacity_factor=float(env_cap))
+        # config × model validation at CONSTRUCTION (satellite of ISSUE
+        # 20): unsupported combos (MoE×tp without ep, ep on a dense
+        # model, ep not dividing num_experts) fail here with the knob
+        # names instead of deep inside a trace
+        self.config.validate(model_cfg)
         self.runner = runner or _runner_for(model_cfg, self.config)
         tp = self.config.tp_size
-        if tp > 1:
+        if self.config.ep_size > 1:
+            # expert-parallel MoE serving (expert_parallel.py): the
+            # stacked expert weights shard over 'expert' (composing with
+            # tp over 'model' on a 2-D mesh when tp_size > 1) and every
+            # runner program rebuilds under the shard_map — host-side
+            # scheduler/allocator stay single-program like TP/seq
+            if not hasattr(self.runner, "init_ep"):
+                raise ValueError(
+                    f"runner {type(self.runner).__name__} does not support "
+                    f"expert-parallel serving (no init_ep)")
+            from .expert_parallel import build_ep_context
+            ep_ctx, params = build_ep_context(self.config, self.runner,
+                                              params, devices=devices)
+            self.runner.init_ep(ep_ctx)
+        elif tp > 1:
             # tensor-parallel serving (tp.py): params are re-laid/sharded
             # over the 'model' mesh and every runner program rebuilds under
             # shard_map — the host-side scheduler/allocator stay as-is
@@ -231,7 +284,16 @@ class InferenceEngineV2:
         self.kv_cache = BlockedKVCache(
             self.config, self.runner.num_layers, self.runner.kv_heads,
             self.runner.head_dim, dtype=resolve_dtype(self.config.dtype))
-        if tp > 1:
+        if self.config.ep_size > 1:
+            if self.runner.tp is not None:
+                # composed ep×tp: the pool head-shards over 'model' on
+                # the 2-D mesh (implicitly replicated over 'expert')
+                self.kv_cache.shard(self.runner.epctx.mesh)
+            else:
+                # ep alone: the pool replicates — the batch (and every
+                # KV write) is identical on all expert ranks
+                self.kv_cache.shard_replicated(self.runner.epctx.mesh)
+        elif tp > 1:
             # head-shard the pool at rest: per-chip KV bytes ∝ 1/tp — the
             # lever that lets a model's KV footprint span chips
             self.kv_cache.shard(self.runner.tp.mesh)
@@ -1954,10 +2016,13 @@ class InferenceEngineV2:
                 f"pair must share the tokenizer")
         if draft_config is None:
             import dataclasses as _dc
+            # ep_size resets: the usual pairing is a DENSE draft for a
+            # MoE target, and the draft replicates across the expert
+            # mesh rather than inheriting an axis it cannot shard over
             draft_config = _dc.replace(
                 self.config, prefix_cache=False, serve_pipeline_depth=0,
                 spec_decode="off", serve_journal="",
-                request_deadline_s=0.0)
+                request_deadline_s=0.0, ep_size=1)
         draft = InferenceEngineV2(draft_model_cfg, draft_params,
                                   draft_config)
         # proposals are internal: never journaled, never counted as

@@ -474,6 +474,23 @@ class BlockedKVCache:
         if self.prefix is not None:
             self._warm_copy()       # recompile eagerly, off the serve loop
 
+    def shard_replicated(self, mesh) -> None:
+        """Replicate the pool at rest over a mesh (the ep-only layout:
+        the serving batch — and therefore every KV write — is identical
+        on all expert ranks, so the pool carries no axis in its specs
+        and the programs' pool spec is ``P()``). ``_mesh``/``_seq_mesh``
+        stay unset: the prefix-cache block copy needs no shard_map over
+        replicated arrays."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        self._copy_jit = None
+        repl = NamedSharding(mesh, P())
+        self.data = jax.device_put(self.data, repl)
+        if self.scales is not None:
+            self.scales = jax.device_put(self.scales, repl)
+        if self.prefix is not None:
+            self._warm_copy()       # recompile eagerly, off the serve loop
+
     def shard_seq(self, mesh) -> None:
         """Shard the pool at rest over the ``seq`` mesh axis: the slots
         dim chunks contiguously, handing chip r its round-robin block

@@ -37,15 +37,27 @@ class LlamaRaggedRunner(RaggedRunnerBase):
     supports_fused_woq = True
 
 
-def _moe_mlp(p_moe, h, cfg: MixtralConfig, dtype):
+def _moe_mlp(p_moe, h, cfg: MixtralConfig, dtype,
+             icfg: RaggedInferenceConfig = None):
     """Grouped-GEMM MoE for the ragged path: tokens sort by their routed
     expert and each expert multiplies only its rows via
     ``jax.lax.ragged_dot`` (sharded_moe.grouped_moe_ffn) — E/k x fewer
     FLOPs than the round-2 dense-every-expert path. Matches the
     reference's CUTLASS grouped GEMM
-    (inference/v2/kernels/cutlass_ops/moe_gemm/)."""
+    (inference/v2/kernels/cutlass_ops/moe_gemm/).
+
+    Inside an ``expert``-axis shard_map (``cfg.ep_size > 1`` engines)
+    the routed rows instead travel the dispatch→grouped-GEMM→combine
+    pipeline of ``grouped_moe_ffn_ep_serve``: router logits computed
+    everywhere from the replicated gate, tokens exchanged to their
+    experts' home chips and back with exactly TWO ``all_to_all`` hops
+    per layer (chunked over ``icfg.ep_comm_chunks`` slices when
+    ``ep_comm_overlap='chunked'`` so chunk k's expert GEMMs run under
+    chunk k+1's exchange). ``p_moe`` then holds this chip's [E/ep, ...]
+    expert stacks while the gate stays full-width."""
     from ...moe.sharded_moe import grouped_moe_ffn
     from ...ops.kernels.fp6_gemm import Fp6GemmWeight, fp6_gemm_unpack
+    from .expert_parallel import EP_AXIS, ep_axis_active
     S, C, M = h.shape
     gate_w = p_moe["gate"]
     if isinstance(gate_w, Fp6GemmWeight):
@@ -57,10 +69,25 @@ def _moe_mlp(p_moe, h, cfg: MixtralConfig, dtype):
         weights = (p_moe["wi_gate"], p_moe["wi_up"], p_moe["wo"])
     else:
         weights = (p_moe["wi"], p_moe["wo"])
+    norm = getattr(cfg, "norm_topk_prob", True)
+    if ep_axis_active():
+        from ...moe.sharded_moe import (ep_serve_capacity,
+                                        grouped_moe_ffn_ep_serve)
+        from ...utils.jax_compat import axis_size
+        ep = axis_size(EP_AXIS)
+        chunks = int(icfg.ep_comm_chunks) \
+            if icfg is not None and icfg.ep_comm_overlap == "chunked" else 1
+        factor = float(icfg.ep_capacity_factor) if icfg is not None else 2.0
+        cap = ep_serve_capacity(S * C, cfg.experts_top_k, ep, factor,
+                                chunks)
+        y, _ = grouped_moe_ffn_ep_serve(
+            h.reshape(S * C, M), logits, cfg.experts_top_k, weights,
+            jax.nn.silu, dtype, EP_AXIS, cfg.num_experts, cap,
+            normalize_weights=norm, chunks=chunks)
+        return y.reshape(S, C, M)
     y, _ = grouped_moe_ffn(
         h.reshape(S * C, M), logits, cfg.experts_top_k, weights,
-        jax.nn.silu, dtype,
-        normalize_weights=getattr(cfg, "norm_topk_prob", True))
+        jax.nn.silu, dtype, normalize_weights=norm)
     return y.reshape(S, C, M)
 
 
@@ -107,7 +134,7 @@ def _llama_ragged_step(params, kv, batch: RaggedBatch, *,
         h = _rms(x, p["post_attn_norm"]["scale"],
                  model_cfg.rms_eps).astype(dtype)
         if is_moe:
-            y = _moe_mlp(p["moe"], h, model_cfg, dtype)
+            y = _moe_mlp(p["moe"], h, model_cfg, dtype, cfg)
             if getattr(model_cfg, "shared_expert_size", 0):
                 # qwen2-moe always-on shared expert (sigmoid scalar gate)
                 gate = woq_mm(h, p["shared_gate_proj"]["kernel"], dtype)

@@ -672,6 +672,7 @@ class RaggedRunnerBase:
             model_cfg.hidden_size // model_cfg.num_heads)
         self.tp = None            # TPContext once init_tp runs
         self.seqctx = None        # SeqContext once init_seq runs
+        self.epctx = None         # EPContext once init_ep runs
         self._build_programs()
 
     # ---------------------------- TP wiring --------------------------- #
@@ -688,10 +689,26 @@ class RaggedRunnerBase:
         shard_map: params replicate, the pool enters as its round-robin
         block shard, and the step wrapper slices each chunk's queries
         chip-major (context-parallel prefill)."""
-        if self.tp is not None:
-            raise ValueError("init_seq after init_tp: one sharding axis "
-                             "per runner")
+        if self.tp is not None or self.epctx is not None:
+            raise ValueError("init_seq after init_tp/init_ep: the seq "
+                             "axis does not compose with model/expert "
+                             "sharding")
         self.seqctx = seq_ctx
+        self._build_programs()
+
+    def init_ep(self, ep_ctx) -> None:
+        """Adopt an ``expert_parallel.EPContext`` and rebuild every
+        device program under its shard_map — 1-D ``(expert,)`` or, when
+        tp composes, 2-D ``(expert, model)``. In the composed case the
+        context carries an inner TPContext built on the SAME mesh, which
+        this runner adopts as ``self.tp`` so head localization,
+        quant-meta fixes and the TP collectives trace exactly as under
+        plain TP; the MoE layers alone ride the ``expert`` axis."""
+        if self.seqctx is not None:
+            raise ValueError("init_ep after init_seq: the expert axis "
+                             "composes with tp, not with seq")
+        self.epctx = ep_ctx
+        self.tp = ep_ctx.tp          # None for ep-only meshes
         self._build_programs()
 
     @property
@@ -699,9 +716,11 @@ class RaggedRunnerBase:
         return self.kv_heads // (self.tp.tp_size if self.tp else 1)
 
     def _wrap(self, fn, in_specs, out_specs):
-        """shard_map ``fn`` over the TP or seq mesh (identity when
-        neither axis is active)."""
-        ctx = self.tp if self.tp is not None else self.seqctx
+        """shard_map ``fn`` over the EP, TP or seq mesh (identity when
+        no axis is active). EP takes precedence: its mesh already
+        contains the composed ``model`` axis when tp rides along."""
+        ctx = self.epctx if self.epctx is not None else (
+            self.tp if self.tp is not None else self.seqctx)
         if ctx is None:
             return fn
         return shard_map(fn, mesh=ctx.mesh, in_specs=in_specs,
@@ -721,12 +740,21 @@ class RaggedRunnerBase:
         model_cfg, cfg = self.model_cfg, self.cfg
         dtype = self.compute_dtype
         tp = self.tp
-        seqc = self.seqctx if tp is None else None
-        mapped = tp is not None or seqc is not None
+        epc = self.epctx
+        seqc = self.seqctx if (tp is None and epc is None) else None
+        mapped = tp is not None or seqc is not None or epc is not None
         mcfg_l = tp.localize_model_cfg(model_cfg) if tp else model_cfg
         vocab = getattr(model_cfg, "vocab_size", -1)
         quantized_pool = cfg.kv_cache_dtype == "int8"
-        if tp is not None:
+        if epc is not None:
+            # expert (or expert×model) mesh: specs merged by the EP
+            # planner — expert stacks over 'expert', tp leaves over
+            # 'model' when composed, pool/ring via the inner tp view
+            pspecs = epc.param_specs
+            pool_spec = epc.pool_spec(quantized_pool)
+            ring_spec = epc.ring_spec
+            batch_spec = RaggedBatch(P(), P(), P(), P())
+        elif tp is not None:
             pspecs = tp.param_specs
             pool_spec = tp.pool_spec(quantized_pool)
             ring_spec = tp.ring_spec

@@ -301,56 +301,17 @@ class TPContext:
         return jax.device_put(params, shardings)
 
 
-def build_tp_context(cfg, runner, params,
-                     devices: Optional[Sequence] = None
-                     ) -> Tuple[TPContext, Any]:
-    """Build the TP context for ``runner`` and re-lay ``params`` for it.
+def plan_param_layout(runner, params, tp: int, num_heads: int, *,
+                      override=None):
+    """Classify and re-lay every param leaf for a ``model``-axis shard.
 
-    Returns ``(ctx, params)`` — params may be column-permuted (fused qkv,
-    WOQ groups) and are device_put sharded over the ``model`` mesh.
+    Returns ``(new_params, specs, kinds, n_sharded)``. ``override(path,
+    leaf)`` may claim a leaf first by returning ``(x, spec, kind)`` (or
+    ``None`` to fall through) — the expert-parallel planner uses it to
+    place MoE subtrees (whose ``wi*``/``wo`` stack names would otherwise
+    match the dense column/row patterns and be mis-sharded over
+    ``model``) before the TP classification runs.
     """
-    tp = int(cfg.tp_size)
-    if tp <= 1:
-        raise ValueError("build_tp_context needs cfg.tp_size > 1")
-    if int(getattr(cfg, "seq_size", 1)) > 1:
-        raise ValueError(
-            "tp_size > 1 with seq_size > 1 is not supported yet — one "
-            "sharding axis per engine (seq_parallel.py mirrors this check)")
-    devices = list(devices if devices is not None else jax.devices())
-    if len(devices) < tp:
-        raise ValueError(
-            f"tp_size={tp} but only {len(devices)} devices visible")
-    mesh = Mesh(np.asarray(devices[:tp]), (MODEL_AXIS,))
-
-    mcfg = runner.model_cfg
-    from ...models.mixtral import MixtralConfig
-    if isinstance(mcfg, MixtralConfig):
-        raise NotImplementedError(
-            "ragged TP does not cover MoE runners (shard experts over the "
-            "'expert' axis instead); serve Mixtral at tp_size=1")
-    num_heads = getattr(mcfg, "num_heads", 0)
-    if num_heads % tp or runner.kv_heads % tp:
-        raise ValueError(
-            f"tp_size={tp} must divide num_heads ({num_heads}) and "
-            f"kv_heads ({runner.kv_heads}) — head-sharded KV needs whole "
-            f"heads per chip")
-    # decomposed collectives: the ring scatters the all-reduce site's
-    # FULL-width activation (hidden_size) into tp shards, chunked into
-    # tp_comm_chunks independent pipelines — the geometry must divide, and
-    # failing at engine build keeps the audited hop counts deterministic
-    # (decomposed_all_reduce would otherwise silently degrade the chunk
-    # count and the budget tests would chase a moving schedule)
-    overlap_mode = getattr(cfg, "tp_comm_overlap", "off")
-    overlap_chunks = int(getattr(cfg, "tp_comm_chunks", 2)) \
-        if overlap_mode == "rs_ag_chunked" else 1
-    hidden = int(getattr(mcfg, "hidden_size", 0))
-    if overlap_mode != "off" and hidden and hidden % (tp * overlap_chunks):
-        raise ValueError(
-            f"tp_comm_overlap={overlap_mode!r} needs hidden_size "
-            f"({hidden}) divisible by tp_size*tp_comm_chunks "
-            f"({tp}*{overlap_chunks}); lower tp_comm_chunks or serve "
-            f"with tp_comm_overlap='off'")
-
     QuantizedTensor, FPQuantizedTensor, Fp6GemmWeight = _quant_leaf_types()
     quant_types = (QuantizedTensor, FPQuantizedTensor, Fp6GemmWeight)
     fused = tuple(getattr(runner, "tp_fused_qkv", ()) or ())
@@ -359,6 +320,12 @@ def build_tp_context(cfg, runner, params,
 
     def leaf(path, x):
         ps = _path_str(path)
+        if override is not None:
+            claimed = override(ps, x)
+            if claimed is not None:
+                if claimed[2] != "replicate":
+                    n_sharded[0] += 1
+                return claimed
         kind = _classify(ps, fused)
         if isinstance(x, QuantizedTensor):
             x2, spec, eff = _shard_quantized(x, kind, tp, num_heads,
@@ -402,6 +369,68 @@ def build_tp_context(cfg, runner, params,
         lambda t: t[1], triples, is_leaf=is_triple)
     kinds = jax.tree_util.tree_map(
         lambda t: t[2], triples, is_leaf=is_triple)
+    return new_params, specs, kinds, n_sharded[0]
+
+
+def build_tp_context(cfg, runner, params,
+                     devices: Optional[Sequence] = None
+                     ) -> Tuple[TPContext, Any]:
+    """Build the TP context for ``runner`` and re-lay ``params`` for it.
+
+    Returns ``(ctx, params)`` — params may be column-permuted (fused qkv,
+    WOQ groups) and are device_put sharded over the ``model`` mesh.
+    """
+    tp = int(cfg.tp_size)
+    if tp <= 1:
+        raise ValueError("build_tp_context needs cfg.tp_size > 1")
+    if int(getattr(cfg, "seq_size", 1)) > 1:
+        raise ValueError(
+            "tp_size > 1 with seq_size > 1 is not supported yet — one "
+            "sharding axis per engine (seq_parallel.py mirrors this check)")
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < tp:
+        raise ValueError(
+            f"tp_size={tp} but only {len(devices)} devices visible")
+    mesh = Mesh(np.asarray(devices[:tp]), (MODEL_AXIS,))
+
+    mcfg = runner.model_cfg
+    from ...models.mixtral import MixtralConfig
+    if isinstance(mcfg, MixtralConfig):
+        # relaxed from the old trace-time hard refusal: tp now COMPOSES
+        # with the expert axis (ep×tp mesh — attention over 'model',
+        # experts over 'expert'); config.validate() rejects tp-without-ep
+        # at engine construction, and the composed path enters through
+        # expert_parallel.build_ep_context, never here directly
+        raise ValueError(
+            "MoE runners shard over the composed ep×tp mesh "
+            "(expert_parallel.build_ep_context with cfg.ep_size > 1); "
+            "build_tp_context alone cannot place the stacked expert "
+            "weights — set ep_size > 1 or serve at tp_size=1")
+    num_heads = getattr(mcfg, "num_heads", 0)
+    if num_heads % tp or runner.kv_heads % tp:
+        raise ValueError(
+            f"tp_size={tp} must divide num_heads ({num_heads}) and "
+            f"kv_heads ({runner.kv_heads}) — head-sharded KV needs whole "
+            f"heads per chip")
+    # decomposed collectives: the ring scatters the all-reduce site's
+    # FULL-width activation (hidden_size) into tp shards, chunked into
+    # tp_comm_chunks independent pipelines — the geometry must divide, and
+    # failing at engine build keeps the audited hop counts deterministic
+    # (decomposed_all_reduce would otherwise silently degrade the chunk
+    # count and the budget tests would chase a moving schedule)
+    overlap_mode = getattr(cfg, "tp_comm_overlap", "off")
+    overlap_chunks = int(getattr(cfg, "tp_comm_chunks", 2)) \
+        if overlap_mode == "rs_ag_chunked" else 1
+    hidden = int(getattr(mcfg, "hidden_size", 0))
+    if overlap_mode != "off" and hidden and hidden % (tp * overlap_chunks):
+        raise ValueError(
+            f"tp_comm_overlap={overlap_mode!r} needs hidden_size "
+            f"({hidden}) divisible by tp_size*tp_comm_chunks "
+            f"({tp}*{overlap_chunks}); lower tp_comm_chunks or serve "
+            f"with tp_comm_overlap='off'")
+
+    new_params, specs, kinds, n_sharded = plan_param_layout(
+        runner, params, tp, num_heads)
 
     ctx = TPContext(mesh=mesh, tp_size=tp, param_specs=specs,
                     param_kinds=kinds,
@@ -410,7 +439,7 @@ def build_tp_context(cfg, runner, params,
                     comm_overlap=overlap_mode,
                     comm_chunks=overlap_chunks)
     new_params = ctx.device_put_params(new_params)
-    log_dist(f"ragged TP: sharded {n_sharded[0]} param tensors over "
+    log_dist(f"ragged TP: sharded {n_sharded} param tensors over "
              f"'{MODEL_AXIS}' (tp={tp}, quantized_comm="
              f"{ctx.quantized_comm}, comm_overlap={ctx.comm_overlap}"
              + (f" x{ctx.comm_chunks}" if ctx.comm_overlap

@@ -374,3 +374,151 @@ def grouped_moe_ffn_ep(tokens: jnp.ndarray, logits: jnp.ndarray, k: int,
     out = jnp.zeros_like(tokens, dtype).at[send_tok].add(back)
 
     return out, _grouped_aux_loss(gates, top_idx, k, E)
+
+
+def ep_serve_capacity(n_tokens: int, k: int, ep: int,
+                      capacity_factor: float, chunks: int = 1) -> int:
+    """Per-destination slot rows for the SERVING expert dispatch.
+
+    ``ceil(rows * factor / ep)`` capped at ``rows`` (a destination can
+    never receive more than every routed row) and rounded up to a
+    ``chunks`` multiple so the overlapped schedule slices evenly. With
+    ``capacity_factor >= ep`` the cap binds — ``Cs == rows`` — and the
+    dispatch is PROVABLY dropless under any routing skew, which is what
+    keeps the ep=1 ≡ ep=2 parity oracle exact (the default factor 2.0
+    makes ep=2 dropless; larger meshes trade slack for wire bytes).
+    """
+    rows = int(n_tokens) * int(k)
+    cs = min(rows, int(math.ceil(rows * float(capacity_factor) / ep)))
+    cs = max(cs, 1)
+    if chunks > 1:
+        cs = -(-cs // chunks) * chunks
+    return cs
+
+
+def grouped_moe_ffn_ep_serve(tokens: jnp.ndarray, logits: jnp.ndarray,
+                             k: int, weights_local, activation, dtype,
+                             expert_axis: str, num_experts: int,
+                             capacity_rows: int,
+                             normalize_weights: bool = True,
+                             chunks: int = 1,
+                             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Expert-parallel grouped MoE FFN for the SERVING programs: exactly
+    TWO ``comm.all_to_all_single`` hops per call (dispatch + combine) on
+    a REPLICATED batch.
+
+    The serving programs replicate activations across the ``expert``
+    ranks (the batch is one request stream, not data-sharded training
+    shards), so ``tokens``/``logits`` are bit-identical on every rank.
+    That changes the dispatch shape vs :func:`grouped_moe_ffn_ep`:
+
+      * every rank packs the FULL routed row set ``[x | w | leid]`` into
+        one f32 payload of per-destination ``capacity_rows`` slots — one
+        operand, so the exchange is ONE all-to-all instead of the
+        training path's three (f32 packing is exact: compute-dtype
+        activations round-trip bf16→f32→bf16 bit-identically, local
+        expert ids are small ints, and the router weights are f32 in the
+        oracle path too);
+      * after the dispatch all-to-all rank ``d`` holds ``ep`` identical
+        copies of its slot block (every sender sent the same buffer); it
+        runs the grouped GEMM ONCE on copy 0 and tiles the results into
+        all ``ep`` return slots — no duplicated GEMM work, and the
+        combine all-to-all hands every rank the same per-slot results;
+      * each rank scatter-adds its own copy back through its (identical)
+        slot→token map, so the output is replicated and bit-identical
+        across ranks — the shard_map out_spec stays ``P()`` and no
+        third collective is needed.
+
+    With ``chunks > 1`` the slot dim is sliced into ``chunks`` pieces
+    and the loop pipelines them — chunk k's GEMM runs under chunk k+1's
+    all-to-all (the PR 6 decomposed-collective shape). Per-row GEMM
+    results are independent of the grouping, chunk slices preserve slot
+    order, and at ``k <= 2`` each token's two scatter-add contributions
+    commute exactly, so ``chunks`` is numerics-invariant (the
+    overlap=off parity oracle in tests/unit/test_moe_serving.py).
+
+    ``capacity_rows`` comes from :func:`ep_serve_capacity`; rows past a
+    destination's slots drop (OOB scatter indices — impossible when the
+    factor makes the cap bind). Returns ``(out [S, M] replicated,
+    l_aux)``.
+    """
+    from .. import comm
+    S, E = logits.shape
+    M = tokens.shape[1]
+    e_loc = jax.tree_util.tree_leaves(weights_local)[0].shape[0]
+    ep = E // e_loc
+    Cs = int(capacity_rows)
+    if Cs % chunks:
+        raise ValueError(
+            f"capacity_rows ({Cs}) must divide by chunks ({chunks}) — "
+            f"ep_serve_capacity rounds this up")
+
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top_vals, top_idx = jax.lax.top_k(logits.astype(jnp.float32), k)
+    if normalize_weights:
+        w_sel = jax.nn.softmax(top_vals, axis=-1)
+    else:
+        w_sel = jnp.take_along_axis(gates, top_idx, axis=-1)
+
+    eid = top_idx.reshape(-1)                      # [S*k] global expert id
+    tok_of = jnp.arange(S * k, dtype=jnp.int32) // k
+    order = jnp.argsort(eid, stable=True)          # dest-major (block owner)
+    eid_s = jnp.take(eid, order)
+    tok_s = jnp.take(tok_of, order)
+    w_s = jnp.take(w_sel.reshape(-1), order)
+    dest_s = eid_s // e_loc
+
+    counts = jnp.bincount(dest_s, length=ep)
+    start = jnp.concatenate([jnp.zeros((1,), counts.dtype),
+                             jnp.cumsum(counts)[:-1]])
+    pos = jnp.arange(S * k, dtype=jnp.int32) \
+        - start[dest_s].astype(jnp.int32)
+    slot = jnp.where(pos < Cs, dest_s * Cs + pos, ep * Cs)  # OOB drops
+
+    # one packed f32 operand: [x | w | leid]; empty slots carry leid =
+    # e_loc (sorts LAST at the receiver) and weight 0
+    x_rows = jnp.take(tokens, tok_s, axis=0).astype(jnp.float32)
+    payload = jnp.concatenate(
+        [x_rows, w_s[:, None].astype(jnp.float32),
+         (eid_s % e_loc)[:, None].astype(jnp.float32)], axis=1)
+    send = jnp.zeros((ep * Cs + 1, M + 2), jnp.float32)
+    send = send.at[:, M + 1].set(float(e_loc)).at[slot].set(payload)
+    send = send[:ep * Cs]
+    send_tok = jnp.full((ep * Cs,), S, jnp.int32).at[slot].set(tok_s)
+
+    Csc = Cs // chunks
+    out = jnp.zeros_like(tokens, dtype)
+    send_c = send.reshape(ep, Cs, M + 2)
+    tok_c = send_tok.reshape(ep, Cs)
+    for c in range(chunks):
+        sl = send_c[:, c * Csc:(c + 1) * Csc].reshape(ep * Csc, M + 2)
+        recv = comm.all_to_all_single(sl, axis_name=expert_axis,
+                                      log_name="ep_dispatch")
+        # ep identical copies arrived (replicated senders) — compute on
+        # copy 0 only, then tile results into every return slot
+        r0 = recv[:Csc]
+        leid0 = r0[:, M + 1].astype(jnp.int32)
+        w0 = r0[:, M]
+        order2 = jnp.argsort(leid0, stable=True)   # empties sort last
+        xs = jnp.take(r0[:, :M], order2, axis=0).astype(dtype)
+        gs = jnp.bincount(leid0, length=e_loc).astype(jnp.int32)
+        if len(weights_local) == 3:
+            wi_gate, wi_up, wo = weights_local
+            g = jax.lax.ragged_dot(xs, wi_gate.astype(dtype), gs)
+            u = jax.lax.ragged_dot(xs, wi_up.astype(dtype), gs)
+            h = activation(g) * u
+        else:
+            wi, wo = weights_local
+            h = activation(jax.lax.ragged_dot(xs, wi.astype(dtype), gs))
+        ys = jax.lax.ragged_dot(h, wo.astype(dtype), gs)
+        valid = jnp.arange(Csc) < gs.sum()         # rows past sum(gs) are
+        ys = jnp.where(valid[:, None], ys, jnp.zeros_like(ys))
+        ys = jnp.take(ys, jnp.argsort(order2, stable=True), axis=0)
+        ys = ys * w0[:, None].astype(dtype)
+        back = comm.all_to_all_single(
+            jnp.broadcast_to(ys[None], (ep, Csc, M)).reshape(ep * Csc, M),
+            axis_name=expert_axis, log_name="ep_combine")
+        # back[i*Csc + p] = rank i's result for my slot (i, chunk c, p)
+        out = out.at[tok_c[:, c * Csc:(c + 1) * Csc].reshape(-1)].add(back)
+
+    return out, _grouped_aux_loss(gates, top_idx, k, E)

@@ -320,6 +320,24 @@ class WorkloadMix:
         kw.update(overrides)
         return cls(**kw)
 
+    @classmethod
+    def moe_decode_heavy(cls, vocab_size: int = 32000,
+                         **overrides) -> "WorkloadMix":
+        """The expert-parallel MoE serving preset (``bin/dstpu_loadgen
+        --mix moe_decode_heavy``, docs/serving.md "Expert-parallel MoE
+        serving"): short prompts with generations several times longer,
+        so single-token decode steps dominate the offered work — the
+        regime where the per-step dispatch/combine ``all_to_all`` pair
+        is the whole comm bill and the sharded experts' HBM saving has
+        to be paid for in exchange latency. Pair with ``--ep`` and read
+        the ``serve_moe`` report section."""
+        kw: Dict[str, Any] = dict(
+            prompt_lens=(8, 16), prompt_probs=(0.5, 0.5),
+            gen_lens=(24, 48), gen_probs=(0.5, 0.5),
+            vocab_size=vocab_size)
+        kw.update(overrides)
+        return cls(**kw)
+
     def describe(self) -> Dict[str, Any]:
         return {
             "prompt_mix": list(self.prompt_lens)
@@ -1083,6 +1101,49 @@ def _tiny_engine(max_seqs: int = 8, num_blocks: int = 96,
     return InferenceEngineV2(mcfg, params, cfg), mcfg
 
 
+#: the tiny MoE engine's expert FFN width; its dense-matched reference
+#: uses top_k x this (same ACTIVE params per token, no routing)
+_TINY_MOE_INTERMEDIATE = 32
+
+
+def _tiny_moe_engine(max_seqs: int = 8, num_blocks: int = 96,
+                     block_size: int = 16, ep: int = 1,
+                     dense_match: bool = False):
+    """CPU-harness Mixtral-style engine for ``--mix moe_decode_heavy``:
+    4 experts, top-2 routing, small enough that a decode step is a few
+    ms. ``ep`` opens the expert axis over that many virtual devices
+    (``--ep``, docs/serving.md "Expert-parallel MoE serving").
+    ``dense_match=True`` instead builds the dense reference at MATCHED
+    ACTIVE PARAMS — a plain Llama runner whose FFN width equals
+    ``top_k x`` the expert width, so per-token GEMM work matches and
+    the throughput ratio isolates routing + dispatch overhead."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..inference.v2 import InferenceEngineV2, RaggedInferenceConfig
+    from ..models import llama, mixtral
+    common = dict(vocab_size=96, max_seq_len=block_size * 16,
+                  num_layers=2, num_heads=2, num_kv_heads=2,
+                  hidden_size=32, dtype=jnp.float32)
+    if dense_match:
+        mcfg = llama.LlamaConfig(
+            intermediate_size=2 * _TINY_MOE_INTERMEDIATE, **common)
+        _, init_fn, _ = llama.make_model(mcfg)
+    else:
+        mcfg = mixtral.MixtralConfig(
+            intermediate_size=_TINY_MOE_INTERMEDIATE, num_experts=4,
+            experts_top_k=2, **common)
+        _, init_fn, _ = mixtral.make_model(mcfg)
+    params = init_fn(jax.random.PRNGKey(0), seq_len=16)
+    cfg = RaggedInferenceConfig(
+        max_seqs=max_seqs, chunk_size=16, block_size=block_size,
+        num_blocks=num_blocks, max_blocks_per_seq=16, dtype="float32",
+        attention_impl="dense", decode_loop_steps=0,
+        serve_pipeline_depth=2, prefix_cache=True,
+        ep_size=1 if dense_match else max(1, ep))
+    return InferenceEngineV2(mcfg, params, cfg), mcfg
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """``bin/dstpu_loadgen`` — run an open-loop pass (or a rate sweep)
     against a self-contained tiny CPU engine and print the report JSON.
@@ -1135,19 +1196,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="draft tokens per speculation round")
     ap.add_argument("--mix", default=os.environ.get(
         "DSTPU_LOADGEN_MIX", "custom"),
-        choices=("custom", "prefill_heavy", "long_context"),
+        choices=("custom", "prefill_heavy", "long_context",
+                 "moe_decode_heavy"),
         help="workload preset: prefill_heavy offers long prompts with "
              "short generations (the disaggregated-serving regime, "
              "docs/serving.md) and overrides --prompt-len/--gen-len; "
              "long_context offers log-spaced prompts up to the engine's "
              "whole per-sequence pool span with small generations (the "
              "sequence-parallel regime — pair with --seq) and adds a "
-             "'longctx' report section")
+             "'longctx' report section; moe_decode_heavy swaps in the "
+             "tiny MoE engine with short prompts and long generations "
+             "(the expert-parallel regime — pair with --ep) and adds a "
+             "'serve_moe' report section")
     ap.add_argument("--seq", type=int, default=int(os.environ.get(
         "DSTPU_LOADGEN_SEQ", "1") or "1"),
         help="sequence-parallel width for the tiny engine(s) — shards "
              "the KV pool round-robin over that many virtual devices "
              "(docs/serving.md Long-context serving)")
+    ap.add_argument("--ep", type=int, default=int(os.environ.get(
+        "DSTPU_LOADGEN_EP", "1") or "1"),
+        help="expert-parallel width for the tiny MoE engine (--mix "
+             "moe_decode_heavy) — shards the expert stacks over that "
+             "many virtual devices (docs/serving.md Expert-parallel "
+             "MoE serving)")
     ap.add_argument("--prompt-len", type=int, default=24)
     ap.add_argument("--gen-len", type=int, default=16)
     ap.add_argument("--shared-prefix-frac", type=float, default=0.0)
@@ -1221,12 +1292,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     pool = None
-    if args.seq > 1 and os.environ.get("JAX_PLATFORMS",
-                                       "").startswith("cpu"):
-        # seq-parallel tiny engines need their virtual devices BEFORE
-        # the backend initializes (same shim as the replica path below)
+    if (args.seq > 1 or args.ep > 1) and os.environ.get(
+            "JAX_PLATFORMS", "").startswith("cpu"):
+        # seq/expert-parallel tiny engines need their virtual devices
+        # BEFORE the backend initializes (same shim as the replica path)
         from ..utils.jax_compat import request_cpu_devices
-        request_cpu_devices(max(2, args.seq * max(1, args.replicas)))
+        request_cpu_devices(max(2, max(args.seq, args.ep)
+                                * max(1, args.replicas)))
+    if args.mix == "moe_decode_heavy" and args.replicas > 1:
+        ap.error("--mix moe_decode_heavy drives the single-engine MoE "
+                 "harness; use --replicas 1")
     if args.replicas > 1:
         from ..serving import ReplicaPool, build_replica_engines
         if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
@@ -1253,6 +1328,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.roles else None
         pool = ReplicaPool(engines, policy=args.policy, roles=roles)
         eng = pool
+    elif args.mix == "moe_decode_heavy":
+        eng, mcfg = _tiny_moe_engine(num_blocks=args.num_blocks,
+                                     ep=args.ep)
     else:
         eng, mcfg = _tiny_engine(num_blocks=args.num_blocks,
                                  spec=args.spec, spec_k=args.spec_k,
@@ -1274,6 +1352,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         # (max_blocks_per_seq=16 x block_size=16 -> 256 tokens)
         mix = WorkloadMix.long_context(
             pool_span_tokens=16 * 16,
+            vocab_size=mcfg.vocab_size,
+            deadline_frac=args.deadline_frac,
+            deadline_s=args.deadline_s,
+            batch_frac=args.batch_frac)
+    elif args.mix == "moe_decode_heavy":
+        mix = WorkloadMix.moe_decode_heavy(
             vocab_size=mcfg.vocab_size,
             deadline_frac=args.deadline_frac,
             deadline_s=args.deadline_s,
@@ -1388,6 +1472,44 @@ def main(argv: Optional[List[str]] = None) -> int:
             "kv_pool_bytes_total": kvrep["kv_pool_bytes_total"],
             "kv_pool_bytes_per_chip": kvrep["kv_pool_bytes_per_chip"],
         }
+    if args.mix == "moe_decode_heavy":
+        # expert-parallel evidence (docs/serving.md "Expert-parallel
+        # MoE serving"): the expert-stack residency gauge (per-chip
+        # bytes ∝ 1/ep — the HBM lever), the audited a2a share of the
+        # decode step, and tokens/s against a dense reference at
+        # MATCHED ACTIVE PARAMS (FFN width = top_k x expert width) —
+        # the honest baseline: same per-token GEMMs, no routing
+        from ..inference.v2.expert_parallel import expert_memory_report
+        from .attribution import comm_share
+        mem = expert_memory_report(eng)
+        out["serve_moe"] = {
+            "ep_size": mem["ep_size"],
+            "num_experts": mcfg.num_experts,
+            "experts_top_k": mcfg.experts_top_k,
+            "expert_bytes_total": mem["expert_bytes_total"],
+            "expert_bytes_per_chip": mem["expert_bytes_per_chip"],
+            "moe_output_tokens_per_sec": out.get("output_tokens_per_sec"),
+            "a2a": comm_share(eng, program="step_greedy_fb"),
+        }
+        if len(rates) == 1 and args.process != "trace":
+            dense_eng, _ = _tiny_moe_engine(num_blocks=args.num_blocks,
+                                            dense_match=True)
+            dense_proc = (UniformArrivals(rates[0])
+                          if args.process == "uniform"
+                          else PoissonArrivals(rates[0], seed=args.seed))
+            dense_res = run_open_loop(
+                dense_eng,
+                build_requests(dense_proc, mix, args.requests,
+                               seed=args.seed),
+                decode_burst=args.burst, shed_after_s=args.shed_after,
+                sampling=sampling)
+            dense_tps = dense_res.report.get("output_tokens_per_sec")
+            out["serve_moe"]["dense_matched_output_tokens_per_sec"] = \
+                dense_tps
+            moe_tps = out.get("output_tokens_per_sec")
+            if moe_tps and dense_tps:
+                out["serve_moe"]["tokens_per_sec_vs_dense"] = round(
+                    moe_tps / dense_tps, 4)
     if pool is not None:
         from ..serving import fleet_prefix_stats
         out["fleet"] = {

@@ -81,6 +81,22 @@ class TestArrivalDeterminism:
         assert np.allclose([r.arrival_s for r in a],
                            proc.schedule(400))
 
+    def test_moe_decode_heavy_mix_shape(self):
+        # the EP serving mix (ISSUE 20): short prompts, long decodes —
+        # rungs fixed, token ids inside the tiny-Mixtral vocab,
+        # deterministic under (mix, seed)
+        mix = WorkloadMix.moe_decode_heavy(vocab_size=96)
+        reqs = build_requests(PoissonArrivals(50.0, seed=4), mix, 200,
+                              seed=4)
+        assert {len(r.prompt) for r in reqs} <= {8, 16}
+        assert {r.gen_len for r in reqs} <= {24, 48}
+        assert set(mix.prompt_lens) == {8, 16}
+        assert set(mix.gen_lens) == {24, 48}
+        assert all(0 < t < 96 for r in reqs for t in r.prompt)
+        again = build_requests(PoissonArrivals(50.0, seed=4), mix, 200,
+                               seed=4)
+        assert [r.prompt for r in reqs] == [r.prompt for r in again]
+
 
 # ------------------------------------------------------------------ #
 # driver on a real engine

@@ -43,6 +43,11 @@ BUDGET_REGISTRY_FILE = "deepspeed_tpu/analysis/budgets.py"
 COLLECTIVE_KINDS = ("psum", "pmax", "pmin", "ppermute", "pshuffle",
                     "all_gather", "all_to_all")
 
+#: comm-layer wrapper names that count as a canonical kind at the call
+#: site (``comm.all_to_all_single`` IS the repo's all_to_all — the
+#: torch.distributed-shaped flat wrapper the EP dispatch/combine uses)
+_SITE_ALIASES = {"all_to_all_single": "all_to_all"}
+
 #: HOP_BUDGETS canonical kinds -> site kinds that can produce them
 _HOP_TO_SITE = {
     "all_reduce": ("psum", "pmax", "pmin"),
@@ -94,10 +99,11 @@ def _collective_kind(node: ast.Call,
     if not dotted:
         return None
     parts = dotted.split(".")
-    if parts[-1] not in COLLECTIVE_KINDS or len(parts) < 2:
+    name = _SITE_ALIASES.get(parts[-1], parts[-1])
+    if name not in COLLECTIVE_KINDS or len(parts) < 2:
         return None
     if parts[-2] in ("lax", "comm"):
-        return parts[-1]
+        return name
     return None
 
 

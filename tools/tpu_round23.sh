@@ -1,0 +1,76 @@
+#!/bin/bash
+# Round-23 on-chip sequence: expert-parallel MoE serving (ISSUE 20) —
+# stacked expert weights sharded over the 'expert' mesh axis, decode
+# through the ragged all-to-all dispatch/combine pipeline with the
+# chunked-overlap schedule. The CPU story is proven in tier-1
+# (test_moe_serving.py: 1-expert MoE == dense runner, ep parity across
+# greedy/sampled/spec/prefix modes, 2-hops-per-MoE-layer budgets,
+# cross-geometry drain, killswitch) and in the serve_moe bench row's
+# capacity/parity/budget/hygiene gates; on chip this captures what the
+# CPU harness CANNOT: (a) real a2a wall clock — the vs-dense decode
+# tokens/s gate (DSTPU_MOE_SERVE_TPS_MIN) and the chunked overlap's
+# EXPOSED a2a fraction only mean something when the exchange rides a
+# real interconnect instead of timeshared host cores, (b) the
+# per-chip expert-bytes gauge read from real HBM shardings, and
+# (c) bench_compare gating the capture against history (plus the
+# standing zero-slack lint pin). Strictly sequential (one process owns
+# the chip), no timeouts around TPU clients (a killed client wedges
+# the grant).
+cd /root/repo || exit 1
+LOG=profiles/r23_tpu_run.log
+exec >> "$LOG" 2>&1
+echo "=== tpu_round23 start $(date -u +%FT%TZ)"
+FAIL=0
+
+echo "--- [1/3] dstpu_lint --json: whole-repo verdict (incl. DSL008"
+echo "    over the new ep-step/ep-decode-loop budget registry rows)"
+python bin/dstpu_lint deepspeed_tpu --json > profiles/lint_r23_raw.json
+LINT_RC=$?
+[ "$LINT_RC" -ne 0 ] && FAIL=1
+python - <<'PY' || FAIL=1
+import json
+raw = json.load(open("profiles/lint_r23_raw.json"))
+out = {"lint": {"lint_findings": raw["count"],
+                "lint_clean": raw["clean"]}}
+json.dump(out, open("profiles/BENCH_LINT_r23.json", "w"), indent=2)
+print(json.dumps(out))
+PY
+
+echo "--- [2/3] bench serve_moe: ep=EP vs ep=1 vs dense-at-active-"
+echo "    params under the moe_decode_heavy stream -> capture"
+python bench.py serve_moe > profiles/serve_moe_r23_raw.json
+MOE_RC=$?
+[ "$MOE_RC" -ne 0 ] && FAIL=1
+python - <<'PY' || FAIL=1
+import json
+lines = [ln for ln in open("profiles/serve_moe_r23_raw.json")
+         if ln.startswith("{")]
+row = json.loads(lines[-1]) if lines else {"error": "no row"}
+json.dump({"serve_moe": row},
+          open("profiles/BENCH_MOE_SERVE_r23.json", "w"), indent=2)
+print(json.dumps({"serve_moe_ok": row.get("serve_moe_ok"),
+                  "tokens_per_sec_vs_dense":
+                      row.get("tokens_per_sec_vs_dense"),
+                  "a2a_exposed_fraction":
+                      row.get("a2a_exposed_fraction")}))
+PY
+
+echo "--- [3/3] bench_compare: lint pin (zero slack) + serve_moe vs"
+echo "    the previous capture (first round is the baseline)"
+PREV=$(ls profiles/BENCH_LINT_r*.json 2>/dev/null | sort | \
+       grep -v r23 | tail -1)
+if [ -n "$PREV" ]; then
+    python tools/bench_compare.py "$PREV" profiles/BENCH_LINT_r23.json \
+        || FAIL=1
+fi
+PREV_MOE=$(ls profiles/BENCH_MOE_SERVE_r*.json 2>/dev/null | sort | \
+           grep -v r23 | tail -1)
+if [ -n "$PREV_MOE" ]; then
+    python tools/bench_compare.py "$PREV_MOE" \
+        profiles/BENCH_MOE_SERVE_r23.json --allow-missing || FAIL=1
+else
+    echo "no prior serve_moe capture — r23 is the baseline"
+fi
+
+echo "=== tpu_round23 done $(date -u +%FT%TZ) FAIL=$FAIL"
+exit $FAIL
